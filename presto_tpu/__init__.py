@@ -1,0 +1,43 @@
+"""presto_tpu — a TPU-native distributed SQL execution framework.
+
+A ground-up re-design of the capabilities of Presto (reference:
+/root/reference, see SURVEY.md) for TPU hardware:
+
+- columnar Pages are fixed-capacity padded device arrays (Column = values +
+  null mask; strings are codes into *sorted* host-side dictionaries), so every
+  operator is a statically-shaped XLA program — no recompilation storms
+  (SURVEY.md §7.3 hard part #1);
+- operators (scan/filter/project, grouped aggregation, joins, sort/topN,
+  window) are jit-compiled whole-fragment kernels rather than the reference's
+  pull-based Operator.getOutput/addInput driver loop
+  (reference: presto-main-base/.../operator/Driver.java:70);
+- the repartitioned exchange (reference:
+  presto-main-base/.../operator/repartition/PartitionedOutputOperator.java:57)
+  is a hash-partitioned `all_to_all` over a `jax.sharding.Mesh` (ICI) inside a
+  multi-chip worker, and Presto's pull-based HTTP SerializedPage protocol
+  across hosts (DCN);
+- the coordinator-facing protocol (PlanFragment / TaskUpdateRequest /
+  TaskInfo; reference: presto-main-base/.../server/TaskUpdateRequest.java:37)
+  is implemented as plain dataclasses + JSON codec so the worker grafts onto
+  an unmodified Java coordinator exactly like presto-native-execution's C++
+  worker (reference: presto-native-execution/presto_cpp/main/TaskResource.cpp).
+"""
+
+import jax
+
+# SQL semantics need exact 64-bit integers (BIGINT) and doubles. TPU emulates
+# f64/i64; the hot paths (filter masks, hashes, group codes) stay in 32-bit.
+jax.config.update("jax_enable_x64", True)
+
+from presto_tpu.types import (  # noqa: E402
+    BOOLEAN, TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE, VARCHAR, DATE,
+    TIMESTAMP, DecimalType, Type,
+)
+from presto_tpu.data.column import Column, Page  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT", "REAL", "DOUBLE",
+    "VARCHAR", "DATE", "TIMESTAMP", "DecimalType", "Type", "Column", "Page",
+]

@@ -1,0 +1,3 @@
+from presto_tpu.data.column import Column, Page, StringDict
+
+__all__ = ["Column", "Page", "StringDict"]

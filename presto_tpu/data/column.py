@@ -1,0 +1,292 @@
+"""Columnar data plane: Column / Page as JAX pytrees.
+
+Re-design of the reference's Page/Block hierarchy
+(presto-common/src/main/java/com/facebook/presto/common/Page.java:45,
+presto-common/.../block/Block.java:40) for XLA's static-shape compilation
+model:
+
+- A Page has a *static capacity* (its array length) and a *traced row count*
+  `num_rows` — rows [num_rows, capacity) are padding. Capacities come from a
+  small set of power-of-two buckets so each operator compiles a handful of
+  times, not once per batch (SURVEY.md §7.3 hard part #1).
+- A Column is `values` (fixed-width, see types.py) + `nulls` (bool mask,
+  True = NULL). Null slots hold the type's sort sentinel so padding/nulls
+  sort last without branching.
+- Strings are int32 codes into a host-side *sorted* StringDict: code order ==
+  lexicographic order, so comparisons, grouping and sorting run on-device on
+  codes alone; only LIKE/substring-style ops touch the host dictionary (they
+  evaluate over the (small) dictionary once, then gather by code).
+- Pages are pytrees, so whole fragments jit/vmap/shard_map over them.
+
+The invariant everywhere: *valid rows are the first num_rows rows*. Filters
+therefore compact (stable partition of survivors to the front) — a gather,
+which is cheap on TPU — instead of carrying per-row masks through every
+downstream operator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.types import Type, DecimalType, VARCHAR
+
+
+# Capacity buckets: pages are padded up to the next bucket so XLA compiles a
+# bounded set of shapes. Min bucket keeps tiny test pages cheap.
+_BUCKETS = [256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216]
+
+
+def bucket_capacity(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    # Beyond the largest bucket, round up to a multiple of the largest.
+    b = _BUCKETS[-1]
+    return ((n + b - 1) // b) * b
+
+
+class StringDict:
+    """Host-side sorted string dictionary. Identity-hashed so it can live in
+    pytree aux data without hashing millions of strings per jit-cache lookup;
+    keep one instance per table column and reuse it."""
+
+    __slots__ = ("words",)
+
+    def __init__(self, words: Sequence[str]):
+        self.words: Tuple[str, ...] = tuple(words)
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+    def __getitem__(self, i: int) -> str:
+        return self.words[i]
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other) -> bool:
+        return self is other
+
+    def __repr__(self) -> str:
+        return f"StringDict(n={len(self.words)})"
+
+    def code_of(self, s: str) -> int:
+        """Exact code of s, or -1 if absent (never matches a real code)."""
+        import bisect
+        i = bisect.bisect_left(self.words, s)
+        if i < len(self.words) and self.words[i] == s:
+            return i
+        return -1
+
+    def lower_bound(self, s: str) -> int:
+        """First code whose word >= s (for range comparisons on codes)."""
+        import bisect
+        return bisect.bisect_left(self.words, s)
+
+    @staticmethod
+    def build(strings: Iterable[str]) -> Tuple["StringDict", np.ndarray]:
+        arr = np.asarray(list(strings), dtype=object)
+        uniq, codes = np.unique(arr.astype(str), return_inverse=True)
+        return StringDict([str(u) for u in uniq]), codes.astype(np.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    values: jnp.ndarray          # [capacity], dtype per type
+    nulls: jnp.ndarray           # [capacity] bool, True = NULL
+    type: Type                   # aux (static)
+    dictionary: Optional[StringDict] = None  # aux (static), strings only
+
+    # -- pytree protocol --------------------------------------------------
+    def tree_flatten(self):
+        return (self.values, self.nulls), (self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        values, nulls = children
+        return cls(values, nulls, aux[0], aux[1])
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, type: Type,
+                   nulls: Optional[np.ndarray] = None,
+                   dictionary: Optional[StringDict] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        n = len(values)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        dt = type.dtype
+        out = np.full(cap, type.null_sentinel(), dtype=dt)
+        out[:n] = np.asarray(values, dtype=dt)
+        nl = np.ones(cap, dtype=bool)
+        if nulls is None:
+            nl[:n] = False
+        else:
+            nl[:n] = np.asarray(nulls, dtype=bool)
+            out[:n] = np.where(nl[:n], dt.type(type.null_sentinel()), out[:n])
+        return Column(jnp.asarray(out), jnp.asarray(nl), type, dictionary)
+
+    @staticmethod
+    def from_strings(strings: Sequence[Optional[str]],
+                     capacity: Optional[int] = None) -> "Column":
+        nulls = np.array([s is None for s in strings], dtype=bool)
+        filled = ["" if s is None else s for s in strings]
+        d, codes = StringDict.build(filled)
+        return Column.from_numpy(codes, VARCHAR, nulls=nulls, dictionary=d,
+                                 capacity=capacity)
+
+    # -- host access ------------------------------------------------------
+    def to_numpy(self, num_rows: Optional[int] = None):
+        v = np.asarray(self.values)
+        n = np.asarray(self.nulls)
+        if num_rows is not None:
+            v, n = v[:num_rows], n[:num_rows]
+        return v, n
+
+    def gather(self, idx: jnp.ndarray, valid: Optional[jnp.ndarray] = None
+               ) -> "Column":
+        """Gather rows; rows where valid is False become padding/null."""
+        vals = jnp.take(self.values, idx, mode="clip")
+        nulls = jnp.take(self.nulls, idx, mode="clip")
+        if valid is not None:
+            sent = jnp.asarray(self.type.null_sentinel(),
+                               dtype=self.values.dtype)
+            vals = jnp.where(valid, vals, sent)
+            nulls = jnp.where(valid, nulls, True)
+        return Column(vals, nulls, self.type, self.dictionary)
+
+    def with_null_sentinels(self) -> "Column":
+        """Ensure null slots hold the sort sentinel (after arithmetic the
+        value lanes of null rows may hold garbage)."""
+        sent = jnp.asarray(self.type.null_sentinel(), dtype=self.values.dtype)
+        return Column(jnp.where(self.nulls, sent, self.values), self.nulls,
+                      self.type, self.dictionary)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Page:
+    columns: Tuple[Column, ...]
+    num_rows: jnp.ndarray        # scalar int32 (traced)
+    names: Tuple[str, ...] = ()  # aux: output column names (may be empty)
+
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), (self.names,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, num_rows = children
+        return cls(tuple(columns), num_rows, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def row_valid(self) -> jnp.ndarray:
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def from_columns(columns: Sequence[Column], num_rows,
+                     names: Sequence[str] = ()) -> "Page":
+        return Page(tuple(columns), jnp.asarray(num_rows, dtype=jnp.int32),
+                    tuple(names))
+
+    @staticmethod
+    def from_pydict(data: dict, types: dict, capacity: Optional[int] = None
+                    ) -> "Page":
+        """Build a Page from {name: list-of-python-values} (tests/tools)."""
+        cols, names = [], []
+        n = 0
+        for name, vals in data.items():
+            n = len(vals)
+            t = types[name]
+            if t.is_string:
+                cols.append(Column.from_strings(vals, capacity=capacity))
+            else:
+                nulls = np.array([v is None for v in vals], dtype=bool)
+                filled = np.array(
+                    [0 if v is None else v for v in vals])
+                if t.is_decimal:
+                    filled = np.round(
+                        np.asarray(filled, dtype=np.float64)
+                        * (10 ** t.scale)).astype(np.int64)
+                cols.append(Column.from_numpy(filled, t, nulls=nulls,
+                                              capacity=capacity))
+            names.append(name)
+        return Page.from_columns(cols, n, names)
+
+    # -- host access ------------------------------------------------------
+    def to_pylist(self) -> List[tuple]:
+        """Materialize valid rows as python tuples (decoded strings,
+        decimals as floats scaled down). For tests and result delivery."""
+        n = int(self.num_rows)
+        rows: List[tuple] = []
+        cols = []
+        for c in self.columns:
+            v, nl = c.to_numpy(n)
+            cols.append((c, v, nl))
+        for i in range(n):
+            row = []
+            for c, v, nl in cols:
+                if nl[i]:
+                    row.append(None)
+                elif c.type.is_string:
+                    row.append(c.dictionary[int(v[i])]
+                               if c.dictionary is not None else int(v[i]))
+                elif isinstance(c.type, DecimalType):
+                    row.append(int(v[i]) / (10 ** c.type.scale))
+                elif c.type.name == "boolean":
+                    row.append(bool(v[i]))
+                elif c.type.is_floating:
+                    row.append(float(v[i]))
+                else:
+                    row.append(int(v[i]))
+            rows.append(tuple(row))
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# Core page transforms (shared by operators)
+# ---------------------------------------------------------------------------
+
+def compact(page: Page, keep: jnp.ndarray) -> Page:
+    """Stable-partition rows where `keep` is True to the front; the result's
+    num_rows is the survivor count. This is the engine's filter primitive —
+    one argsort + gathers, all statically shaped.
+
+    Reference semantics: PageProcessor's filter
+    (presto-main-base/.../operator/project/PageProcessor.java:56), re-expressed
+    as a compaction so downstream ops see dense pages.
+    """
+    keep = keep & page.row_valid()
+    # Stable order: non-survivors get index offset + capacity.
+    cap = page.capacity
+    order_key = jnp.where(keep, 0, cap) + jnp.arange(cap, dtype=jnp.int32)
+    perm = jnp.argsort(order_key)
+    n = jnp.sum(keep).astype(jnp.int32)
+    valid = jnp.arange(cap, dtype=jnp.int32) < n
+    cols = tuple(c.gather(perm, valid) for c in page.columns)
+    return Page(cols, n, page.names)
+
+
+def gather_page(page: Page, idx: jnp.ndarray, valid: jnp.ndarray,
+                num_rows) -> Page:
+    cols = tuple(c.gather(idx, valid) for c in page.columns)
+    return Page(cols, jnp.asarray(num_rows, dtype=jnp.int32), page.names)

@@ -1,0 +1,7 @@
+from presto_tpu.expr.nodes import (
+    RowExpression, InputRef, Literal, Call, SpecialForm, Form,
+)
+from presto_tpu.expr.compile import compile_expr
+
+__all__ = ["RowExpression", "InputRef", "Literal", "Call", "SpecialForm",
+           "Form", "compile_expr"]

@@ -1,0 +1,463 @@
+"""RowExpression -> JAX compiler.
+
+The engine's analogue of the reference's bytecode expression compiler
+(presto-main-base/.../sql/gen/ExpressionCompiler.java:62,
+PageFunctionCompiler.java): instead of emitting JVM bytecode per expression,
+we emit a Python closure over jax.numpy ops that evaluates the whole
+expression tree vectorized over a Page. The closure runs under `jit` as part
+of a whole-fragment program, so XLA fuses everything into the surrounding
+kernel (no per-expression dispatch at all — strictly more fusion than the
+reference's per-operator loop).
+
+SQL three-valued NULL logic is carried as an explicit bool lane per
+sub-expression. String operations exploit the sorted-dictionary invariant
+(data/column.py): comparisons run on int32 codes; LIKE and string transforms
+evaluate host-side over the (static) dictionary at trace time and become a
+single device gather.
+
+Divergence from the reference, by design: row-level runtime errors (division
+by zero, overflow) yield NULL instead of failing the query — a data-parallel
+engine cannot raise per-row. (reference behavior: throws
+PrestoException DIVISION_BY_ZERO).
+"""
+
+from __future__ import annotations
+
+import re
+from functools import reduce
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.data.column import Column, Page, StringDict
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, TIMESTAMP, VARCHAR, DecimalType,
+    Type,
+)
+from presto_tpu.expr.nodes import (
+    Call, Form, InputRef, Literal, RowExpression, SpecialForm,
+)
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _const_column(value, typ: Type, cap: int,
+                  dictionary: Optional[StringDict] = None) -> Column:
+    if value is None:
+        vals = jnp.full((cap,), typ.null_sentinel(), dtype=typ.dtype)
+        return Column(vals, jnp.ones((cap,), dtype=bool), typ, dictionary)
+    vals = jnp.full((cap,), value, dtype=typ.dtype)
+    return Column(vals, jnp.zeros((cap,), dtype=bool), typ, dictionary)
+
+
+def _bool(values: jnp.ndarray, nulls: jnp.ndarray) -> Column:
+    return Column(values.astype(bool), nulls, BOOLEAN, None)
+
+
+def _merge_dicts(a: StringDict, b: StringDict):
+    """Merge two sorted dictionaries; returns (merged, map_a, map_b) where
+    map_x[i] is the merged code of x's word i. Host-side, trace-time."""
+    wa, wb = np.asarray(a.words, dtype=object), np.asarray(b.words, dtype=object)
+    merged = sorted(set(a.words) | set(b.words))
+    md = StringDict(merged)
+    marr = np.asarray(merged, dtype=object)
+    map_a = np.searchsorted(marr.astype(str), wa.astype(str)).astype(np.int32)
+    map_b = np.searchsorted(marr.astype(str), wb.astype(str)).astype(np.int32)
+    return md, jnp.asarray(map_a), jnp.asarray(map_b)
+
+
+def align_string_columns(x: Column, y: Column):
+    """Recode two VARCHAR columns onto one shared sorted dictionary."""
+    if x.dictionary is y.dictionary:
+        return x, y
+    md, ma, mb = _merge_dicts(x.dictionary, y.dictionary)
+    xv = jnp.take(ma, jnp.clip(x.values, 0, len(x.dictionary) - 1))
+    yv = jnp.take(mb, jnp.clip(y.values, 0, len(y.dictionary) - 1))
+    return (Column(xv, x.nulls, x.type, md),
+            Column(yv, y.nulls, y.type, md))
+
+
+def _civil_from_days(z: jnp.ndarray):
+    """days-since-epoch -> (year, month, day), vectorized integer math
+    (public-domain civil_from_days algorithm)."""
+    z = z.astype(jnp.int32) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def days_from_civil(y: int, m: int, d: int) -> int:
+    """Host-side inverse (for date literals)."""
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+_LIKE_CACHE: dict = {}
+
+
+def _like_regex(pattern: str, escape: Optional[str] = None) -> "re.Pattern":
+    key = (pattern, escape)
+    if key not in _LIKE_CACHE:
+        out, i = [], 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if escape and ch == escape and i + 1 < len(pattern):
+                out.append(re.escape(pattern[i + 1])); i += 2; continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(ch))
+            i += 1
+        _LIKE_CACHE[key] = re.compile("^" + "".join(out) + "$", re.DOTALL)
+    return _LIKE_CACHE[key]
+
+
+def _rescale_decimal(v: jnp.ndarray, from_scale: int, to_scale: int):
+    if to_scale == from_scale:
+        return v
+    if to_scale > from_scale:
+        return v * (10 ** (to_scale - from_scale))
+    f = 10 ** (from_scale - to_scale)  # round half away from zero
+    return jnp.where(v >= 0, (v + f // 2) // f, -((-v + f // 2) // f))
+
+
+def _cast(col: Column, to: Type) -> Column:
+    frm = col.type
+    if frm == to:
+        return col
+    v, n = col.values, col.nulls
+    if isinstance(to, DecimalType):
+        if isinstance(frm, DecimalType):
+            return Column(_rescale_decimal(v, frm.scale, to.scale), n, to)
+        if frm.is_integer:
+            return Column(v.astype(jnp.int64) * (10 ** to.scale), n, to)
+        if frm.is_floating:
+            return Column(jnp.round(v * (10 ** to.scale)).astype(jnp.int64),
+                          n, to)
+        raise NotImplementedError(f"cast {frm} -> {to}")
+    if isinstance(frm, DecimalType):
+        if to.is_floating:
+            return Column((v / (10 ** frm.scale)).astype(to.dtype), n, to)
+        if to.is_integer:
+            return Column(_rescale_decimal(v, frm.scale, 0).astype(to.dtype),
+                          n, to)
+        raise NotImplementedError(f"cast {frm} -> {to}")
+    if to.is_floating or to.is_integer:
+        if frm.is_floating and to.is_integer:
+            return Column(jnp.round(v).astype(to.dtype), n, to)
+        if frm.name == "boolean":
+            return Column(v.astype(to.dtype), n, to)
+        if frm.is_integer or frm.is_floating or frm.is_temporal:
+            return Column(v.astype(to.dtype), n, to)
+    if to == DATE and frm.is_string:
+        words = col.dictionary.words
+        mapped = np.array([_parse_date_host(w) for w in words],
+                          dtype=np.int32)
+        return Column(jnp.take(jnp.asarray(mapped),
+                               jnp.clip(v, 0, len(words) - 1)), n, to)
+    if to == TIMESTAMP and frm == DATE:
+        return Column(v.astype(jnp.int64) * 86_400_000_000, n, to)
+    if to == BOOLEAN and (frm.is_integer or frm.is_floating):
+        return Column(v != 0, n, to)
+    if to.is_string and frm.is_string:
+        return Column(v, n, to, col.dictionary)
+    raise NotImplementedError(f"cast {frm} -> {to}")
+
+
+def _parse_date_host(s: str) -> int:
+    y, m, d = s.strip().split("-")
+    return days_from_civil(int(y), int(m), int(d))
+
+
+def _common_numeric(x: Column, y: Column):
+    """Promote two numeric/temporal columns to a common device dtype for
+    comparison; decimals are aligned by scale (exact int64 path)."""
+    if isinstance(x.type, DecimalType) or isinstance(y.type, DecimalType):
+        if isinstance(x.type, DecimalType) and isinstance(y.type, DecimalType):
+            s = max(x.type.scale, y.type.scale)
+            t = DecimalType(18, s)
+            return _cast(x, t), _cast(y, t)
+        t = DOUBLE if (x.type.is_floating or y.type.is_floating) else None
+        if t is None:
+            s = (x.type if isinstance(x.type, DecimalType) else y.type).scale
+            t = DecimalType(18, s)
+        return _cast(x, t), _cast(y, t)
+    dt = jnp.promote_types(x.values.dtype, y.values.dtype)
+    return (Column(x.values.astype(dt), x.nulls, x.type),
+            Column(y.values.astype(dt), y.nulls, y.type))
+
+
+# ---------------------------------------------------------------------------
+# the compiler
+# ---------------------------------------------------------------------------
+
+Compiled = Callable[[Page], Column]
+
+
+def compile_expr(expr: RowExpression) -> Compiled:
+    """Compile a RowExpression into fn(Page) -> Column. The returned closure
+    is trace-friendly: dictionary work happens at trace time (static aux)."""
+
+    def ev(e: RowExpression, page: Page) -> Column:
+        cap = page.capacity
+        if isinstance(e, InputRef):
+            return page.columns[e.field]
+        if isinstance(e, Literal):
+            return _literal_column(e, cap)
+        if isinstance(e, SpecialForm):
+            return _special(e, page, ev)
+        if isinstance(e, Call):
+            return _call(e, page, ev)
+        raise NotImplementedError(f"expression {e!r}")
+
+    return lambda page: ev(expr, page)
+
+
+def _literal_column(e: Literal, cap: int) -> Column:
+    t = e.type
+    if t.is_string:
+        if e.value is None:
+            return _const_column(None, t, cap, StringDict([]))
+        d = StringDict([e.value])
+        return _const_column(0, t, cap, d)
+    return _const_column(e.value, t, cap)
+
+
+def _special(e: SpecialForm, page: Page, ev) -> Column:
+    f = e.form
+    if f == Form.AND:
+        cols = [ev(a, page) for a in e.args]
+        val = reduce(jnp.logical_and,
+                     [jnp.where(c.nulls, True, c.values.astype(bool))
+                      for c in cols])
+        any_false = reduce(jnp.logical_or,
+                           [~c.nulls & ~c.values.astype(bool) for c in cols])
+        any_null = reduce(jnp.logical_or, [c.nulls for c in cols])
+        return _bool(val, ~any_false & any_null)
+    if f == Form.OR:
+        cols = [ev(a, page) for a in e.args]
+        val = reduce(jnp.logical_or,
+                     [jnp.where(c.nulls, False, c.values.astype(bool))
+                      for c in cols])
+        any_true = reduce(jnp.logical_or,
+                          [~c.nulls & c.values.astype(bool) for c in cols])
+        any_null = reduce(jnp.logical_or, [c.nulls for c in cols])
+        return _bool(val, ~any_true & any_null)
+    if f == Form.IS_NULL:
+        c = ev(e.args[0], page)
+        return _bool(c.nulls, jnp.zeros_like(c.nulls))
+    if f == Form.IF:
+        c = ev(e.args[0], page)
+        t = ev(e.args[1], page)
+        el = ev(e.args[2], page)
+        if t.type.is_string and el.type.is_string:
+            t, el = align_string_columns(t, el)
+        elif t.type != el.type:
+            t, el = _common_numeric(t, el)
+        take_then = ~c.nulls & c.values.astype(bool)
+        return Column(jnp.where(take_then, t.values, el.values),
+                      jnp.where(take_then, t.nulls, el.nulls),
+                      t.type if not t.type.is_string else t.type,
+                      t.dictionary)
+    if f == Form.COALESCE:
+        cols = [ev(a, page) for a in e.args]
+        out = cols[0]
+        for c in cols[1:]:
+            if out.type.is_string:
+                out, c = align_string_columns(out, c)
+            out = Column(jnp.where(out.nulls, c.values, out.values),
+                         out.nulls & c.nulls, out.type, out.dictionary)
+        return out
+    if f == Form.BETWEEN:
+        v, lo, hi = (ev(a, page) for a in e.args)
+        return _and2(_compare("ge", v, lo), _compare("le", v, hi))
+    if f == Form.IN:
+        v = ev(e.args[0], page)
+        eqs = [_compare("eq", v, ev(a, page)) for a in e.args[1:]]
+        val = reduce(jnp.logical_or, [~c.nulls & c.values for c in eqs])
+        any_null = reduce(jnp.logical_or, [c.nulls for c in eqs])
+        return _bool(val, ~val & (any_null | v.nulls))
+    raise NotImplementedError(f"special form {f}")
+
+
+def _and2(a: Column, b: Column) -> Column:
+    val = (jnp.where(a.nulls, True, a.values.astype(bool))
+           & jnp.where(b.nulls, True, b.values.astype(bool)))
+    any_false = (~a.nulls & ~a.values.astype(bool)) | \
+                (~b.nulls & ~b.values.astype(bool))
+    return _bool(val, ~any_false & (a.nulls | b.nulls))
+
+
+_CMP = {
+    "eq": lambda x, y: x == y, "ne": lambda x, y: x != y,
+    "lt": lambda x, y: x < y, "le": lambda x, y: x <= y,
+    "gt": lambda x, y: x > y, "ge": lambda x, y: x >= y,
+}
+
+
+def _compare(op: str, x: Column, y: Column) -> Column:
+    if x.type.is_string and y.type.is_string:
+        x, y = align_string_columns(x, y)
+        return _bool(_CMP[op](x.values, y.values), x.nulls | y.nulls)
+    x, y = _common_numeric(x, y)
+    return _bool(_CMP[op](x.values, y.values), x.nulls | y.nulls)
+
+
+def _arith(op: str, e: Call, x: Column, y: Column) -> Column:
+    rt = e.type
+    nulls = x.nulls | y.nulls
+    if isinstance(rt, DecimalType):
+        xs = x.type.scale if isinstance(x.type, DecimalType) else 0
+        ys = y.type.scale if isinstance(y.type, DecimalType) else 0
+        xv = x.values.astype(jnp.int64)
+        yv = y.values.astype(jnp.int64)
+        if op == "multiply":
+            v = xv * yv
+            return Column(_rescale_decimal(v, xs + ys, rt.scale), nulls, rt)
+        xv = _rescale_decimal(xv, xs, rt.scale)
+        yv = _rescale_decimal(yv, ys, rt.scale)
+        if op == "add":
+            return Column(xv + yv, nulls, rt)
+        if op == "subtract":
+            return Column(xv - yv, nulls, rt)
+        raise NotImplementedError(f"decimal {op}")
+    x = _cast(x, rt)
+    y = _cast(y, rt)
+    xv, yv = x.values, y.values
+    if op == "add":
+        v = xv + yv
+    elif op == "subtract":
+        v = xv - yv
+    elif op == "multiply":
+        v = xv * yv
+    elif op == "divide":
+        if rt.is_integer:
+            zero = yv == 0
+            v = jax.lax.div(xv, jnp.where(zero, 1, yv))
+            nulls = nulls | zero
+        else:
+            zero = yv == 0
+            v = xv / jnp.where(zero, 1, yv)
+            nulls = nulls | zero
+    elif op == "modulus":
+        zero = yv == 0
+        v = jax.lax.rem(xv, jnp.where(zero, 1, yv))
+        nulls = nulls | zero
+    else:
+        raise NotImplementedError(op)
+    return Column(v, nulls, rt)
+
+
+def _dict_transform(col: Column, fn) -> Column:
+    """Apply a host string->string fn over the dictionary, producing a new
+    sorted dictionary + device code remap (one gather)."""
+    words = [fn(w) for w in col.dictionary.words]
+    newd, codes = StringDict.build(words) if words else (StringDict([]), np.zeros(0, np.int32))
+    remap = jnp.asarray(codes) if len(words) else jnp.zeros((1,), jnp.int32)
+    nv = jnp.take(remap, jnp.clip(col.values, 0, max(len(words) - 1, 0)))
+    return Column(nv, col.nulls, col.type, newd)
+
+
+def _dict_predicate(col: Column, fn) -> Column:
+    """Host predicate over dictionary words -> device boolean via gather."""
+    words = col.dictionary.words
+    if not words:
+        return _bool(jnp.zeros_like(col.nulls), col.nulls)
+    tbl = jnp.asarray(np.array([bool(fn(w)) for w in words]))
+    v = jnp.take(tbl, jnp.clip(col.values, 0, len(words) - 1))
+    return _bool(v, col.nulls)
+
+
+def _call(e: Call, page: Page, ev) -> Column:
+    name = e.name
+    if name in ("add", "subtract", "multiply", "divide", "modulus"):
+        return _arith(name, e, ev(e.args[0], page), ev(e.args[1], page))
+    if name in _CMP:
+        return _compare(name, ev(e.args[0], page), ev(e.args[1], page))
+    if name == "not":
+        c = ev(e.args[0], page)
+        return _bool(~c.values.astype(bool), c.nulls)
+    if name == "negate":
+        c = ev(e.args[0], page)
+        return Column(-c.values, c.nulls, c.type)
+    if name == "abs":
+        c = ev(e.args[0], page)
+        return Column(jnp.abs(c.values), c.nulls, c.type)
+    if name == "cast":
+        return _cast(ev(e.args[0], page), e.type)
+    if name in ("extract_year", "extract_month", "extract_day", "year",
+                "month", "day"):
+        c = ev(e.args[0], page)
+        days = c.values if c.type == DATE else c.values // 86_400_000_000
+        y, m, d = _civil_from_days(days)
+        part = {"year": y, "month": m, "day": d}[name.replace("extract_", "")]
+        return Column(part.astype(jnp.int64), c.nulls, BIGINT)
+    if name == "like":
+        c = ev(e.args[0], page)
+        pat = e.args[1]
+        assert isinstance(pat, Literal), "LIKE pattern must be a literal"
+        rx = _like_regex(pat.value)
+        return _dict_predicate(c, lambda w: rx.match(w) is not None)
+    if name == "substr":
+        c = ev(e.args[0], page)
+        start = e.args[1].value  # 1-based, literal
+        length = e.args[2].value if len(e.args) > 2 else None
+        if length is None:
+            return _dict_transform(c, lambda w: w[start - 1:])
+        return _dict_transform(c, lambda w: w[start - 1:start - 1 + length])
+    if name in ("lower", "upper", "trim", "ltrim", "rtrim"):
+        c = ev(e.args[0], page)
+        fn = {"lower": str.lower, "upper": str.upper, "trim": str.strip,
+              "ltrim": str.lstrip, "rtrim": str.rstrip}[name]
+        return _dict_transform(c, fn)
+    if name == "length":
+        c = ev(e.args[0], page)
+        words = c.dictionary.words
+        tbl = jnp.asarray(np.array([len(w) for w in words], dtype=np.int64)
+                          if words else np.zeros(1, np.int64))
+        v = jnp.take(tbl, jnp.clip(c.values, 0, max(len(words) - 1, 0)))
+        return Column(v, c.nulls, BIGINT)
+    if name == "concat":
+        a, b = ev(e.args[0], page), ev(e.args[1], page)
+        if isinstance(e.args[1], Literal):
+            return _dict_transform(a, lambda w: w + e.args[1].value)
+        if isinstance(e.args[0], Literal):
+            return _dict_transform(b, lambda w: e.args[0].value + w)
+        raise NotImplementedError("concat of two non-literal strings")
+    if name in ("sqrt", "ln", "log10", "exp", "floor", "ceil", "round"):
+        c = ev(e.args[0], page)
+        if name == "round" and len(e.args) > 1:
+            nd = e.args[1].value
+            f = 10.0 ** nd
+            v = jnp.round(c.values.astype(jnp.float64) * f) / f
+            return Column(v, c.nulls, DOUBLE)
+        fn = {"sqrt": jnp.sqrt, "ln": jnp.log, "log10": jnp.log10,
+              "exp": jnp.exp, "floor": jnp.floor, "ceil": jnp.ceil,
+              "round": jnp.round}[name]
+        v = fn(c.values.astype(jnp.float64))
+        if name in ("floor", "ceil", "round") and c.type.is_integer:
+            return Column(c.values, c.nulls, c.type)
+        return Column(v, c.nulls, DOUBLE)
+    if name == "date_add_days":
+        c = ev(e.args[0], page)
+        k = ev(e.args[1], page)
+        return Column(c.values + k.values.astype(c.values.dtype),
+                      c.nulls | k.nulls, c.type)
+    raise NotImplementedError(f"function {name}")

@@ -1,0 +1,192 @@
+"""SQL type system.
+
+Re-designed (not ported) from the reference's type layer
+(presto-common/src/main/java/com/facebook/presto/common/type/, 86 files).
+Each SQL type maps to a fixed-width device representation:
+
+    BOOLEAN              -> bool_
+    TINYINT/SMALLINT/
+    INTEGER              -> int32
+    BIGINT               -> int64
+    REAL                 -> float32
+    DOUBLE               -> float64
+    DECIMAL(p<=18, s)    -> int64 scaled by 10**s (exact)
+    DATE                 -> int32 days since 1970-01-01
+    TIMESTAMP            -> int64 microseconds since epoch
+    VARCHAR/CHAR         -> int32 codes into a *sorted* host-side dictionary
+                            (sorted => code order == lexicographic order, so
+                            <,>,=,group-by work directly on codes on device)
+
+Types are immutable, hashable values so they can ride in pytree aux data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """Base SQL type. `name` follows Presto's type-signature spelling."""
+
+    name: str
+
+    # ---- classification ------------------------------------------------
+    @property
+    def is_string(self) -> bool:
+        return self.name in ("varchar", "char")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("tinyint", "smallint", "integer", "bigint")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("real", "double")
+
+    @property
+    def is_decimal(self) -> bool:
+        return isinstance(self, DecimalType)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_floating or self.is_decimal
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.name in ("date", "timestamp")
+
+    @property
+    def is_orderable(self) -> bool:
+        return True
+
+    # ---- device representation ----------------------------------------
+    @property
+    def dtype(self) -> np.dtype:
+        return _DTYPES[self.name]
+
+    def null_sentinel(self):
+        """Value stored in the `values` array where nulls is True. Chosen so
+        padding/null rows sort *after* every real value (ascending)."""
+        dt = self.dtype
+        if dt == np.bool_:
+            return False
+        if np.issubdtype(dt, np.integer):
+            return np.iinfo(dt).max
+        return np.inf
+
+    def __str__(self) -> str:
+        return self.name
+
+    def __repr__(self) -> str:
+        return f"Type({self.name})"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class DecimalType(Type):
+    precision: int = 18
+    scale: int = 0
+
+    def __init__(self, precision: int = 18, scale: int = 0):
+        if precision > 18:
+            raise NotImplementedError(
+                f"DECIMAL({precision},{scale}): precision > 18 (int128) not "
+                "yet supported on the int64 fast path")
+        object.__setattr__(self, "name", "decimal")
+        object.__setattr__(self, "precision", precision)
+        object.__setattr__(self, "scale", scale)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+    def __str__(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def __repr__(self) -> str:
+        return f"DecimalType({self.precision},{self.scale})"
+
+
+BOOLEAN = Type("boolean")
+TINYINT = Type("tinyint")
+SMALLINT = Type("smallint")
+INTEGER = Type("integer")
+BIGINT = Type("bigint")
+REAL = Type("real")
+DOUBLE = Type("double")
+VARCHAR = Type("varchar")
+CHAR = Type("char")
+DATE = Type("date")
+TIMESTAMP = Type("timestamp")
+UNKNOWN = Type("unknown")  # type of a bare NULL literal
+
+_DTYPES = {
+    "boolean": np.dtype(np.bool_),
+    "tinyint": np.dtype(np.int32),
+    "smallint": np.dtype(np.int32),
+    "integer": np.dtype(np.int32),
+    "bigint": np.dtype(np.int64),
+    "real": np.dtype(np.float32),
+    "double": np.dtype(np.float64),
+    "varchar": np.dtype(np.int32),
+    "char": np.dtype(np.int32),
+    "date": np.dtype(np.int32),
+    "timestamp": np.dtype(np.int64),
+    "decimal": np.dtype(np.int64),
+    "unknown": np.dtype(np.bool_),
+}
+
+_BY_NAME = {
+    "boolean": BOOLEAN, "tinyint": TINYINT, "smallint": SMALLINT,
+    "integer": INTEGER, "int": INTEGER, "bigint": BIGINT, "real": REAL,
+    "double": DOUBLE, "varchar": VARCHAR, "char": CHAR, "date": DATE,
+    "timestamp": TIMESTAMP, "unknown": UNKNOWN,
+}
+
+
+def parse_type(signature: str) -> Type:
+    """Parse a Presto type signature, e.g. 'bigint', 'decimal(12,2)',
+    'varchar(25)'."""
+    s = signature.strip().lower()
+    if s.startswith("decimal"):
+        if "(" in s:
+            inner = s[s.index("(") + 1:s.rindex(")")]
+            p, _, sc = inner.partition(",")
+            return DecimalType(int(p), int(sc or 0))
+        return DecimalType()
+    if "(" in s:  # varchar(25), char(1) — length is metadata only
+        s = s[:s.index("(")]
+    try:
+        return _BY_NAME[s]
+    except KeyError:
+        raise ValueError(f"unsupported type signature: {signature!r}") from None
+
+
+def common_super_type(a: Type, b: Type) -> Optional[Type]:
+    """Numeric/temporal coercion lattice (reference:
+    presto-common/.../type/TypeManager semantics, simplified)."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    order = ["tinyint", "smallint", "integer", "bigint", "real", "double"]
+    if a.is_decimal and b.is_decimal:
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType(min(18, intd + scale), scale)
+    if a.is_decimal and b.name in order:
+        return DOUBLE if b.is_floating else a
+    if b.is_decimal and a.name in order:
+        return DOUBLE if a.is_floating else b
+    if a.name in order and b.name in order:
+        return _BY_NAME[order[max(order.index(a.name), order.index(b.name))]]
+    if {a.name, b.name} == {"date", "timestamp"}:
+        return TIMESTAMP
+    if a.is_string and b.is_string:
+        return VARCHAR
+    return None

@@ -1,0 +1,24 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's in-JVM multi-node trick (DistributedQueryRunner,
+presto-tests/.../DistributedQueryRunner.java:114): N devices inside one
+process, real collectives between them.
+
+Note: this environment's sitecustomize registers the axon TPU platform and
+*programmatically* sets jax_platforms, so the JAX_PLATFORMS env var alone is
+ignored — we must override via jax.config before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", \
+    f"test harness needs 8 CPU devices, got {jax.devices()}"

@@ -1,0 +1,59 @@
+import numpy as np
+import pytest
+
+from presto_tpu import BIGINT, DOUBLE, VARCHAR
+from presto_tpu.data.column import (
+    Column, Page, StringDict, bucket_capacity, compact,
+)
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(1) == 256
+    assert bucket_capacity(256) == 256
+    assert bucket_capacity(257) == 1024
+    assert bucket_capacity(20_000_000) % 16777216 == 0
+
+
+def test_column_from_numpy_pads_with_sentinel():
+    c = Column.from_numpy(np.array([3, 1, 2]), BIGINT)
+    v, n = c.to_numpy()
+    assert c.capacity == 256
+    assert list(v[:3]) == [3, 1, 2]
+    assert not n[:3].any() and n[3:].all()
+    assert (v[3:] == np.iinfo(np.int64).max).all()
+
+
+def test_nulls_get_sentinel():
+    c = Column.from_numpy(np.array([3.0, 1.0]), DOUBLE,
+                          nulls=np.array([False, True]))
+    v, n = c.to_numpy(2)
+    assert v[0] == 3.0 and np.isinf(v[1]) and n[1]
+
+
+def test_string_dict_sorted_codes():
+    c = Column.from_strings(["banana", "apple", None, "cherry", "apple"])
+    v, n = c.to_numpy(5)
+    d = c.dictionary
+    assert list(d.words) == sorted(d.words)
+    assert d[int(v[0])] == "banana"
+    assert d[int(v[1])] == "apple"
+    assert n[2]
+    assert d.code_of("zzz") == -1
+    assert d.code_of("apple") == int(v[1])
+
+
+def test_page_roundtrip():
+    p = Page.from_pydict(
+        {"a": [1, 2, None], "b": ["x", None, "y"]},
+        {"a": BIGINT, "b": VARCHAR})
+    assert p.to_pylist() == [(1, "x"), (2, None), (None, "y")]
+
+
+def test_compact():
+    p = Page.from_pydict({"a": [1, 2, 3, 4, 5]}, {"a": BIGINT})
+    import jax.numpy as jnp
+    keep = jnp.asarray(
+        np.array([True, False, True, False, True] + [True] * 251))
+    out = compact(p, keep)
+    assert int(out.num_rows) == 3
+    assert out.to_pylist() == [(1,), (3,), (5,)]

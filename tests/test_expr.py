@@ -1,0 +1,116 @@
+import numpy as np
+
+from presto_tpu import BIGINT, BOOLEAN, DOUBLE, VARCHAR, DATE
+from presto_tpu.data.column import Page
+from presto_tpu.expr import (
+    Call, Form, InputRef, Literal, SpecialForm, compile_expr,
+)
+from presto_tpu.expr.compile import days_from_civil
+from presto_tpu.types import DecimalType
+
+
+def _page(**cols):
+    types = {}
+    data = {}
+    for k, (vals, t) in cols.items():
+        data[k] = vals
+        types[k] = t
+    return Page.from_pydict(data, types)
+
+
+def _run(expr, page):
+    col = compile_expr(expr)(page)
+    n = int(page.num_rows)
+    v, nl = col.to_numpy(n)
+    return [None if nl[i] else v[i] for i in range(n)]
+
+
+def test_arith_nulls():
+    p = _page(a=([1, 2, None], BIGINT), b=([10, None, 30], BIGINT))
+    e = Call("add", (InputRef(0, BIGINT), InputRef(1, BIGINT)), BIGINT)
+    assert _run(e, p) == [11, None, None]
+
+
+def test_division_by_zero_is_null():
+    p = _page(a=([10, 7], BIGINT), b=([0, 2], BIGINT))
+    e = Call("divide", (InputRef(0, BIGINT), InputRef(1, BIGINT)), BIGINT)
+    assert _run(e, p) == [None, 3]
+
+
+def test_integer_division_truncates_toward_zero():
+    p = _page(a=([-7, 7], BIGINT), b=([2, -2], BIGINT))
+    e = Call("divide", (InputRef(0, BIGINT), InputRef(1, BIGINT)), BIGINT)
+    assert _run(e, p) == [-3, -3]
+
+
+def test_three_valued_and_or():
+    p = _page(a=([True, True, None, False], BOOLEAN),
+              b=([None, True, None, None], BOOLEAN))
+    a, b = InputRef(0, BOOLEAN), InputRef(1, BOOLEAN)
+    assert _run(SpecialForm(Form.AND, (a, b), BOOLEAN), p) == \
+        [None, True, None, False]
+    assert _run(SpecialForm(Form.OR, (a, b), BOOLEAN), p) == \
+        [True, True, None, None]
+
+
+def test_string_compare_literal():
+    p = _page(s=(["apple", "pear", None, "fig"], VARCHAR))
+    e = Call("lt", (InputRef(0, VARCHAR), Literal("grape", VARCHAR)), BOOLEAN)
+    assert _run(e, p) == [True, False, None, True]
+    e = Call("eq", (InputRef(0, VARCHAR), Literal("pear", VARCHAR)), BOOLEAN)
+    assert _run(e, p) == [False, True, None, False]
+
+
+def test_like():
+    p = _page(s=(["BRASS widget", "small COPPER", "LARGE BRASS"], VARCHAR))
+    e = Call("like", (InputRef(0, VARCHAR), Literal("%BRASS%", VARCHAR)),
+             BOOLEAN)
+    assert _run(e, p) == [True, False, True]
+
+
+def test_date_extract_and_literal():
+    d0 = days_from_civil(1995, 3, 15)
+    d1 = days_from_civil(1998, 12, 1)
+    p = _page(d=([d0, d1], DATE))
+    e = Call("year", (InputRef(0, DATE),), BIGINT)
+    assert _run(e, p) == [1995, 1998]
+    e = Call("month", (InputRef(0, DATE),), BIGINT)
+    assert _run(e, p) == [3, 12]
+
+
+def test_between_and_case():
+    p = _page(x=([1, 5, 10, None], BIGINT))
+    x = InputRef(0, BIGINT)
+    e = SpecialForm(Form.BETWEEN,
+                    (x, Literal(2, BIGINT), Literal(9, BIGINT)), BOOLEAN)
+    assert _run(e, p) == [False, True, False, None]
+    e = SpecialForm(Form.IF, (
+        Call("gt", (x, Literal(4, BIGINT)), BOOLEAN),
+        Literal(1, BIGINT), Literal(0, BIGINT)), BIGINT)
+    assert _run(e, p) == [0, 1, 1, 0]
+
+
+def test_in_list():
+    p = _page(x=([1, 3, 7, None], BIGINT))
+    e = SpecialForm(Form.IN, (InputRef(0, BIGINT), Literal(1, BIGINT),
+                              Literal(7, BIGINT)), BOOLEAN)
+    assert _run(e, p) == [True, False, True, None]
+
+
+def test_decimal_arith():
+    t = DecimalType(12, 2)
+    p = _page(x=([1.50, 2.25], t))
+    e = Call("multiply", (InputRef(0, t), Literal(200, DecimalType(3, 2))),
+             DecimalType(18, 4))
+    out = _run(e, p)
+    assert out == [int(1.50 * 2.00 * 10000), int(2.25 * 2.00 * 10000)]
+
+
+def test_substr_and_upper():
+    p = _page(s=(["hello world", "abc"], VARCHAR))
+    e = Call("substr", (InputRef(0, VARCHAR), Literal(1, BIGINT),
+                        Literal(5, BIGINT)), VARCHAR)
+    col = compile_expr(e)(p)
+    v, nl = col.to_numpy(2)
+    assert col.dictionary[int(v[0])] == "hello"
+    assert col.dictionary[int(v[1])] == "abc"
