@@ -1,0 +1,3 @@
+from presto_tpu.connectors.tpch import TPCH_SCHEMA, TpchConnector
+
+__all__ = ["TPCH_SCHEMA", "TpchConnector"]

@@ -1,0 +1,4 @@
+from presto_tpu.exec.executor import Executor
+from presto_tpu.exec.engine import LocalEngine
+
+__all__ = ["Executor", "LocalEngine"]

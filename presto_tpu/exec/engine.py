@@ -1,0 +1,38 @@
+"""LocalEngine — single-process parse->plan->execute entry point.
+
+Reference role: LocalQueryRunner
+(presto-main-base/.../testing/LocalQueryRunner.java:311) — the full engine
+in one process, no HTTP, used for tests, benchmarks and as the worker's
+fragment-execution core."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from presto_tpu.exec.executor import Executor
+from presto_tpu.plan.nodes import PlanNode, explain
+from presto_tpu.sql.analyzer import Planner
+from presto_tpu.sql.parser import parse_sql
+
+
+class LocalEngine:
+    def __init__(self, connector):
+        self.connector = connector
+        self.planner = Planner(connector)
+        self.executor = Executor(connector)
+        self._plans = {}
+
+    def plan_sql(self, sql: str) -> PlanNode:
+        if sql not in self._plans:
+            self._plans[sql] = self.planner.plan_query(parse_sql(sql))
+        return self._plans[sql]
+
+    def explain_sql(self, sql: str) -> str:
+        return explain(self.plan_sql(sql))
+
+    def execute_sql(self, sql: str) -> List[tuple]:
+        page = self.executor.execute(self.plan_sql(sql))
+        return page.to_pylist()
+
+    def column_names(self, sql: str) -> Tuple[str, ...]:
+        return self.plan_sql(sql).output_names

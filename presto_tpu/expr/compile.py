@@ -142,6 +142,11 @@ def _cast(col: Column, to: Type) -> Column:
     frm = col.type
     if frm == to:
         return col
+    if frm.name == "unknown":  # typed NULL literal
+        sent = jnp.asarray(to.null_sentinel(), dtype=to.dtype)
+        return Column(jnp.full(col.values.shape, sent, dtype=to.dtype),
+                      jnp.ones_like(col.nulls), to,
+                      StringDict([]) if to.is_string else None)
     v, n = col.values, col.nulls
     if isinstance(to, DecimalType):
         if isinstance(frm, DecimalType):
@@ -316,6 +321,11 @@ def _compare(op: str, x: Column, y: Column) -> Column:
     if x.type.is_string and y.type.is_string:
         x, y = align_string_columns(x, y)
         return _bool(_CMP[op](x.values, y.values), x.nulls | y.nulls)
+    # varchar <-> date coercion (Presto: cast('1998-09-02' as date) implied)
+    if x.type.is_temporal and y.type.is_string:
+        y = _cast(y, x.type if x.type.name == "date" else DATE)
+    elif y.type.is_temporal and x.type.is_string:
+        x = _cast(x, y.type if y.type.name == "date" else DATE)
     x, y = _common_numeric(x, y)
     return _bool(_CMP[op](x.values, y.values), x.nulls | y.nulls)
 
@@ -413,7 +423,8 @@ def _call(e: Call, page: Page, ev) -> Column:
         c = ev(e.args[0], page)
         pat = e.args[1]
         assert isinstance(pat, Literal), "LIKE pattern must be a literal"
-        rx = _like_regex(pat.value)
+        esc = e.args[2].value if len(e.args) > 2 else None
+        rx = _like_regex(pat.value, esc)
         return _dict_predicate(c, lambda w: rx.match(w) is not None)
     if name == "substr":
         c = ev(e.args[0], page)

@@ -1,0 +1,7 @@
+from presto_tpu.ops.keys import sort_perm, hash_columns, SortKey
+from presto_tpu.ops.aggregate import grouped_aggregate, AggSpec
+from presto_tpu.ops.join import hash_join
+from presto_tpu.ops.sort import sort_page, top_n, limit_page
+
+__all__ = ["sort_perm", "hash_columns", "SortKey", "grouped_aggregate",
+           "AggSpec", "hash_join", "sort_page", "top_n", "limit_page"]

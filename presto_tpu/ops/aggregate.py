@@ -1,0 +1,193 @@
+"""Grouped aggregation — sort-based, fully vectorized.
+
+The engine's analogue of HashAggregationOperator
+(presto-main-base/.../operator/HashAggregationOperator.java:56,413 over
+MultiChannelGroupByHash.java:55). TPU-first redesign: instead of an
+open-addressing hash table probed row-at-a-time, we sort by the group keys
+(one fused multi-key argsort), detect group boundaries, and reduce with
+segment ops — every step is a statically-shaped XLA op that maps onto the
+vector units; no data-dependent control flow.
+
+Partial/final split (the distributed pattern, reference
+AggregationNode.Step): `grouped_aggregate` evaluates any step; AVG carries
+(sum, count) through partials exactly like the reference's accumulator
+states.
+
+Capacity contract: the output page has static capacity `out_capacity`
+(default: input capacity). If the true group count exceeds it, num_rows is
+clamped and `overflowed(page)` lets the host re-run at a bigger bucket —
+the engine's recompile-and-retry answer to dynamic cardinalities
+(SURVEY.md §7.3 #1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Column, Page
+from presto_tpu.ops.keys import SortKey, new_group_flags, sort_perm
+from presto_tpu.types import BIGINT, DOUBLE, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: kind in {sum,count,count_star,min,max,avg,
+    sum_partial,count_partial,avg_partial,avg_final,...}.
+
+    Step handling (mirrors AggregationNode.Step PARTIAL/FINAL/SINGLE):
+      - SINGLE: kind as-is over raw input.
+      - PARTIAL: avg -> emits two columns (sum, count); others emit their
+        partial state (sum/count/min/max).
+      - FINAL: count -> sum of partial counts; avg -> sum(sums)/sum(counts).
+    The *planner* rewrites kinds for partial/final; this op just evaluates
+    what it is given.
+    """
+    kind: str
+    field: Optional[int]          # input column (None for count_star)
+    output_type: Type
+    field2: Optional[int] = None  # second state input (avg_final: count)
+    mask_field: Optional[int] = None  # FILTER / mask channel (bool column)
+
+
+def _segment_sum(vals, seg_ids, num_segments):
+    return jnp.zeros((num_segments,), dtype=vals.dtype).at[seg_ids].add(vals)
+
+
+def _segment_min(vals, seg_ids, num_segments, identity):
+    return jnp.full((num_segments,), identity,
+                    dtype=vals.dtype).at[seg_ids].min(vals)
+
+
+def _segment_max(vals, seg_ids, num_segments, identity):
+    return jnp.full((num_segments,), identity,
+                    dtype=vals.dtype).at[seg_ids].max(vals)
+
+
+def grouped_aggregate(page: Page, group_fields: Sequence[int],
+                      aggs: Sequence[AggSpec],
+                      out_capacity: Optional[int] = None):
+    """Group `page` by `group_fields` and evaluate `aggs`. Output columns:
+    group keys (in order) then one column per agg (avg_partial emits two).
+    With no group fields, emits exactly one row (SQL global aggregation).
+
+    Returns (page, true_group_count): true_group_count is unclamped so the
+    host can detect out_capacity overflow and retry at a bigger bucket."""
+    cap = page.capacity
+    out_cap = out_capacity or cap
+    valid = page.row_valid()
+
+    if group_fields:
+        perm = sort_perm(page, [SortKey(f) for f in group_fields])
+        flags = new_group_flags(page, group_fields, perm) & valid[perm]
+        gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+        gid = jnp.where(valid[perm], gid, out_cap)  # padding -> overflow bin
+        num_groups = jnp.where(page.num_rows > 0,
+                               jnp.max(jnp.where(valid[perm], gid, -1)) + 1,
+                               0).astype(jnp.int32)
+    else:
+        perm = jnp.arange(cap, dtype=jnp.int32)
+        gid = jnp.where(valid, 0, out_cap)
+        num_groups = jnp.asarray(1, dtype=jnp.int32)
+
+    nseg = out_cap + 1  # last bin swallows padding/overflow
+    gvalid = valid[perm]
+
+    # Representative row (first of each group) for key materialization.
+    first_idx = jnp.full((nseg,), cap, dtype=jnp.int32).at[gid].min(
+        jnp.arange(cap, dtype=jnp.int32))
+    out_valid = jnp.arange(out_cap, dtype=jnp.int32) < jnp.minimum(
+        num_groups, out_cap)
+
+    cols = []
+    for f in group_fields:
+        src = page.columns[f]
+        sorted_col = src.gather(perm, gvalid)
+        cols.append(sorted_col.gather(first_idx[:out_cap], out_valid))
+
+    for a in aggs:
+        cols.extend(_eval_agg(a, page, perm, gid, nseg, out_cap, gvalid,
+                              out_valid))
+
+    return Page(tuple(cols), jnp.minimum(num_groups, out_cap), ()), \
+        num_groups
+
+
+def _eval_agg(a: AggSpec, page: Page, perm, gid, nseg, out_cap, gvalid,
+              out_valid):
+    t = a.output_type
+    if a.field is not None:
+        col = page.columns[a.field]
+        vals = col.values[perm]
+        nulls = col.nulls[perm] | ~gvalid
+    else:
+        vals = jnp.zeros((page.capacity,), dtype=jnp.int64)
+        nulls = ~gvalid
+    if a.mask_field is not None:
+        m = page.columns[a.mask_field]
+        keep = (~m.nulls & m.values.astype(bool))[perm]
+        nulls = nulls | ~keep
+
+    dictionary = (page.columns[a.field].dictionary
+                  if a.field is not None and t.is_string else None)
+
+    def out(values, nullmask):
+        sent = jnp.asarray(t.null_sentinel(), dtype=t.dtype)
+        v = jnp.where(nullmask | ~out_valid, sent,
+                      values[:out_cap].astype(t.dtype))
+        return Column(v, (nullmask | ~out_valid), t, dictionary)
+
+    kind = a.kind
+    if kind == "count_star":
+        c = _segment_sum(gvalid.astype(jnp.int64), gid, nseg)[:out_cap]
+        return [out(c, jnp.zeros_like(out_valid))]
+    if kind == "count":
+        c = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
+        return [out(c, jnp.zeros_like(out_valid))]
+    if kind in ("sum", "avg", "avg_partial"):
+        acc_dtype = jnp.float64 if t.is_floating or kind == "avg" \
+            else jnp.int64
+        contrib = jnp.where(nulls, 0, vals).astype(acc_dtype)
+        s = _segment_sum(contrib, gid, nseg)[:out_cap]
+        n = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
+        if kind == "sum":
+            return [out(s, n == 0)]
+        if kind == "avg":
+            return [out(s / jnp.maximum(n, 1), n == 0)]
+        # avg_partial -> (sum: double, count: bigint)
+        sum_col = Column(jnp.where(n == 0, jnp.inf, s), n == 0, DOUBLE)
+        cnt_col = Column(n, jnp.zeros_like(n, dtype=bool), BIGINT)
+        return [sum_col, cnt_col]
+    if kind == "avg_final":
+        # field = partial sum, field2 = partial count
+        cnt_col = page.columns[a.field2]
+        cvals = jnp.where(cnt_col.nulls, 0, cnt_col.values)[perm]
+        s = _segment_sum(jnp.where(nulls, 0.0, vals).astype(jnp.float64),
+                         gid, nseg)[:out_cap]
+        n = _segment_sum(cvals.astype(jnp.int64), gid, nseg)[:out_cap]
+        return [out(s / jnp.maximum(n, 1), n == 0)]
+    if kind in ("min", "max"):
+        if jnp.issubdtype(vals.dtype, jnp.floating):
+            ident = jnp.inf if kind == "min" else -jnp.inf
+        elif vals.dtype == jnp.bool_:
+            vals = vals.astype(jnp.int32)
+            ident = 1 if kind == "min" else 0
+        else:
+            info = jnp.iinfo(vals.dtype)
+            ident = info.max if kind == "min" else info.min
+        masked = jnp.where(nulls, ident, vals)
+        fn = _segment_min if kind == "min" else _segment_max
+        r = fn(masked, gid, nseg, ident)[:out_cap]
+        n = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
+        return [out(r, n == 0)]
+    if kind in ("bool_or", "bool_and"):
+        b = vals.astype(bool)
+        masked = jnp.where(nulls, kind == "bool_and", b)
+        fn = _segment_max if kind == "bool_or" else _segment_min
+        r = fn(masked.astype(jnp.int32), gid, nseg,
+               0 if kind == "bool_or" else 1)[:out_cap]
+        n = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
+        return [out(r.astype(bool), n == 0)]
+    raise NotImplementedError(f"aggregate {kind}")

@@ -1,0 +1,135 @@
+"""Key normalization, multi-key sort permutations, and vectorized hashing.
+
+These are the shared primitives under grouping, joins, sorting and the
+partitioned exchange — the roles the reference implements with
+MultiChannelGroupByHash (presto-main-base/.../operator/MultiChannelGroupByHash.java:55),
+PagesIndex sorting (.../operator/PagesIndex.java) and
+InterpretedHashGenerator (.../operator/InterpretedHashGenerator.java).
+TPU-first design: everything is a statically-shaped argsort / gather /
+bit-mix — no open-addressing probe loops on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Column, Page
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    field: int
+    ascending: bool = True
+    # Presto default: nulls are "larger than any value" — last for ASC,
+    # first for DESC (reference: presto-common/.../SortOrder.java).
+    nulls_first: Optional[bool] = None
+
+    @property
+    def nulls_sort_first(self) -> bool:
+        if self.nulls_first is not None:
+            return self.nulls_first
+        return not self.ascending
+
+
+def _orderable_values(col: Column) -> jnp.ndarray:
+    """Per-type array whose ascending order == SQL ascending order.
+    Strings are already codes into a sorted dictionary."""
+    v = col.values
+    if v.dtype == jnp.bool_:
+        return v.astype(jnp.int32)
+    return v
+
+
+def group_values(col: Column) -> jnp.ndarray:
+    """Per-type int64 array where equality == SQL group equality.
+    Floats are bit-canonicalized (-0.0 == 0.0, all NaNs equal)."""
+    v = col.values
+    if v.dtype == jnp.float64 or v.dtype == jnp.float32:
+        v64 = v.astype(jnp.float64)
+        v64 = jnp.where(v64 == 0.0, 0.0, v64)          # -0.0 -> +0.0
+        v64 = jnp.where(jnp.isnan(v64), jnp.nan, v64)  # canonical NaN
+        return jax_bitcast_f64_i64(v64)
+    if v.dtype == jnp.bool_:
+        return v.astype(jnp.int64)
+    return v.astype(jnp.int64)
+
+
+def jax_bitcast_f64_i64(x: jnp.ndarray) -> jnp.ndarray:
+    import jax
+    return jax.lax.bitcast_convert_type(x, jnp.int64)
+
+
+def sort_perm(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
+    """Permutation that stably sorts valid rows by `keys` with SQL null
+    ordering; padding rows always sort last. Implemented as composed stable
+    argsorts, least-significant key first."""
+    cap = page.capacity
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    for k in reversed(list(keys)):
+        col = page.columns[k.field]
+        v = _orderable_values(col)[perm]
+        if not k.ascending:
+            # Descending: sort on rank under reversed order. Negate where
+            # safe; for unsigned-ish codes negation is fine in int64.
+            v = -v.astype(jnp.int64) if v.dtype != jnp.float64 \
+                and v.dtype != jnp.float32 else -v
+        # Null placement: stable two-pass — first values, then null bucket.
+        s = jnp.argsort(v, stable=True)
+        perm = perm[s]
+        n = col.nulls[perm]
+        null_key = jnp.where(n, 0, 1) if k.nulls_sort_first else \
+            n.astype(jnp.int32)
+        perm = perm[jnp.argsort(null_key, stable=True)]
+    # Padding rows last (most-significant).
+    pad = (jnp.arange(cap, dtype=jnp.int32) >= page.num_rows)[perm]
+    perm = perm[jnp.argsort(pad.astype(jnp.int32), stable=True)]
+    return perm
+
+
+def new_group_flags(page: Page, fields: Sequence[int],
+                    perm: jnp.ndarray) -> jnp.ndarray:
+    """After sorting by `fields`, True where a row starts a new group
+    (row 0 is always a start). Null == null for grouping."""
+    cap = page.capacity
+    flags = jnp.zeros((cap,), dtype=bool).at[0].set(True)
+    for f in fields:
+        col = page.columns[f]
+        v = group_values(col)[perm]
+        n = col.nulls[perm]
+        prev_v = jnp.roll(v, 1)
+        prev_n = jnp.roll(n, 1)
+        same = ((v == prev_v) & ~n & ~prev_n) | (n & prev_n)
+        flags = flags | ~same
+    return flags.at[0].set(True)
+
+
+# -- hashing ---------------------------------------------------------------
+
+_SPLITMIX_C1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_SPLITMIX_C2 = jnp.uint64(0x94D049BB133111EB)
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    x = (x ^ (x >> jnp.uint64(30))) * _SPLITMIX_C1
+    x = (x ^ (x >> jnp.uint64(27))) * _SPLITMIX_C2
+    return x ^ (x >> jnp.uint64(31))
+
+
+def hash_columns(cols: Sequence[Column]) -> jnp.ndarray:
+    """Combined 64-bit hash of the key columns per row (splitmix64 mixing).
+    NULL hashes to a fixed tag so null==null grouping/partitioning works;
+    join ops must still exclude null keys explicitly (SQL: null != null).
+
+    The reference role: InterpretedHashGenerator / HashGenerationOptimizer's
+    precomputed $hash channel."""
+    h = jnp.zeros((cols[0].capacity,), dtype=jnp.uint64)
+    for c in cols:
+        v = group_values(c).astype(jnp.uint64)
+        v = jnp.where(c.nulls, jnp.uint64(0x5BD1E995), v)
+        h = _mix64(h ^ (v + _GOLDEN + (h << jnp.uint64(6))
+                        + (h >> jnp.uint64(2))))
+    return h.astype(jnp.int64) & jnp.int64(0x7FFFFFFFFFFFFFFF)

@@ -1,0 +1,33 @@
+"""ORDER BY / TopN / Limit.
+
+Reference roles: OrderByOperator (PagesIndex sort), TopNOperator
+(presto-main-base/.../operator/TopNOperator.java:32), LimitOperator.
+TPU-first: one fused multi-key argsort (ops/keys.py) + gather; TopN is the
+same sort with a clamped row count (XLA's sort is already O(n log n)
+vectorized; a separate heap structure would be slower on this hardware).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Page
+from presto_tpu.ops.keys import SortKey, sort_perm
+
+
+def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
+    perm = sort_perm(page, keys)
+    valid = jnp.arange(page.capacity, dtype=jnp.int32) < page.num_rows
+    cols = tuple(c.gather(perm, valid) for c in page.columns)
+    return Page(cols, page.num_rows, page.names)
+
+
+def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:
+    out = sort_page(page, keys)
+    return Page(out.columns, jnp.minimum(out.num_rows, n), out.names)
+
+
+def limit_page(page: Page, n: int) -> Page:
+    return Page(page.columns, jnp.minimum(page.num_rows, n), page.names)

@@ -1,0 +1,10 @@
+from presto_tpu.plan.nodes import (
+    PlanNode, TableScanNode, FilterNode, ProjectNode, AggregationNode,
+    JoinNode, SortNode, TopNNode, LimitNode, OutputNode, ValuesNode,
+    ExchangeNode, Step, JoinType, Partitioning,
+)
+
+__all__ = ["PlanNode", "TableScanNode", "FilterNode", "ProjectNode",
+           "AggregationNode", "JoinNode", "SortNode", "TopNNode",
+           "LimitNode", "OutputNode", "ValuesNode", "ExchangeNode", "Step",
+           "JoinType", "Partitioning"]

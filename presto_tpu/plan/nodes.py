@@ -1,0 +1,197 @@
+"""Logical/physical plan IR.
+
+Re-design of the reference's serialized plan-node surface — the PlanNode
+classes under presto-spi/src/main/java/com/facebook/presto/spi/plan/
+(TableScanNode, FilterNode, ProjectNode, AggregationNode, JoinNode,
+SortNode, TopNNode, LimitNode, ValuesNode, ...) plus the engine-side
+ExchangeNode/OutputNode (presto-main-base/.../sql/planner/plan/). Variable
+references are positional InputRefs into the single child's output row
+(children are ordered; join output = probe fields ++ build fields),
+which is what a vectorized columnar executor wants — no symbol maps at
+execution time.
+
+Every node carries `output_types`; `output_names` exist for analysis and
+result headers only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Sequence, Tuple
+
+from presto_tpu.expr.nodes import RowExpression
+from presto_tpu.ops.aggregate import AggSpec
+from presto_tpu.ops.keys import SortKey
+from presto_tpu.types import Type
+
+
+class Step(enum.Enum):
+    SINGLE = "single"
+    PARTIAL = "partial"
+    FINAL = "final"
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    SEMI = "semi"
+    ANTI = "anti"
+
+
+class Partitioning(enum.Enum):
+    """Reference: SystemPartitioningHandle kinds (SURVEY.md §2.5)."""
+    SINGLE = "single"
+    HASH = "hash"
+    BROADCAST = "broadcast"
+    SOURCE = "source"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanNode:
+    output_names: Tuple[str, ...]
+    output_types: Tuple[Type, ...]
+
+    def children(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+    @property
+    def arity(self) -> int:
+        return len(self.output_types)
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScanNode(PlanNode):
+    table: str
+    columns: Tuple[str, ...]   # pruned source columns, in output order
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesNode(PlanNode):
+    rows: Tuple[tuple, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterNode(PlanNode):
+    source: PlanNode = None
+    predicate: RowExpression = None
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectNode(PlanNode):
+    source: PlanNode = None
+    expressions: Tuple[RowExpression, ...] = ()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregationNode(PlanNode):
+    source: PlanNode = None
+    group_fields: Tuple[int, ...] = ()
+    aggs: Tuple[AggSpec, ...] = ()
+    step: Step = Step.SINGLE
+    group_count_hint: int = 0   # 0 = unknown; executor buckets/retries
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinNode(PlanNode):
+    probe: PlanNode = None
+    build: PlanNode = None
+    join_type: JoinType = JoinType.INNER
+    probe_keys: Tuple[int, ...] = ()
+    build_keys: Tuple[int, ...] = ()
+    # residual non-equi condition evaluated over joined rows
+    filter: Optional[RowExpression] = None
+    fanout_hint: float = 1.0    # expected |out| / |probe|
+
+    def children(self):
+        return (self.probe, self.build)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortNode(PlanNode):
+    source: PlanNode = None
+    keys: Tuple[SortKey, ...] = ()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopNNode(PlanNode):
+    source: PlanNode = None
+    keys: Tuple[SortKey, ...] = ()
+    count: int = 0
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class LimitNode(PlanNode):
+    source: PlanNode = None
+    count: int = 0
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeNode(PlanNode):
+    """Repartition boundary. In a fragmented distributed plan this is where
+    the fragmenter cuts (reference: PlanFragmenter.java:48 cutting at remote
+    ExchangeNodes; AddExchanges inserts them). keys index into the child
+    output."""
+    source: PlanNode = None
+    partitioning: Partitioning = Partitioning.SINGLE
+    keys: Tuple[int, ...] = ()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputNode(PlanNode):
+    source: PlanNode = None
+
+    def children(self):
+        return (self.source,)
+
+
+def explain(node: PlanNode, indent: int = 0) -> str:
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, TableScanNode):
+        detail = f" {node.table}{list(node.columns)}"
+    elif isinstance(node, FilterNode):
+        detail = f" {node.predicate}"
+    elif isinstance(node, ProjectNode):
+        detail = " [" + ", ".join(str(e) for e in node.expressions) + "]"
+    elif isinstance(node, AggregationNode):
+        detail = f" keys={list(node.group_fields)} " \
+                 f"aggs={[(a.kind, a.field) for a in node.aggs]} " \
+                 f"step={node.step.value}"
+    elif isinstance(node, JoinNode):
+        detail = f" {node.join_type.value} " \
+                 f"probe{list(node.probe_keys)}=build{list(node.build_keys)}"
+    elif isinstance(node, (SortNode, TopNNode)):
+        detail = f" {[(k.field, 'asc' if k.ascending else 'desc') for k in node.keys]}"
+        if isinstance(node, TopNNode):
+            detail += f" n={node.count}"
+    elif isinstance(node, LimitNode):
+        detail = f" n={node.count}"
+    elif isinstance(node, ExchangeNode):
+        detail = f" {node.partitioning.value} keys={list(node.keys)}"
+    out = f"{pad}{name}{detail}\n"
+    for c in node.children():
+        out += explain(c, indent + 1)
+    return out

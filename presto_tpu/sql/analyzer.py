@@ -1,0 +1,943 @@
+"""Analyzer + logical planner: AST -> typed plan tree.
+
+Reference roles, collapsed into one pass over a much smaller SQL surface:
+ - StatementAnalyzer (presto-main-base/.../sql/analyzer/StatementAnalyzer.java:397)
+   — scopes, name resolution, type checking, aggregation analysis;
+ - SqlToRowExpressionTranslator (.../sql/relational/) — AST expr -> typed
+   RowExpression with coercions;
+ - LogicalPlanner / QueryPlanner / RelationPlanner
+   (.../sql/planner/LogicalPlanner.java:158) — relation tree -> PlanNodes;
+ - a slice of the optimizer that matters for a columnar TPU engine:
+   predicate pushdown to scans, column pruning, equi-join extraction with a
+   greedy size-ordered left-deep join tree (cost model = connector row
+   counts), IN-subquery -> semi join rewrite
+   (.../optimizations/PredicatePushDown.java, AddExchanges.java,
+   TransformUncorrelatedInPredicateSubqueryToSemiJoin rule).
+
+Output plans use positional InputRefs (plan/nodes.py); scalar subqueries
+appear as expr.Subquery placeholders the executor pre-evaluates
+(uncorrelated only — the reference's correlated decorrelation rules are
+future work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from presto_tpu.expr.compile import days_from_civil
+from presto_tpu.expr.nodes import (
+    Call, Form, InputRef, Literal, RowExpression, SpecialForm,
+)
+from presto_tpu.ops.aggregate import AggSpec
+from presto_tpu.ops.keys import SortKey
+from presto_tpu.plan.nodes import (
+    AggregationNode, FilterNode, JoinNode, JoinType, LimitNode, OutputNode,
+    PlanNode, ProjectNode, SortNode, Step, TableScanNode, TopNNode,
+)
+from presto_tpu.sql import ast
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, VARCHAR, DecimalType,
+    Type, common_super_type, parse_type,
+)
+
+
+class AnalysisError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Subquery(RowExpression):
+    """Scalar subquery placeholder — executor evaluates plan, substitutes a
+    Literal (must yield exactly one row/column; reference:
+    EnforceSingleRowOperator)."""
+    plan: PlanNode
+    type: Type
+
+    def __str__(self):
+        return f"subquery:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    type: Type
+    qualifier: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RelationPlan:
+    node: PlanNode
+    fields: Tuple[Field, ...]
+    est_rows: float
+
+
+_AGG_FUNCS = {"sum", "avg", "count", "min", "max", "bool_or", "bool_and"}
+
+_SCALAR_FUNCS = {"substr", "length", "lower", "upper", "trim", "ltrim",
+                 "rtrim", "abs", "sqrt", "ln", "log10", "exp", "floor",
+                 "ceil", "ceiling", "round", "year", "month", "day",
+                 "concat", "negate", "like"}
+
+
+def _conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinaryOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _expr_idents(e) -> Set[Tuple[str, ...]]:
+    out: Set[Tuple[str, ...]] = set()
+
+    def walk(x):
+        if isinstance(x, ast.Ident):
+            out.add(x.parts)
+        elif dataclasses.is_dataclass(x):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+    walk(e)
+    return out
+
+
+class Planner:
+    """Plans one Select (recursively for subqueries) against a catalog.
+
+    catalog must provide: schema(table) -> [(name, Type)...] and
+    row_count(table) -> int."""
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # ================================================================ FROM
+    def plan_query(self, q: ast.Select) -> PlanNode:
+        rp = self._plan_select(q)
+        return OutputNode(tuple(f.name for f in rp.fields),
+                          tuple(f.type for f in rp.fields), rp.node)
+
+    def _plan_select(self, q: ast.Select) -> RelationPlan:
+        where_conjuncts = _conjuncts(q.where)
+
+        if q.relations:
+            rp = self._plan_from(list(q.relations), where_conjuncts, q)
+        else:
+            # SELECT without FROM: single-row relation with a dummy column
+            # so downstream pages keep a nonzero capacity
+            from presto_tpu.plan.nodes import ValuesNode
+            rp = RelationPlan(ValuesNode(("_dummy",), (BIGINT,), ((0,),)),
+                              (), 1)
+
+        has_aggs = self._query_has_aggregates(q)
+        if has_aggs or q.group_by:
+            rp = self._plan_aggregation(q, rp)
+        else:
+            rp = self._plan_plain_select(q, rp)
+
+        if q.distinct:
+            node = AggregationNode(
+                tuple(f.name for f in rp.fields),
+                tuple(f.type for f in rp.fields), rp.node,
+                tuple(range(len(rp.fields))), (), Step.SINGLE)
+            rp = RelationPlan(node, rp.fields, rp.est_rows)
+
+        rp = self._plan_order_limit(q, rp)
+        return rp
+
+    def _plan_from(self, relations: List[ast.Relation],
+                   conjuncts: List[ast.Expr], q: ast.Select) -> RelationPlan:
+        # classify conjuncts: single-relation -> pushdown filter;
+        # two-relation equi -> join condition; else residual.
+        plans = [self._plan_relation(r, q) for r in relations]
+        aliases = [self._relation_aliases(p) for p in plans]
+
+        def refs_of(c) -> Set[int]:
+            idents = _expr_idents(c)
+            out = set()
+            for parts in idents:
+                for i, als in enumerate(aliases):
+                    if self._ident_resolves(parts, plans[i].fields):
+                        out.add(i)
+            return out
+
+        residual: List[ast.Expr] = []
+        pushed: Dict[int, List[ast.Expr]] = {i: [] for i in range(len(plans))}
+        join_conds: List[Tuple[Set[int], ast.Expr]] = []
+        semijoins: List[ast.Expr] = []
+        for c in conjuncts:
+            if isinstance(c, (ast.InSubquery, ast.Exists)):
+                semijoins.append(c)
+                continue
+            r = refs_of(c)
+            if len(r) == 1:
+                pushed[next(iter(r))].append(c)
+            elif len(r) >= 2 and self._is_equi(c):
+                join_conds.append((r, c))
+            else:
+                residual.append(c)
+
+        for i, cs in pushed.items():
+            if cs:
+                plans[i] = self._apply_filter(plans[i], cs)
+
+        # greedy left-deep join: start from the largest relation as probe
+        # so builds stay small (reference heuristic: probe the fact table)
+        used = [False] * len(plans)
+        remaining_conds = list(join_conds)
+        start = max(range(len(plans)), key=lambda i: plans[i].est_rows)
+        current = plans[start]
+        current_set = {start}
+        used[start] = True
+
+        while not all(used):
+            # pick an unused relation connected to the current set
+            pick, conds = None, []
+            for i in range(len(plans)):
+                if used[i]:
+                    continue
+                cs = [c for r, c in remaining_conds
+                      if i in r and (r - {i}) <= current_set]
+                if cs:
+                    pick, conds = i, cs
+                    break
+            if pick is None:  # cross join the smallest remaining
+                pick = min((i for i in range(len(plans)) if not used[i]),
+                           key=lambda i: plans[i].est_rows)
+            current = self._join(current, plans[pick], conds)
+            for c in conds:
+                remaining_conds = [rc for rc in remaining_conds
+                                   if rc[1] is not c]
+            current_set.add(pick)
+            used[pick] = True
+
+        # leftover multi-relation conds (cycles) + residual -> filter
+        leftover = [c for _, c in remaining_conds] + residual
+        if leftover:
+            current = self._apply_filter(current, leftover)
+
+        for sq in semijoins:
+            current = self._apply_semijoin(current, sq)
+        return current
+
+    def _relation_aliases(self, rp: RelationPlan) -> Set[str]:
+        return {f.qualifier for f in rp.fields if f.qualifier}
+
+    def _ident_resolves(self, parts: Tuple[str, ...], fields) -> bool:
+        try:
+            self._resolve(parts, fields)
+            return True
+        except AnalysisError:
+            return False
+
+    def _is_equi(self, c) -> bool:
+        return isinstance(c, ast.BinaryOp) and c.op == "eq"
+
+    def _plan_relation(self, r: ast.Relation, q: ast.Select) -> RelationPlan:
+        if isinstance(r, ast.TableRef):
+            schema = self.catalog.schema(r.name)
+            alias = r.alias or r.name
+            used = self._used_columns(q, alias, [c for c, _ in schema])
+            cols = tuple(c for c, _ in schema if c in used) or \
+                (schema[0][0],)
+            types = dict(schema)
+            fields = tuple(Field(c, types[c], alias) for c in cols)
+            node = TableScanNode(tuple(cols),
+                                 tuple(types[c] for c in cols),
+                                 r.name, cols)
+            return RelationPlan(node, fields, self.catalog.row_count(r.name))
+        if isinstance(r, ast.SubqueryRef):
+            sub = self._plan_select(r.query)
+            fields = tuple(Field(f.name, f.type, r.alias)
+                           for f in sub.fields)
+            return RelationPlan(sub.node, fields,
+                                max(sub.est_rows / 10.0, 1.0))
+        if isinstance(r, ast.Join):
+            left = self._plan_relation(r.left, q)
+            right = self._plan_relation(r.right, q)
+            if r.kind == "cross":
+                return self._join(left, right, [])
+            conds = _conjuncts(r.on)
+            if r.kind == "inner":
+                # single-side conds push down; rest become join/residual
+                lc = [c for c in conds
+                      if self._only_refs(c, left.fields)]
+                rc = [c for c in conds
+                      if self._only_refs(c, right.fields)]
+                rest = [c for c in conds if c not in lc and c not in rc]
+                if lc:
+                    left = self._apply_filter(left, lc)
+                if rc:
+                    right = self._apply_filter(right, rc)
+                return self._join(left, right, rest)
+            if r.kind in ("left", "right"):
+                if r.kind == "right":
+                    left, right = right, left
+                return self._join(left, right, conds, outer=True,
+                                  preserve_order=(r.kind == "left"))
+            raise AnalysisError(f"join kind {r.kind}")
+        raise AnalysisError(f"relation {r}")
+
+    def _only_refs(self, c, fields) -> bool:
+        return all(self._ident_resolves(p, fields) for p in _expr_idents(c))
+
+    def _used_columns(self, q: ast.Select, alias: str,
+                      cols: List[str]) -> Set[str]:
+        """Column pruning: every identifier anywhere in the query that could
+        refer to this relation."""
+        idents: Set[Tuple[str, ...]] = set()
+
+        def walk_query(s: ast.Select):
+            for it in s.items:
+                if isinstance(it.expr, ast.Star):
+                    idents.update({(c,) for c in cols})
+                else:
+                    idents.update(_expr_idents(it.expr))
+            for e in (s.where, s.having):
+                if e is not None:
+                    idents.update(_expr_idents(e))
+            for e in s.group_by:
+                idents.update(_expr_idents(e))
+            for o in s.order_by:
+                idents.update(_expr_idents(o.expr))
+            for r in s.relations:
+                walk_rel(r)
+
+        def walk_rel(r):
+            if isinstance(r, ast.Join):
+                if r.on is not None:
+                    idents.update(_expr_idents(r.on))
+                walk_rel(r.left)
+                walk_rel(r.right)
+
+        walk_query(q)
+        out = set()
+        for parts in idents:
+            if len(parts) == 1 and parts[0] in cols:
+                out.add(parts[0])
+            elif len(parts) == 2 and parts[0] == alias and parts[1] in cols:
+                out.add(parts[1])
+        return out
+
+    def _apply_filter(self, rp: RelationPlan,
+                      conjuncts: List[ast.Expr]) -> RelationPlan:
+        pred = None
+        for c in conjuncts:
+            e = self.analyze(c, rp.fields)
+            pred = e if pred is None else \
+                SpecialForm(Form.AND, (pred, e), BOOLEAN)
+        node = FilterNode(tuple(f.name for f in rp.fields),
+                          tuple(f.type for f in rp.fields), rp.node, pred)
+        return RelationPlan(node, rp.fields, max(rp.est_rows * 0.3, 1.0))
+
+    def _join(self, probe: RelationPlan, build: RelationPlan,
+              conds: List[ast.Expr], outer: bool = False,
+              preserve_order: bool = True) -> RelationPlan:
+        fields = probe.fields + build.fields
+        pk, bk, residual = [], [], []
+        for c in conds:
+            if self._is_equi(c):
+                l, r = c.left, c.right
+                lp = self._only_refs(l, probe.fields)
+                rp_ = self._only_refs(r, build.fields)
+                if lp and rp_:
+                    pe = self.analyze(l, probe.fields)
+                    be = self.analyze(r, build.fields)
+                elif self._only_refs(r, probe.fields) and \
+                        self._only_refs(l, build.fields):
+                    pe = self.analyze(r, probe.fields)
+                    be = self.analyze(l, build.fields)
+                else:
+                    residual.append(c)
+                    continue
+                pi = self._as_input_field(pe, probe)
+                bi = self._as_input_field(be, build)
+                pk.append(pi)
+                bk.append(bi)
+            else:
+                residual.append(c)
+        probe, pk = self._maybe_project_keys(probe, pk)
+        build, bk = self._maybe_project_keys(build, bk)
+        fields = probe.fields + build.fields
+
+        jt = JoinType.LEFT if outer else JoinType.INNER
+        res_expr = None
+        if residual:
+            for c in residual:
+                e = self.analyze(c, fields)
+                res_expr = e if res_expr is None else \
+                    SpecialForm(Form.AND, (res_expr, e), BOOLEAN)
+        est = probe.est_rows if pk else probe.est_rows * build.est_rows
+        node = JoinNode(tuple(f.name for f in fields),
+                        tuple(f.type for f in fields),
+                        probe.node, build.node, jt, tuple(pk), tuple(bk),
+                        res_expr,
+                        fanout_hint=1.0 if pk else build.est_rows)
+        return RelationPlan(node, fields, max(est, 1.0))
+
+    def _as_input_field(self, e: RowExpression, rp: RelationPlan) -> int:
+        """Join keys must be plain columns on device; project computed keys
+        into the relation first (simplification: only direct InputRefs are
+        zero-cost)."""
+        if isinstance(e, InputRef):
+            return e.field
+        raise AnalysisError(
+            f"computed join keys not yet supported: {e}")
+
+    def _maybe_project_keys(self, rp, keys):
+        return rp, keys
+
+    def _apply_semijoin(self, rp: RelationPlan, c) -> RelationPlan:
+        if isinstance(c, ast.Exists):
+            raise AnalysisError("correlated EXISTS not yet supported")
+        assert isinstance(c, ast.InSubquery)
+        sub = self._plan_select(c.query)
+        if len(sub.fields) != 1:
+            raise AnalysisError("IN subquery must return one column")
+        v = self.analyze(c.value, rp.fields)
+        if not isinstance(v, InputRef):
+            raise AnalysisError("IN subquery over computed value "
+                                "not yet supported")
+        jt = JoinType.ANTI if c.negated else JoinType.SEMI
+        fields = rp.fields
+        node = JoinNode(tuple(f.name for f in fields),
+                        tuple(f.type for f in fields),
+                        rp.node, sub.node, jt, (v.field,), (0,), None)
+        return RelationPlan(node, fields, max(rp.est_rows * 0.5, 1.0))
+
+    # ========================================================== aggregation
+    def _query_has_aggregates(self, q: ast.Select) -> bool:
+        found = False
+
+        def walk(x):
+            nonlocal found
+            if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
+                found = True
+            elif dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
+                for f in dataclasses.fields(x):
+                    walk(getattr(x, f.name))
+            elif isinstance(x, tuple):
+                for i in x:
+                    walk(i)
+        for it in q.items:
+            walk(it.expr)
+        if q.having is not None:
+            walk(q.having)
+        return found
+
+    def _plan_aggregation(self, q: ast.Select, rp: RelationPlan
+                          ) -> RelationPlan:
+        fields = rp.fields
+        # 1. group keys (support ordinals)
+        key_exprs: List[RowExpression] = []
+        key_names: List[str] = []
+        for g in q.group_by:
+            if isinstance(g, ast.NumberLit):
+                item = q.items[int(g.text) - 1]
+                e = self.analyze(item.expr, fields)
+                nm = item.alias or f"_col{int(g.text)-1}"
+            else:
+                e = self.analyze(g, fields)
+                nm = g.parts[-1] if isinstance(g, ast.Ident) else "_key"
+            key_exprs.append(e)
+            key_names.append(nm)
+
+        # 2. aggregate calls from select/having/order
+        agg_calls: List[ast.FuncCall] = []
+
+        def collect(x):
+            if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
+                if x.distinct:
+                    raise AnalysisError(
+                        "DISTINCT aggregates not yet supported")
+                if x not in agg_calls:
+                    agg_calls.append(x)
+                return
+            if dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
+                for f in dataclasses.fields(x):
+                    collect(getattr(x, f.name))
+            elif isinstance(x, tuple):
+                for i in x:
+                    collect(i)
+        for it in q.items:
+            collect(it.expr)
+        if q.having is not None:
+            collect(q.having)
+        for o in q.order_by:
+            collect(o.expr)
+
+        # 3. pre-projection: key exprs ++ deduped agg args
+        pre_exprs: List[RowExpression] = list(key_exprs)
+        arg_pos: Dict[RowExpression, int] = {}
+        agg_specs: List[AggSpec] = []
+        agg_types: List[Type] = []
+        agg_to_output: Dict[ast.FuncCall, int] = {}
+        for call in agg_calls:
+            if call.is_star or not call.args:
+                spec_field = None
+                out_t = BIGINT
+                spec = AggSpec("count_star", None, BIGINT)
+            else:
+                arg = self.analyze(call.args[0], fields)
+                if call.name == "avg" and isinstance(arg.type, DecimalType):
+                    # avg accumulates in double; descale the scaled int64
+                    arg = Call("cast", (arg,), DOUBLE)
+                if arg not in arg_pos:
+                    arg_pos[arg] = len(pre_exprs)
+                    pre_exprs.append(arg)
+                f = arg_pos[arg]
+                kind = call.name
+                if kind == "count":
+                    out_t = BIGINT
+                elif kind == "avg":
+                    out_t = DOUBLE
+                elif kind in ("bool_or", "bool_and"):
+                    out_t = BOOLEAN
+                else:  # sum/min/max keep arg type (sum: int widens to int64)
+                    out_t = arg.type if kind != "sum" or \
+                        not arg.type.is_integer else BIGINT
+                spec = AggSpec(kind, f, out_t)
+            agg_to_output[call] = len(key_exprs) + len(agg_specs)
+            agg_specs.append(spec)
+            agg_types.append(spec.output_type)
+
+        if not pre_exprs:
+            # keyless count(*): carry a constant channel so the page keeps
+            # its capacity/row-count through the projection
+            pre_exprs.append(Literal(1, BIGINT))
+        pre = ProjectNode(tuple(f"_c{i}" for i in range(len(pre_exprs))),
+                          tuple(e.type for e in pre_exprs), rp.node,
+                          tuple(pre_exprs))
+        agg_out_names = tuple(key_names +
+                              [f"_agg{i}" for i in range(len(agg_specs))])
+        agg_out_types = tuple([e.type for e in key_exprs] + agg_types)
+        agg = AggregationNode(agg_out_names, agg_out_types, pre,
+                              tuple(range(len(key_exprs))),
+                              tuple(agg_specs), Step.SINGLE)
+        est = max(rp.est_rows / 100.0, 1.0) if key_exprs else 1.0
+        arp = RelationPlan(agg, tuple(
+            Field(n, t) for n, t in zip(agg_out_names, agg_out_types)), est)
+
+        # 4. post-projection of select items over (keys ++ aggs)
+        rewriter = _AggRewriter(self, fields, key_exprs, agg_to_output,
+                                agg_out_types)
+        out_exprs, out_names = [], []
+        for i, it in enumerate(q.items):
+            e = rewriter.rewrite(it.expr)
+            out_exprs.append(e)
+            out_names.append(it.alias or self._default_name(it.expr, i))
+
+        if q.having is not None:
+            h = rewriter.rewrite(q.having)
+            arp = RelationPlan(
+                FilterNode(agg_out_names, agg_out_types, arp.node, h),
+                arp.fields, arp.est_rows)
+
+        # ORDER BY handled on the post-projection: remember mapping
+        self._order_scope = (rewriter, out_exprs, out_names)
+        post = ProjectNode(tuple(out_names), tuple(e.type for e in out_exprs),
+                           arp.node, tuple(out_exprs))
+        return RelationPlan(post, tuple(
+            Field(n, e.type) for n, e in zip(out_names, out_exprs)),
+            arp.est_rows)
+
+    def _plan_plain_select(self, q: ast.Select, rp: RelationPlan
+                           ) -> RelationPlan:
+        fields = rp.fields
+        out_exprs: List[RowExpression] = []
+        out_names: List[str] = []
+        for i, it in enumerate(q.items):
+            if isinstance(it.expr, ast.Star):
+                for j, f in enumerate(fields):
+                    if it.expr.qualifier in (None, f.qualifier):
+                        out_exprs.append(InputRef(j, f.type))
+                        out_names.append(f.name)
+                continue
+            e = self.analyze(it.expr, fields)
+            out_exprs.append(e)
+            out_names.append(it.alias or self._default_name(it.expr, i))
+        self._order_scope = None
+        self._plain_fields = fields
+        node = ProjectNode(tuple(out_names),
+                           tuple(e.type for e in out_exprs), rp.node,
+                           tuple(out_exprs))
+        return RelationPlan(node, tuple(
+            Field(n, e.type) for n, e in zip(out_names, out_exprs)),
+            rp.est_rows)
+
+    def _default_name(self, e, i: int) -> str:
+        if isinstance(e, ast.Ident):
+            return e.parts[-1]
+        return f"_col{i}"
+
+    # ========================================================= order/limit
+    def _plan_order_limit(self, q: ast.Select, rp: RelationPlan
+                          ) -> RelationPlan:
+        node = rp.node
+        if q.order_by:
+            keys = []
+            for o in q.order_by:
+                idx = self._resolve_order_expr(o.expr, q, rp)
+                keys.append(SortKey(idx, o.ascending, o.nulls_first))
+            if q.limit is not None:
+                node = TopNNode(node.output_names, node.output_types, node,
+                                tuple(keys), q.limit)
+            else:
+                node = SortNode(node.output_names, node.output_types, node,
+                                tuple(keys))
+        elif q.limit is not None:
+            node = LimitNode(node.output_names, node.output_types, node,
+                             q.limit)
+        return RelationPlan(node, rp.fields, rp.est_rows)
+
+    def _resolve_order_expr(self, e: ast.Expr, q: ast.Select,
+                            rp: RelationPlan) -> int:
+        # ordinal
+        if isinstance(e, ast.NumberLit) and "." not in e.text:
+            return int(e.text) - 1
+        # alias match
+        if isinstance(e, ast.Ident) and len(e.parts) == 1:
+            for i, f in enumerate(rp.fields):
+                if f.name == e.parts[0]:
+                    return i
+        # expression match against select items
+        if self._order_scope is not None:
+            rewriter, out_exprs, _names = self._order_scope
+            try:
+                re_ = rewriter.rewrite(e)
+            except AnalysisError:
+                re_ = None
+            if re_ is not None:
+                for i, oe in enumerate(out_exprs):
+                    if oe == re_:
+                        return i
+        raise AnalysisError(f"ORDER BY expression not in select list: {e}")
+
+    # ======================================================== expressions
+    def _resolve(self, parts: Tuple[str, ...], fields) -> Tuple[int, Field]:
+        matches = []
+        for i, f in enumerate(fields):
+            if len(parts) == 1 and f.name == parts[0]:
+                matches.append((i, f))
+            elif len(parts) == 2 and f.qualifier == parts[0] and \
+                    f.name == parts[1]:
+                matches.append((i, f))
+        if not matches:
+            raise AnalysisError(f"column not found: {'.'.join(parts)}")
+        if len(matches) > 1:
+            raise AnalysisError(f"ambiguous column: {'.'.join(parts)}")
+        return matches[0]
+
+    def analyze(self, e: ast.Expr, fields) -> RowExpression:
+        a = lambda x: self.analyze(x, fields)  # noqa: E731
+        if isinstance(e, ast.Ident):
+            i, f = self._resolve(e.parts, fields)
+            return InputRef(i, f.type)
+        if isinstance(e, ast.NumberLit):
+            if "e" in e.text.lower():
+                return Literal(float(e.text), DOUBLE)
+            if "." in e.text:
+                # Presto semantics: exact decimal literal (DECIMAL(p,s)),
+                # so 0.06 + 0.01 == 0.07 exactly — double literals would
+                # silently change BETWEEN bounds (reference:
+                # presto-common/.../type/DecimalType literal typing).
+                from decimal import Decimal as _D
+                d = _D(e.text)
+                scale = max(0, -d.as_tuple().exponent)
+                unscaled = int(d.scaleb(scale))
+                prec = max(len(str(abs(unscaled))), scale + 1)
+                return Literal(unscaled, DecimalType(prec, scale))
+            v = int(e.text)
+            return Literal(v, BIGINT)
+        if isinstance(e, ast.StringLit):
+            return Literal(e.value, VARCHAR)
+        if isinstance(e, ast.DateLit):
+            y, m, d = e.value.split("-")
+            return Literal(days_from_civil(int(y), int(m), int(d)), DATE)
+        if isinstance(e, ast.NullLit):
+            return Literal(None, UNKNOWN)
+        if isinstance(e, ast.IntervalLit):
+            raise AnalysisError("interval literal outside date arithmetic")
+        if isinstance(e, ast.UnaryOp):
+            if e.op == "not":
+                x = a(e.operand)
+                return Call("not", (x,), BOOLEAN)
+            x = a(e.operand)
+            return Call("negate", (x,), x.type)
+        if isinstance(e, ast.BinaryOp):
+            return self._analyze_binary(e, fields)
+        if isinstance(e, ast.Between):
+            v, lo, hi = a(e.value), a(e.low), a(e.high)
+            r = SpecialForm(Form.BETWEEN, (v, lo, hi), BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.InList):
+            v = a(e.value)
+            items = tuple(a(i) for i in e.items)
+            r = SpecialForm(Form.IN, (v,) + items, BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.Like):
+            v = a(e.value)
+            p = a(e.pattern)
+            args = (v, p) if e.escape is None else \
+                (v, p, Literal(e.escape, VARCHAR))
+            r = Call("like", args, BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.IsNull):
+            v = a(e.value)
+            r = SpecialForm(Form.IS_NULL, (v,), BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.Case):
+            return self._analyze_case(e, fields)
+        if isinstance(e, ast.Cast):
+            v = a(e.value)
+            t = parse_type(e.type_name)
+            return Call("cast", (v,), t)
+        if isinstance(e, ast.Extract):
+            v = a(e.value)
+            if e.part not in ("year", "month", "day"):
+                raise AnalysisError(f"extract({e.part}) unsupported")
+            return Call(e.part, (v,), BIGINT)
+        if isinstance(e, ast.ScalarSubquery):
+            sub = self.plan_query(e.query)
+            if len(sub.output_types) != 1:
+                raise AnalysisError("scalar subquery must return one column")
+            return Subquery(sub, sub.output_types[0])
+        if isinstance(e, ast.FuncCall):
+            return self._analyze_func(e, fields)
+        if isinstance(e, (ast.InSubquery, ast.Exists)):
+            raise AnalysisError(
+                "IN/EXISTS subquery only supported as a top-level WHERE "
+                "conjunct")
+        raise AnalysisError(f"unsupported expression {e}")
+
+    def _analyze_binary(self, e: ast.BinaryOp, fields) -> RowExpression:
+        if e.op in ("and", "or"):
+            l = self.analyze(e.left, fields)
+            r = self.analyze(e.right, fields)
+            return SpecialForm(Form.AND if e.op == "and" else Form.OR,
+                               (l, r), BOOLEAN)
+        # date +/- interval (constant-fold or date_add_days)
+        if e.op in ("+", "-") and isinstance(e.right, ast.IntervalLit):
+            l = self.analyze(e.left, fields)
+            iv = e.right
+            n = int(iv.value) * (-1 if e.op == "-" else 1)
+            if isinstance(l, Literal) and l.type == DATE:
+                return Literal(_shift_date(l.value, n, iv.unit), DATE)
+            if iv.unit == "day":
+                return Call("date_add_days", (l, Literal(n, BIGINT)), l.type)
+            raise AnalysisError(
+                f"non-constant date ± interval {iv.unit} unsupported")
+        l = self.analyze(e.left, fields)
+        r = self.analyze(e.right, fields)
+        if e.op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return Call(e.op, (l, r), BOOLEAN)
+        op = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+              "%": "modulus"}[e.op]
+        t = self._arith_type(op, l.type, r.type)
+        return Call(op, (l, r), t)
+
+    def _arith_type(self, op: str, a: Type, b: Type) -> Type:
+        if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+            if a.is_floating or b.is_floating:
+                return DOUBLE
+            da = a if isinstance(a, DecimalType) else DecimalType(18, 0)
+            db = b if isinstance(b, DecimalType) else DecimalType(18, 0)
+            if op == "multiply":
+                return DecimalType(18, min(da.scale + db.scale, 10))
+            if op == "divide":
+                return DOUBLE
+            return DecimalType(18, max(da.scale, db.scale))
+        if a == DATE and b == DATE and op == "subtract":
+            return BIGINT
+        t = common_super_type(a, b)
+        if t is None:
+            raise AnalysisError(f"cannot {op} {a} and {b}")
+        if op == "divide" and t.is_integer:
+            return t
+        return t
+
+    def _analyze_case(self, e: ast.Case, fields) -> RowExpression:
+        whens = []
+        for c, v in e.whens:
+            if e.operand is not None:
+                cond = self.analyze(ast.BinaryOp("eq", e.operand, c), fields)
+            else:
+                cond = self.analyze(c, fields)
+            whens.append((cond, self.analyze(v, fields)))
+        default = self.analyze(e.default, fields) if e.default is not None \
+            else None
+        # result type
+        ts = [v.type for _, v in whens] + \
+            ([default.type] if default is not None else [])
+        rt = ts[0]
+        for t in ts[1:]:
+            c = common_super_type(rt, t)
+            if c is None:
+                raise AnalysisError(f"CASE branches {rt} vs {t}")
+            rt = c
+        out = default if default is not None else Literal(None, rt)
+        if out.type != rt and not (out.type == UNKNOWN):
+            out = Call("cast", (out,), rt)
+        for cond, v in reversed(whens):
+            if v.type != rt:
+                v = Call("cast", (v,), rt)
+            out = SpecialForm(Form.IF, (cond, v, out), rt)
+        return out
+
+    def _analyze_func(self, e: ast.FuncCall, fields) -> RowExpression:
+        if e.name in _AGG_FUNCS:
+            raise AnalysisError(
+                f"aggregate {e.name} not allowed in this context")
+        args = tuple(self.analyze(x, fields) for x in e.args)
+        return self._typed_func(e.name, args)
+
+    def _typed_func(self, name: str,
+                    args: Tuple[RowExpression, ...]) -> RowExpression:
+        """Type a scalar function call over already-analyzed args (shared
+        by the main analyzer and the post-aggregation rewriter)."""
+        if name == "coalesce":
+            rt = args[0].type
+            for x in args[1:]:
+                rt = common_super_type(rt, x.type) or rt
+            return SpecialForm(Form.COALESCE, args, rt)
+        if name in ("substr", "substring"):
+            return Call("substr", args, VARCHAR)
+        name = {"ceiling": "ceil"}.get(name, name)
+        if name in _SCALAR_FUNCS:
+            if name in ("year", "month", "day", "length"):
+                rt = BIGINT
+            elif name in ("lower", "upper", "trim", "ltrim", "rtrim",
+                          "concat"):
+                rt = VARCHAR
+            elif name in ("floor", "ceil", "round") and \
+                    args[0].type.is_integer:
+                rt = args[0].type
+            elif name == "abs":
+                rt = args[0].type
+            else:
+                rt = DOUBLE
+            return Call(name, args, rt)
+        raise AnalysisError(f"unknown function {name}")
+
+
+def _shift_date(days: int, n: int, unit: str) -> int:
+    if unit == "day":
+        return days + n
+    from presto_tpu.expr.compile import _civil_from_days
+    import numpy as np
+    import jax.numpy as jnp
+    y, m, d = _civil_from_days(jnp.asarray([days], dtype=jnp.int32))
+    y, m, d = int(y[0]), int(m[0]), int(d[0])
+    months = n if unit == "month" else 12 * n
+    total = (y * 12 + (m - 1)) + months
+    y2, m2 = divmod(total, 12)
+    return days_from_civil(y2, m2 + 1, d)
+
+
+class _AggRewriter:
+    """Rewrites a post-aggregation expression (select item / having /
+    order-by) into the (group keys ++ agg outputs) space. Aggregate calls
+    and group-key expression matches become InputRefs; any other column
+    reference is a non-grouped-column error (reference:
+    AggregationAnalyzer)."""
+
+    def __init__(self, planner: Planner, src_fields, key_exprs,
+                 agg_to_output, out_types):
+        self.p = planner
+        self.src_fields = src_fields
+        self.key_exprs = list(key_exprs)
+        self.agg_to_output = agg_to_output
+        self.out_types = out_types
+
+    def rewrite(self, e: ast.Expr) -> RowExpression:
+        if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
+            pos = self._find_agg(e)
+            return InputRef(pos, self.out_types[pos])
+        # whole-expression group-key match
+        try:
+            analyzed = self.p.analyze(e, self.src_fields)
+        except AnalysisError:
+            analyzed = None
+        if analyzed is not None:
+            for i, k in enumerate(self.key_exprs):
+                if k == analyzed:
+                    return InputRef(i, k.type)
+        # else recurse structurally
+        if isinstance(e, ast.BinaryOp):
+            l = self.rewrite(e.left)
+            r = self.rewrite(e.right)
+            if e.op in ("and", "or"):
+                return SpecialForm(Form.AND if e.op == "and" else Form.OR,
+                                   (l, r), BOOLEAN)
+            if e.op in ("eq", "ne", "lt", "le", "gt", "ge"):
+                return Call(e.op, (l, r), BOOLEAN)
+            op = {"+": "add", "-": "subtract", "*": "multiply",
+                  "/": "divide", "%": "modulus"}[e.op]
+            t = self.p._arith_type(op, l.type, r.type)
+            return Call(op, (l, r), t)
+        if isinstance(e, ast.UnaryOp):
+            x = self.rewrite(e.operand)
+            if e.op == "not":
+                return Call("not", (x,), BOOLEAN)
+            return Call("negate", (x,), x.type)
+        if isinstance(e, ast.Cast):
+            x = self.rewrite(e.value)
+            return Call("cast", (x,), parse_type(e.type_name))
+        if isinstance(e, ast.FuncCall):  # scalar over aggregates
+            args = tuple(self.rewrite(a) for a in e.args)
+            return self.p._typed_func(e.name, args)
+        if isinstance(e, ast.Case):
+            whens = []
+            for c, v in e.whens:
+                if e.operand is not None:
+                    cond = self.rewrite(ast.BinaryOp("eq", e.operand, c))
+                else:
+                    cond = self.rewrite(c)
+                whens.append((cond, self.rewrite(v)))
+            default = self.rewrite(e.default) if e.default is not None \
+                else None
+            ts = [v.type for _, v in whens] + \
+                ([default.type] if default is not None else [])
+            rt = ts[0]
+            for t in ts[1:]:
+                rt = common_super_type(rt, t) or rt
+            out = default if default is not None else Literal(None, rt)
+            for cond, v in reversed(whens):
+                out = SpecialForm(Form.IF, (cond, v, out), rt)
+            return out
+        if isinstance(e, ast.Between):
+            v, lo, hi = (self.rewrite(x) for x in (e.value, e.low, e.high))
+            r = SpecialForm(Form.BETWEEN, (v, lo, hi), BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.IsNull):
+            r = SpecialForm(Form.IS_NULL, (self.rewrite(e.value),), BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.InList):
+            v = self.rewrite(e.value)
+            items = tuple(self.rewrite(i) for i in e.items)
+            r = SpecialForm(Form.IN, (v,) + items, BOOLEAN)
+            return Call("not", (r,), BOOLEAN) if e.negated else r
+        if isinstance(e, ast.Extract):
+            return Call(e.part, (self.rewrite(e.value),), BIGINT)
+        if isinstance(e, (ast.NumberLit, ast.StringLit, ast.DateLit,
+                          ast.NullLit)):
+            return self.p.analyze(e, ())
+        if isinstance(e, ast.ScalarSubquery):
+            return self.p.analyze(e, ())
+        if analyzed is not None and not _contains_column(analyzed):
+            return analyzed
+        raise AnalysisError(
+            f"expression references non-grouped columns: {e}")
+
+    def _find_agg(self, call: ast.FuncCall) -> int:
+        if call in self.agg_to_output:
+            return self.agg_to_output[call]
+        raise AnalysisError(f"aggregate {call.name} not collected")
+
+
+def _contains_column(e: RowExpression) -> bool:
+    if isinstance(e, InputRef):
+        return True
+    return any(_contains_column(c) for c in e.children())

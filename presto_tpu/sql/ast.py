@@ -1,0 +1,193 @@
+"""SQL AST — untyped parse tree produced by sql/parser.py, consumed by the
+analyzer. Reference role: presto-parser's sql/tree/* node classes (the
+ANTLR-generated AST), scoped to the analytical-SQL subset this engine
+executes (full TPC-H shape: select/joins/group/having/order/limit,
+subqueries in FROM, scalar subqueries, CASE/CAST/EXTRACT, date & interval
+literals)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+
+# ---- expressions ----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Ident:
+    parts: Tuple[str, ...]        # possibly qualified: t.c
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLit:
+    text: str
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLit:
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLit:
+    value: str                    # 'YYYY-MM-DD'
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLit:
+    value: str
+    unit: str                     # day | month | year
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLit:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Star:
+    qualifier: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp:
+    op: str                       # '-', 'not'
+    operand: "Expr"
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp:
+    op: str                       # + - * / % = <> < <= > >= and or
+    left: "Expr"
+    right: "Expr"
+
+
+@dataclasses.dataclass(frozen=True)
+class Between:
+    value: "Expr"
+    low: "Expr"
+    high: "Expr"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList:
+    value: "Expr"
+    items: Tuple["Expr", ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery:
+    value: "Expr"
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists:
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Like:
+    value: "Expr"
+    pattern: "Expr"
+    negated: bool = False
+    escape: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull:
+    value: "Expr"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Case:
+    operand: Optional["Expr"]
+    whens: Tuple[Tuple["Expr", "Expr"], ...]
+    default: Optional["Expr"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast:
+    value: "Expr"
+    type_name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract:
+    part: str                     # year | month | day
+    value: "Expr"
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncCall:
+    name: str
+    args: Tuple["Expr", ...]
+    distinct: bool = False
+    is_star: bool = False         # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery:
+    query: "Select"
+
+
+Expr = Union[Ident, NumberLit, StringLit, DateLit, IntervalLit, NullLit,
+             UnaryOp, BinaryOp, Between, InList, InSubquery, Exists, Like,
+             IsNull, Case, Cast, Extract, FuncCall, ScalarSubquery, Star]
+
+
+# ---- relations ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRef:
+    query: "Select"
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Join:
+    kind: str                     # inner | left | right | cross
+    left: "Relation"
+    right: "Relation"
+    on: Optional[Expr] = None
+
+
+Relation = Union[TableRef, SubqueryRef, Join]
+
+
+# ---- query ----------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderItem:
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Select:
+    items: Tuple[SelectItem, ...]
+    relations: Tuple[Relation, ...]          # comma-list (implicit cross)
+    where: Optional[Expr] = None
+    group_by: Tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    distinct: bool = False

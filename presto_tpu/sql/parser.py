@@ -1,0 +1,469 @@
+"""SQL lexer + recursive-descent parser.
+
+Reference role: presto-parser (ANTLR4 grammar
+presto-parser/src/main/antlr4/.../SqlBase.g4, SqlParser.java:48). This is a
+hand-written recursive-descent/precedence-climbing parser over the
+analytical subset in sql/ast.py — no parser generator dependency, and error
+messages point at token offsets.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from presto_tpu.sql import ast
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=;])
+""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "exists", "between", "like", "escape",
+    "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
+    "date", "interval", "join", "inner", "left", "right", "outer", "cross",
+    "on", "asc", "desc", "nulls", "first", "last", "distinct", "all",
+    "union", "year", "month", "day", "substring", "for", "count",
+}
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind: str, text: str, pos: int):
+        self.kind = kind      # number | string | ident | keyword | op | eof
+        self.text = text
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.text!r})"
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise SyntaxError(f"unexpected character {sql[i]!r} at {i}")
+        i = m.end()
+        if m.lastgroup == "ws":
+            continue
+        text = m.group()
+        kind = m.lastgroup
+        if kind == "ident":
+            if text.startswith('"'):
+                text = text[1:-1].replace('""', '"')
+            elif text.lower() in _KEYWORDS:
+                kind, text = "keyword", text.lower()
+            else:
+                text = text.lower()
+        elif kind == "string":
+            text = text[1:-1].replace("''", "'")
+        out.append(Token(kind, text, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, text: Optional[str] = None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (text is None or t.text == text):
+            return self.next()
+        return None
+
+    def accept_kw(self, *words: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "keyword" and t.text in words:
+            self.next()
+            return t.text
+        return None
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Token:
+        t = self.next()
+        if t.kind != kind or (text is not None and t.text != text):
+            raise SyntaxError(
+                f"expected {text or kind}, got {t.text!r} at {t.pos}: "
+                f"...{self.sql[max(0, t.pos-30):t.pos+10]}...")
+        return t
+
+    def expect_kw(self, word: str) -> None:
+        t = self.next()
+        if t.kind != "keyword" or t.text != word:
+            raise SyntaxError(f"expected {word.upper()}, got {t.text!r} "
+                              f"at {t.pos}")
+
+    # -- entry ------------------------------------------------------------
+    def parse(self) -> ast.Select:
+        q = self.query()
+        self.accept("op", ";")
+        self.expect("eof")
+        return q
+
+    def query(self) -> ast.Select:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        items = [self.select_item()]
+        while self.accept("op", ","):
+            items.append(self.select_item())
+
+        relations: List[ast.Relation] = []
+        if self.accept_kw("from"):
+            relations.append(self.relation())
+            while self.accept("op", ","):
+                relations.append(self.relation())
+
+        where = self.expr() if self.accept_kw("where") else None
+        group_by: Tuple[ast.Expr, ...] = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            g = [self.expr()]
+            while self.accept("op", ","):
+                g.append(self.expr())
+            group_by = tuple(g)
+        having = self.expr() if self.accept_kw("having") else None
+        order_by: Tuple[ast.OrderItem, ...] = ()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            o = [self.order_item()]
+            while self.accept("op", ","):
+                o.append(self.order_item())
+            order_by = tuple(o)
+        limit = None
+        if self.accept_kw("limit"):
+            limit = int(self.expect("number").text)
+        return ast.Select(tuple(items), tuple(relations), where, group_by,
+                          having, order_by, limit, distinct)
+
+    def select_item(self) -> ast.SelectItem:
+        if self.peek().kind == "op" and self.peek().text == "*":
+            self.next()
+            return ast.SelectItem(ast.Star(), None)
+        # qualified star: ident . *
+        if (self.peek().kind == "ident" and self.peek(1).text == "."
+                and self.peek(2).text == "*"):
+            q = self.next().text
+            self.next(); self.next()
+            return ast.SelectItem(ast.Star(q), None)
+        e = self.expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident_text()
+        elif self.peek().kind == "ident":
+            alias = self.ident_text()
+        return ast.SelectItem(e, alias)
+
+    def ident_text(self) -> str:
+        t = self.next()
+        if t.kind not in ("ident", "keyword"):
+            raise SyntaxError(f"expected identifier, got {t.text!r} at {t.pos}")
+        return t.text
+
+    def order_item(self) -> ast.OrderItem:
+        e = self.expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            w = self.accept_kw("first", "last")
+            nulls_first = (w == "first")
+        return ast.OrderItem(e, asc, nulls_first)
+
+    # -- relations --------------------------------------------------------
+    def relation(self) -> ast.Relation:
+        rel = self.relation_primary()
+        while True:
+            kind = None
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                kind = "cross"
+            elif self.accept_kw("inner"):
+                self.expect_kw("join")
+                kind = "inner"
+            elif self.peek().text in ("left", "right") and \
+                    self.peek().kind == "keyword":
+                kind = self.next().text
+                self.accept_kw("outer")
+                self.expect_kw("join")
+            elif self.accept_kw("join"):
+                kind = "inner"
+            if kind is None:
+                return rel
+            right = self.relation_primary()
+            on = None
+            if kind != "cross":
+                self.expect_kw("on")
+                on = self.expr()
+            rel = ast.Join(kind, rel, right, on)
+
+    def relation_primary(self) -> ast.Relation:
+        if self.accept("op", "("):
+            q = self.query()
+            self.expect("op", ")")
+            alias = None
+            if self.accept_kw("as"):
+                alias = self.ident_text()
+            elif self.peek().kind == "ident":
+                alias = self.ident_text()
+            return ast.SubqueryRef(q, alias)
+        name = self.ident_text()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident_text()
+        elif self.peek().kind == "ident":
+            alias = self.ident_text()
+        return ast.TableRef(name, alias)
+
+    # -- expressions (precedence climbing) --------------------------------
+    def expr(self) -> ast.Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Expr:
+        e = self.and_expr()
+        while self.accept_kw("or"):
+            e = ast.BinaryOp("or", e, self.and_expr())
+        return e
+
+    def and_expr(self) -> ast.Expr:
+        e = self.not_expr()
+        while self.accept_kw("and"):
+            e = ast.BinaryOp("and", e, self.not_expr())
+        return e
+
+    def not_expr(self) -> ast.Expr:
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> ast.Expr:
+        if self.peek().kind == "keyword" and self.peek().text == "exists":
+            self.next()
+            self.expect("op", "(")
+            q = self.query()
+            self.expect("op", ")")
+            return ast.Exists(q)
+        e = self.additive()
+        while True:
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                lo = self.additive()
+                self.expect_kw("and")
+                hi = self.additive()
+                e = ast.Between(e, lo, hi, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect("op", "(")
+                if self.peek().kind == "keyword" and \
+                        self.peek().text == "select":
+                    q = self.query()
+                    self.expect("op", ")")
+                    e = ast.InSubquery(e, q, negated)
+                else:
+                    items = [self.expr()]
+                    while self.accept("op", ","):
+                        items.append(self.expr())
+                    self.expect("op", ")")
+                    e = ast.InList(e, tuple(items), negated)
+                continue
+            if self.accept_kw("like"):
+                pat = self.additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self.expect("string").text
+                e = ast.Like(e, pat, negated, escape)
+                continue
+            if negated:
+                self.i = save
+                break
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                e = ast.IsNull(e, neg)
+                continue
+            t = self.peek()
+            if t.kind == "op" and t.text in ("=", "<>", "!=", "<", "<=",
+                                             ">", ">="):
+                self.next()
+                op = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+                      "<=": "le", ">": "gt", ">=": "ge"}[t.text]
+                rhs = self.additive()
+                e = ast.BinaryOp(op, e, rhs)
+                continue
+            break
+        return e
+
+    def additive(self) -> ast.Expr:
+        e = self.multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in ("+", "-"):
+                self.next()
+                e = ast.BinaryOp(t.text, e, self.multiplicative())
+            elif t.kind == "op" and t.text == "||":
+                self.next()
+                e = ast.FuncCall("concat", (e, self.multiplicative()))
+            else:
+                return e
+
+    def multiplicative(self) -> ast.Expr:
+        e = self.unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in ("*", "/", "%"):
+                self.next()
+                e = ast.BinaryOp(t.text, e, self.unary())
+            else:
+                return e
+
+    def unary(self) -> ast.Expr:
+        if self.accept("op", "-"):
+            return ast.UnaryOp("-", self.unary())
+        if self.accept("op", "+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return ast.NumberLit(t.text)
+        if t.kind == "string":
+            self.next()
+            return ast.StringLit(t.text)
+        if t.kind == "op" and t.text == "(":
+            self.next()
+            if self.peek().kind == "keyword" and self.peek().text == "select":
+                q = self.query()
+                self.expect("op", ")")
+                return ast.ScalarSubquery(q)
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if t.kind == "keyword":
+            if t.text == "null":
+                self.next()
+                return ast.NullLit()
+            if t.text == "date":
+                self.next()
+                s = self.expect("string")
+                return ast.DateLit(s.text)
+            if t.text == "interval":
+                self.next()
+                v = self.expect("string").text
+                unit = self.ident_text().rstrip("s")
+                return ast.IntervalLit(v, unit)
+            if t.text == "case":
+                return self.case_expr()
+            if t.text == "cast":
+                self.next()
+                self.expect("op", "(")
+                e = self.expr()
+                self.expect_kw("as")
+                tn = self.type_name()
+                self.expect("op", ")")
+                return ast.Cast(e, tn)
+            if t.text == "extract":
+                self.next()
+                self.expect("op", "(")
+                part = self.ident_text()
+                self.expect_kw("from")
+                e = self.expr()
+                self.expect("op", ")")
+                return ast.Extract(part, e)
+            if t.text == "substring":
+                self.next()
+                self.expect("op", "(")
+                e = self.expr()
+                if self.accept_kw("from"):
+                    start = self.expr()
+                    length = self.expr() if self.accept_kw("for") else None
+                else:
+                    self.expect("op", ",")
+                    start = self.expr()
+                    length = self.expr() if self.accept("op", ",") else None
+                self.expect("op", ")")
+                args = (e, start) + ((length,) if length else ())
+                return ast.FuncCall("substr", args)
+            if t.text == "count":
+                self.next()
+                self.expect("op", "(")
+                if self.accept("op", "*"):
+                    self.expect("op", ")")
+                    return ast.FuncCall("count", (), is_star=True)
+                distinct = bool(self.accept_kw("distinct"))
+                arg = self.expr()
+                self.expect("op", ")")
+                return ast.FuncCall("count", (arg,), distinct=distinct)
+        if t.kind in ("ident", "keyword"):
+            name = self.ident_text()
+            if self.peek().kind == "op" and self.peek().text == "(":
+                self.next()
+                if self.accept("op", ")"):
+                    return ast.FuncCall(name, ())
+                distinct = bool(self.accept_kw("distinct"))
+                args = [self.expr()]
+                while self.accept("op", ","):
+                    args.append(self.expr())
+                self.expect("op", ")")
+                return ast.FuncCall(name, tuple(args), distinct=distinct)
+            parts = [name]
+            while self.peek().text == "." and self.peek().kind == "op":
+                self.next()
+                parts.append(self.ident_text())
+            return ast.Ident(tuple(parts))
+        raise SyntaxError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def case_expr(self) -> ast.Expr:
+        self.expect_kw("case")
+        operand = None
+        if not (self.peek().kind == "keyword" and self.peek().text == "when"):
+            operand = self.expr()
+        whens = []
+        while self.accept_kw("when"):
+            c = self.expr()
+            self.expect_kw("then")
+            v = self.expr()
+            whens.append((c, v))
+        default = self.expr() if self.accept_kw("else") else None
+        self.expect_kw("end")
+        return ast.Case(operand, tuple(whens), default)
+
+    def type_name(self) -> str:
+        name = self.ident_text()
+        if self.accept("op", "("):
+            args = [self.expect("number").text]
+            while self.accept("op", ","):
+                args.append(self.expect("number").text)
+            self.expect("op", ")")
+            return f"{name}({','.join(args)})"
+        return name
+
+
+def parse_sql(sql: str) -> ast.Select:
+    return Parser(sql).parse()

@@ -1,0 +1,167 @@
+"""End-to-end SQL tests: real TPC-H queries vs a pandas oracle over the same
+generated data (the reference's H2QueryRunner strategy)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.expr.compile import days_from_civil
+from tests.oracle import assert_rows_match, table_df
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LocalEngine(TpchConnector(SF))
+
+
+@pytest.fixture(scope="module")
+def dfs():
+    c = TpchConnector(SF)
+    return {t: table_df(c, t) for t in
+            ["lineitem", "orders", "customer", "nation", "region",
+             "supplier", "part", "partsupp"]}
+
+
+Q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+def test_q1(engine, dfs):
+    rows = engine.execute_sql(Q1)
+    li = dfs["lineitem"]
+    cut = days_from_civil(1998, 12, 1) - 90
+    f = li[li.l_shipdate <= cut]
+    g = f.groupby(["l_returnflag", "l_linestatus"], sort=True)
+    exp = []
+    for (rf, ls), grp in g:
+        disc_price = grp.l_extendedprice * (1 - grp.l_discount)
+        exp.append((
+            rf, ls, grp.l_quantity.sum(), grp.l_extendedprice.sum(),
+            disc_price.sum(), (disc_price * (1 + grp.l_tax)).sum(),
+            grp.l_quantity.mean(), grp.l_extendedprice.mean(),
+            grp.l_discount.mean(), len(grp)))
+    assert_rows_match(rows, exp, float_tol=1e-9)
+
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.06 - 0.01 and 0.06 + 0.01
+  and l_quantity < 24
+"""
+
+
+def test_q6(engine, dfs):
+    rows = engine.execute_sql(Q6)
+    li = dfs["lineitem"]
+    lo = days_from_civil(1994, 1, 1)
+    hi = days_from_civil(1995, 1, 1)
+    f = li[(li.l_shipdate >= lo) & (li.l_shipdate < hi)
+           & (li.l_discount >= 0.05 - 1e-12) & (li.l_discount <= 0.07 + 1e-12)
+           & (li.l_quantity < 24)]
+    exp = [((f.l_extendedprice * f.l_discount).sum(),)]
+    assert_rows_match(rows, exp, float_tol=1e-9)
+
+
+Q3 = """
+select
+    l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+
+def test_q3(engine, dfs):
+    rows = engine.execute_sql(Q3)
+    cut = days_from_civil(1995, 3, 15)
+    c = dfs["customer"]
+    o = dfs["orders"]
+    li = dfs["lineitem"]
+    c = c[c.c_mktsegment == "BUILDING"]
+    o = o[o.o_orderdate < cut]
+    li = li[li.l_shipdate > cut]
+    j = li.merge(o, left_on="l_orderkey", right_on="o_orderkey").merge(
+        c, left_on="o_custkey", right_on="c_custkey")
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                  as_index=False).rev.sum()
+    g = g.sort_values(["rev", "o_orderdate"],
+                      ascending=[False, True]).head(10)
+    exp = [(int(r.l_orderkey), r.rev, int(r.o_orderdate),
+            int(r.o_shippriority)) for r in g.itertuples()]
+    assert_rows_match(rows, exp, float_tol=1e-9)
+
+
+def test_simple_select_projection(engine, dfs):
+    rows = engine.execute_sql(
+        "select n_name, n_regionkey + 100 from nation "
+        "where n_regionkey = 2 order by n_name")
+    n = dfs["nation"]
+    exp = [(r.n_name, int(r.n_regionkey) + 100)
+           for r in n[n.n_regionkey == 2].sort_values("n_name").itertuples()]
+    assert_rows_match(rows, exp)
+
+
+def test_explicit_join_syntax(engine, dfs):
+    rows = engine.execute_sql(
+        "select n_name, r_name from nation "
+        "join region on n_regionkey = r_regionkey "
+        "where r_name = 'ASIA' order by n_name")
+    n, r = dfs["nation"], dfs["region"]
+    j = n.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    j = j[j.r_name == "ASIA"].sort_values("n_name")
+    exp = [(x.n_name, x.r_name) for x in j.itertuples()]
+    assert_rows_match(rows, exp)
+
+
+def test_count_distinct_groups(engine, dfs):
+    rows = engine.execute_sql(
+        "select count(*) from (select distinct l_orderkey from lineitem)")
+    li = dfs["lineitem"]
+    assert rows == [(li.l_orderkey.nunique(),)]
+
+
+def test_scalar_subquery(engine, dfs):
+    rows = engine.execute_sql(
+        "select count(*) from part "
+        "where p_retailprice > (select avg(p_retailprice) from part)")
+    p = dfs["part"]
+    assert rows == [(int((p.p_retailprice > p.p_retailprice.mean()).sum()),)]
+
+
+def test_in_subquery_semijoin(engine, dfs):
+    rows = engine.execute_sql(
+        "select count(*) from orders where o_custkey in "
+        "(select c_custkey from customer where c_mktsegment = 'BUILDING')")
+    c, o = dfs["customer"], dfs["orders"]
+    keys = set(c[c.c_mktsegment == "BUILDING"].c_custkey)
+    assert rows == [(int(o.o_custkey.isin(keys).sum()),)]

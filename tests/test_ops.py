@@ -1,0 +1,143 @@
+import numpy as np
+import pytest
+
+from presto_tpu import BIGINT, DOUBLE, VARCHAR
+from presto_tpu.data.column import Page
+from presto_tpu.ops import (
+    AggSpec, SortKey, grouped_aggregate, hash_join, limit_page, sort_page,
+    top_n,
+)
+
+
+def _page(data, types):
+    return Page.from_pydict(data, types)
+
+
+# ---------------------------------------------------------------- aggregate
+
+def test_grouped_sum_count():
+    p = _page({"k": ["a", "b", "a", "a", "b"],
+               "v": [1.0, 2.0, 3.0, None, 5.0]},
+              {"k": VARCHAR, "v": DOUBLE})
+    out, _ = grouped_aggregate(p, [0], [
+        AggSpec("sum", 1, DOUBLE),
+        AggSpec("count", 1, BIGINT),
+        AggSpec("count_star", None, BIGINT),
+        AggSpec("avg", 1, DOUBLE),
+    ], out_capacity=256)
+    rows = sorted(out.to_pylist())
+    assert rows == [("a", 4.0, 2, 3, 2.0), ("b", 7.0, 2, 2, 3.5)]
+
+
+def test_group_null_key_is_its_own_group():
+    p = _page({"k": [1, None, 1, None], "v": [10, 20, 30, 40]},
+              {"k": BIGINT, "v": BIGINT})
+    out, _ = grouped_aggregate(p, [0], [AggSpec("sum", 1, BIGINT)],
+                            out_capacity=256)
+    rows = sorted(out.to_pylist(), key=lambda r: (r[0] is None, r[0]))
+    assert rows == [(1, 40), (None, 60)]
+
+
+def test_global_agg_empty_input():
+    p = _page({"v": []}, {"v": BIGINT})
+    out, _ = grouped_aggregate(p, [], [
+        AggSpec("count_star", None, BIGINT), AggSpec("sum", 0, BIGINT)])
+    assert out.to_pylist() == [(0, None)]
+
+
+def test_min_max_strings():
+    p = _page({"k": [1, 1, 2], "s": ["pear", "apple", "fig"]},
+              {"k": BIGINT, "s": VARCHAR})
+    out, _ = grouped_aggregate(p, [0], [
+        AggSpec("min", 1, VARCHAR), AggSpec("max", 1, VARCHAR)],
+        out_capacity=256)
+    assert sorted(out.to_pylist()) == [(1, "apple", "pear"), (2, "fig", "fig")]
+
+
+def test_partial_final_avg_roundtrip():
+    p = _page({"k": [1, 1, 2], "v": [1.0, 2.0, 9.0]},
+              {"k": BIGINT, "v": DOUBLE})
+    part, _ = grouped_aggregate(p, [0], [AggSpec("avg_partial", 1, DOUBLE)],
+                             out_capacity=256)
+    # partial page: k, sum, count
+    fin, _ = grouped_aggregate(part, [0], [AggSpec("avg_final", 1, DOUBLE,
+                                                field2=2)],
+                            out_capacity=256)
+    assert sorted(fin.to_pylist()) == [(1, 1.5), (2, 9.0)]
+
+
+# ---------------------------------------------------------------- sort/topn
+
+def test_sort_multi_key_null_ordering():
+    p = _page({"a": [2, 1, 2, None, 1], "b": [1, 9, 0, 5, 8]},
+              {"a": BIGINT, "b": BIGINT})
+    out = sort_page(p, [SortKey(0, ascending=True), SortKey(1, False)])
+    # ASC nulls last on a; within a, b DESC
+    assert out.to_pylist() == [(1, 9), (1, 8), (2, 1), (2, 0), (None, 5)]
+
+
+def test_sort_desc_nulls_first():
+    p = _page({"a": [2, None, 1]}, {"a": BIGINT})
+    out = sort_page(p, [SortKey(0, ascending=False)])
+    assert out.to_pylist() == [(None,), (2,), (1,)]
+
+
+def test_topn_and_limit():
+    p = _page({"a": [5, 3, 9, 1]}, {"a": BIGINT})
+    out = top_n(p, [SortKey(0)], 2)
+    assert out.to_pylist() == [(1,), (3,)]
+    assert limit_page(p, 3).to_pylist()[:3] == [(5,), (3,), (9,)]
+
+
+# ---------------------------------------------------------------- joins
+
+def test_inner_join_duplicates():
+    probe = _page({"k": [1, 2, 2, 3], "pv": [10, 20, 21, 30]},
+                  {"k": BIGINT, "pv": BIGINT})
+    build = _page({"bk": [2, 2, 3, 4], "bv": [200, 201, 300, 400]},
+                  {"bk": BIGINT, "bv": BIGINT})
+    out, total = hash_join(probe, build, [0], [0], out_capacity=256)
+    rows = sorted(out.to_pylist())
+    assert rows == [(2, 20, 2, 200), (2, 20, 2, 201),
+                    (2, 21, 2, 200), (2, 21, 2, 201),
+                    (3, 30, 3, 300)]
+    assert int(total) == 5
+
+
+def test_left_join_nulls_and_misses():
+    probe = _page({"k": [1, None, 3], "pv": [10, 20, 30]},
+                  {"k": BIGINT, "pv": BIGINT})
+    build = _page({"bk": [3], "bv": [300]}, {"bk": BIGINT, "bv": BIGINT})
+    out, _ = hash_join(probe, build, [0], [0], out_capacity=256,
+                       join_type="left")
+    rows = sorted(out.to_pylist(), key=lambda r: r[1])
+    assert rows == [(1, 10, None, None), (None, 20, None, None),
+                    (3, 30, 3, 300)]
+
+
+def test_semi_and_anti_join():
+    probe = _page({"k": [1, 2, None, 3]}, {"k": BIGINT})
+    build = _page({"bk": [2, 2, 3]}, {"bk": BIGINT})
+    semi, _ = hash_join(probe, build, [0], [0], 256, join_type="semi")
+    v, n = semi.columns[-1].to_numpy(4)
+    assert list(v) == [False, True, False, True]
+    anti, _ = hash_join(probe, build, [0], [0], 256, join_type="anti")
+    v, n = anti.columns[-1].to_numpy(4)
+    # SQL NOT IN semantics with null key: row with null key is NOT matched
+    # by anti (null != anything is unknown) -> anti excludes null-key rows
+    assert list(v) == [True, False, False, False]
+
+
+def test_join_string_keys_cross_dictionary():
+    probe = _page({"k": ["x", "y", "z"]}, {"k": VARCHAR})
+    build = _page({"bk": ["y", "w"], "bv": [7, 8]},
+                  {"bk": VARCHAR, "bv": BIGINT})
+    out, _ = hash_join(probe, build, [0], [0], 256)
+    assert out.to_pylist() == [("y", "y", 7)]
+
+
+def test_join_overflow_detection():
+    probe = _page({"k": [1] * 10}, {"k": BIGINT})
+    build = _page({"bk": [1] * 10}, {"bk": BIGINT})
+    out, total = hash_join(probe, build, [0], [0], out_capacity=64)
+    assert int(total) == 100  # 100 pairs > 64 capacity -> host must retry

@@ -1,0 +1,63 @@
+"""Regressions for bugs found in code review (round 1)."""
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LocalEngine(TpchConnector(0.001))
+
+
+def test_select_without_from(engine):
+    assert engine.execute_sql("select 1") == [(1,)]
+    assert engine.execute_sql("select 1 + 2, 'x'") == [(3, "x")]
+
+
+def test_avg_of_decimal_is_descaled(engine):
+    rows = engine.execute_sql(
+        "select avg(cast(l_quantity as decimal(10,2))) from lineitem")
+    raw = engine.execute_sql("select avg(l_quantity) from lineitem")
+    assert abs(rows[0][0] - raw[0][0]) < 1e-6
+
+
+def test_date_vs_string_comparison(engine):
+    a = engine.execute_sql(
+        "select count(*) from lineitem where l_shipdate <= '1998-09-02'")
+    b = engine.execute_sql(
+        "select count(*) from lineitem "
+        "where l_shipdate <= date '1998-09-02'")
+    assert a == b and a[0][0] > 0
+
+
+def test_not_in_with_null_build_side(engine):
+    # NOT IN over a set containing NULL yields no rows (SQL 3VL)
+    rows = engine.execute_sql(
+        "select count(*) from nation where n_nationkey not in "
+        "(select case when n_regionkey = 0 then null else n_nationkey end "
+        " from nation)")
+    assert rows == [(0,)]
+
+
+def test_scalar_function_over_aggregate(engine):
+    rows = engine.execute_sql(
+        "select n_regionkey, round(avg(n_nationkey), 2) from nation "
+        "group by n_regionkey order by 1")
+    assert len(rows) == 5
+    assert all(isinstance(r[1], float) for r in rows)
+
+
+def test_not_like(engine):
+    rows = engine.execute_sql(
+        "select count(*) from region where r_name not like 'A%'")
+    # AMERICA, AFRICA, ASIA start with A -> EUROPE, MIDDLE EAST remain
+    assert rows == [(2,)]
+
+
+def test_like_escape(engine):
+    # '%' escaped matches only a literal percent (none in region names)
+    rows = engine.execute_sql(
+        "select count(*) from region where r_name like '!%' escape '!'")
+    assert rows == [(0,)]

@@ -1,0 +1,61 @@
+import numpy as np
+
+from presto_tpu.connectors import TPCH_SCHEMA, TpchConnector
+from tests.oracle import table_df
+
+
+def test_row_counts_scale():
+    c = TpchConnector(0.01)
+    assert c.table("region").num_rows == 5
+    assert c.table("nation").num_rows == 25
+    assert c.table("supplier").num_rows == 100
+    assert c.table("customer").num_rows == 1500
+    assert c.table("part").num_rows == 2000
+    assert c.table("partsupp").num_rows == 8000
+    assert c.table("orders").num_rows == 15000
+    li = c.table("lineitem")
+    assert 15000 <= li.num_rows <= 7 * 15000
+
+
+def test_partitioned_generation_is_consistent():
+    c = TpchConnector(0.01)
+    whole = c.table("orders")
+    parts = [c.table("orders", part=k, num_parts=4) for k in range(4)]
+    keys = np.concatenate([p.arrays["o_orderkey"][:p.num_rows]
+                           for p in parts])
+    assert len(keys) == whole.num_rows
+    assert len(np.unique(keys)) == whole.num_rows
+
+
+def test_lineitem_fk_integrity():
+    c = TpchConnector(0.01)
+    li = table_df(c, "lineitem")
+    ps = table_df(c, "partsupp")
+    orders = table_df(c, "orders")
+    # every (l_partkey, l_suppkey) exists in partsupp
+    pairs = set(zip(ps.ps_partkey, ps.ps_suppkey))
+    lipairs = set(zip(li.l_partkey, li.l_suppkey))
+    assert lipairs <= pairs
+    assert set(li.l_orderkey) == set(orders.o_orderkey)
+    # no customer with custkey % 3 == 0 has orders
+    assert not (orders.o_custkey % 3 == 0).any()
+
+
+def test_page_upload_and_pruning():
+    c = TpchConnector(0.01)
+    t = c.table("nation")
+    p = t.page(columns=["n_name", "n_regionkey"])
+    rows = p.to_pylist()
+    assert ("ALGERIA", 0) in rows and ("CHINA", 2) in rows
+    assert len(rows) == 25
+
+
+def test_deterministic():
+    import presto_tpu.connectors.tpch as m
+    m._gen_table.cache_clear()
+    m._gen_orders_lineitem.cache_clear()
+    a = TpchConnector(0.01).table("customer").arrays["c_acctbal"]
+    m._gen_table.cache_clear()
+    m._gen_orders_lineitem.cache_clear()
+    b = TpchConnector(0.01).table("customer").arrays["c_acctbal"]
+    assert (a == b).all()
