@@ -1,0 +1,28 @@
+"""Distributed execution over a TPU device mesh.
+
+Re-expresses the reference's exchange system (SURVEY.md §2.5, §3.5) on TPU
+fabric: the hash-partitioned exchange between plan fragments
+(presto-main-base/.../operator/repartition/PartitionedOutputOperator.java:57
+feeding .../operator/ExchangeClient.java:71 over HTTP) becomes a
+`jax.lax.all_to_all` over the ICI mesh inside one multi-chip worker;
+broadcast replication (execution/buffer/BroadcastOutputBuffer.java) becomes
+`all_gather`. Cross-host (DCN) exchange keeps Presto's pull-based HTTP
+SerializedPage protocol (presto_tpu.server / presto_tpu.protocol).
+"""
+
+from presto_tpu.parallel.mesh import (
+    device_mesh, stack_pages, unstack_page, run_sharded,
+)
+from presto_tpu.parallel.shuffle import (
+    repartition_page, all_gather_page, partition_ids,
+)
+from presto_tpu.parallel.dist import (
+    dist_aggregate, dist_hash_join, broadcast_hash_join, gather_page_global,
+)
+
+__all__ = [
+    "device_mesh", "stack_pages", "unstack_page", "run_sharded",
+    "repartition_page", "all_gather_page", "partition_ids",
+    "dist_aggregate", "dist_hash_join", "broadcast_hash_join",
+    "gather_page_global",
+]

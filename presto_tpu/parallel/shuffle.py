@@ -1,0 +1,159 @@
+"""ICI shuffle primitives — run INSIDE shard_map over axis "d".
+
+The TPU-native form of Presto's partitioned exchange (SURVEY.md §3.5):
+
+  PartitionedOutputOperator.addInput      -> partition_ids + pack_by_partition
+    (presto-main-base/.../operator/repartition/PartitionedOutputOperator.java:57,
+     hash via InterpretedHashGenerator)
+  PagesSerde + HTTP pull + ExchangeClient -> lax.all_to_all over ICI
+    (.../operator/ExchangeClient.java:71)
+  BroadcastOutputBuffer                   -> lax.all_gather
+    (.../execution/buffer/BroadcastOutputBuffer.java)
+
+Static-shape contract: each device sends at most `chunk` rows to each peer
+(chunk is a compile-time constant). Skew beyond the chunk, or receive totals
+beyond out_capacity, are reported back as traced "needed" counters so the
+host can re-lower at a bigger bucket — the same overflow-retry protocol the
+local operators use (exec/executor.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Column, Page
+from presto_tpu.ops.keys import hash_columns
+from presto_tpu.parallel.mesh import AXIS
+
+
+def partition_ids(page: Page, key_fields: Sequence[int], ndev: int
+                  ) -> jnp.ndarray:
+    """Hash-partition id per row in [0, ndev); padding rows get ndev.
+    NULL keys hash to a stable bin (null==null for partitioning, matching
+    the reference's hash-partitioning of nullable group keys)."""
+    return partition_ids_cols([page.columns[f] for f in key_fields],
+                              ndev, page.row_valid())
+
+
+def partition_ids_cols(cols: Sequence[Column], ndev: int,
+                       valid: jnp.ndarray) -> jnp.ndarray:
+    """partition_ids over explicit key columns (already cross-side aligned
+    for joins — string codes only hash consistently across pages when the
+    columns share one dictionary, cf. ops/join._aligned_keys)."""
+    h = hash_columns(cols)
+    pid = (h % ndev).astype(jnp.int32)
+    return jnp.where(valid, pid, ndev)
+
+
+def _pack_by_partition(arrs, pid, ndev: int, chunk: int, valid):
+    """Scatter rows into per-destination blocks.
+
+    Returns (packed arrays shaped [ndev, chunk], counts [ndev], max_count).
+    Rows beyond `chunk` for a destination are dropped (reported via
+    max_count so the host retries)."""
+    cap = pid.shape[0]
+    order = jnp.argsort(pid, stable=True)          # group rows by dest
+    spid = pid[order]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), spid[1:] != spid[:-1]])
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, idx, 0))
+    rank = idx - seg_start
+    counts = jnp.zeros((ndev + 1,), jnp.int32).at[spid].add(
+        valid[order].astype(jnp.int32))[:ndev]
+    ok = (rank < chunk) & (spid < ndev) & valid[order]
+    slot = jnp.where(ok, spid * chunk + rank, ndev * chunk)
+    packed = []
+    for a in arrs:
+        buf = jnp.zeros((ndev * chunk + 1,), dtype=a.dtype)
+        buf = buf.at[slot].set(a[order], mode="drop")
+        packed.append(buf[:ndev * chunk].reshape(ndev, chunk))
+    return packed, counts, jnp.max(counts)
+
+
+def repartition_page(page: Page, pid: jnp.ndarray, ndev: int,
+                     out_capacity: int, chunk: Optional[int] = None,
+                     axis: str = AXIS) -> Tuple[Page, jnp.ndarray, jnp.ndarray]:
+    """All-to-all exchange: each row moves to device pid[row].
+
+    Must run inside shard_map over `axis`. Returns
+    (local page of received rows with capacity out_capacity,
+     needed_recv  — true received total (may exceed out_capacity),
+     needed_send  — max rows destined to one peer (may exceed chunk)).
+    """
+    cap = page.capacity
+    if chunk is None:
+        chunk = max(2 * cap // ndev, 64)
+    valid = page.row_valid()
+
+    arrs = []
+    for c in page.columns:
+        arrs.append(c.values)
+        arrs.append(c.nulls)
+    packed, counts, max_send = _pack_by_partition(
+        arrs, pid, ndev, chunk, valid)
+
+    # counts[d] = rows we send to d; exchange so recv_counts[j] = rows
+    # device j sent to me.
+    recv_counts = jax.lax.all_to_all(
+        counts.reshape(ndev, 1), axis, split_axis=0, concat_axis=0
+    ).reshape(ndev)
+    recv = [jax.lax.all_to_all(p, axis, split_axis=0, concat_axis=0)
+            for p in packed]
+
+    # Flatten [ndev, chunk] -> [ndev*chunk]; block j's first
+    # min(recv_counts[j], chunk) rows are live.
+    row_in_block = jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    live = (row_in_block < jnp.minimum(recv_counts, chunk)[:, None]
+            ).reshape(ndev * chunk)
+    total = jnp.sum(recv_counts)
+
+    flat = [(recv[2 * i].reshape(ndev * chunk),
+             recv[2 * i + 1].reshape(ndev * chunk), c)
+            for i, c in enumerate(page.columns)]
+    out = _compact_flat(flat, live, out_capacity, page.names)
+    return out, total, max_send
+
+
+def _compact_flat(flat_cols, live: jnp.ndarray, out_capacity: int,
+                  names) -> Page:
+    """Stable-partition live rows to the front of an out_capacity page.
+    flat_cols: [(values, nulls, template Column)] with 1-D arrays."""
+    flat_cap = live.shape[0]
+    order_key = jnp.where(live, 0, flat_cap) + jnp.arange(
+        flat_cap, dtype=jnp.int32)
+    perm = jnp.argsort(order_key)
+    n = jnp.sum(live).astype(jnp.int32)
+    take = jnp.arange(out_capacity, dtype=jnp.int32)
+    src = perm[jnp.clip(take, 0, flat_cap - 1)]
+    out_valid = take < jnp.minimum(n, out_capacity)
+
+    cols = []
+    for vals, nulls, c in flat_cols:
+        v = vals[src]
+        nl = nulls[src]
+        sent = jnp.asarray(c.type.null_sentinel(), dtype=v.dtype)
+        v = jnp.where(out_valid, v, sent)
+        nl = jnp.where(out_valid, nl, True)
+        cols.append(Column(v, nl, c.type, c.dictionary))
+    return Page(tuple(cols), jnp.minimum(n, out_capacity), names)
+
+
+def all_gather_page(page: Page, ndev: int, axis: str = AXIS) -> Page:
+    """Replicate all rows of a sharded page onto every device (broadcast
+    build side of a join). Output capacity is ndev * local capacity, rows
+    compacted to the front. Must run inside shard_map over `axis`."""
+    cap = page.capacity
+    flat_cap = ndev * cap
+    nums = jax.lax.all_gather(page.num_rows, axis)        # [ndev]
+    live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+            < nums[:, None]).reshape(flat_cap)
+
+    flat = [(jax.lax.all_gather(c.values, axis).reshape(flat_cap),
+             jax.lax.all_gather(c.nulls, axis).reshape(flat_cap), c)
+            for c in page.columns]
+    return _compact_flat(flat, live, flat_cap, page.names)
