@@ -1,0 +1,70 @@
+"""Benchmark: TPC-H Q1 throughput on the local accelerator.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: lineitem rows/sec through the full jit-compiled Q1 fragment
+(scan pages resident on device; filter+project+grouped aggregate+sort),
+median of BENCH_RUNS timed runs after BENCH_WARMUP warmups. The reference
+publishes no absolute numbers (BASELINE.md) — vs_baseline is measured
+against the recorded Java single-node rows/sec when BASELINE_ROWS_PER_SEC
+is set, else reported as 0.0 (unknown).
+
+Env knobs: BENCH_SF (default 1.0), BENCH_RUNS (5), BENCH_WARMUP (2).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    runs = int(os.environ.get("BENCH_RUNS", "5"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+
+    import jax
+
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.sql.parser import parse_sql
+    from __graft_entry__ import Q1
+
+    engine = LocalEngine(TpchConnector(sf))
+    plan = engine.planner.plan_query(parse_sql(Q1))
+
+    caps = {}
+    fn, scans, _watch = engine.executor._lower(plan, caps)
+    pages = [engine.executor._fetch(s) for s in scans]
+    in_rows = sum(int(p.num_rows) for p in pages)
+    jitted = jax.jit(fn)
+
+    for _ in range(warmup):
+        out, _needed = jitted(pages)
+        jax.block_until_ready(out.num_rows)
+
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out, _needed = jitted(pages)
+        jax.block_until_ready((out.columns[0].values, out.num_rows))
+        times.append(time.perf_counter() - t0)
+
+    med = statistics.median(times)
+    rows_per_sec = in_rows / med
+    base = float(os.environ.get("BASELINE_ROWS_PER_SEC", "0") or 0)
+    vs = rows_per_sec / base if base > 0 else 0.0
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+    }))
+    print(f"# device={jax.devices()[0].platform} rows={in_rows} "
+          f"median_s={med:.4f} groups={int(out.num_rows)} runs={times}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -198,7 +198,15 @@ class Executor:
                     return Page(cols, p.num_rows, node.output_names)
                 return project_fn, cap
             if isinstance(node, AggregationNode):
-                src, cap = build(node.source)
+                # Fuse an immediately-below Filter into the aggregation as
+                # a row mask: skips the compaction argsort (the reference's
+                # ScanFilterAndProject -> HashAggregation pipeline fusion).
+                pred = None
+                source = node.source
+                if isinstance(source, FilterNode):
+                    pred = compile_expr(source.predicate)
+                    source = source.source
+                src, cap = build(source)
                 hint = node.group_count_hint or 65536
                 out_cap = caps.get(nid) or min(
                     cap, bucket_capacity(hint))
@@ -207,10 +215,15 @@ class Executor:
                 caps[nid] = out_cap
                 watch.append(nid)
 
-                def agg_fn(pages, node=node, out_cap=out_cap):
+                def agg_fn(pages, node=node, out_cap=out_cap, pred=pred):
                     p = src(pages)
+                    mask = None
+                    if pred is not None:
+                        c = pred(p)
+                        mask = ~c.nulls & c.values.astype(bool)
                     out, true_groups = grouped_aggregate(
-                        p, node.group_fields, node.aggs, out_cap)
+                        p, node.group_fields, node.aggs, out_cap,
+                        row_mask=mask)
                     _needed.append(true_groups)
                     return out
                 return agg_fn, out_cap

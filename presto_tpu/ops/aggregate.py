@@ -66,27 +66,202 @@ def _segment_max(vals, seg_ids, num_segments, identity):
                     dtype=vals.dtype).at[seg_ids].max(vals)
 
 
+# Direct (sort-free, scatter-free) grouping.
+#
+# When every group key has a small static domain (dictionary-coded strings,
+# booleans) the group id is a mixed-radix code computed per row, and each
+# aggregate becomes a handful of masked whole-array reductions — one per
+# bin — instead of a per-row scatter-add. On TPU this matters twice over:
+# no argsorts (the general path's grouping mechanism) and no scatters
+# (which XLA serializes row-by-row for colliding indices: measured ~0.65 s
+# per scatter over 8M rows vs ~0.06 s for the fused masked reductions).
+# The TPU counterpart of MultiChannelGroupByHash's dictionary fast path.
+
+_DIRECT_MAX_BINS = 64
+
+
+def _direct_domains(page: Page, group_fields: Sequence[int]):
+    """Per-key domain sizes if the direct path applies, else None."""
+    domains = []
+    for f in group_fields:
+        c = page.columns[f]
+        if c.type.is_string and c.dictionary is not None:
+            domains.append(len(c.dictionary))
+        elif c.values.dtype == jnp.bool_:
+            domains.append(2)
+        else:
+            return None
+    prod = 1
+    for d in domains:
+        prod *= d + 1                      # +1: per-key NULL bin
+        if prod > _DIRECT_MAX_BINS:
+            return None
+    return domains, prod
+
+
+def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
+                              aggs: Sequence[AggSpec], out_cap: int,
+                              valid: jnp.ndarray, domains, prod: int):
+    cap = page.capacity
+    code = jnp.zeros((cap,), jnp.int32)
+    for f, dom in zip(group_fields, domains):
+        c = page.columns[f]
+        v = jnp.clip(c.values.astype(jnp.int32), 0, dom - 1)
+        v = jnp.where(c.nulls, dom, v)     # NULL sorts after all codes
+        code = code * (dom + 1) + v
+
+    # Per-bin row masks; XLA fuses these into a few passes over the page.
+    masks = [valid & (code == b) for b in range(prod)]
+    counts = jnp.stack([jnp.sum(m) for m in masks])          # [prod]
+    nonempty = counts > 0
+    num_groups = jnp.sum(nonempty).astype(jnp.int32)
+
+    # Compact non-empty bins to the front; raw bin order == sorted key
+    # order (sorted dictionaries), nulls last per key.
+    order_key = jnp.where(nonempty, 0, prod) + jnp.arange(prod,
+                                                          dtype=jnp.int32)
+    bin_perm = jnp.argsort(order_key)                        # [prod] tiny
+    width = min(out_cap, prod)
+    take = bin_perm[:width]
+    out_valid_w = jnp.arange(width, dtype=jnp.int32) < num_groups
+
+    def widen(bins_arr, t: Type, nulls_w, dictionary=None):
+        """Place per-bin results [prod] into an out_cap column."""
+        v = bins_arr[take].astype(t.dtype)
+        sent = jnp.asarray(t.null_sentinel(), dtype=t.dtype)
+        v = jnp.where(nulls_w | ~out_valid_w, sent, v)
+        nl = nulls_w | ~out_valid_w
+        if width < out_cap:
+            pad = out_cap - width
+            v = jnp.concatenate([v, jnp.full((pad,), sent, dtype=t.dtype)])
+            nl = jnp.concatenate([nl, jnp.ones((pad,), bool)])
+        return Column(v, nl, t, dictionary)
+
+    cols = []
+    # Group keys: decode the mixed-radix bin index statically.
+    stride = prod
+    for f, dom in zip(group_fields, domains):
+        c = page.columns[f]
+        stride //= (dom + 1)
+        key_code = (jnp.arange(prod, dtype=jnp.int32) // stride) % (dom + 1)
+        knull = key_code == dom
+        cols.append(widen(jnp.where(knull, 0, key_code), c.type,
+                          knull[take], c.dictionary))
+
+    false_w = jnp.zeros((width,), bool)
+    for a in aggs:
+        vals, nulls = _agg_inputs(a, page)
+        dictionary = (page.columns[a.field].dictionary
+                      if a.field is not None and a.output_type.is_string
+                      else None)
+        t = a.output_type
+        kind = a.kind
+        live = [m & ~nulls for m in masks]
+        n_per = jnp.stack([jnp.sum(lv) for lv in live])
+        if kind == "count_star":
+            cols.append(widen(counts.astype(jnp.int64), t, false_w))
+        elif kind == "count":
+            cols.append(widen(n_per.astype(jnp.int64), t, false_w))
+        elif kind in ("sum", "avg", "avg_partial", "avg_final"):
+            acc = jnp.float64 if (t.is_floating or kind != "sum") \
+                else jnp.int64
+            zero = jnp.asarray(0, dtype=acc)
+            s = jnp.stack([jnp.sum(jnp.where(lv, vals, zero).astype(acc))
+                           for lv in live])
+            if kind == "avg_final":
+                c2 = page.columns[a.field2]
+                c2v = jnp.where(c2.nulls, 0, c2.values)
+                n2 = jnp.stack([jnp.sum(jnp.where(m, c2v, 0))
+                                for m in masks])
+                cols.append(widen(s / jnp.maximum(n2, 1), t,
+                                  (n2 == 0)[take]))
+            elif kind == "sum":
+                cols.append(widen(s, t, (n_per == 0)[take]))
+            elif kind == "avg":
+                cols.append(widen(s / jnp.maximum(n_per, 1), t,
+                                  (n_per == 0)[take]))
+            else:  # avg_partial -> (sum double, count bigint)
+                cols.append(widen(s, DOUBLE, (n_per == 0)[take]))
+                cols.append(widen(n_per.astype(jnp.int64), BIGINT, false_w))
+        elif kind in ("min", "max"):
+            v = vals.astype(jnp.int32) if vals.dtype == jnp.bool_ else vals
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                ident = jnp.inf if kind == "min" else -jnp.inf
+            else:
+                info = jnp.iinfo(v.dtype)
+                ident = info.max if kind == "min" else info.min
+            red = jnp.min if kind == "min" else jnp.max
+            r = jnp.stack([red(jnp.where(lv, v, ident)) for lv in live])
+            cols.append(widen(r, t, (n_per == 0)[take], dictionary))
+        elif kind in ("bool_or", "bool_and"):
+            if kind == "bool_or":
+                r = jnp.stack([jnp.any(lv & vals.astype(bool))
+                               for lv in live])
+            else:
+                r = jnp.stack([jnp.all(~lv | vals.astype(bool))
+                               for lv in live])
+            cols.append(widen(r, t, (n_per == 0)[take]))
+        else:
+            raise NotImplementedError(f"aggregate {kind}")
+
+    out_rows = jnp.minimum(num_groups, out_cap)
+    return Page(tuple(cols), out_rows, ()), num_groups
+
+
+def _agg_inputs(a: AggSpec, page: Page):
+    """(values, null-or-masked-out) for an aggregate input, unpermuted."""
+    if a.field is not None:
+        col = page.columns[a.field]
+        vals = col.values
+        nulls = col.nulls
+    else:
+        vals = jnp.zeros((page.capacity,), dtype=jnp.int64)
+        nulls = jnp.zeros((page.capacity,), dtype=bool)
+    if a.mask_field is not None:
+        m = page.columns[a.mask_field]
+        nulls = nulls | ~(~m.nulls & m.values.astype(bool))
+    return vals, nulls
+
+
 def grouped_aggregate(page: Page, group_fields: Sequence[int],
                       aggs: Sequence[AggSpec],
-                      out_capacity: Optional[int] = None):
+                      out_capacity: Optional[int] = None,
+                      row_mask: Optional[jnp.ndarray] = None):
     """Group `page` by `group_fields` and evaluate `aggs`. Output columns:
     group keys (in order) then one column per agg (avg_partial emits two).
     With no group fields, emits exactly one row (SQL global aggregation).
+    `row_mask` (bool per row) pre-filters rows without a compaction pass —
+    the fused ScanFilterAndProject -> Aggregation pipeline.
 
     Returns (page, true_group_count): true_group_count is unclamped so the
     host can detect out_capacity overflow and retry at a bigger bucket."""
     cap = page.capacity
     out_cap = out_capacity or cap
     valid = page.row_valid()
+    if row_mask is not None:
+        valid = valid & row_mask
 
     if group_fields:
-        perm = sort_perm(page, [SortKey(f) for f in group_fields])
-        flags = new_group_flags(page, group_fields, perm) & valid[perm]
-        gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
-        gid = jnp.where(valid[perm], gid, out_cap)  # padding -> overflow bin
-        num_groups = jnp.where(page.num_rows > 0,
-                               jnp.max(jnp.where(valid[perm], gid, -1)) + 1,
-                               0).astype(jnp.int32)
+        d = _direct_domains(page, group_fields)
+        if d is not None:
+            domains, prod = d
+            return _direct_grouped_aggregate(
+                page, group_fields, aggs, out_cap, valid, domains, prod)
+        else:
+            perm = sort_perm(page, [SortKey(f) for f in group_fields])
+            if row_mask is not None:
+                # Masked rows interleave after the key sort but must not
+                # split/merge boundary flags: stable-push them last, like
+                # sort_perm already does for padding.
+                perm = perm[jnp.argsort((~valid)[perm].astype(jnp.int32),
+                                        stable=True)]
+            flags = new_group_flags(page, group_fields, perm) & valid[perm]
+            gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+            gid = jnp.where(valid[perm], gid, out_cap)  # padding -> overflow
+            num_groups = jnp.where(
+                page.num_rows > 0,
+                jnp.max(jnp.where(valid[perm], gid, -1)) + 1,
+                0).astype(jnp.int32)
     else:
         perm = jnp.arange(cap, dtype=jnp.int32)
         gid = jnp.where(valid, 0, out_cap)

@@ -2,7 +2,7 @@ import numpy as np
 import pytest
 
 from presto_tpu import BIGINT, DOUBLE, VARCHAR
-from presto_tpu.data.column import Page
+from presto_tpu.data.column import Column, Page
 from presto_tpu.ops import (
     AggSpec, SortKey, grouped_aggregate, hash_join, limit_page, sort_page,
     top_n,
@@ -141,3 +141,19 @@ def test_join_overflow_detection():
     build = _page({"bk": [1] * 10}, {"bk": BIGINT})
     out, total = hash_join(probe, build, [0], [0], out_capacity=64)
     assert int(total) == 100  # 100 pairs > 64 capacity -> host must retry
+
+
+def test_direct_path_min_varchar_keeps_dictionary():
+    """Direct (small-domain) grouping must decode string min/max via the
+    column dictionary, like the general sort path (review regression)."""
+    import numpy as np
+    from presto_tpu.ops.aggregate import AggSpec, grouped_aggregate
+    from presto_tpu.types import BOOLEAN, VARCHAR
+
+    names = Column.from_strings(["banana", "apple", "cherry", "apple"],
+                                capacity=256)
+    flags = Column.from_numpy(np.array([True, False, True, False]), BOOLEAN,
+                              capacity=256)
+    p = Page.from_columns([flags, names], 4, ("f", "s"))
+    out, _ = grouped_aggregate(p, [0], [AggSpec("min", 1, VARCHAR)], 256)
+    assert out.to_pylist() == [(False, "apple"), (True, "banana")]
