@@ -33,9 +33,9 @@ from presto_tpu.ops.aggregate import grouped_aggregate
 from presto_tpu.ops.join import hash_join
 from presto_tpu.ops.sort import limit_page, sort_page, top_n
 from presto_tpu.plan.nodes import (
-    AggregationNode, ExchangeNode, FilterNode, JoinNode, JoinType, LimitNode,
-    OutputNode, PlanNode, ProjectNode, SortNode, TableScanNode, TopNNode,
-    ValuesNode,
+    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
+    JoinType, LimitNode, OutputNode, PlanNode, ProjectNode, SortNode,
+    TableScanNode, TopNNode, ValuesNode,
 )
 
 
@@ -157,7 +157,28 @@ class Executor:
             counter[0] += 1
             return counter[0]
 
+        # Shared subtrees (mark joins reference the probe pipeline twice)
+        # must lower and evaluate ONCE: memoize by node identity, and cache
+        # each node's output per run so trace-time Python also runs once.
+        memo: Dict[int, Tuple[Callable, int]] = {}
+        run_cache: Dict[int, Page] = {}
+
         def build(node: PlanNode):
+            key = id(node)
+            if key in memo:
+                return memo[key]
+            fn, cap = build_inner(node)
+
+            def cached(pages, fn=fn, key=key):
+                if key in run_cache:
+                    return run_cache[key]
+                out = fn(pages)
+                run_cache[key] = out
+                return out
+            memo[key] = (cached, cap)
+            return memo[key]
+
+        def build_inner(node: PlanNode):
             nid = node_id(node)
             if isinstance(node, TableScanNode):
                 # Exact row count (generation is cached), not the planner
@@ -230,7 +251,8 @@ class Executor:
             if isinstance(node, JoinNode):
                 psrc, pcap = build(node.probe)
                 bsrc, bcap = build(node.build)
-                if node.join_type in (JoinType.SEMI, JoinType.ANTI):
+                if node.join_type in (JoinType.SEMI, JoinType.ANTI,
+                                      JoinType.ANTI_EXISTS):
                     def semi_fn(pages, node=node):
                         p = psrc(pages)
                         b = bsrc(pages)
@@ -268,6 +290,17 @@ class Executor:
                                       ~c.nulls & c.values.astype(bool))
                     return out
                 return join_fn, out_cap
+            if isinstance(node, AssignUniqueIdNode):
+                src, cap = build(node.source)
+
+                def rowid_fn(pages, node=node):
+                    p = src(pages)
+                    ids = jnp.arange(p.capacity, dtype=jnp.int64)
+                    col = Column(ids, ~p.row_valid(),
+                                 node.output_types[-1], None)
+                    return Page(p.columns + (col,), p.num_rows,
+                                node.output_names)
+                return rowid_fn, cap
             if isinstance(node, SortNode):
                 src, cap = build(node.source)
                 return (lambda pages: sort_page(src(pages), node.keys)), cap
@@ -293,6 +326,7 @@ class Executor:
 
         def run(pages):
             _needed.clear()
+            run_cache.clear()
             out = root(pages)
             return out, list(_needed)
 

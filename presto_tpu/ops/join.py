@@ -84,12 +84,16 @@ def hash_join(probe: Page, build: Page,
     hi = jnp.searchsorted(bh_sorted, ph, side="right")
     counts = jnp.where(p_live, hi - lo, 0).astype(jnp.int64)
 
-    if join_type in ("semi", "anti"):
+    if join_type in ("semi", "anti", "anti_exists"):
         # Need >=1 *true* match; verify keys over the candidate window via a
         # bounded scan on the max bucket width (collision windows are tiny).
         matched = _window_any_match(pcols, bcols, order, lo, counts)
         if join_type == "semi":
             flag = matched
+        elif join_type == "anti_exists":
+            # NOT EXISTS: null keys simply never match; non-matching rows
+            # survive (no three-valued NOT IN poisoning).
+            flag = ~matched
         else:
             # SQL NOT IN: if the build side contains ANY null key, every
             # non-match is UNKNOWN -> anti join emits nothing; a null probe

@@ -36,7 +36,12 @@ class JoinType(enum.Enum):
     INNER = "inner"
     LEFT = "left"
     SEMI = "semi"
+    # ANTI implements NOT IN three-valued logic (any NULL build key empties
+    # the result); ANTI_EXISTS implements NOT EXISTS (nulls never match,
+    # non-matching probe rows survive). Reference: SemiJoinNode vs the
+    # planner's distinct handling of NOT IN null semantics.
     ANTI = "anti"
+    ANTI_EXISTS = "anti_exists"
 
 
 class Partitioning(enum.Enum):
@@ -114,6 +119,17 @@ class JoinNode(PlanNode):
 
     def children(self):
         return (self.probe, self.build)
+
+
+@dataclasses.dataclass(frozen=True)
+class AssignUniqueIdNode(PlanNode):
+    """Appends a BIGINT row-id column unique within the task (reference:
+    spi/plan/AssignUniqueIdNode). Used by the mark-join decorrelation of
+    EXISTS with non-equi correlated conditions."""
+    source: PlanNode = None
+
+    def children(self):
+        return (self.source,)
 
 
 @dataclasses.dataclass(frozen=True)

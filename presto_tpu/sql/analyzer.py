@@ -32,8 +32,9 @@ from presto_tpu.expr.nodes import (
 from presto_tpu.ops.aggregate import AggSpec
 from presto_tpu.ops.keys import SortKey
 from presto_tpu.plan.nodes import (
-    AggregationNode, FilterNode, JoinNode, JoinType, LimitNode, OutputNode,
-    PlanNode, ProjectNode, SortNode, Step, TableScanNode, TopNNode,
+    AggregationNode, AssignUniqueIdNode, FilterNode, JoinNode, JoinType,
+    LimitNode, OutputNode, PlanNode, ProjectNode, SortNode, Step,
+    TableScanNode, TopNNode,
 )
 from presto_tpu.sql import ast
 from presto_tpu.types import (
@@ -88,6 +89,50 @@ def _conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:
     return [e]
 
 
+def _disjuncts(e: ast.Expr) -> List[ast.Expr]:
+    if isinstance(e, ast.BinaryOp) and e.op == "or":
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _and_all(conjs: Sequence[ast.Expr]) -> Optional[ast.Expr]:
+    out = None
+    for c in conjs:
+        out = c if out is None else ast.BinaryOp("and", out, c)
+    return out
+
+
+def _normalize_conjuncts(conjuncts: List[ast.Expr]) -> List[ast.Expr]:
+    """Hoist conjuncts common to every branch of an OR-of-ANDs (TPC-H Q19's
+    `(p=l and ...) or (p=l and ...)`) so equi-join keys buried in a
+    disjunction still reach the join planner. Reference:
+    expressions/LogicalRowExpressions extractCommonPredicates."""
+    out: List[ast.Expr] = []
+    for c in conjuncts:
+        branches = _disjuncts(c)
+        if len(branches) < 2:
+            out.append(c)
+            continue
+        branch_conjs = [_conjuncts(b) for b in branches]
+        common = [x for x in branch_conjs[0]
+                  if all(x in bc for bc in branch_conjs[1:])]
+        if not common:
+            out.append(c)
+            continue
+        out.extend(common)
+        rests = [[x for x in bc if x not in common] for bc in branch_conjs]
+        if all(rests):  # if any branch is exhausted the OR is always true
+            out.append(_or_all([_and_all(r) for r in rests]))
+    return out
+
+
+def _or_all(disjs: Sequence[ast.Expr]) -> ast.Expr:
+    out = disjs[0]
+    for d in disjs[1:]:
+        out = ast.BinaryOp("or", out, d)
+    return out
+
+
 def _expr_idents(e) -> Set[Tuple[str, ...]]:
     out: Set[Tuple[str, ...]] = set()
 
@@ -112,6 +157,14 @@ class Planner:
 
     def __init__(self, catalog):
         self.catalog = catalog
+        self._cte_stack: List[Dict[str, ast.Select]] = []
+
+    # ================================================================ CTEs
+    def _lookup_cte(self, name: str) -> Optional[ast.Select]:
+        for scope in reversed(self._cte_stack):
+            if name in scope:
+                return scope[name]
+        return None
 
     # ================================================================ FROM
     def plan_query(self, q: ast.Select) -> PlanNode:
@@ -120,7 +173,14 @@ class Planner:
                           tuple(f.type for f in rp.fields), rp.node)
 
     def _plan_select(self, q: ast.Select) -> RelationPlan:
-        where_conjuncts = _conjuncts(q.where)
+        if q.ctes:
+            self._cte_stack.append(dict(q.ctes))
+            try:
+                return self._plan_select(
+                    dataclasses.replace(q, ctes=()))
+            finally:
+                self._cte_stack.pop()
+        where_conjuncts = _normalize_conjuncts(_conjuncts(q.where))
 
         if q.relations:
             rp = self._plan_from(list(q.relations), where_conjuncts, q)
@@ -167,9 +227,19 @@ class Planner:
         pushed: Dict[int, List[ast.Expr]] = {i: [] for i in range(len(plans))}
         join_conds: List[Tuple[Set[int], ast.Expr]] = []
         semijoins: List[ast.Expr] = []
+        corr_scalars: List[Tuple[str, ast.Expr, ast.Select, bool]] = []
         for c in conjuncts:
+            # NOT EXISTS / NOT IN arrive as UnaryOp(not, ...).
+            if isinstance(c, ast.UnaryOp) and c.op == "not" and \
+                    isinstance(c.operand, (ast.InSubquery, ast.Exists)):
+                c = dataclasses.replace(c.operand,
+                                        negated=not c.operand.negated)
             if isinstance(c, (ast.InSubquery, ast.Exists)):
                 semijoins.append(c)
+                continue
+            cs = self._match_correlated_scalar(c)
+            if cs is not None:
+                corr_scalars.append(cs)
                 continue
             r = refs_of(c)
             if len(r) == 1:
@@ -218,12 +288,208 @@ class Planner:
         if leftover:
             current = self._apply_filter(current, leftover)
 
+        for op, value_ast, sub_q, flipped in corr_scalars:
+            current = self._apply_correlated_scalar(current, op, value_ast,
+                                                    sub_q, flipped)
         for sq in semijoins:
             current = self._apply_semijoin(current, sq)
         return current
 
+    def _match_correlated_scalar(self, c: ast.Expr):
+        """cmp(value, correlated scalar subquery) in either orientation ->
+        (op, value_ast, subquery, flipped)."""
+        if not (isinstance(c, ast.BinaryOp)
+                and c.op in ("eq", "ne", "lt", "le", "gt", "ge")):
+            return None
+        for side, other, flipped in ((c.right, c.left, False),
+                                     (c.left, c.right, True)):
+            if isinstance(side, ast.ScalarSubquery) and \
+                    self._free_idents(side.query):
+                return (c.op, other, side.query, flipped)
+        return None
+
+    def _apply_correlated_scalar(self, rp: RelationPlan, op: str,
+                                 value_ast: ast.Expr, sub_q: ast.Select,
+                                 flipped: bool) -> RelationPlan:
+        """Decorrelate `value CMP (select AGG(..) from inner where
+        inner.k = outer.k and ...)`: group the inner by its correlation
+        keys, LEFT-join on them, filter, and project the outer columns
+        back. Reference: TransformCorrelatedScalarAggregation rules
+        (sql/planner/iterative/rule/)."""
+        inner_shallow = self._shallow_fields(list(sub_q.relations))
+        if len(sub_q.items) != 1:
+            raise AnalysisError("scalar subquery must return one column")
+        if sub_q.group_by or sub_q.having:
+            raise AnalysisError(
+                "correlated scalar subquery with GROUP BY/HAVING "
+                "unsupported")
+        kept: List[ast.Expr] = []
+        corr: List[Tuple[ast.Expr, ast.Ident]] = []  # (outer, inner)
+        for cc in _conjuncts(sub_q.where):
+            free = [p for p in _expr_idents(cc)
+                    if not self._shallow_resolves(p, inner_shallow)]
+            if not free:
+                kept.append(cc)
+                continue
+            if not (isinstance(cc, ast.BinaryOp) and cc.op == "eq"):
+                raise AnalysisError(
+                    f"unsupported correlated condition: {cc}")
+            l_inner = isinstance(cc.left, ast.Ident) and \
+                self._shallow_resolves(cc.left.parts, inner_shallow)
+            r_inner = isinstance(cc.right, ast.Ident) and \
+                self._shallow_resolves(cc.right.parts, inner_shallow)
+            if l_inner and not r_inner:
+                corr.append((cc.right, cc.left))
+            elif r_inner and not l_inner:
+                corr.append((cc.left, cc.right))
+            else:
+                raise AnalysisError(
+                    f"unsupported correlated equality: {cc}")
+        if not corr:
+            raise AnalysisError("correlated subquery without correlation "
+                                "equalities")
+
+        items = tuple(ast.SelectItem(inner, f"_ck{i}")
+                      for i, (_o, inner) in enumerate(corr))
+        items += (ast.SelectItem(sub_q.items[0].expr, "_cval"),)
+        inner_sel = ast.Select(items, sub_q.relations, _and_all(kept),
+                               tuple(inner for _o, inner in corr),
+                               ctes=sub_q.ctes)
+        sub_rp = self._plan_select(inner_sel)
+
+        n_outer = len(rp.fields)
+        pk: List[int] = []
+        for o, _inner in corr:
+            oe = self.analyze(o, rp.fields)
+            pk.append(self._as_input_field(oe, rp))
+        bk = list(range(len(corr)))
+        fields = rp.fields + sub_rp.fields
+        node = JoinNode(tuple(f.name for f in fields),
+                        tuple(f.type for f in fields),
+                        rp.node, sub_rp.node, JoinType.LEFT,
+                        tuple(pk), tuple(bk), None, fanout_hint=1.0)
+
+        val = self.analyze(value_ast, fields)
+        agg_col: RowExpression = InputRef(n_outer + len(corr),
+                                          sub_rp.fields[len(corr)].type)
+        # SQL: count over an empty correlated set is 0, not NULL — the
+        # LEFT-join miss must coalesce for count-shaped subqueries.
+        if isinstance(sub_q.items[0].expr, ast.FuncCall) and \
+                sub_q.items[0].expr.name == "count":
+            agg_col = SpecialForm(Form.COALESCE,
+                                  (agg_col, Literal(0, agg_col.type)),
+                                  agg_col.type)
+        args = (agg_col, val) if flipped else (val, agg_col)
+        pred = Call(op, args, BOOLEAN)
+        filt = FilterNode(node.output_names, node.output_types, node, pred)
+        proj = ProjectNode(tuple(f.name for f in rp.fields),
+                           tuple(f.type for f in rp.fields), filt,
+                           tuple(InputRef(i, f.type)
+                                 for i, f in enumerate(rp.fields)))
+        return RelationPlan(proj, rp.fields, max(rp.est_rows * 0.3, 1.0))
+
     def _relation_aliases(self, rp: RelationPlan) -> Set[str]:
         return {f.qualifier for f in rp.fields if f.qualifier}
+
+    # ---------------- scoping without planning (correlation detection) ----
+    def _select_output_names(self, q: ast.Select) -> List[str]:
+        names: List[str] = []
+        for i, it in enumerate(q.items):
+            if isinstance(it.expr, ast.Star):
+                for f in self._shallow_fields(list(q.relations)):
+                    names.append(f.name)
+            elif it.alias:
+                names.append(it.alias)
+            elif isinstance(it.expr, ast.Ident):
+                names.append(it.expr.parts[-1])
+            else:
+                names.append(f"_col{i}")
+        return names
+
+    def _shallow_fields(self, relations: List[ast.Relation]
+                        ) -> Tuple[Field, ...]:
+        out: List[Field] = []
+        for r in relations:
+            out.extend(self._shallow_rel_fields(r))
+        return tuple(out)
+
+    def _shallow_rel_fields(self, r: ast.Relation) -> List[Field]:
+        if isinstance(r, ast.TableRef):
+            alias = r.alias or r.name
+            cte = self._lookup_cte(r.name)
+            if cte is not None:
+                return [Field(n, UNKNOWN, alias)
+                        for n in self._select_output_names(cte)]
+            return [Field(c, t, alias)
+                    for c, t in self.catalog.schema(r.name)]
+        if isinstance(r, ast.SubqueryRef):
+            return [Field(n, UNKNOWN, r.alias)
+                    for n in self._select_output_names(r.query)]
+        if isinstance(r, ast.Join):
+            return (self._shallow_rel_fields(r.left)
+                    + self._shallow_rel_fields(r.right))
+        raise AnalysisError(f"relation {r}")
+
+    def _shallow_resolves(self, parts: Tuple[str, ...], fields) -> bool:
+        for f in fields:
+            if len(parts) == 1 and f.name == parts[0]:
+                return True
+            if len(parts) == 2 and f.qualifier == parts[0] and \
+                    f.name == parts[1]:
+                return True
+        return False
+
+    def _free_idents(self, q: ast.Select) -> Set[Tuple[str, ...]]:
+        """Identifiers used in `q` (and its nested subqueries) that do not
+        resolve in q's own FROM scope — i.e. correlated references.
+        Reference: StatementAnalyzer scope chains / Analysis outer
+        references."""
+        if q.ctes:
+            self._cte_stack.append(dict(q.ctes))
+        try:
+            fields = self._shallow_fields(list(q.relations))
+            idents: Set[Tuple[str, ...]] = set()
+
+            def walk(x):
+                if isinstance(x, (ast.ScalarSubquery, ast.Exists)):
+                    idents.update(self._free_idents(x.query))
+                    return
+                if isinstance(x, ast.InSubquery):
+                    walk(x.value)
+                    idents.update(self._free_idents(x.query))
+                    return
+                if isinstance(x, ast.SubqueryRef):
+                    idents.update(self._free_idents(x.query))
+                    return
+                if isinstance(x, ast.Ident):
+                    idents.add(x.parts)
+                    return
+                if isinstance(x, ast.Select):
+                    idents.update(self._free_idents(x))
+                    return
+                if dataclasses.is_dataclass(x):
+                    for f in dataclasses.fields(x):
+                        walk(getattr(x, f.name))
+                elif isinstance(x, tuple):
+                    for i in x:
+                        walk(i)
+
+            for it in q.items:
+                walk(it.expr)
+            for e in (q.where, q.having):
+                if e is not None:
+                    walk(e)
+            for g in q.group_by:
+                walk(g)
+            for o in q.order_by:
+                walk(o.expr)
+            for r in q.relations:
+                walk(r)
+            return {p for p in idents
+                    if not self._shallow_resolves(p, fields)}
+        finally:
+            if q.ctes:
+                self._cte_stack.pop()
 
     def _ident_resolves(self, parts: Tuple[str, ...], fields) -> bool:
         try:
@@ -237,6 +503,14 @@ class Planner:
 
     def _plan_relation(self, r: ast.Relation, q: ast.Select) -> RelationPlan:
         if isinstance(r, ast.TableRef):
+            cte = self._lookup_cte(r.name)
+            if cte is not None:
+                sub = self._plan_select(cte)
+                alias = r.alias or r.name
+                fields = tuple(Field(f.name, f.type, alias)
+                               for f in sub.fields)
+                return RelationPlan(sub.node, fields,
+                                    max(sub.est_rows, 1.0))
             schema = self.catalog.schema(r.name)
             alias = r.alias or r.name
             used = self._used_columns(q, alias, [c for c, _ in schema])
@@ -275,6 +549,15 @@ class Planner:
             if r.kind in ("left", "right"):
                 if r.kind == "right":
                     left, right = right, left
+                # Build-side-only ON conditions are equivalent to
+                # pre-filtering the build input (a false condition just
+                # null-extends, same as a missing row); probe-side-only
+                # conditions must stay in the join (they do NOT drop
+                # probe rows in an outer join).
+                bc = [c for c in conds if self._only_refs(c, right.fields)]
+                if bc:
+                    right = self._apply_filter(right, bc)
+                    conds = [c for c in conds if c not in bc]
                 return self._join(left, right, conds, outer=True,
                                   preserve_order=(r.kind == "left"))
             raise AnalysisError(f"join kind {r.kind}")
@@ -391,7 +674,7 @@ class Planner:
 
     def _apply_semijoin(self, rp: RelationPlan, c) -> RelationPlan:
         if isinstance(c, ast.Exists):
-            raise AnalysisError("correlated EXISTS not yet supported")
+            return self._apply_exists(rp, c.query, c.negated)
         assert isinstance(c, ast.InSubquery)
         sub = self._plan_select(c.query)
         if len(sub.fields) != 1:
@@ -406,6 +689,112 @@ class Planner:
                         tuple(f.type for f in fields),
                         rp.node, sub.node, jt, (v.field,), (0,), None)
         return RelationPlan(node, fields, max(rp.est_rows * 0.5, 1.0))
+
+    def _apply_exists(self, rp: RelationPlan, sub_q: ast.Select,
+                      negated: bool) -> RelationPlan:
+        """Decorrelate [NOT] EXISTS. Equality correlations become semi /
+        anti-exists join keys; other correlated conditions force the
+        mark-join form (row ids + inner join + residual filter + semi on
+        row id). Reference: TransformCorrelatedExistsToJoin rules,
+        AssignUniqueIdNode-based mark joins."""
+        inner_shallow = self._shallow_fields(list(sub_q.relations))
+        if sub_q.group_by or sub_q.having:
+            raise AnalysisError(
+                "EXISTS subquery with GROUP BY/HAVING unsupported")
+        kept: List[ast.Expr] = []
+        corr_eq: List[Tuple[ast.Expr, ast.Ident]] = []   # (outer, inner)
+        corr_res: List[ast.Expr] = []
+        for cc in _conjuncts(sub_q.where):
+            free = [p for p in _expr_idents(cc)
+                    if not self._shallow_resolves(p, inner_shallow)]
+            if not free:
+                kept.append(cc)
+                continue
+            if isinstance(cc, ast.BinaryOp) and cc.op == "eq":
+                l_inner = isinstance(cc.left, ast.Ident) and \
+                    self._shallow_resolves(cc.left.parts, inner_shallow)
+                r_inner = isinstance(cc.right, ast.Ident) and \
+                    self._shallow_resolves(cc.right.parts, inner_shallow)
+                if l_inner and not r_inner:
+                    corr_eq.append((cc.right, cc.left))
+                    continue
+                if r_inner and not l_inner:
+                    corr_eq.append((cc.left, cc.right))
+                    continue
+            corr_res.append(cc)
+
+        # Inner columns the join needs: correlation keys + residual refs.
+        needed: List[Tuple[str, ...]] = []
+        for _o, inner in corr_eq:
+            if inner.parts not in needed:
+                needed.append(inner.parts)
+        for cc in corr_res:
+            for p in _expr_idents(cc):
+                if self._shallow_resolves(p, inner_shallow) and \
+                        p not in needed:
+                    needed.append(p)
+        if not needed:
+            raise AnalysisError("uncorrelated EXISTS not yet supported")
+        items = tuple(ast.SelectItem(ast.Ident(p), f"_ek{i}")
+                      for i, p in enumerate(needed))
+        inner_sel = ast.Select(items, sub_q.relations, _and_all(kept),
+                               ctes=sub_q.ctes)
+        sub_rp = self._plan_select(inner_sel)
+
+        key_pos = {p: i for i, p in enumerate(needed)}
+        fields = rp.fields
+        if not corr_res:
+            pk = [self._as_input_field(self.analyze(o, fields), rp)
+                  for o, _i in corr_eq]
+            bk = [key_pos[i.parts] for _o, i in corr_eq]
+            jt = JoinType.ANTI_EXISTS if negated else JoinType.SEMI
+            node = JoinNode(tuple(f.name for f in fields),
+                            tuple(f.type for f in fields),
+                            rp.node, sub_rp.node, jt, tuple(pk), tuple(bk),
+                            None)
+            return RelationPlan(node, fields, max(rp.est_rows * 0.5, 1.0))
+
+        # Mark-join: rowid-tagged probe x inner, residual filtered, then
+        # semi/anti on the rowid.
+        rowid_t = BIGINT
+        tagged = AssignUniqueIdNode(
+            tuple(f.name for f in fields) + ("_rowid",),
+            tuple(f.type for f in fields) + (rowid_t,), rp.node)
+        tagged_fields = fields + (Field("_rowid", rowid_t),)
+        tagged_rp = RelationPlan(tagged, tagged_fields, rp.est_rows)
+
+        pk = [self._as_input_field(self.analyze(o, tagged_fields),
+                                   tagged_rp) for o, _i in corr_eq]
+        bk = [key_pos[i.parts] for _o, i in corr_eq]
+        join_fields = tagged_fields + sub_rp.fields
+        # Residual references inner cols by their original (possibly
+        # qualified) names: give the joined inner fields those names.
+        view_fields = tagged_fields + tuple(
+            Field(p[-1], sub_rp.fields[i].type,
+                  p[0] if len(p) == 2 else None)
+            for i, p in enumerate(needed))
+        res_expr = None
+        for cc in corr_res:
+            e = self.analyze(cc, view_fields)
+            res_expr = e if res_expr is None else \
+                SpecialForm(Form.AND, (res_expr, e), BOOLEAN)
+        matches = JoinNode(tuple(f.name for f in join_fields),
+                           tuple(f.type for f in join_fields),
+                           tagged, sub_rp.node, JoinType.INNER,
+                           tuple(pk), tuple(bk), res_expr,
+                           fanout_hint=2.0)
+        rowid_idx = len(fields)
+        match_ids = ProjectNode(("_rowid",), (rowid_t,), matches,
+                                (InputRef(rowid_idx, rowid_t),))
+        jt = JoinType.ANTI_EXISTS if negated else JoinType.SEMI
+        marked = JoinNode(tuple(f.name for f in tagged_fields),
+                          tuple(f.type for f in tagged_fields),
+                          tagged, match_ids, jt, (rowid_idx,), (0,), None)
+        proj = ProjectNode(tuple(f.name for f in fields),
+                           tuple(f.type for f in fields), marked,
+                           tuple(InputRef(i, f.type)
+                                 for i, f in enumerate(fields)))
+        return RelationPlan(proj, fields, max(rp.est_rows * 0.5, 1.0))
 
     # ========================================================== aggregation
     def _query_has_aggregates(self, q: ast.Select) -> bool:
@@ -429,6 +818,8 @@ class Planner:
 
     def _plan_aggregation(self, q: ast.Select, rp: RelationPlan
                           ) -> RelationPlan:
+        if self._has_distinct_aggs(q):
+            q, rp = self._rewrite_distinct_aggs(q, rp)
         fields = rp.fields
         # 1. group keys (support ordinals)
         key_exprs: List[RowExpression] = []
@@ -449,9 +840,6 @@ class Planner:
 
         def collect(x):
             if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
-                if x.distinct:
-                    raise AnalysisError(
-                        "DISTINCT aggregates not yet supported")
                 if x not in agg_calls:
                     agg_calls.append(x)
                 return
@@ -542,6 +930,108 @@ class Planner:
         return RelationPlan(post, tuple(
             Field(n, e.type) for n, e in zip(out_names, out_exprs)),
             arp.est_rows)
+
+    def _has_distinct_aggs(self, q: ast.Select) -> bool:
+        found = False
+
+        def walk(x):
+            nonlocal found
+            if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS \
+                    and x.distinct:
+                found = True
+            elif dataclasses.is_dataclass(x) and \
+                    not isinstance(x, ast.Select):
+                for f in dataclasses.fields(x):
+                    walk(getattr(x, f.name))
+            elif isinstance(x, tuple):
+                for i in x:
+                    walk(i)
+        for it in q.items:
+            walk(it.expr)
+        if q.having is not None:
+            walk(q.having)
+        for o in q.order_by:
+            walk(o.expr)
+        return found
+
+    def _rewrite_distinct_aggs(self, q: ast.Select, rp: RelationPlan
+                               ) -> Tuple[ast.Select, RelationPlan]:
+        """agg(DISTINCT x) GROUP BY k.. -> dedupe (k.., x) with an inner
+        aggregation, then plain agg(x) over the deduped rows (reference:
+        SingleDistinctAggregationToGroupBy rule). Requires every aggregate
+        DISTINCT over one shared argument and plain-identifier group keys."""
+        calls: List[ast.FuncCall] = []
+
+        def collect(x):
+            if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
+                calls.append(x)
+            elif dataclasses.is_dataclass(x) and \
+                    not isinstance(x, ast.Select):
+                for f in dataclasses.fields(x):
+                    collect(getattr(x, f.name))
+            elif isinstance(x, tuple):
+                for i in x:
+                    collect(i)
+        for it in q.items:
+            collect(it.expr)
+        if q.having is not None:
+            collect(q.having)
+        for o in q.order_by:
+            collect(o.expr)
+        if not all(c.distinct for c in calls):
+            raise AnalysisError(
+                "mixing DISTINCT and plain aggregates unsupported")
+        if len({c.args for c in calls}) != 1:
+            raise AnalysisError("multiple DISTINCT arguments unsupported")
+        for g in q.group_by:
+            if not isinstance(g, ast.Ident):
+                raise AnalysisError(
+                    "DISTINCT aggregates require plain group keys")
+
+        fields = rp.fields
+        key_exprs = [self.analyze(g, fields) for g in q.group_by]
+        arg = self.analyze(calls[0].args[0], fields)
+        dedup_exprs = key_exprs + [arg]
+        names, quals = [], []
+        for g in q.group_by:
+            names.append(g.parts[-1])
+            quals.append(g.parts[0] if len(g.parts) == 2 else None)
+        names.append("_darg")
+        quals.append(None)
+        pre = ProjectNode(tuple(names),
+                          tuple(e.type for e in dedup_exprs), rp.node,
+                          tuple(dedup_exprs))
+        dedup = AggregationNode(pre.output_names, pre.output_types, pre,
+                                tuple(range(len(dedup_exprs))), (),
+                                Step.SINGLE)
+        new_rp = RelationPlan(
+            dedup,
+            tuple(Field(n, t, qu) for n, t, qu in
+                  zip(names, pre.output_types, quals)),
+            max(rp.est_rows / 2.0, 1.0))
+
+        def rewrite(x):
+            if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS \
+                    and x.distinct:
+                return dataclasses.replace(
+                    x, args=(ast.Ident(("_darg",)),), distinct=False)
+            if dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
+                return dataclasses.replace(x, **{
+                    f.name: rewrite(getattr(x, f.name))
+                    for f in dataclasses.fields(x)})
+            if isinstance(x, tuple):
+                return tuple(rewrite(i) for i in x)
+            return x
+
+        new_q = dataclasses.replace(
+            q,
+            items=tuple(ast.SelectItem(rewrite(it.expr), it.alias)
+                        for it in q.items),
+            having=rewrite(q.having) if q.having is not None else None,
+            order_by=tuple(ast.OrderItem(rewrite(o.expr), o.ascending,
+                                         o.nulls_first)
+                           for o in q.order_by))
+        return new_q, new_rp
 
     def _plan_plain_select(self, q: ast.Select, rp: RelationPlan
                            ) -> RelationPlan:

@@ -191,3 +191,6 @@ class Select:
     order_by: Tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
     distinct: bool = False
+    # WITH clause: ((name, query), ...); names visible to relations and
+    # subqueries of this Select (reference: sql/tree/With.java)
+    ctes: Tuple[Tuple[str, "Select"], ...] = ()

@@ -9,6 +9,7 @@ messages point at token offsets.
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import List, Optional, Tuple
 
@@ -28,7 +29,7 @@ _KEYWORDS = {
     "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
     "date", "interval", "join", "inner", "left", "right", "outer", "cross",
     "on", "asc", "desc", "nulls", "first", "last", "distinct", "all",
-    "union", "year", "month", "day", "substring", "for", "count",
+    "union", "year", "month", "day", "substring", "for", "count", "with",
 }
 
 
@@ -120,6 +121,20 @@ class Parser:
         return q
 
     def query(self) -> ast.Select:
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.ident_text()
+                self.expect_kw("as")
+                self.expect("op", "(")
+                ctes.append((name, self.query()))
+                self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
+        q = self._select_body()
+        return dataclasses.replace(q, ctes=tuple(ctes)) if ctes else q
+
+    def _select_body(self) -> ast.Select:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         self.accept_kw("all")
