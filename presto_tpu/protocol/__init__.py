@@ -1,0 +1,22 @@
+"""Coordinator-facing wire protocol.
+
+The worker side of Presto's coordinator<->worker contract, re-implemented
+from the serialized formats (not the Java code): the SerializedPage data
+plane (presto-spi/.../page/PagesSerdeUtil.java:64 framing,
+presto-common/.../block/*Encoding.java block formats), and the JSON control
+plane (TaskUpdateRequest presto-main-base/.../server/TaskUpdateRequest.java:37,
+PlanFragment presto-main-base/.../sql/planner/PlanFragment.java:52,
+RowExpression presto-spi/.../relation/RowExpression.java @JsonSubTypes).
+The same graft surface as the C++ worker's presto_protocol
+(presto-native-execution/presto_cpp/presto_protocol/).
+"""
+
+from presto_tpu.protocol.serde import (
+    WireBlock, decode_serialized_page, encode_serialized_page,
+    page_to_wire_blocks, wire_blocks_to_page,
+)
+
+__all__ = [
+    "WireBlock", "decode_serialized_page", "encode_serialized_page",
+    "page_to_wire_blocks", "wire_blocks_to_page",
+]
