@@ -1,15 +1,26 @@
-"""Benchmark: TPC-H Q1 throughput on the local accelerator.
+"""Benchmark: TPC-H throughput on the local accelerator, vs a measured
+sqlite baseline over the IDENTICAL generated data.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Metric: lineitem rows/sec through the full jit-compiled Q1 fragment
-(scan pages resident on device; filter+project+grouped aggregate+sort),
-median of BENCH_RUNS timed runs after BENCH_WARMUP warmups. The reference
-publishes no absolute numbers (BASELINE.md) — vs_baseline is measured
-against the recorded Java single-node rows/sec when BASELINE_ROWS_PER_SEC
-is set, else reported as 0.0 (unknown).
+Headline metric: lineitem rows/sec through the full jit-compiled Q1
+fragment (scan pages resident on device), median of BENCH_RUNS timed runs
+after warmup. `detail` carries the same measurement for Q6 (fused
+scan-filter global agg), Q3 (join + large-domain agg + topN) and Q18
+(double join + group-by-orderkey), each with its own vs_baseline.
 
-Env knobs: BENCH_SF (default 1.0), BENCH_RUNS (5), BENCH_WARMUP (2).
+Baseline: the reference publishes no absolute numbers (BASELINE.md), and
+no JVM exists in this environment, so the measured proxy is sqlite3
+executing the same SQL over the same rows (the test suite's correctness
+oracle, standing in for H2QueryRunner). It is measured once and cached in
+BASELINE_MEASURED.json (keyed by scale factor) because loading SF1 into
+sqlite takes minutes; delete the file to re-measure. Roofline context: Q1
+touches ~7 of 16 lineitem columns ~= 0.4 GB at SF1; at v5e HBM bandwidth
+(~820 GB/s) one pass is ~0.5 ms, so wall time is dominated by how few
+passes the compiled fragment makes, not FLOPs.
+
+Env knobs: BENCH_SF (default 1.0), BENCH_RUNS (5), BENCH_WARMUP (2),
+BENCH_QUERIES (comma list, default "1,6,3,18").
 """
 
 import json
@@ -18,52 +29,146 @@ import statistics
 import sys
 import time
 
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_MEASURED.json")
+
+
+def measure_sqlite_baseline(conn, sf, qids):
+    """Wall time per query in sqlite3 over the same generated rows."""
+    import sqlite3
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from test_tpch_full import to_sqlite  # dialect bridge
+    from oracle import table_df
+    from tpch_queries import QUERIES
+
+    db = sqlite3.connect(":memory:")
+    tables = ["region", "nation", "supplier", "customer", "part",
+              "partsupp", "orders", "lineitem"]
+    for t in tables:
+        df = table_df(conn, t)
+        # DATE ints -> ISO strings for sqlite comparability
+        for col in df.columns:
+            if conn.table(t).types[col].name == "date":
+                import datetime
+                epoch = datetime.date(1970, 1, 1)
+                df[col] = df[col].map(
+                    lambda d: (epoch + datetime.timedelta(days=int(d))
+                               ).isoformat())
+        df.to_sql(t, db, index=False)
+    out = {}
+    for qid in qids:
+        sql = to_sqlite(QUERIES[qid])
+        t0 = time.perf_counter()
+        db.execute(sql).fetchall()
+        out[str(qid)] = time.perf_counter() - t0
+    db.close()
+    return out
+
+
+def load_or_measure_baseline(conn, sf, qids):
+    key = f"sf{sf:g}"
+    data = {}
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            data = json.load(f)
+    missing = [q for q in qids
+               if str(q) not in data.get(key, {}).get("sqlite_seconds", {})]
+    if missing:
+        measured = measure_sqlite_baseline(conn, sf, missing)
+        entry = data.setdefault(key, {}).setdefault("sqlite_seconds", {})
+        entry.update(measured)
+        data[key]["note"] = (
+            "sqlite3 :memory: wall seconds on identical generated data; "
+            "measured on this machine, cached (delete file to re-measure)")
+        try:
+            with open(BASELINE_FILE, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+        except OSError:
+            pass
+    return data[key]["sqlite_seconds"]
+
 
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     runs = int(os.environ.get("BENCH_RUNS", "5"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    qids = [int(q) for q in
+            os.environ.get("BENCH_QUERIES", "1,6,3,18").split(",")]
 
     import jax
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from tpch_queries import QUERIES
 
     from presto_tpu.connectors import TpchConnector
     from presto_tpu.exec import LocalEngine
     from presto_tpu.sql.parser import parse_sql
-    from __graft_entry__ import Q1
 
-    engine = LocalEngine(TpchConnector(sf))
-    plan = engine.planner.plan_query(parse_sql(Q1))
+    conn = TpchConnector(sf)
+    engine = LocalEngine(conn)
+    baseline = load_or_measure_baseline(conn, sf, qids)
 
-    caps = {}
-    fn, scans, _watch = engine.executor._lower(plan, caps)
-    pages = [engine.executor._fetch(s) for s in scans]
-    in_rows = sum(int(p.num_rows) for p in pages)
-    jitted = jax.jit(fn)
+    detail = {}
+    for qid in qids:
+        plan = engine.planner.plan_query(parse_sql(QUERIES[qid]))
+        plan = engine.executor._resolve_subqueries(plan)
+        # Converge capacities (overflow retries) before timing.
+        caps = {}
+        for _ in range(8):
+            fn, scans, watch = engine.executor._lower(plan, caps)
+            jitted = jax.jit(fn)
+            pages = [engine.executor._fetch(s) for s in scans]
+            out, needed = jitted(pages)
+            import numpy as np
+            needed = np.asarray(needed)
+            grew = False
+            for nid, need in zip(watch, needed):
+                if int(need) > caps[nid]:
+                    from presto_tpu.data.column import bucket_capacity
+                    caps[nid] = bucket_capacity(int(need))
+                    grew = True
+            if not grew:
+                break
+        else:
+            raise RuntimeError(
+                f"q{qid}: capacity retries did not converge; refusing to "
+                "time a truncated fragment")
+        in_rows = sum(int(p.num_rows) for p in pages)
+        for _ in range(warmup):
+            out, _n = jitted(pages)
+            jax.block_until_ready(out.num_rows)
+        times = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            out, _n = jitted(pages)
+            jax.block_until_ready((out.columns[0].values if out.columns
+                                   else out.num_rows, out.num_rows))
+            times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        base_s = baseline.get(str(qid), 0.0)
+        detail[f"q{qid:02d}"] = {
+            "median_s": round(med, 4),
+            "rows_per_sec": round(in_rows / med, 1),
+            "input_rows": in_rows,
+            "sqlite_baseline_s": round(base_s, 4),
+            "vs_baseline": round(base_s / med, 3) if base_s else 0.0,
+        }
+        print(f"# q{qid:02d}: median={med:.4f}s rows={in_rows} "
+              f"sqlite={base_s:.2f}s speedup={base_s/med if base_s else 0:.1f}x",
+              file=sys.stderr)
 
-    for _ in range(warmup):
-        out, _needed = jitted(pages)
-        jax.block_until_ready(out.num_rows)
-
-    times = []
-    for _ in range(runs):
-        t0 = time.perf_counter()
-        out, _needed = jitted(pages)
-        jax.block_until_ready((out.columns[0].values, out.num_rows))
-        times.append(time.perf_counter() - t0)
-
-    med = statistics.median(times)
-    rows_per_sec = in_rows / med
-    base = float(os.environ.get("BASELINE_ROWS_PER_SEC", "0") or 0)
-    vs = rows_per_sec / base if base > 0 else 0.0
+    head_name = "q01" if "q01" in detail else next(iter(detail))
+    head = detail[head_name]
     print(json.dumps({
-        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
-        "value": round(rows_per_sec, 1),
+        "metric": f"tpch_{head_name}_sf{sf:g}_rows_per_sec",
+        "value": head["rows_per_sec"],
         "unit": "rows/s",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": head["vs_baseline"],
+        "detail": detail,
     }))
-    print(f"# device={jax.devices()[0].platform} rows={in_rows} "
-          f"median_s={med:.4f} groups={int(out.num_rows)} runs={times}",
-          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -75,6 +75,7 @@ class Executor:
             fn, scans, watch = entry
             pages = [self._fetch(s) for s in scans]
             out, needed = fn(pages)
+            needed = __import__("numpy").asarray(needed)   # single sync
             grew = False
             for nid, need in zip(watch, needed):
                 need = int(need)
@@ -219,14 +220,27 @@ class Executor:
                     return Page(cols, p.num_rows, node.output_names)
                 return project_fn, cap
             if isinstance(node, AggregationNode):
-                # Fuse an immediately-below Filter into the aggregation as
-                # a row mask: skips the compaction argsort (the reference's
-                # ScanFilterAndProject -> HashAggregation pipeline fusion).
-                pred = None
+                # Fuse the whole Filter/Project chain below the aggregation
+                # into it: projections are row-wise column rewrites (row
+                # count unchanged) and filters become a row mask consumed
+                # by the aggregation — so the pipeline never compacts, and
+                # never pays a sort. This is the reference's
+                # ScanFilterAndProject -> HashAggregation pipeline fusion
+                # (ScanFilterAndProjectOperator.java:67), taken further
+                # because XLA fuses the mask into the reductions.
+                steps = []            # bottom-up (kind, compiled payload)
                 source = node.source
-                if isinstance(source, FilterNode):
-                    pred = compile_expr(source.predicate)
+                while isinstance(source, (FilterNode, ProjectNode)):
+                    if isinstance(source, FilterNode):
+                        steps.append(("filter",
+                                      compile_expr(source.predicate), None))
+                    else:
+                        steps.append(
+                            ("project",
+                             [compile_expr(e) for e in source.expressions],
+                             source.output_names))
                     source = source.source
+                steps.reverse()
                 src, cap = build(source)
                 hint = node.group_count_hint or 65536
                 out_cap = caps.get(nid) or min(
@@ -236,12 +250,17 @@ class Executor:
                 caps[nid] = out_cap
                 watch.append(nid)
 
-                def agg_fn(pages, node=node, out_cap=out_cap, pred=pred):
+                def agg_fn(pages, node=node, out_cap=out_cap, steps=steps):
                     p = src(pages)
                     mask = None
-                    if pred is not None:
-                        c = pred(p)
-                        mask = ~c.nulls & c.values.astype(bool)
+                    for kind, payload, names in steps:
+                        if kind == "filter":
+                            c = payload(p)
+                            m = ~c.nulls & c.values.astype(bool)
+                            mask = m if mask is None else (mask & m)
+                        else:
+                            cols = tuple(ex(p) for ex in payload)
+                            p = Page(cols, p.num_rows, names)
                     out, true_groups = grouped_aggregate(
                         p, node.group_fields, node.aggs, out_cap,
                         row_mask=mask)
@@ -328,6 +347,13 @@ class Executor:
             _needed.clear()
             run_cache.clear()
             out = root(pages)
-            return out, list(_needed)
+            # One stacked array => one host transfer for all overflow
+            # counters (each scalar fetch pays a full host sync).
+            if _needed:
+                counters = jnp.stack(
+                    [jnp.asarray(n, jnp.int64) for n in _needed])
+            else:
+                counters = jnp.zeros((0,), jnp.int64)
+            return out, counters
 
         return run, scans, watch

@@ -28,7 +28,6 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
-from presto_tpu.ops.keys import SortKey, new_group_flags, sort_perm
 from presto_tpu.types import BIGINT, DOUBLE, Type
 
 
@@ -50,20 +49,6 @@ class AggSpec:
     output_type: Type
     field2: Optional[int] = None  # second state input (avg_final: count)
     mask_field: Optional[int] = None  # FILTER / mask channel (bool column)
-
-
-def _segment_sum(vals, seg_ids, num_segments):
-    return jnp.zeros((num_segments,), dtype=vals.dtype).at[seg_ids].add(vals)
-
-
-def _segment_min(vals, seg_ids, num_segments, identity):
-    return jnp.full((num_segments,), identity,
-                    dtype=vals.dtype).at[seg_ids].min(vals)
-
-
-def _segment_max(vals, seg_ids, num_segments, identity):
-    return jnp.full((num_segments,), identity,
-                    dtype=vals.dtype).at[seg_ids].max(vals)
 
 
 # Direct (sort-free, scatter-free) grouping.
@@ -101,7 +86,8 @@ def _direct_domains(page: Page, group_fields: Sequence[int]):
 
 def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
                               aggs: Sequence[AggSpec], out_cap: int,
-                              valid: jnp.ndarray, domains, prod: int):
+                              valid: jnp.ndarray, domains, prod: int,
+                              min_groups: int = 0):
     cap = page.capacity
     code = jnp.zeros((cap,), jnp.int32)
     for f, dom in zip(group_fields, domains):
@@ -114,7 +100,7 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
     masks = [valid & (code == b) for b in range(prod)]
     counts = jnp.stack([jnp.sum(m) for m in masks])          # [prod]
     nonempty = counts > 0
-    num_groups = jnp.sum(nonempty).astype(jnp.int32)
+    num_groups = jnp.maximum(jnp.sum(nonempty), min_groups).astype(jnp.int32)
 
     # Compact non-empty bins to the front; raw bin order == sorted key
     # order (sorted dictionaries), nulls last per key.
@@ -241,128 +227,173 @@ def grouped_aggregate(page: Page, group_fields: Sequence[int],
     if row_mask is not None:
         valid = valid & row_mask
 
-    if group_fields:
-        d = _direct_domains(page, group_fields)
-        if d is not None:
-            domains, prod = d
-            return _direct_grouped_aggregate(
-                page, group_fields, aggs, out_cap, valid, domains, prod)
-        else:
-            perm = sort_perm(page, [SortKey(f) for f in group_fields])
-            if row_mask is not None:
-                # Masked rows interleave after the key sort but must not
-                # split/merge boundary flags: stable-push them last, like
-                # sort_perm already does for padding.
-                perm = perm[jnp.argsort((~valid)[perm].astype(jnp.int32),
-                                        stable=True)]
-            flags = new_group_flags(page, group_fields, perm) & valid[perm]
-            gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
-            gid = jnp.where(valid[perm], gid, out_cap)  # padding -> overflow
-            num_groups = jnp.where(
-                page.num_rows > 0,
-                jnp.max(jnp.where(valid[perm], gid, -1)) + 1,
-                0).astype(jnp.int32)
-    else:
-        perm = jnp.arange(cap, dtype=jnp.int32)
-        gid = jnp.where(valid, 0, out_cap)
-        num_groups = jnp.asarray(1, dtype=jnp.int32)
+    if not group_fields:
+        # Global aggregation: one bin, pure masked whole-array reductions —
+        # never a scatter (XLA serializes colliding-index scatters on TPU).
+        # min_groups=1: SQL global aggregation emits exactly one row even
+        # over empty input (count()=0, sum()=NULL).
+        return _direct_grouped_aggregate(page, (), aggs, out_cap, valid,
+                                         [], 1, min_groups=1)
 
-    nseg = out_cap + 1  # last bin swallows padding/overflow
-    gvalid = valid[perm]
+    d = _direct_domains(page, group_fields)
+    if d is not None:
+        domains, prod = d
+        return _direct_grouped_aggregate(
+            page, group_fields, aggs, out_cap, valid, domains, prod)
+    return _sorted_grouped_aggregate(page, group_fields, aggs, out_cap,
+                                     valid)
 
-    # Representative row (first of each group) for key materialization.
-    first_idx = jnp.full((nseg,), cap, dtype=jnp.int32).at[gid].min(
-        jnp.arange(cap, dtype=jnp.int32))
-    out_valid = jnp.arange(out_cap, dtype=jnp.int32) < jnp.minimum(
-        num_groups, out_cap)
+
+def _sorted_grouped_aggregate(page: Page, group_fields: Sequence[int],
+                              aggs: Sequence[AggSpec], out_cap: int,
+                              valid: jnp.ndarray):
+    """General (large-domain) grouping: ONE multi-operand lax.sort that
+    carries every page column as payload (never argsort+gather — random
+    gathers serialize on TPU), then contiguous-segment reductions via
+    blocked cumsum (ops/scan.py; scatter-adds also serialize on TPU).
+
+    Reference role: HashAggregationOperator over MultiChannelGroupByHash —
+    re-expressed as sort + segment reduce because a probe-loop hash table
+    has no efficient TPU form, but a bitonic sort network does."""
+    import jax
+
+    from presto_tpu.ops import scan as pscan
+    from presto_tpu.ops.keys import group_values
+
+    cap = page.capacity
+
+    # Sort keys: invalid rows last, then per group field (nulls last,
+    # group-canonical value).
+    key_ops = [(~valid).astype(jnp.int8)]
+    for f in group_fields:
+        c = page.columns[f]
+        key_ops.append(c.nulls.astype(jnp.int8))
+        key_ops.append(group_values(c))
+    operands = tuple(key_ops) + (valid,)
+    for c in page.columns:
+        operands += (c.values, c.nulls)
+    sorted_ops = jax.lax.sort(operands, num_keys=len(key_ops),
+                              is_stable=False)
+    nk = len(key_ops)
+    gvalid = sorted_ops[nk]
+    sp_cols = tuple(
+        Column(sorted_ops[nk + 1 + 2 * i], sorted_ops[nk + 2 + 2 * i],
+               c.type, c.dictionary)
+        for i, c in enumerate(page.columns))
+    sp = Page(sp_cols, page.num_rows, page.names)
+
+    # New-group flags from adjacent compare on the sorted key operands.
+    flags = jnp.zeros((cap,), dtype=bool).at[0].set(True)
+    for i in range(len(group_fields)):
+        n = sorted_ops[1 + 2 * i].astype(bool)
+        v = sorted_ops[2 + 2 * i]
+        prev_n = jnp.roll(n, 1)
+        prev_v = jnp.roll(v, 1)
+        same = ((v == prev_v) & ~n & ~prev_n) | (n & prev_n)
+        flags = flags | ~same
+    flags = flags.at[0].set(True)
+
+    starts, gid = pscan.group_starts(flags, gvalid, out_cap)
+    num_groups = jnp.sum(flags & gvalid).astype(jnp.int32)
+    total_valid = jnp.sum(gvalid).astype(jnp.int32)
+    g_arange = jnp.arange(out_cap, dtype=jnp.int32)
+    out_valid = g_arange < jnp.minimum(num_groups, out_cap)
+    nxt = jnp.concatenate([starts[1:], jnp.full((1,), cap, jnp.int32)])
+    ends = jnp.where(g_arange + 1 < num_groups, nxt, total_valid)
+    ends = jnp.where(out_valid, ends, starts)        # empty for overflow
 
     cols = []
     for f in group_fields:
-        src = page.columns[f]
-        sorted_col = src.gather(perm, gvalid)
-        cols.append(sorted_col.gather(first_idx[:out_cap], out_valid))
-
+        cols.append(sp.columns[f].gather(starts, out_valid))
     for a in aggs:
-        cols.extend(_eval_agg(a, page, perm, gid, nseg, out_cap, gvalid,
-                              out_valid))
+        cols.extend(_eval_agg_sorted(a, sp, gvalid, gid, starts, ends,
+                                     out_valid, pscan))
 
     return Page(tuple(cols), jnp.minimum(num_groups, out_cap), ()), \
         num_groups
 
 
-def _eval_agg(a: AggSpec, page: Page, perm, gid, nseg, out_cap, gvalid,
-              out_valid):
+def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
+                     out_valid, pscan):
+    """Evaluate one aggregate over contiguous sorted segments."""
     t = a.output_type
+    out_cap = starts.shape[0]
     if a.field is not None:
-        col = page.columns[a.field]
-        vals = col.values[perm]
-        nulls = col.nulls[perm] | ~gvalid
+        col = sp.columns[a.field]
+        vals = col.values
+        nulls = col.nulls | ~gvalid
     else:
-        vals = jnp.zeros((page.capacity,), dtype=jnp.int64)
+        vals = jnp.zeros((sp.capacity,), dtype=jnp.int64)
         nulls = ~gvalid
     if a.mask_field is not None:
-        m = page.columns[a.mask_field]
-        keep = (~m.nulls & m.values.astype(bool))[perm]
-        nulls = nulls | ~keep
+        m = sp.columns[a.mask_field]
+        nulls = nulls | ~(~m.nulls & m.values.astype(bool))
 
-    dictionary = (page.columns[a.field].dictionary
+    dictionary = (sp.columns[a.field].dictionary
                   if a.field is not None and t.is_string else None)
 
     def out(values, nullmask):
         sent = jnp.asarray(t.null_sentinel(), dtype=t.dtype)
-        v = jnp.where(nullmask | ~out_valid, sent,
-                      values[:out_cap].astype(t.dtype))
+        v = jnp.where(nullmask | ~out_valid, sent, values.astype(t.dtype))
         return Column(v, (nullmask | ~out_valid), t, dictionary)
+
+    def seg_count(live_mask):
+        return pscan.segment_sums(live_mask.astype(jnp.int32), starts,
+                                  ends).astype(jnp.int64)
 
     kind = a.kind
     if kind == "count_star":
-        c = _segment_sum(gvalid.astype(jnp.int64), gid, nseg)[:out_cap]
-        return [out(c, jnp.zeros_like(out_valid))]
+        return [out((ends - starts).astype(jnp.int64),
+                    jnp.zeros_like(out_valid))]
     if kind == "count":
-        c = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
-        return [out(c, jnp.zeros_like(out_valid))]
+        return [out(seg_count(~nulls), jnp.zeros_like(out_valid))]
     if kind in ("sum", "avg", "avg_partial"):
-        acc_dtype = jnp.float64 if t.is_floating or kind == "avg" \
+        acc_dtype = jnp.float64 if t.is_floating or kind != "sum" \
             else jnp.int64
         contrib = jnp.where(nulls, 0, vals).astype(acc_dtype)
-        s = _segment_sum(contrib, gid, nseg)[:out_cap]
-        n = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
+        s = pscan.segment_sums(contrib, starts, ends)
+        n = seg_count(~nulls)
         if kind == "sum":
             return [out(s, n == 0)]
         if kind == "avg":
             return [out(s / jnp.maximum(n, 1), n == 0)]
-        # avg_partial -> (sum: double, count: bigint)
-        sum_col = Column(jnp.where(n == 0, jnp.inf, s), n == 0, DOUBLE)
+        sum_col = Column(jnp.where(n == 0, jnp.inf, s.astype(jnp.float64)),
+                         n == 0, DOUBLE)
         cnt_col = Column(n, jnp.zeros_like(n, dtype=bool), BIGINT)
         return [sum_col, cnt_col]
     if kind == "avg_final":
-        # field = partial sum, field2 = partial count
-        cnt_col = page.columns[a.field2]
-        cvals = jnp.where(cnt_col.nulls, 0, cnt_col.values)[perm]
-        s = _segment_sum(jnp.where(nulls, 0.0, vals).astype(jnp.float64),
-                         gid, nseg)[:out_cap]
-        n = _segment_sum(cvals.astype(jnp.int64), gid, nseg)[:out_cap]
+        cnt_col = sp.columns[a.field2]
+        cvals = jnp.where(cnt_col.nulls, 0, cnt_col.values)
+        s = pscan.segment_sums(jnp.where(nulls, 0.0, vals)
+                               .astype(jnp.float64), starts, ends)
+        n = pscan.segment_sums(cvals.astype(jnp.int64), starts, ends)
         return [out(s / jnp.maximum(n, 1), n == 0)]
     if kind in ("min", "max"):
-        if jnp.issubdtype(vals.dtype, jnp.floating):
-            ident = jnp.inf if kind == "min" else -jnp.inf
-        elif vals.dtype == jnp.bool_:
-            vals = vals.astype(jnp.int32)
-            ident = 1 if kind == "min" else 0
-        else:
-            info = jnp.iinfo(vals.dtype)
-            ident = info.max if kind == "min" else info.min
-        masked = jnp.where(nulls, ident, vals)
-        fn = _segment_min if kind == "min" else _segment_max
-        r = fn(masked, gid, nseg, ident)[:out_cap]
-        n = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
-        return [out(r, n == 0)]
+        # Secondary sort keyed by (gid, null-last, value): the winner lands
+        # at each segment start. One extra multi-operand sort, no scatter.
+        import jax
+
+        from presto_tpu.ops.keys import _orderable_values
+
+        v = _orderable_values(Column(vals, nulls, a.output_type if
+                                     a.field is None else
+                                     sp.columns[a.field].type, dictionary))
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        sort_v = v if kind == "min" else (
+            -v if jnp.issubdtype(v.dtype, jnp.floating)
+            else -v.astype(jnp.int64))
+        s_ops = jax.lax.sort(
+            (gid, nulls.astype(jnp.int8), sort_v, vals, nulls),
+            num_keys=3, is_stable=False)
+        win_vals = jnp.take(s_ops[3], starts, mode="clip")
+        win_nulls = jnp.take(s_ops[4], starts, mode="clip")
+        n = seg_count(~nulls)
+        return [out(win_vals, win_nulls | (n == 0))]
     if kind in ("bool_or", "bool_and"):
-        b = vals.astype(bool)
-        masked = jnp.where(nulls, kind == "bool_and", b)
-        fn = _segment_max if kind == "bool_or" else _segment_min
-        r = fn(masked.astype(jnp.int32), gid, nseg,
-               0 if kind == "bool_or" else 1)[:out_cap]
-        n = _segment_sum((~nulls).astype(jnp.int64), gid, nseg)[:out_cap]
-        return [out(r.astype(bool), n == 0)]
+        b = vals.astype(bool) & ~nulls
+        trues = pscan.segment_sums(b.astype(jnp.int32), starts, ends)
+        n = seg_count(~nulls)
+        r = (trues > 0) if kind == "bool_or" else (trues == n)
+        return [out(r, n == 0)]
     raise NotImplementedError(f"aggregate {kind}")

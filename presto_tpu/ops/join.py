@@ -151,27 +151,54 @@ def hash_join(probe: Page, build: Page,
     return Page(out_cols, n, ()), total
 
 
-_MAX_BUCKET_SCAN = 8  # max hash-equal window width scanned for semi/anti
+_UNROLLED_BUCKET_SCAN = 4  # unrolled fast path for typical window widths
 
 
 def _window_any_match(pcols, bcols, order, lo, counts):
     """For each probe row: any true key match within its hash window.
-    Windows wider than _MAX_BUCKET_SCAN (pathological collision pileup)
-    are handled conservatively by scanning only the first slots — with a
-    64-bit hash, equal-hash windows beyond the duplicate-key case are
-    vanishingly rare, and duplicate build keys all satisfy key_eq at slot 0."""
+
+    The first few slots are unrolled (equal-hash windows are almost always
+    a handful of duplicate keys); the remainder — wide duplicate runs or a
+    collision pileup — is scanned exactly by a fori_loop whose trip count
+    is the *traced* max window width, so arbitrarily wide windows are
+    correct, not just "vanishingly unlikely to be wrong"."""
+    import jax
+
     pcap = pcols[0].capacity
     bcap = bcols[0].capacity
-    matched = jnp.zeros((pcap,), dtype=bool)
-    for k in range(_MAX_BUCKET_SCAN):
+    pvals = [group_values(pc) for pc in pcols]
+    pnulls = [pc.nulls for pc in pcols]
+    # Gather build keys into hash-sorted order once; slot k of probe row i
+    # is then sorted position lo[i]+k.
+    bvals = [group_values(bc)[order] for bc in bcols]
+    bnulls = [bc.nulls[order] for bc in bcols]
+
+    def slot_match(k, matched):
         in_win = k < counts
         bpos = jnp.clip(lo + k, 0, bcap - 1).astype(jnp.int32)
-        bidx = order[bpos]
-        eq = jnp.ones((pcap,), dtype=bool)
-        for pc, bc in zip(pcols, bcols):
-            eq = eq & (group_values(pc) == group_values(bc)[bidx]) \
-                & ~pc.nulls & ~bc.nulls[bidx]
-        matched = matched | (in_win & eq)
+        eq = in_win
+        for pv, pn, bv, bn in zip(pvals, pnulls, bvals, bnulls):
+            eq = eq & (pv == bv[bpos]) & ~pn & ~bn[bpos]
+        return matched | eq
+
+    matched = jnp.zeros((pcap,), dtype=bool)
+    for k in range(_UNROLLED_BUCKET_SCAN):
+        matched = slot_match(k, matched)
+
+    # Early exit: a row needs further slots only while it is unmatched and
+    # its window extends past k — so a million duplicates of one build key
+    # stop after their probe rows match at slot 0 instead of serializing
+    # the scan for the whole page.
+    def cond(state):
+        k, matched = state
+        return jnp.any(~matched & (counts > k))
+
+    def body(state):
+        k, matched = state
+        return k + 1, slot_match(k, matched)
+
+    _, matched = jax.lax.while_loop(
+        cond, body, (jnp.int64(_UNROLLED_BUCKET_SCAN), matched))
     return matched
 
 

@@ -2,25 +2,54 @@
 
 Reference roles: OrderByOperator (PagesIndex sort), TopNOperator
 (presto-main-base/.../operator/TopNOperator.java:32), LimitOperator.
-TPU-first: one fused multi-key argsort (ops/keys.py) + gather; TopN is the
-same sort with a clamped row count (XLA's sort is already O(n log n)
-vectorized; a separate heap structure would be slower on this hardware).
+TPU-first: ONE multi-key multi-operand lax.sort — sort keys are
+lexicographic key operands (padding rank, then per-key null rank + value),
+and every page column rides along as a payload operand. No argsort+gather:
+random index gathers serialize on TPU (~25 ns/row measured on v5e) while
+the sorting network moves payload lanes together.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import List, Sequence
 
+import jax
 import jax.numpy as jnp
 
-from presto_tpu.data.column import Page
-from presto_tpu.ops.keys import SortKey, sort_perm
+from presto_tpu.data.column import Column, Page
+from presto_tpu.ops.keys import SortKey, _orderable_values
+
+
+def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
+    """Lexicographic key operands for lax.sort: padding rows last, then
+    per-SortKey (null rank, order-transformed value)."""
+    cap = page.capacity
+    ops: List = [
+        (jnp.arange(cap, dtype=jnp.int32) >= page.num_rows).astype(jnp.int8)]
+    for k in keys:
+        col = page.columns[k.field]
+        null_rank = jnp.where(col.nulls,
+                              jnp.int8(0 if k.nulls_sort_first else 1),
+                              jnp.int8(1 if k.nulls_sort_first else 0))
+        ops.append(null_rank)
+        v = _orderable_values(col)
+        if not k.ascending:
+            v = -v.astype(jnp.int64) if not jnp.issubdtype(
+                v.dtype, jnp.floating) else -v
+        ops.append(v)
+    return ops
 
 
 def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
-    perm = sort_perm(page, keys)
-    valid = jnp.arange(page.capacity, dtype=jnp.int32) < page.num_rows
-    cols = tuple(c.gather(perm, valid) for c in page.columns)
+    key_ops = _sort_key_operands(page, keys)
+    operands = tuple(key_ops)
+    for c in page.columns:
+        operands += (c.values, c.nulls)
+    out = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=True)
+    base = len(key_ops)
+    cols = tuple(
+        Column(out[base + 2 * i], out[base + 2 * i + 1], c.type, c.dictionary)
+        for i, c in enumerate(page.columns))
     return Page(cols, page.num_rows, page.names)
 
 

@@ -134,7 +134,7 @@ def dist_hash_join_local(probe: Page, build: Page,
         build, b_pid, ndev, b_cap, axis=axis)
     out, pairs = hash_join(p_recv, b_recv, probe_fields, build_fields,
                            out_capacity, join_type)
-    if join_type in ("semi", "anti"):
+    if join_type in ("semi", "anti", "anti_exists"):
         out = _filter_semi_flag(out)
     if join_type == "anti":
         # NOT IN over a partitioned build: a NULL build key lives on only
@@ -162,7 +162,7 @@ def broadcast_hash_join_local(probe: Page, build: Page,
     b_all = all_gather_page(build, ndev, axis)
     out, pairs = hash_join(probe, b_all, probe_fields, build_fields,
                            out_capacity, join_type)
-    if join_type in ("semi", "anti"):
+    if join_type in ("semi", "anti", "anti_exists"):
         out = _filter_semi_flag(out)
     return out, (pairs,)
 

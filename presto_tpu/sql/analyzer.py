@@ -149,6 +149,57 @@ def _expr_idents(e) -> Set[Tuple[str, ...]]:
     return out
 
 
+def _rewrite_idents(e, mapping):
+    """Replace Idents whose parts are in `mapping` with bare Idents of the
+    mapped name, rebuilding only changed nodes. Does not descend into
+    nested ast.Select scopes (their identifiers resolve locally)."""
+    if isinstance(e, ast.Ident):
+        new = mapping.get(e.parts)
+        return ast.Ident((new,)) if new is not None else e
+    if isinstance(e, ast.Select):
+        return e
+    if dataclasses.is_dataclass(e):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            nv = _rewrite_idents(v, mapping)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+    if isinstance(e, tuple):
+        return tuple(_rewrite_idents(x, mapping) for x in e)
+    return e
+
+
+def _null_preserving_item(e) -> bool:
+    """True if the scalar-subquery item expression is NULL-preserving
+    around its aggregates at this query's scope: a NULL aggregate result
+    (empty group) propagates to a NULL item value, so the decorrelating
+    LEFT-join miss produces the correct SQL answer. count() (0, not NULL,
+    over an empty group) and null-swallowing forms (coalesce, case,
+    is-null tests) break that. Nested ast.Select scopes resolve their own
+    aggregates and are not descended into."""
+    ok = True
+
+    def walk(x):
+        nonlocal ok
+        if not ok:
+            return
+        if isinstance(x, ast.FuncCall) and x.name in ("count", "coalesce",
+                                                      "ifnull", "nullif"):
+            ok = False
+        elif isinstance(x, (ast.Case, ast.IsNull)):
+            ok = False
+        elif dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+    walk(e)
+    return ok
+
+
 class Planner:
     """Plans one Select (recursively for subqueries) against a catalog.
 
@@ -373,12 +424,26 @@ class Planner:
         agg_col: RowExpression = InputRef(n_outer + len(corr),
                                           sub_rp.fields[len(corr)].type)
         # SQL: count over an empty correlated set is 0, not NULL — the
-        # LEFT-join miss must coalesce for count-shaped subqueries.
-        if isinstance(sub_q.items[0].expr, ast.FuncCall) and \
-                sub_q.items[0].expr.name == "count":
+        # LEFT-join miss must coalesce for count-shaped subqueries. Other
+        # bare aggregates (sum/min/max/avg) are NULL over an empty set, so
+        # the LEFT-join NULL is already correct; but an *expression around*
+        # count (count(*)+1, coalesce(count(x),0)*2) would need the
+        # coalesce applied under the expression — unsupported, fail loudly
+        # instead of silently returning NULL for empty groups.
+        item_expr = sub_q.items[0].expr
+        if isinstance(item_expr, ast.FuncCall) and item_expr.name == "count":
             agg_col = SpecialForm(Form.COALESCE,
                                   (agg_col, Literal(0, agg_col.type)),
                                   agg_col.type)
+        elif not _null_preserving_item(item_expr):
+            # Expressions around an aggregate are fine iff NULL-preserving
+            # (0.2*avg(x) -> NULL on empty group == SQL). count (NULL vs 0)
+            # and null-swallowing wrappers (coalesce/case/is null) are not.
+            raise AnalysisError(
+                "correlated scalar subquery item is not null-preserving "
+                "around its aggregate (count()/coalesce/case); the empty-"
+                "group result would be NULL instead of the SQL value — "
+                "rewrite with the bare aggregate as the subquery item")
         args = (agg_col, val) if flipped else (val, agg_col)
         pred = Call(op, args, BOOLEAN)
         filt = FilterNode(node.output_names, node.output_types, node, pred)
@@ -768,14 +833,14 @@ class Planner:
         bk = [key_pos[i.parts] for _o, i in corr_eq]
         join_fields = tagged_fields + sub_rp.fields
         # Residual references inner cols by their original (possibly
-        # qualified) names: give the joined inner fields those names.
-        view_fields = tagged_fields + tuple(
-            Field(p[-1], sub_rp.fields[i].type,
-                  p[0] if len(p) == 2 else None)
-            for i, p in enumerate(needed))
+        # qualified) names. Re-aliasing the joined inner fields back to
+        # those names would shadow/clash with same-named outer fields, so
+        # instead rewrite the residual AST's inner identifiers to the
+        # unique _ek aliases and analyze in the combined scope as-is.
+        ek_map = {p: f"_ek{i}" for i, p in enumerate(needed)}
         res_expr = None
         for cc in corr_res:
-            e = self.analyze(cc, view_fields)
+            e = self.analyze(_rewrite_idents(cc, ek_map), join_fields)
             res_expr = e if res_expr is None else \
                 SpecialForm(Form.AND, (res_expr, e), BOOLEAN)
         matches = JoinNode(tuple(f.name for f in join_fields),

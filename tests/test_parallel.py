@@ -251,3 +251,24 @@ def test_dist_semi_join(mesh):
     expect = sorted(k for rows in probe_rows for k, _ in rows
                     if k in build_keys)
     assert got == expect
+
+
+@pytest.mark.parametrize("broadcast", [False, True])
+def test_dist_anti_exists_join(mesh, broadcast):
+    # ADVICE r1: dist wrappers must strip the match-flag column for
+    # anti_exists too, not just semi/anti.
+    probe_rows = [[(d * 2 + j, 1.0) for j in range(2)] for d in range(NDEV)]
+    build_rows = [[(d, 0.0)] if d % 2 == 0 else [] for d in range(NDEV)]
+    probe = stack_pages(make_local_pages(probe_rows, cap=16))
+    build = stack_pages(make_local_pages(build_rows, cap=16))
+
+    out, _ = dist_hash_join(device_mesh(NDEV), probe, build, [0], [0],
+                            out_capacity=256, join_type="anti_exists",
+                            broadcast=broadcast)
+    pages = unstack_page(out)
+    assert pages[0].num_columns == 2       # flag column stripped
+    got = sorted(r[0] for p in pages for r in p.to_pylist())
+    build_keys = {d for d in range(NDEV) if d % 2 == 0}
+    expect = sorted(k for rows in probe_rows for k, _ in rows
+                    if k not in build_keys)
+    assert got == expect
