@@ -14,11 +14,11 @@ testing compares against a pandas oracle over the *same* data
 (tests/oracle.py), mirroring the reference's H2QueryRunner strategy
 (presto-tests/.../H2QueryRunner.java).
 
-Tables partition by their primary key ranges (part k of n), matching the
+Tables partition by primary-key row ranges (part k of n), matching the
 reference's split model where tpch splits are self-describing
-(TpchSplitManager): part k of the distributed scan regenerates exactly its
-rows with a part-local RNG, so any worker/shard can produce its split
-without coordination.
+(TpchSplitManager). Splits are row-range slices of the cached full table
+so string codes share one table-wide StringDict — the invariant every
+cross-device exchange and dictionary-aligned operator relies on.
 """
 
 from __future__ import annotations
@@ -219,7 +219,10 @@ def _seed(name: str, sf: float, part: int) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def _gen_table(name: str, sf: float, part: int, num_parts: int) -> HostTable:
+def _gen_table(name: str, sf: float) -> HostTable:
+    # Whole-table generation only: splits are row-range slices served by
+    # TpchConnector.table() so codes share one table-wide StringDict.
+    part, num_parts = 0, 1
     c = _counts(sf)
     rng = np.random.default_rng(
         _seed(name if name != "lineitem" else "orders", sf, part))
@@ -319,7 +322,7 @@ def _gen_table(name: str, sf: float, part: int, num_parts: int) -> HostTable:
             rng.uniform(1.0, 1000.0, size=n), 2)
         put_str("ps_comment", _comment(rng, n, 6))
     elif name in ("orders", "lineitem"):
-        return _gen_orders_lineitem(name, sf, part, num_parts)
+        return _gen_orders_lineitem(name, sf)
     else:
         raise KeyError(name)
 
@@ -327,10 +330,10 @@ def _gen_table(name: str, sf: float, part: int, num_parts: int) -> HostTable:
 
 
 @functools.lru_cache(maxsize=32)
-def _gen_orders_lineitem(which: str, sf: float, part: int,
-                         num_parts: int) -> HostTable:
+def _gen_orders_lineitem(which: str, sf: float) -> HostTable:
     """Orders and their lineitems generate together (totalprice is the sum
-    of its lines; lineitem is partitioned by orderkey range with orders)."""
+    of its lines). Whole-table only — splits are slices, see table()."""
+    part, num_parts = 0, 1
     c = _counts(sf)
     rng = np.random.default_rng(_seed("orders", sf, part))
     lo, hi = _slice_rows(c["orders"], part, num_parts)
@@ -442,6 +445,17 @@ class TpchConnector:
 
     def table(self, name: str, part: int = 0, num_parts: int = 1
               ) -> HostTable:
+        """Full table (cached), or split `part` of `num_parts` as a
+        row-range slice of it. Slices share the full table's StringDicts,
+        so codes are globally consistent — the property every cross-device
+        exchange and dictionary-aligned operator relies on (reference
+        analogue: TpchSplitManager handing row ranges of one logical
+        table, presto-tpch/.../TpchSplitManager.java)."""
         if name not in TPCH_SCHEMA:
             raise KeyError(f"unknown tpch table {name}")
-        return _gen_table(name, self.scale_factor, part, num_parts)
+        full = _gen_table(name, self.scale_factor)  # lru_cached
+        if num_parts == 1:
+            return full
+        lo, hi = _slice_rows(full.num_rows, part, num_parts)
+        arrays = {c: a[lo:hi] for c, a in full.arrays.items()}
+        return HostTable(name, hi - lo, arrays, full.types, full.dicts)

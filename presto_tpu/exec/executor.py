@@ -30,7 +30,7 @@ from presto_tpu.expr.nodes import (
     Call, InputRef, Literal, RowExpression, SpecialForm,
 )
 from presto_tpu.ops.aggregate import grouped_aggregate
-from presto_tpu.ops.join import hash_join
+from presto_tpu.ops.join import hash_join, merge_join
 from presto_tpu.ops.sort import limit_page, sort_page, top_n
 from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
@@ -59,18 +59,22 @@ class Executor:
     def __init__(self, connector):
         self.connector = connector
         self._compiled: Dict = {}   # (plan, caps) -> (jitted, scans, watch)
+        self._learned: Dict = {}    # plan -> learned capacity assignment
 
     def execute(self, plan: PlanNode) -> Page:
         plan = self._resolve_subqueries(plan)
-        caps: Dict[int, int] = {}
+        plan = self._prepare(plan)
+        # Learned capacities persist per plan: overflow retries and
+        # merge-join duplicate fallbacks are paid once, not per execution.
+        caps: Dict = self._learned.setdefault(plan, {})
         for _attempt in range(8):
             # _lower is cheap (no tracing) and fills `caps` with its chosen
             # capacities, which completes the compilation cache key.
             fn, scans, watch = self._lower(plan, caps)
-            key = (plan, tuple(sorted(caps.items())))
+            key = (plan, tuple(sorted(caps.items(), key=repr)))
             entry = self._compiled.get(key)
             if entry is None:
-                entry = (jax.jit(fn), scans, watch)
+                entry = (jax.jit(self._wrap(fn)), scans, watch)
                 self._compiled[key] = entry
             fn, scans, watch = entry
             pages = [self._fetch(s) for s in scans]
@@ -86,6 +90,37 @@ class Executor:
                 return out
         raise RuntimeError("capacity retry loop did not converge")
 
+    # ---- hooks overridden by the distributed executor ------------------
+    def _prepare(self, plan: PlanNode) -> PlanNode:
+        return plan
+
+    def _wrap(self, fn: Callable) -> Callable:
+        return fn
+
+    def _page_rows(self, page: Page):
+        return page.to_pylist()
+
+    def _scan_rows(self, node) -> int:
+        return self.connector.table(node.table).num_rows
+
+    def _unique_ids(self, p: Page) -> jnp.ndarray:
+        return jnp.arange(p.capacity, dtype=jnp.int64)
+
+    def _finish_agg(self, node, out: Page) -> Page:
+        return out
+
+    def _finish_values(self, out: Page) -> Page:
+        return out
+
+    def _lower_exchange(self, node, nid, src, cap, caps, watch, _needed):
+        """Single-process executor: an exchange is a no-op relabel (all
+        rows already live in one page). The distributed executor overrides
+        this with ICI collectives."""
+        def out_fn(pages, node=node):
+            p = src(pages)
+            return Page(p.columns, p.num_rows, node.output_names)
+        return out_fn, cap
+
     # ------------------------------------------------------------------
     def _fetch(self, s: ScanSpec) -> Page:
         t = self.connector.table(s.table)
@@ -100,7 +135,7 @@ class Executor:
         def rewrite_expr(e: RowExpression) -> RowExpression:
             if isinstance(e, Subquery):
                 page = self.execute(e.plan)
-                rows = page.to_pylist()
+                rows = self._page_rows(page)
                 if len(rows) != 1:
                     raise RuntimeError(
                         f"scalar subquery returned {len(rows)} rows")
@@ -185,7 +220,7 @@ class Executor:
                 # Exact row count (generation is cached), not the planner
                 # estimate — an under-estimated bucket would truncate rows.
                 cap = caps.get(nid) or bucket_capacity(
-                    self.connector.table(node.table).num_rows)
+                    self._scan_rows(node))
                 idx = len(scans)
                 scans.append(ScanSpec(node.table, node.columns, cap))
                 return lambda pages: pages[idx], cap
@@ -197,9 +232,8 @@ class Executor:
                             __import__("numpy").array(
                                 [r[i] for r in node.rows]), t)
                         for i, t in enumerate(node.output_types))
-                    if not cols:
-                        return Page((), jnp.asarray(n, jnp.int32), ())
-                    return Page(cols, jnp.asarray(n, jnp.int32), ())
+                    return self._finish_values(
+                        Page(cols, jnp.asarray(n, jnp.int32), ()))
                 return values_fn, bucket_capacity(max(len(node.rows), 1))
             if isinstance(node, FilterNode):
                 src, cap = build(node.source)
@@ -265,19 +299,26 @@ class Executor:
                         p, node.group_fields, node.aggs, out_cap,
                         row_mask=mask)
                     _needed.append(true_groups)
-                    return out
+                    return self._finish_agg(node, out)
                 return agg_fn, out_cap
             if isinstance(node, JoinNode):
                 psrc, pcap = build(node.probe)
                 bsrc, bcap = build(node.build)
                 if node.join_type in (JoinType.SEMI, JoinType.ANTI,
                                       JoinType.ANTI_EXISTS):
+                    # Merge path: duplicates can't change a match flag,
+                    # so no fallback is ever needed here.
                     def semi_fn(pages, node=node):
                         p = psrc(pages)
                         b = bsrc(pages)
-                        out, _tot = hash_join(
+                        out, _dup = merge_join(
                             p, b, node.probe_keys, node.build_keys,
-                            p.capacity, node.join_type.value)
+                            node.join_type.value)
+                        if node.emit_flag:
+                            # Protocol SemiJoinNode contract: keep every
+                            # probe row, expose the flag column.
+                            return Page(out.columns, out.num_rows,
+                                        node.output_names)
                         flag = out.columns[-1]
                         filtered = compact(
                             Page(out.columns[:-1], out.num_rows,
@@ -285,6 +326,39 @@ class Executor:
                             flag.values.astype(bool))
                         return filtered
                     return semi_fn, pcap
+
+                # Unique-build merge join first (two sorts + scans; the
+                # TPU-fast path — TPC-H joins are FK joins). The dup
+                # counter rides the generic overflow-retry loop under the
+                # negated node id: any duplicate live build key re-lowers
+                # onto the expansion hash_join below.
+                use_merge = (bool(node.probe_keys)
+                             and node.join_type in (JoinType.INNER,
+                                                    JoinType.LEFT)
+                             and caps.get(-nid, 0) == 0)
+                if use_merge:
+                    caps[-nid] = 0
+                    watch.append(-nid)
+
+                    def mjoin_fn(pages, node=node):
+                        p = psrc(pages)
+                        b = bsrc(pages)
+                        out, dup = merge_join(
+                            p, b, node.probe_keys, node.build_keys,
+                            node.join_type.value)
+                        _needed.append(dup)
+                        out = Page(out.columns, out.num_rows,
+                                   node.output_names)
+                        if node.filter is not None:
+                            c = compile_expr(node.filter)(out)
+                            if node.join_type == JoinType.LEFT:
+                                raise NotImplementedError(
+                                    "residual filter on outer join")
+                            out = compact(out,
+                                          ~c.nulls & c.values.astype(bool))
+                        return out
+                    return mjoin_fn, pcap
+
                 fan = max(node.fanout_hint, 1.0)
                 out_cap = caps.get(nid) or bucket_capacity(
                     min(int(pcap * fan), 2**26))
@@ -314,7 +388,7 @@ class Executor:
 
                 def rowid_fn(pages, node=node):
                     p = src(pages)
-                    ids = jnp.arange(p.capacity, dtype=jnp.int64)
+                    ids = self._unique_ids(p)
                     col = Column(ids, ~p.row_valid(),
                                  node.output_types[-1], None)
                     return Page(p.columns + (col,), p.num_rows,
@@ -331,7 +405,11 @@ class Executor:
                 src, cap = build(node.source)
                 return (lambda pages: limit_page(src(pages),
                                                  node.count)), cap
-            if isinstance(node, (OutputNode, ExchangeNode)):
+            if isinstance(node, ExchangeNode):
+                src, cap = build(node.source)
+                return self._lower_exchange(node, nid, src, cap, caps,
+                                            watch, _needed)
+            if isinstance(node, OutputNode):
                 src, cap = build(node.source)
 
                 def out_fn(pages, node=node):

@@ -41,6 +41,146 @@ def _aligned_keys(probe: Page, build: Page, probe_fields, build_fields):
     return pcols, bcols
 
 
+def merge_join(probe: Page, build: Page,
+               probe_fields: Sequence[int], build_fields: Sequence[int],
+               join_type: str = "inner",
+               ) -> Tuple[Page, jnp.ndarray]:
+    """Sort-merge join for UNIQUE build keys (+ semi/anti, where
+    duplicates cannot change the answer). The TPU-native replacement for
+    the searchsorted probe: binary search with millions of queries and
+    random pair-expansion gathers both serialize on TPU, while this path
+    is two multi-operand sorts plus blocked fill-forward scans.
+
+      1. Co-sort build+probe rows by the actual key values, build rows
+         first within a key run.
+      2. Blocked fill-forward (ops/scan.py) propagates each build row's
+         payload and key to the probe slots after it — a probe slot
+         matches iff the propagated key equals its own.
+      3. A second sort restores probe order carrying only the per-probe
+         results; probe columns never move at all.
+
+    Returns (page, dup_count) where dup_count > 0 means the build side
+    had duplicate live keys: for inner/left the caller must fall back to
+    the expansion join (hash_join); semi/anti results stay valid. Output
+    layout matches hash_join: probe cols ++ build cols (inner/left), or
+    probe cols ++ match flag (semi/anti/anti_exists).
+
+    Reference roles: MergeJoinNode / sorted-exchange MergeOperator
+    (presto-main-base/.../operator/MergeOperator.java) fused with the
+    LookupJoin contract (LookupJoinOperator.java:52).
+    """
+    import jax
+
+    from presto_tpu.ops.scan import fill_forward
+
+    pcap, bcap = probe.capacity, build.capacity
+    cap = bcap + pcap
+    pcols, bcols = _aligned_keys(probe, build, probe_fields, build_fields)
+
+    p_null = jnp.zeros((pcap,), dtype=bool)
+    for c in pcols:
+        p_null = p_null | c.nulls
+    b_null = jnp.zeros((bcap,), dtype=bool)
+    for c in bcols:
+        b_null = b_null | c.nulls
+
+    b_present = build.row_valid() & ~b_null
+    p_live = probe.row_valid()
+
+    def cat(b, p):
+        return jnp.concatenate([b, p])
+
+    # Sort keys: dead rows last, then per key column (null rank, value),
+    # then build-before-probe.
+    key_ops = [cat((~build.row_valid()).astype(jnp.int8),
+                   (~p_live).astype(jnp.int8))]
+    for pc, bc in zip(pcols, bcols):
+        key_ops.append(cat(bc.nulls, pc.nulls).astype(jnp.int8))
+        key_ops.append(cat(group_values(bc), group_values(pc)))
+    tag = cat(jnp.zeros((bcap,), jnp.int8), jnp.ones((pcap,), jnp.int8))
+    key_ops.append(tag)
+
+    present = cat(b_present, jnp.zeros((pcap,), bool))
+    src_pos = cat(jnp.zeros((bcap,), jnp.int32),
+                  jnp.arange(pcap, dtype=jnp.int32))
+    operands = tuple(key_ops) + (present, src_pos)
+    carry_build = join_type in ("inner", "left")
+    if carry_build:
+        for c in build.columns:
+            operands += (cat(c.values, jnp.zeros((pcap,), c.values.dtype)),
+                         cat(c.nulls, jnp.ones((pcap,), bool)))
+    s = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=False)
+    nk = len(key_ops)
+    s_tag = s[nk - 1]
+    s_present = s[nk]
+    s_src = s[nk + 1]
+    is_probe = s_tag.astype(bool)
+
+    # Duplicate live build keys: adjacent present build rows, equal keys.
+    prev_present = jnp.roll(s_present, 1).at[0].set(False)
+    same_key = jnp.ones((cap,), bool)
+    for i in range(len(probe_fields)):
+        kv = s[2 + 2 * i]
+        kn = s[1 + 2 * i].astype(bool)
+        same_key = same_key & (kv == jnp.roll(kv, 1)) & ~kn \
+            & ~jnp.roll(kn, 1)
+    dup_count = jnp.sum(s_present & prev_present & same_key
+                        ).astype(jnp.int64)
+
+    # Propagate build key + payload to following slots.
+    seen = fill_forward(s_present.astype(jnp.int8), s_present) > 0
+    match = is_probe & seen
+    for i in range(len(probe_fields)):
+        kv = s[2 + 2 * i]
+        kn = s[1 + 2 * i].astype(bool)
+        ffv = fill_forward(kv, s_present)
+        match = match & (ffv == kv) & ~kn
+    ff_payload = []
+    if carry_build:
+        for j in range(len(build.columns)):
+            vals = s[nk + 2 + 2 * j]
+            nulls = s[nk + 3 + 2 * j]
+            ff_payload.append((fill_forward(vals, s_present),
+                               fill_forward(nulls, s_present)))
+
+    # Restore probe order; carry only per-probe results.
+    back_keys = ((1 - s_tag).astype(jnp.int8), s_src)
+    back_ops = back_keys + (match,)
+    for fv, fn in ff_payload:
+        back_ops += (fv, fn)
+    b2 = jax.lax.sort(back_ops, num_keys=2, is_stable=False)
+    match_p = b2[2][:pcap]
+
+    if join_type in ("semi", "anti", "anti_exists"):
+        if join_type == "semi":
+            flag = match_p
+        elif join_type == "anti_exists":
+            flag = ~match_p & p_live
+        else:
+            b_has_null = jnp.any(b_null & build.row_valid())
+            flag = ~match_p & ~p_null & ~b_has_null & p_live
+        col = Column(flag, jnp.zeros((pcap,), bool), _bool_type(), None)
+        out = Page(probe.columns + (col,), probe.num_rows, ())
+        return out, dup_count
+
+    build_valid = match_p
+    out_cols = list(probe.columns)
+    for j, c in enumerate(build.columns):
+        fv = b2[3 + 2 * j][:pcap]
+        fn = b2[4 + 2 * j][:pcap]
+        sent = jnp.asarray(c.type.null_sentinel(), dtype=fv.dtype)
+        vals = jnp.where(build_valid, fv, sent)
+        nulls = jnp.where(build_valid, fn, True)
+        out_cols.append(Column(vals, nulls, c.type, c.dictionary))
+
+    if join_type == "left":
+        return Page(tuple(out_cols), probe.num_rows, ()), dup_count
+    # inner: keep only matched probe rows.
+    from presto_tpu.data.column import compact
+    page = Page(tuple(out_cols), probe.num_rows, ())
+    return compact(page, match_p), dup_count
+
+
 def hash_join(probe: Page, build: Page,
               probe_fields: Sequence[int], build_fields: Sequence[int],
               out_capacity: int, join_type: str = "inner",

@@ -43,6 +43,37 @@ def cumsum(x: jnp.ndarray) -> jnp.ndarray:
     return out.reshape(-1)[:n]
 
 
+def fill_forward(vals: jnp.ndarray, present: jnp.ndarray,
+                 init=None):
+    """Per-slot last `present` value at or before the slot (blocked
+    fill-forward scan). Slots before the first present value get `init`
+    (default: the dtype's zero). The merge-join propagation primitive:
+    after co-sorting build rows ahead of probe rows per key, every probe
+    slot reads its candidate build row without any random gather."""
+    import jax
+
+    if init is None:
+        init = jnp.zeros((), dtype=vals.dtype)
+    x2, n = _pad_to_blocks(vals)
+    p2, _ = _pad_to_blocks(present.astype(jnp.int8))
+    p2 = p2.astype(bool)
+
+    def op(a, b):
+        av, ap = a
+        bv, bp = b
+        return jnp.where(bp, bv, av), ap | bp
+
+    within_v, within_p = jax.lax.associative_scan(op, (x2, p2), axis=1)
+    blk_v, blk_p = within_v[:, -1], within_p[:, -1]
+    pre_v, pre_p = jax.lax.associative_scan(op, (blk_v, blk_p), axis=0)
+    # exclusive block prefix
+    pre_v = jnp.concatenate([jnp.full((1,), init, vals.dtype), pre_v[:-1]])
+    pre_p = jnp.concatenate([jnp.zeros((1,), bool), pre_p[:-1]])
+    out = jnp.where(within_p, within_v,
+                    jnp.where(pre_p[:, None], pre_v[:, None], init))
+    return out.reshape(-1)[:n]
+
+
 def segment_sums(vals: jnp.ndarray, starts: jnp.ndarray,
                  ends: jnp.ndarray) -> jnp.ndarray:
     """Per-segment sums over *contiguous* segments (rows pre-sorted by

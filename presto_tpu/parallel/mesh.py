@@ -44,8 +44,13 @@ def stack_pages(pages: Sequence[Page]) -> Page:
 
 
 def unstack_page(stacked: Page) -> List[Page]:
-    ndev = stacked.num_rows.shape[0]
-    return [jax.tree_util.tree_map(lambda x: x[i], stacked)
+    """Split a sharded page into per-device host-side pages. Transfers to
+    host first: eager slicing of a sharded device array re-dispatches an
+    XLA program per access (and aborts on some backends); result
+    consumption is a host concern anyway."""
+    host = jax.device_get(stacked)
+    ndev = host.num_rows.shape[0]
+    return [jax.tree_util.tree_map(lambda x: x[i], host)
             for i in range(ndev)]
 
 

@@ -1,0 +1,194 @@
+"""AddExchanges + PlanFragmenter — the passes that make a plan distributed.
+
+Reference roles:
+  - presto-main-base/.../sql/planner/optimizations/AddExchanges.java:
+    walks the plan tracking each subtree's partitioning property and
+    inserts ExchangeNodes where an operator needs a different distribution
+    (hash for aggregations/joins, broadcast for replicated builds, single
+    for order/limit/output).
+  - presto-main-base/.../sql/planner/PlanFragmenter.java:48: cuts the
+    exchanged plan at remote ExchangeNodes into PlanFragments, each with a
+    partitioning handle and remote sources.
+
+TPU mapping (SURVEY.md §2.5): inside one multi-chip worker every exchange
+lowers to an ICI collective (all_to_all / all_gather) over the 1-D device
+mesh; across workers the same fragment tree rides the HTTP pull protocol.
+
+Aggregations are split PARTIAL -> exchange(hash group keys) -> FINAL using
+the same AggSpec rewrite the distributed layer uses
+(parallel/dist.split_agg_specs — AggregationNode.Step semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from presto_tpu.plan.nodes import (
+    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
+    JoinType, LimitNode, OutputNode, Partitioning, PlanNode, ProjectNode,
+    SortNode, Step, TableScanNode, TopNNode, ValuesNode,
+)
+from presto_tpu.types import BIGINT, DOUBLE
+
+
+def _partial_agg_layout(node: AggregationNode):
+    """(partial_specs, final_specs, partial_names, partial_types)."""
+    from presto_tpu.parallel.dist import split_agg_specs
+
+    k = len(node.group_fields)
+    partial, final = split_agg_specs(node.aggs, k)
+    names: List[str] = [node.source.output_names[f]
+                        for f in node.group_fields]
+    types = [node.source.output_types[f] for f in node.group_fields]
+    for i, a in enumerate(partial):
+        if a.kind == "avg_partial":
+            names += [f"_p{i}_sum", f"_p{i}_cnt"]
+            types += [DOUBLE, BIGINT]
+        elif a.kind in ("count", "count_star"):
+            names.append(f"_p{i}")
+            types.append(BIGINT)
+        else:
+            names.append(f"_p{i}")
+            types.append(a.output_type)
+    return partial, final, tuple(names), tuple(types)
+
+
+def add_exchanges(plan: PlanNode) -> PlanNode:
+    """Insert ExchangeNodes so every operator sees the distribution it
+    needs. Shared subtrees (mark joins) are rewritten once (id-memoized) so
+    execution-time memoization still evaluates them once."""
+    memo: Dict[int, Tuple[PlanNode, Partitioning]] = {}
+
+    def visit(node: PlanNode) -> Tuple[PlanNode, Partitioning]:
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        out = visit_inner(node)
+        memo[key] = out
+        return out
+
+    def exchange(child: PlanNode, part: Partitioning,
+                 keys: Tuple[int, ...] = ()) -> PlanNode:
+        return ExchangeNode(child.output_names, child.output_types,
+                            source=child, partitioning=part, keys=keys)
+
+    def single(child: PlanNode, part: Partitioning) -> PlanNode:
+        if part == Partitioning.SINGLE:
+            return child
+        return exchange(child, Partitioning.SINGLE)
+
+    def visit_inner(node: PlanNode) -> Tuple[PlanNode, Partitioning]:
+        if isinstance(node, (TableScanNode,)):
+            return node, Partitioning.SOURCE
+        if isinstance(node, ValuesNode):
+            # Emitted on device 0 only (see dist executor) — a single
+            # stream, exchanged when a consumer needs otherwise.
+            return node, Partitioning.SINGLE
+
+        if isinstance(node, (FilterNode, ProjectNode, AssignUniqueIdNode)):
+            src, part = visit(node.source)
+            return dataclasses.replace(node, source=src), part
+
+        if isinstance(node, AggregationNode):
+            src, part = visit(node.source)
+            assert node.step == Step.SINGLE, "re-fragmenting a split agg"
+            partial, final, pnames, ptypes = _partial_agg_layout(node)
+            part_node = AggregationNode(
+                pnames, ptypes, source=src,
+                group_fields=node.group_fields, aggs=tuple(partial),
+                step=Step.PARTIAL, group_count_hint=node.group_count_hint)
+            k = len(node.group_fields)
+            if k == 0:
+                exch = exchange(part_node, Partitioning.SINGLE)
+                out_part = Partitioning.SINGLE
+            else:
+                exch = exchange(part_node, Partitioning.HASH,
+                                tuple(range(k)))
+                out_part = Partitioning.HASH
+            final_node = AggregationNode(
+                node.output_names, node.output_types, source=exch,
+                group_fields=tuple(range(k)), aggs=tuple(final),
+                step=Step.FINAL, group_count_hint=node.group_count_hint)
+            return final_node, out_part
+
+        if isinstance(node, JoinNode):
+            probe, _pp = visit(node.probe)
+            build, _bp = visit(node.build)
+            string_keys = any(
+                node.probe.output_types[f].is_string
+                for f in node.probe_keys)
+            broadcast = (not node.probe_keys or string_keys
+                         or node.join_type == JoinType.ANTI)
+            if broadcast:
+                # Replicated build: correct for every join type incl. the
+                # NOT IN null-globalization (whole build side visible).
+                b = exchange(build, Partitioning.BROADCAST)
+                return (dataclasses.replace(node, probe=probe, build=b),
+                        Partitioning.SOURCE)
+            p = exchange(probe, Partitioning.HASH, tuple(node.probe_keys))
+            b = exchange(build, Partitioning.HASH, tuple(node.build_keys))
+            return (dataclasses.replace(node, probe=p, build=b),
+                    Partitioning.HASH)
+
+        if isinstance(node, (SortNode, TopNNode, LimitNode)):
+            src, part = visit(node.source)
+            return (dataclasses.replace(node, source=single(src, part)),
+                    Partitioning.SINGLE)
+
+        if isinstance(node, OutputNode):
+            src, part = visit(node.source)
+            return (dataclasses.replace(node, source=src), part)
+
+        raise NotImplementedError(f"add_exchanges: {type(node).__name__}")
+
+    out, _part = visit(plan)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanFragment:
+    """One fragment of the distributed plan (reference: PlanFragment.java:52
+    — root node, partitioning handle, remote source fragment ids)."""
+    fragment_id: int
+    root: PlanNode
+    partitioning: Partitioning
+    remote_sources: Tuple[int, ...]
+
+
+def create_fragments(plan: PlanNode) -> List[PlanFragment]:
+    """Cut the exchanged plan at ExchangeNodes (reference:
+    PlanFragmenter.createSubPlans). Fragment 0 is the root. Each
+    ExchangeNode becomes the boundary: its source subtree moves into a new
+    fragment whose id the parent fragment records as a remote source."""
+    fragments: List[PlanFragment] = []
+    counter = [0]
+
+    def cut(node: PlanNode, sources: List[int]) -> PlanNode:
+        if isinstance(node, ExchangeNode):
+            child_sources: List[int] = []
+            child_root = cut(node.source, child_sources)
+            fid = counter[0] = counter[0] + 1
+            fragments.append(PlanFragment(
+                fid, child_root, node.partitioning,
+                tuple(child_sources)))
+            sources.append(fid)
+            return dataclasses.replace(node, source=None)
+        kids = node.children()
+        if not kids:
+            return node
+        repl = {}
+        names = [f.name for f in dataclasses.fields(node)]
+        if isinstance(node, JoinNode):
+            repl["probe"] = cut(node.probe, sources)
+            repl["build"] = cut(node.build, sources)
+        elif "source" in names:
+            repl["source"] = cut(node.source, sources)
+        return dataclasses.replace(node, **repl)
+
+    root_sources: List[int] = []
+    root = cut(plan, root_sources)
+    fragments.append(PlanFragment(0, root, Partitioning.SINGLE,
+                                  tuple(root_sources)))
+    fragments.sort(key=lambda f: f.fragment_id)
+    return fragments

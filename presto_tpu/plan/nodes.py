@@ -116,6 +116,10 @@ class JoinNode(PlanNode):
     # residual non-equi condition evaluated over joined rows
     filter: Optional[RowExpression] = None
     fanout_hint: float = 1.0    # expected |out| / |probe|
+    # SEMI/ANTI only: emit the match flag as a trailing BOOLEAN column
+    # instead of filtering (the protocol's SemiJoinNode semiJoinOutput
+    # contract — the coordinator plans its own FilterNode above).
+    emit_flag: bool = False
 
     def children(self):
         return (self.probe, self.build)

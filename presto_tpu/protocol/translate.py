@@ -1,0 +1,428 @@
+"""Protocol PlanFragment -> engine plan translation.
+
+The TPU worker's analogue of the C++ worker's plan conversion
+(presto-native-execution/presto_cpp/main/types/PrestoToVeloxQueryPlan.h:44
++ PrestoToVeloxExpr.cpp): protocol structs (structs.py, parsed from the
+coordinator's JSON) become presto_tpu.plan nodes + expr RowExpressions
+with positional InputRefs, resolved against each child's output layout.
+"""
+
+from __future__ import annotations
+
+import base64
+import re
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from presto_tpu.expr import nodes as E
+from presto_tpu.ops.aggregate import AggSpec
+from presto_tpu.ops.keys import SortKey
+from presto_tpu.plan import nodes as P
+from presto_tpu.protocol import structs as S
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TIMESTAMP,
+    TINYINT, VARCHAR, DecimalType, Type,
+)
+
+
+# ------------------------------------------------------------------ types
+
+_SIMPLE_TYPES = {
+    "bigint": BIGINT, "integer": INTEGER, "smallint": SMALLINT,
+    "tinyint": TINYINT, "double": DOUBLE, "real": REAL,
+    "boolean": BOOLEAN, "date": DATE, "timestamp": TIMESTAMP,
+    "varchar": VARCHAR, "char": VARCHAR, "unknown": BIGINT,
+}
+
+
+def parse_type(sig: str) -> Type:
+    """Type-signature string -> engine Type ("varchar(25)", "decimal(12,2)"
+    ...). Reference: presto_cpp/main/types/TypeParser.cpp."""
+    sig = sig.strip().lower()
+    base = sig.split("(", 1)[0]
+    if base in _SIMPLE_TYPES:
+        return _SIMPLE_TYPES[base]
+    if base == "decimal":
+        m = re.match(r"decimal\((\d+)\s*,\s*(\d+)\)", sig)
+        return DecimalType(int(m.group(1)), int(m.group(2)))
+    raise NotImplementedError(f"type signature {sig!r}")
+
+
+def _var_key_name(key: str) -> str:
+    """Map key "name<type>" -> name (Jackson key serializer for
+    VariableReferenceExpression)."""
+    return key.split("<", 1)[0]
+
+
+# ------------------------------------------------------------ expressions
+
+# presto.default.$operator$xxx / function names -> engine Call registry
+_FN_MAP = {
+    "$operator$equal": "eq", "$operator$not_equal": "ne",
+    "$operator$less_than": "lt", "$operator$less_than_or_equal": "le",
+    "$operator$greater_than": "gt",
+    "$operator$greater_than_or_equal": "ge",
+    "$operator$add": "add", "$operator$subtract": "subtract",
+    "$operator$multiply": "multiply", "$operator$divide": "divide",
+    "$operator$modulus": "modulus", "$operator$negation": "negate",
+    "$operator$cast": "cast", "not": "not", "like": "like",
+    "substr": "substr", "substring": "substr", "round": "round",
+    "abs": "abs", "lower": "lower", "upper": "upper", "length": "length",
+    "year": "extract_year", "month": "extract_month",
+    "day": "extract_day", "coalesce": "coalesce",
+}
+
+_FORM_MAP = {
+    "IF": E.Form.IF, "AND": E.Form.AND, "OR": E.Form.OR,
+    "COALESCE": E.Form.COALESCE, "IN": E.Form.IN,
+    "IS_NULL": E.Form.IS_NULL, "SWITCH": E.Form.SWITCH,
+    "BETWEEN": E.Form.BETWEEN,
+}
+
+
+def _fn_name(call: S.Call) -> str:
+    h = call.functionHandle or {}
+    sig = (h.get("signature") or {}) if isinstance(h, dict) else {}
+    qualified = sig.get("name") or call.displayName or ""
+    short = qualified.rsplit(".", 1)[-1].lower()
+    if short in _FN_MAP:
+        return _FN_MAP[short]
+    disp = (call.displayName or "").lower()
+    if disp in _FN_MAP:
+        return _FN_MAP[disp]
+    return short  # engine registry may know it directly (sum/min/...)
+
+
+def decode_constant(const: S.Constant) -> E.Literal:
+    """ConstantExpression.valueBlock (base64 single-position Block) ->
+    typed Literal, via the SerializedPage block codec."""
+    from presto_tpu.protocol.serde import _block_to_strings, _decode_block
+
+    t = parse_type(const.type)
+    raw = base64.b64decode(const.valueBlock)
+    blk, _off = _decode_block(memoryview(raw), 0)
+    if blk.nulls is not None and bool(np.asarray(blk.nulls)[0]):
+        return E.Literal(None, t)
+    if t.is_string:
+        words, codes, _nulls = _block_to_strings(blk, 1)
+        return E.Literal(str(words[int(codes[0])]), t)
+    v = np.asarray(blk.values)[0]
+    if t.name == "boolean":
+        return E.Literal(bool(v), t)
+    if t.is_floating:
+        if blk.encoding == "LONG_ARRAY" and t.name == "double":
+            v = np.asarray(blk.values).view(np.float64)[0]
+        elif blk.encoding == "INT_ARRAY" and t.name == "real":
+            v = np.asarray(blk.values).view(np.float32)[0]
+        return E.Literal(float(v), t)
+    return E.Literal(int(v), t)
+
+
+def encode_constant(value, t: Type) -> S.Constant:
+    """Typed python value -> ConstantExpression with a wire-format
+    valueBlock (inverse of decode_constant; used by tests and the
+    coordinator-side fragment builder)."""
+    from presto_tpu.protocol.serde import WireBlock, _encode_block
+
+    sig = t.name if not isinstance(t, DecimalType) else \
+        f"decimal({t.precision},{t.scale})"
+    if value is None:
+        nulls = np.array([True])
+        blk = WireBlock("LONG_ARRAY", np.zeros(1, np.int64), nulls)
+    elif t.is_string:
+        blk = WireBlock("VARIABLE_WIDTH",
+                        np.array([value.encode()], dtype=object), None)
+    elif t.name == "boolean":
+        blk = WireBlock("BYTE_ARRAY", np.array([1 if value else 0],
+                                               np.int8), None)
+    elif t.name == "double":
+        blk = WireBlock("LONG_ARRAY",
+                        np.array([value], np.float64).view(np.int64), None)
+    elif t.name == "real":
+        blk = WireBlock("INT_ARRAY",
+                        np.array([value], np.float32).view(np.int32), None)
+    elif t.name in ("integer", "date"):
+        blk = WireBlock("INT_ARRAY", np.array([value], np.int32), None)
+    elif t.name == "smallint":
+        blk = WireBlock("SHORT_ARRAY", np.array([value], np.int16), None)
+    elif t.name == "tinyint":
+        blk = WireBlock("BYTE_ARRAY", np.array([value], np.int8), None)
+    else:
+        blk = WireBlock("LONG_ARRAY", np.array([value], np.int64), None)
+    out = bytearray()
+    _encode_block(out, blk)
+    return S.Constant(base64.b64encode(bytes(out)).decode(), sig)
+
+
+class Scope:
+    """Variable name -> (channel, Type) resolution for one plan input."""
+
+    def __init__(self, variables: Sequence[S.Variable]):
+        self.index: Dict[str, int] = {}
+        self.types: List[Type] = []
+        self.names: List[str] = []
+        for i, v in enumerate(variables):
+            self.index[v.name] = i
+            self.types.append(parse_type(v.type))
+            self.names.append(v.name)
+
+    def ref(self, var: S.Variable) -> E.InputRef:
+        return E.InputRef(self.index[var.name], parse_type(var.type))
+
+
+def translate_expr(x, scope: Scope) -> E.RowExpression:
+    if isinstance(x, S.Variable):
+        return scope.ref(x)
+    if isinstance(x, S.Constant):
+        return decode_constant(x)
+    if isinstance(x, S.InputReference):
+        return E.InputRef(x.field, parse_type(x.type))
+    if isinstance(x, S.SpecialForm):
+        form = _FORM_MAP.get(x.form)
+        if form is None:
+            raise NotImplementedError(f"special form {x.form}")
+        args = tuple(translate_expr(a, scope) for a in x.arguments)
+        return E.SpecialForm(form, args, parse_type(x.returnType))
+    if isinstance(x, S.Call):
+        name = _fn_name(x)
+        args = tuple(translate_expr(a, scope) for a in x.arguments)
+        return E.Call(name, args, parse_type(x.returnType))
+    raise NotImplementedError(f"expression {type(x).__name__}")
+
+
+# ------------------------------------------------------------- plan nodes
+
+_AGG_KINDS = {"sum", "count", "min", "max", "avg", "bool_or", "bool_and"}
+
+_JOIN_TYPES = {"INNER": P.JoinType.INNER, "LEFT": P.JoinType.LEFT}
+
+
+def _scan_info(node: S.TableScanNode):
+    """TableHandle/ColumnHandles -> (table name, column per variable).
+    Understands this engine's tpch connector handles; the shape mirrors
+    how PrestoToVeloxQueryPlan consults its connector protocol."""
+    h = node.table or {}
+    ch = h.get("connectorHandle", {}) if isinstance(h, dict) else {}
+    table = ch.get("tableName") or ch.get("table") or ""
+    cols = []
+    for v in node.outputVariables:
+        key = f"{v.name}<{v.type}>"
+        col = node.assignments.get(key) or node.assignments.get(v.name) or {}
+        cols.append(col.get("columnName") or col.get("name") or v.name)
+    return table, tuple(cols)
+
+
+def _sort_keys(scheme: S.OrderingScheme, scope: Scope):
+    keys = []
+    for o in scheme.orderBy:
+        order = o.sortOrder.upper()
+        keys.append(SortKey(
+            scope.index[o.variable.name],
+            ascending=order.startswith("ASC"),
+            nulls_first="NULLS_FIRST" in order))
+    return tuple(keys)
+
+
+def translate_fragment(frag: S.PlanFragment) -> P.PlanNode:
+    """protocol PlanFragment -> executable engine plan tree."""
+    return _node(frag.root)
+
+
+def _out_vars(node) -> List[S.Variable]:
+    """The protocol node's output layout (mirrors PlanNode.getOutputVariables
+    per subclass in spi/plan)."""
+    if isinstance(node, (S.TableScanNode, S.OutputNode, S.ValuesNode,
+                         S.RemoteSourceNode)):
+        return node.outputVariables
+    if isinstance(node, S.FilterNode):
+        return _out_vars(node.source)
+    if isinstance(node, S.ProjectNode):
+        return [S.Variable(_var_key_name(k), k.split("<", 1)[1][:-1])
+                for k in node.assignments.assignments]
+    if isinstance(node, S.AggregationNode):
+        out = list(node.groupingSets.groupingKeys)
+        out += [S.Variable(_var_key_name(k), k.split("<", 1)[1][:-1])
+                for k in node.aggregations]
+        return out
+    if isinstance(node, S.JoinNode):
+        return node.outputVariables
+    if isinstance(node, S.SemiJoinNode):
+        return _out_vars(node.source) + [node.semiJoinOutput]
+    if isinstance(node, (S.LimitNode, S.TopNNode, S.SortNode,
+                         S.EnforceSingleRowNode)):
+        return _out_vars(node.source)
+    if isinstance(node, S.AssignUniqueIdNode):
+        return _out_vars(node.source) + [node.idVariable]
+    if isinstance(node, S.ExchangeNode):
+        return node.partitioningScheme.outputLayout
+    raise NotImplementedError(f"output vars of {type(node).__name__}")
+
+
+def _node(n) -> P.PlanNode:
+    if isinstance(n, S.OutputNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        # Output may reorder/rename: project to the declared layout.
+        exprs = tuple(scope.ref(v) for v in n.outputVariables)
+        types = tuple(e.type for e in exprs)
+        inner = P.ProjectNode(tuple(n.columnNames), types, source=src,
+                              expressions=exprs)
+        return P.OutputNode(tuple(n.columnNames), types, source=inner)
+
+    if isinstance(n, S.TableScanNode):
+        table, cols = _scan_info(n)
+        names = tuple(v.name for v in n.outputVariables)
+        types = tuple(parse_type(v.type) for v in n.outputVariables)
+        return P.TableScanNode(names, types, table=table, columns=cols)
+
+    if isinstance(n, S.FilterNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        pred = translate_expr(n.predicate, scope)
+        return P.FilterNode(src.output_names, src.output_types,
+                            source=src, predicate=pred)
+
+    if isinstance(n, S.ProjectNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        names, types, exprs = [], [], []
+        for key, ex in n.assignments.assignments.items():
+            e = translate_expr(ex, scope)
+            names.append(_var_key_name(key))
+            types.append(e.type)
+            exprs.append(e)
+        return P.ProjectNode(tuple(names), tuple(types), source=src,
+                             expressions=tuple(exprs))
+
+    if isinstance(n, S.AggregationNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        group_fields = tuple(scope.index[v.name]
+                             for v in n.groupingSets.groupingKeys)
+        step = {"SINGLE": P.Step.SINGLE, "PARTIAL": P.Step.PARTIAL,
+                "FINAL": P.Step.FINAL}.get(n.step, P.Step.SINGLE)
+        aggs, names, types = [], [], []
+        for key, agg in n.aggregations.items():
+            kind = _fn_name(agg.call)
+            if kind == "count" and not agg.call.arguments:
+                kind = "count_star"
+            out_t = parse_type(agg.call.returnType)
+            field = None
+            if agg.call.arguments:
+                a0 = agg.call.arguments[0]
+                if not isinstance(a0, S.Variable):
+                    raise NotImplementedError(
+                        "aggregate over non-variable input (planner "
+                        "projects arguments first)")
+                field = scope.index[a0.name]
+            mask = (scope.index[agg.mask.name]
+                    if agg.mask is not None else None)
+            if kind not in _AGG_KINDS and kind != "count_star":
+                raise NotImplementedError(f"aggregate {kind}")
+            aggs.append(AggSpec(kind, field, out_t, mask_field=mask))
+            names.append(_var_key_name(key))
+            types.append(out_t)
+        out_names = tuple(v.name for v in n.groupingSets.groupingKeys) \
+            + tuple(names)
+        out_types = tuple(scope.types[f] for f in group_fields) \
+            + tuple(types)
+        return P.AggregationNode(out_names, out_types, source=src,
+                                 group_fields=group_fields,
+                                 aggs=tuple(aggs), step=step)
+
+    if isinstance(n, S.JoinNode):
+        left = _node(n.left)
+        right = _node(n.right)
+        lscope = Scope(_out_vars(n.left))
+        rscope = Scope(_out_vars(n.right))
+        jt = _JOIN_TYPES.get(n.type)
+        if jt is None:
+            raise NotImplementedError(f"join type {n.type}")
+        pk = tuple(lscope.index[c.left.name] for c in n.criteria)
+        bk = tuple(rscope.index[c.right.name] for c in n.criteria)
+        joined_vars = list(_out_vars(n.left)) + list(_out_vars(n.right))
+        jscope = Scope(joined_vars)
+        filt = (translate_expr(n.filter, jscope)
+                if n.filter is not None else None)
+        joined_names = tuple(v.name for v in joined_vars)
+        joined_types = tuple(parse_type(v.type) for v in joined_vars)
+        join = P.JoinNode(joined_names, joined_types, probe=left,
+                          build=right, join_type=jt, probe_keys=pk,
+                          build_keys=bk, filter=filt)
+        # Project down to the declared output variables.
+        exprs = tuple(jscope.ref(v) for v in n.outputVariables)
+        return P.ProjectNode(tuple(v.name for v in n.outputVariables),
+                             tuple(e.type for e in exprs), source=join,
+                             expressions=exprs)
+
+    if isinstance(n, S.SemiJoinNode):
+        src = _node(n.source)
+        filt = _node(n.filteringSource)
+        sscope = Scope(_out_vars(n.source))
+        fscope = Scope(_out_vars(n.filteringSource))
+        out_names = src.output_names + (n.semiJoinOutput.name,)
+        out_types = src.output_types + (BOOLEAN,)
+        # emit_flag: the coordinator consumes semiJoinOutput in its own
+        # FilterNode/projection above, so every probe row must survive
+        # with the match flag as a trailing BOOLEAN column.
+        return P.JoinNode(
+            out_names, out_types, probe=src, build=filt,
+            join_type=P.JoinType.SEMI,
+            probe_keys=(sscope.index[n.sourceJoinVariable.name],),
+            build_keys=(fscope.index[n.filteringSourceJoinVariable.name],),
+            filter=None, emit_flag=True)
+
+    if isinstance(n, S.LimitNode):
+        src = _node(n.source)
+        return P.LimitNode(src.output_names, src.output_types, source=src,
+                           count=int(n.count))
+
+    if isinstance(n, S.TopNNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        return P.TopNNode(src.output_names, src.output_types, source=src,
+                          keys=_sort_keys(n.orderingScheme, scope),
+                          count=int(n.count))
+
+    if isinstance(n, S.SortNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        return P.SortNode(src.output_names, src.output_types, source=src,
+                          keys=_sort_keys(n.orderingScheme, scope))
+
+    if isinstance(n, S.ValuesNode):
+        names = tuple(v.name for v in n.outputVariables)
+        types = tuple(parse_type(v.type) for v in n.outputVariables)
+        scope = Scope([])
+        rows = []
+        for row in n.rows:
+            vals = []
+            for x in row:
+                e = translate_expr(x, scope)
+                if not isinstance(e, E.Literal):
+                    raise NotImplementedError("non-literal VALUES row")
+                vals.append(e.value)
+            rows.append(tuple(vals))
+        return P.ValuesNode(names, types, rows=tuple(rows))
+
+    if isinstance(n, S.AssignUniqueIdNode):
+        src = _node(n.source)
+        return P.AssignUniqueIdNode(
+            src.output_names + (n.idVariable.name,),
+            src.output_types + (BIGINT,), source=src)
+
+    if isinstance(n, S.ExchangeNode):
+        # Local exchanges are no-ops for a whole-fragment jit executor;
+        # remote ones are fragment boundaries handled by RemoteSourceNode.
+        if len(n.sources) != 1:
+            raise NotImplementedError("multi-source exchange in fragment")
+        src = _node(n.sources[0])
+        scope = Scope(_out_vars(n.sources[0]))
+        layout = n.partitioningScheme.outputLayout
+        exprs = tuple(scope.ref(v) for v in layout)
+        return P.ProjectNode(tuple(v.name for v in layout),
+                             tuple(e.type for e in exprs), source=src,
+                             expressions=exprs)
+
+    raise NotImplementedError(f"plan node {type(n).__name__}")

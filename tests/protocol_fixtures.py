@@ -1,0 +1,262 @@
+"""Builders for coordinator-protocol fixtures.
+
+No JVM exists in this environment, so these construct the JSON the Java
+coordinator would POST (field names/discriminators follow the Java
+@JsonProperty annotations; shape verified against the captured JSON under
+the reference's presto_protocol/tests/data). Run as a script to
+(re)generate tests/fixtures/*.json.
+"""
+
+import json
+import os
+
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.translate import encode_constant
+from presto_tpu.types import DATE, DOUBLE, Type
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def var(name: str, sig: str) -> S.Variable:
+    return S.Variable(name, sig)
+
+
+def fn_handle(name: str, arg_sigs, ret: str, kind: str = "SCALAR"):
+    return {"@type": "$static", "signature": {
+        "name": f"presto.default.{name}", "kind": kind,
+        "argumentTypes": list(arg_sigs), "returnType": ret,
+        "typeVariableConstraints": [], "longVariableConstraints": [],
+        "variableArity": False}}
+
+
+def call(display: str, fname: str, ret: str, args, arg_sigs=None):
+    if arg_sigs is None:
+        arg_sigs = []
+    return S.Call(displayName=display,
+                  functionHandle=fn_handle(fname, arg_sigs, ret),
+                  returnType=ret, arguments=list(args))
+
+
+def const(value, t: Type) -> S.Constant:
+    return encode_constant(value, t)
+
+
+def tpch_table_handle(table: str, sf: float):
+    return {"connectorId": "tpch",
+            "connectorHandle": {"@type": "tpch", "tableName": table,
+                                "scaleFactor": sf}}
+
+
+def tpch_scan(node_id: str, table: str, sf: float, cols):
+    """cols: [(var name, column name, type sig)]"""
+    out_vars = [var(n, sig) for n, _c, sig in cols]
+    assigns = {f"{n}<{sig}>": {"@type": "tpch", "columnName": c,
+                               "typeSignature": sig}
+               for n, c, sig in cols}
+    return S.TableScanNode(id=node_id,
+                           table=tpch_table_handle(table, sf),
+                           outputVariables=out_vars, assignments=assigns)
+
+
+def single_partitioning():
+    return S.PartitioningHandle(
+        connectorId=None, transactionHandle=None,
+        connectorHandle={"@type": "$remote", "partitioning": "SINGLE",
+                         "function": "SINGLE"})
+
+
+def source_partitioning():
+    return S.PartitioningHandle(
+        connectorId=None, transactionHandle=None,
+        connectorHandle={"@type": "$remote",
+                         "partitioning": "SOURCE_DISTRIBUTED",
+                         "function": "UNKNOWN"})
+
+
+def partitioning_scheme(layout):
+    return S.PartitioningScheme(
+        partitioning=S.PartitioningScheme_Partitioning(
+            handle=single_partitioning(), arguments=[]),
+        outputLayout=list(layout))
+
+
+def fragment(fid: str, root, variables, scan_ids) -> S.PlanFragment:
+    return S.PlanFragment(
+        id=fid, root=root, variables=list(variables),
+        partitioning=source_partitioning(),
+        tableScanSchedulingOrder=list(scan_ids),
+        partitioningScheme=partitioning_scheme(
+            root.outputVariables if hasattr(root, "outputVariables")
+            else []),
+        stageExecutionDescriptor=S.StageExecutionDescriptor())
+
+
+def q6_fragment(sf: float = 0.01) -> S.PlanFragment:
+    """TPC-H Q6 as one single-stage fragment:
+    Output <- Agg(sum) <- Project(mul) <- Filter <- TableScan(lineitem)."""
+    scan = tpch_scan("0", "lineitem", sf, [
+        ("l_shipdate", "l_shipdate", "date"),
+        ("l_discount", "l_discount", "double"),
+        ("l_quantity", "l_quantity", "double"),
+        ("l_extendedprice", "l_extendedprice", "double"),
+    ])
+    ship = var("l_shipdate", "date")
+    disc = var("l_discount", "double")
+    qty = var("l_quantity", "double")
+    price = var("l_extendedprice", "double")
+    ge = call("GREATER_THAN_OR_EQUAL", "$operator$greater_than_or_equal",
+              "boolean", [ship, const(9131, DATE)], ["date", "date"])
+    lt = call("LESS_THAN", "$operator$less_than", "boolean",
+              [ship, const(9496, DATE)], ["date", "date"])
+    dlo = call("GREATER_THAN_OR_EQUAL",
+               "$operator$greater_than_or_equal", "boolean",
+               [disc, const(0.05, DOUBLE)], ["double", "double"])
+    dhi = call("LESS_THAN_OR_EQUAL", "$operator$less_than_or_equal",
+               "boolean", [disc, const(0.07, DOUBLE)],
+               ["double", "double"])
+    qlt = call("LESS_THAN", "$operator$less_than", "boolean",
+               [qty, const(24.0, DOUBLE)], ["double", "double"])
+    pred = S.SpecialForm(form="AND", returnType="boolean",
+                         arguments=[ge, S.SpecialForm(
+                             form="AND", returnType="boolean",
+                             arguments=[lt, S.SpecialForm(
+                                 form="AND", returnType="boolean",
+                                 arguments=[dlo, S.SpecialForm(
+                                     form="AND", returnType="boolean",
+                                     arguments=[dhi, qlt])])])])
+    filt = S.FilterNode(id="1", source=scan, predicate=pred)
+    mul = call("MULTIPLY", "$operator$multiply", "double",
+               [price, disc], ["double", "double"])
+    proj = S.ProjectNode(id="2", source=filt,
+                         assignments=S.Assignments(
+                             {"expr<double>": mul}))
+    sum_call = call("sum", "sum", "double",
+                    [var("expr", "double")], ["double"], )
+    sum_call.functionHandle["signature"]["kind"] = "AGGREGATE"
+    agg = S.AggregationNode(
+        id="3", source=proj,
+        aggregations={"revenue<double>": S.Aggregation(call=sum_call)},
+        groupingSets=S.GroupingSetDescriptor(groupingKeys=[],
+                                             groupingSetCount=1,
+                                             globalGroupingSets=[0]),
+        step="SINGLE")
+    out = S.OutputNode(id="4", source=agg, columnNames=["revenue"],
+                       outputVariables=[var("revenue", "double")])
+    return fragment("0", out, [var("revenue", "double")], ["0"])
+
+
+def q1_like_fragment(sf: float = 0.01) -> S.PlanFragment:
+    """Grouped aggregation fragment: group by returnflag/linestatus."""
+    scan = tpch_scan("0", "lineitem", sf, [
+        ("l_returnflag", "l_returnflag", "varchar(1)"),
+        ("l_linestatus", "l_linestatus", "varchar(1)"),
+        ("l_quantity", "l_quantity", "double"),
+        ("l_shipdate", "l_shipdate", "date"),
+    ])
+    ship = var("l_shipdate", "date")
+    pred = call("LESS_THAN_OR_EQUAL", "$operator$less_than_or_equal",
+                "boolean", [ship, const(10471, DATE)], ["date", "date"])
+    filt = S.FilterNode(id="1", source=scan, predicate=pred)
+    sum_call = call("sum", "sum", "double",
+                    [var("l_quantity", "double")], ["double"])
+    sum_call.functionHandle["signature"]["kind"] = "AGGREGATE"
+    cnt_call = call("count", "count", "bigint", [], [])
+    cnt_call.functionHandle["signature"]["kind"] = "AGGREGATE"
+    agg = S.AggregationNode(
+        id="2", source=filt,
+        aggregations={"sum_qty<double>": S.Aggregation(call=sum_call),
+                      "count_order<bigint>": S.Aggregation(call=cnt_call)},
+        groupingSets=S.GroupingSetDescriptor(
+            groupingKeys=[var("l_returnflag", "varchar(1)"),
+                          var("l_linestatus", "varchar(1)")],
+            groupingSetCount=1, globalGroupingSets=[]),
+        step="SINGLE")
+    sort = S.SortNode(
+        id="3", source=agg,
+        orderingScheme=S.OrderingScheme([
+            S.Ordering(var("l_returnflag", "varchar(1)"),
+                       "ASC_NULLS_LAST"),
+            S.Ordering(var("l_linestatus", "varchar(1)"),
+                       "ASC_NULLS_LAST")]))
+    names = ["l_returnflag", "l_linestatus", "sum_qty", "count_order"]
+    sigs = ["varchar(1)", "varchar(1)", "double", "bigint"]
+    out = S.OutputNode(id="4", source=sort, columnNames=names,
+                       outputVariables=[var(n, s)
+                                        for n, s in zip(names, sigs)])
+    return fragment("0", out, [var(n, s) for n, s in zip(names, sigs)],
+                    ["0"])
+
+
+def task_update_request(frag: S.PlanFragment, n_splits: int = 1,
+                        sf: float = 0.01) -> S.TaskUpdateRequest:
+    splits = [S.ScheduledSplit(
+        sequenceId=i, planNodeId="0",
+        split=S.Split(connectorId="tpch",
+                      connectorSplit={"@type": "tpch", "part": i,
+                                      "numParts": n_splits,
+                                      "scaleFactor": sf}))
+        for i in range(n_splits)]
+    return S.TaskUpdateRequest(
+        session=S.SessionRepresentation(queryId="q_fixture", user="test",
+                                        catalog="tpch", schema="sf"),
+        extraCredentials={},
+        fragment=frag.to_bytes(),
+        sources=[S.TaskSource(planNodeId="0", splits=splits,
+                              noMoreSplits=True)],
+        outputIds=S.OutputBuffers(type="PARTITIONED", version=1,
+                                  noMoreBufferIds=True,
+                                  buffers={"0": 0}))
+
+
+def write_fixtures():
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    for name, frag in (("q6_fragment", q6_fragment()),
+                       ("q1_like_fragment", q1_like_fragment())):
+        with open(os.path.join(FIXTURE_DIR, name + ".json"), "w") as f:
+            json.dump(S.PlanFragment.to_json(frag), f, indent=1,
+                      sort_keys=True)
+    tur = task_update_request(q6_fragment())
+    with open(os.path.join(FIXTURE_DIR,
+                           "task_update_request.json"), "w") as f:
+        json.dump(S.TaskUpdateRequest.to_json(tur), f, indent=1,
+                  sort_keys=True)
+
+
+if __name__ == "__main__":
+    write_fixtures()
+
+
+def semijoin_fragment(sf: float = 0.01) -> S.PlanFragment:
+    """Orders whose custkey IS IN (customers with acctbal > 0):
+    Output <- Filter(semiJoinOutput) <- SemiJoin <- scans."""
+    from presto_tpu.types import DOUBLE as _D
+
+    orders = tpch_scan("0", "orders", sf, [
+        ("o_orderkey", "o_orderkey", "bigint"),
+        ("o_custkey", "o_custkey", "bigint"),
+    ])
+    cust = tpch_scan("10", "customer", sf, [
+        ("c_custkey", "c_custkey", "bigint"),
+        ("c_acctbal", "c_acctbal", "double"),
+    ])
+    pos = call("GREATER_THAN", "$operator$greater_than", "boolean",
+               [var("c_acctbal", "double"), const(0.0, _D)],
+               ["double", "double"])
+    cust_f = S.FilterNode(id="11", source=cust, predicate=pos)
+    cust_p = S.ProjectNode(id="12", source=cust_f,
+                           assignments=S.Assignments(
+                               {"c_custkey<bigint>":
+                                var("c_custkey", "bigint")}))
+    semi = S.SemiJoinNode(
+        id="13", source=orders, filteringSource=cust_p,
+        sourceJoinVariable=var("o_custkey", "bigint"),
+        filteringSourceJoinVariable=var("c_custkey", "bigint"),
+        semiJoinOutput=var("in_set", "boolean"))
+    filt = S.FilterNode(id="14", source=semi,
+                        predicate=var("in_set", "boolean"))
+    out = S.OutputNode(id="15", source=filt,
+                       columnNames=["o_orderkey", "o_custkey"],
+                       outputVariables=[var("o_orderkey", "bigint"),
+                                        var("o_custkey", "bigint")])
+    return fragment("0", out, [var("o_orderkey", "bigint"),
+                               var("o_custkey", "bigint")], ["0", "10"])

@@ -1,0 +1,140 @@
+"""Protocol structs round-trip + PlanFragment -> engine translation tests.
+
+Round 2 acceptance (VERDICT.md #3): protocol dataclasses round-trip real
+PlanFragment JSON (committed fixtures in tests/fixtures/, plus — when the
+reference checkout is present — the coordinator JSON captured in its
+protocol test data, parsed in place), and a translated fragment EXECUTES
+against the connector with results matching the SQL engine."""
+
+import json
+import os
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.translate import (
+    decode_constant, encode_constant, parse_type, translate_fragment,
+)
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DecimalType,
+)
+from tests.protocol_fixtures import (
+    FIXTURE_DIR, q1_like_fragment, q6_fragment, task_update_request,
+    write_fixtures,
+)
+
+REF_DATA = ("/root/reference/presto-native-execution/presto_cpp/"
+            "presto_protocol/tests/data")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def fixtures():
+    write_fixtures()
+
+
+# ----------------------------------------------------------- round trips
+
+def _roundtrip(cls, j):
+    obj = cls.from_json(j)
+    j2 = cls.to_json(obj)
+    obj2 = cls.from_json(j2)
+    assert cls.to_json(obj2) == j2
+    return obj
+
+
+def test_committed_fixtures_roundtrip():
+    for name in ("q6_fragment", "q1_like_fragment"):
+        with open(os.path.join(FIXTURE_DIR, name + ".json")) as f:
+            j = json.load(f)
+        frag = _roundtrip(S.PlanFragment, j)
+        assert isinstance(frag.root, S.OutputNode)
+    with open(os.path.join(FIXTURE_DIR, "task_update_request.json")) as f:
+        j = json.load(f)
+    tur = _roundtrip(S.TaskUpdateRequest, j)
+    frag = S.PlanFragment.from_bytes(tur.fragment)
+    assert isinstance(frag.root, S.OutputNode)
+    assert tur.sources[0].splits[0].split.connectorId == "tpch"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DATA),
+                    reason="reference checkout not present")
+def test_reference_coordinator_json_parses():
+    """Parse the real coordinator-captured JSON shipped with the reference
+    (read in place, never copied): every node resolves to a typed struct,
+    and re-encoding preserves the fields this worker consumes."""
+    cases = [("FilterNode.json", S.PlanNode, S.FilterNode),
+             ("OutputNode.json", S.PlanNode, S.OutputNode),
+             ("ExchangeNode.json", S.PlanNode, S.ExchangeNode),
+             ("RemoteSourceNodeHttp.json", S.PlanNode, S.RemoteSourceNode),
+             ("ValuesNode.json", S.PlanNode, S.ValuesNode),
+             ("PlanFragmentWithRemoteSource.json", S.PlanFragment, None)]
+    for fname, cls, expect in cases:
+        with open(os.path.join(REF_DATA, fname)) as f:
+            obj = cls.from_json(json.load(f))
+        if expect is not None:
+            assert isinstance(obj, expect), fname
+    for fname in ("TaskUpdateRequest.1", "TaskUpdateRequest.2"):
+        with open(os.path.join(REF_DATA, fname)) as f:
+            tur = S.TaskUpdateRequest.from_json(json.load(f))
+        assert tur.session.queryId
+        frag = S.PlanFragment.from_bytes(tur.fragment)
+        assert isinstance(
+            frag.root, (S.AggregationNode, S.OutputNode, S.ProjectNode,
+                        S.TableScanNode, S.LimitNode))
+
+
+def test_constant_roundtrip():
+    for value, t in [(42, BIGINT), (9131, DATE), (0.07, DOUBLE),
+                     (True, BOOLEAN), ("BUILDING", VARCHAR),
+                     (None, DOUBLE), (1234, DecimalType(12, 2))]:
+        c = encode_constant(value, t)
+        lit = decode_constant(c)
+        assert lit.value == value, (value, lit.value)
+        assert parse_type(c.type).name == t.name
+
+
+# ------------------------------------------------- translate and execute
+
+def test_translated_q6_executes():
+    frag = q6_fragment(0.01)
+    # through the wire: bytes -> parse -> translate -> execute
+    plan = translate_fragment(S.PlanFragment.from_bytes(frag.to_bytes()))
+    engine = LocalEngine(TpchConnector(0.01))
+    got = engine.executor.execute(plan).to_pylist()
+    exp = engine.execute_sql(
+        "select sum(l_extendedprice * l_discount) as revenue from lineitem"
+        " where l_shipdate >= date '1995-01-01'"
+        " and l_shipdate < date '1996-01-01'"
+        " and l_discount between 0.05 and 0.07 and l_quantity < 24")
+    assert len(got) == 1
+    assert abs(got[0][0] - exp[0][0]) <= 1e-6 * max(abs(exp[0][0]), 1.0)
+
+
+def test_translated_q1_like_executes():
+    frag = q1_like_fragment(0.01)
+    plan = translate_fragment(S.PlanFragment.from_bytes(frag.to_bytes()))
+    engine = LocalEngine(TpchConnector(0.01))
+    got = engine.executor.execute(plan).to_pylist()
+    exp = engine.execute_sql(
+        "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+        "from lineitem where l_shipdate <= date '1998-09-02' "
+        "group by l_returnflag, l_linestatus "
+        "order by l_returnflag, l_linestatus")
+    assert len(got) == len(exp)
+    for g, e in zip(got, exp):
+        assert g[0] == e[0] and g[1] == e[1] and g[3] == e[3]
+        assert abs(g[2] - e[2]) <= 1e-6 * max(abs(e[2]), 1.0)
+
+
+def test_translated_semijoin_executes():
+    from tests.protocol_fixtures import semijoin_fragment
+    frag = semijoin_fragment(0.01)
+    plan = translate_fragment(S.PlanFragment.from_bytes(frag.to_bytes()))
+    engine = LocalEngine(TpchConnector(0.01))
+    got = sorted(engine.executor.execute(plan).to_pylist())
+    exp = sorted(engine.execute_sql(
+        "select o_orderkey, o_custkey from orders where o_custkey in "
+        "(select c_custkey from customer where c_acctbal > 0)"))
+    assert got == exp and len(got) > 0

@@ -1,0 +1,173 @@
+"""Blocked-scan primitives + merge join unit tests (CPU mesh harness)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import BIGINT, DOUBLE, VARCHAR
+from presto_tpu.data.column import Page
+from presto_tpu.ops.join import hash_join, merge_join
+from presto_tpu.ops.scan import cumsum, fill_forward, segment_sums
+
+
+def _page(data, types):
+    return Page.from_pydict(data, types)
+
+
+def test_blocked_cumsum_matches_numpy():
+    rng = np.random.RandomState(0)
+    for n in (1, 7, 2048, 2049, 10000):
+        x = rng.randint(-5, 5, n).astype(np.int64)
+        import jax.numpy as jnp
+        got = np.asarray(cumsum(jnp.asarray(x)))
+        assert (got == np.cumsum(x)).all(), n
+
+
+def test_fill_forward_matches_loop():
+    rng = np.random.RandomState(1)
+    import jax.numpy as jnp
+    n = 6000
+    vals = rng.randint(0, 100, n).astype(np.int64)
+    pres = rng.rand(n) < 0.05
+    got = np.asarray(fill_forward(jnp.asarray(vals), jnp.asarray(pres)))
+    exp, last = np.zeros(n, np.int64), 0
+    for i in range(n):
+        if pres[i]:
+            last = vals[i]
+        exp[i] = last
+    assert (got == exp).all()
+
+
+def test_segment_sums_contiguous():
+    import jax.numpy as jnp
+    vals = jnp.asarray(np.array([1.0, 2.0, 3.0, 4.0, 5.0, 0.0, 0.0]))
+    starts = jnp.asarray(np.array([0, 2, 5], dtype=np.int32))
+    ends = jnp.asarray(np.array([2, 5, 5], dtype=np.int32))
+    got = np.asarray(segment_sums(vals, starts, ends))
+    assert got.tolist() == [3.0, 12.0, 0.0]
+
+
+# ------------------------------------------------------------- merge join
+
+def _mj(probe, build, jt):
+    out, dup = merge_join(probe, build, [0], [0], jt)
+    return out, int(dup)
+
+
+def test_merge_join_inner_unique():
+    probe = _page({"k": [3, 1, 4, 9, 1], "v": [30.0, 10.0, 40.0, 90.0, 11.0]},
+                  {"k": BIGINT, "v": DOUBLE})
+    build = _page({"k": [1, 2, 3, 4], "w": [100.0, 200.0, 300.0, 400.0]},
+                  {"k": BIGINT, "w": DOUBLE})
+    out, dup = _mj(probe, build, "inner")
+    assert dup == 0
+    rows = sorted(out.to_pylist())
+    assert rows == [(1, 10.0, 1, 100.0), (1, 11.0, 1, 100.0),
+                    (3, 30.0, 3, 300.0), (4, 40.0, 4, 400.0)]
+
+
+def test_merge_join_left_nulls():
+    probe = _page({"k": [3, 9, None], "v": [1.0, 2.0, 3.0]},
+                  {"k": BIGINT, "v": DOUBLE})
+    build = _page({"k": [3], "w": [33.0]}, {"k": BIGINT, "w": DOUBLE})
+    out, dup = _mj(probe, build, "left")
+    assert dup == 0
+    rows = sorted(out.to_pylist(), key=lambda r: (r[1]))
+    assert rows == [(3, 1.0, 3, 33.0), (9, 2.0, None, None),
+                    (None, 3.0, None, None)]
+
+
+def test_merge_join_detects_duplicates():
+    probe = _page({"k": [1, 2], "v": [1.0, 2.0]},
+                  {"k": BIGINT, "v": DOUBLE})
+    build = _page({"k": [1, 1, 2], "w": [9.0, 8.0, 7.0]},
+                  {"k": BIGINT, "w": DOUBLE})
+    _out, dup = _mj(probe, build, "inner")
+    assert dup > 0
+
+
+def test_merge_join_semi_anti_with_dups_and_nulls():
+    probe = _page({"k": [1, 2, None, 5], "v": [1.0, 2.0, 3.0, 4.0]},
+                  {"k": BIGINT, "v": DOUBLE})
+    build = _page({"k": [1, 1, 7], "w": [0.0, 0.0, 0.0]},
+                  {"k": BIGINT, "w": DOUBLE})
+    out, _d = _mj(probe, build, "semi")
+    flags = [bool(f) for f in np.asarray(out.columns[-1].values)[:4]]
+    assert flags == [True, False, False, False]
+    out, _d = _mj(probe, build, "anti_exists")
+    flags = [bool(f) for f in np.asarray(out.columns[-1].values)[:4]]
+    assert flags == [False, True, True, True]
+    # NOT IN with a NULL build key -> nothing survives
+    build_n = _page({"k": [1, None], "w": [0.0, 0.0]},
+                    {"k": BIGINT, "w": DOUBLE})
+    out, _d = _mj(probe, build_n, "anti")
+    flags = [bool(f) for f in np.asarray(out.columns[-1].values)[:4]]
+    assert flags == [False, False, False, False]
+
+
+def test_merge_join_string_keys():
+    probe = _page({"k": ["apple", "kiwi", "pear"], "v": [1.0, 2.0, 3.0]},
+                  {"k": VARCHAR, "v": DOUBLE})
+    build = _page({"k": ["pear", "apple"], "w": [10.0, 20.0]},
+                  {"k": VARCHAR, "w": DOUBLE})
+    out, dup = _mj(probe, build, "inner")
+    assert dup == 0
+    rows = sorted(out.to_pylist())
+    assert rows == [("apple", 1.0, "apple", 20.0),
+                    ("pear", 3.0, "pear", 10.0)]
+
+
+def test_merge_join_matches_hash_join_random():
+    rng = np.random.RandomState(7)
+    pk = rng.randint(0, 50, 300)
+    bk = rng.permutation(60)[:40]          # unique build keys
+    probe = _page({"k": pk.tolist(),
+                   "v": rng.rand(300).round(3).tolist()},
+                  {"k": BIGINT, "v": DOUBLE})
+    build = _page({"k": bk.tolist(),
+                   "w": rng.rand(40).round(3).tolist()},
+                  {"k": BIGINT, "w": DOUBLE})
+    m, dup = _mj(probe, build, "inner")
+    assert dup == 0
+    h, _tot = hash_join(probe, build, [0], [0], 1024, "inner")
+    assert sorted(m.to_pylist()) == sorted(h.to_pylist())
+
+
+def test_fragmenter_structure():
+    """add_exchanges + create_fragments produce the reference fragment
+    shape: partial agg fragment (hash-partitioned) feeding a final
+    fragment, SINGLE root for ORDER BY."""
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.plan.fragment import add_exchanges, create_fragments
+    from presto_tpu.plan.nodes import AggregationNode, Partitioning, Step
+    from presto_tpu.sql.analyzer import Planner
+    from presto_tpu.sql.parser import parse_sql
+
+    planner = Planner(TpchConnector(0.01))
+    plan = planner.plan_query(parse_sql(
+        "select o_custkey, count(*) from orders group by o_custkey "
+        "order by 2 desc limit 3"))
+    exchanged = add_exchanges(plan)
+    frags = create_fragments(exchanged)
+    assert [f.fragment_id for f in frags] == [0, 1, 2]
+    parts = {f.fragment_id: f.partitioning for f in frags}
+    assert parts[0] == Partitioning.SINGLE          # root (sort/limit)
+    assert Partitioning.HASH in parts.values()      # partial->final cut
+    # Fragment sources form a tree reaching every fragment.
+    reachable, todo = set(), [0]
+    by_id = {f.fragment_id: f for f in frags}
+    while todo:
+        f = by_id[todo.pop()]
+        reachable.add(f.fragment_id)
+        todo.extend(f.remote_sources)
+    assert reachable == {0, 1, 2}
+
+    def steps(n, acc):
+        if isinstance(n, AggregationNode):
+            acc.append(n.step)
+        for c in n.children():
+            if c is not None:
+                steps(c, acc)
+    acc = []
+    for f in frags:
+        steps(f.root, acc)
+    assert Step.PARTIAL in acc and Step.FINAL in acc

@@ -1,0 +1,29 @@
+"""All 22 TPC-H queries executed DISTRIBUTED on the 8-device CPU mesh,
+checked against the same sqlite oracle as the single-device suite.
+
+This is the round-2 acceptance gate from VERDICT.md #1: the fragmenter
+(plan/fragment.add_exchanges) + DistExecutor lower every SQL plan onto the
+mesh — sharded scans, partial/final aggregation around hash exchanges,
+co-partitioned and broadcast joins — and the results must match sqlite
+row-for-row. Reference analogue: re-running AbstractTestQueries under
+DistributedQueryRunner (SURVEY.md §4)."""
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec.dist_executor import DistEngine
+from presto_tpu.parallel import device_mesh
+from tests.test_tpch_full import SF, oracle, run_case  # noqa: F401
+from tests.tpch_queries import QUERIES
+
+NDEV = 8
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DistEngine(TpchConnector(SF), device_mesh(NDEV))
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_distributed(qnum, engine, oracle):  # noqa: F811
+    run_case(qnum, engine, oracle)
