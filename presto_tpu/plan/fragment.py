@@ -56,11 +56,19 @@ def _partial_agg_layout(node: AggregationNode):
 
 def add_exchanges(plan: PlanNode) -> PlanNode:
     """Insert ExchangeNodes so every operator sees the distribution it
-    needs. Shared subtrees (mark joins) are rewritten once (id-memoized) so
-    execution-time memoization still evaluates them once."""
-    memo: Dict[int, Tuple[PlanNode, Partitioning]] = {}
+    needs. Tracks each subtree's partitioning PROPERTY — (kind, hash key
+    positions) — exactly like the reference pass, so data already
+    partitioned compatibly is never reshuffled (a FINAL aggregation or
+    join output hash-partitioned on the needed keys flows straight into
+    the next join/aggregation). Shared subtrees (mark joins) are rewritten
+    once (id-memoized) so execution-time memoization still evaluates them
+    once."""
+    # property: (Partitioning, keys) — keys are positions in the node's
+    # output, meaningful for HASH only.
+    Prop = Tuple[PlanNode, Tuple[Partitioning, Tuple[int, ...]]]
+    memo: Dict[int, Prop] = {}
 
-    def visit(node: PlanNode) -> Tuple[PlanNode, Partitioning]:
+    def visit(node: PlanNode) -> Prop:
         key = id(node)
         if key in memo:
             return memo[key]
@@ -73,48 +81,82 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
         return ExchangeNode(child.output_names, child.output_types,
                             source=child, partitioning=part, keys=keys)
 
-    def single(child: PlanNode, part: Partitioning) -> PlanNode:
-        if part == Partitioning.SINGLE:
-            return child
-        return exchange(child, Partitioning.SINGLE)
+    def hash_satisfied(prop, required: Tuple[int, ...],
+                      subset_ok: bool = False) -> bool:
+        """Is `prop` already a compatible hash partitioning? Exact key
+        tuple match always suffices (both join sides hash the same column
+        list in order). For grouping, any partition-key set CONTAINED in
+        the group keys suffices: the group keys then determine the device."""
+        kind, keys = prop
+        if kind != Partitioning.HASH or not keys:
+            return False
+        if keys == required:
+            return True
+        return subset_ok and set(keys) <= set(required)
 
-    def visit_inner(node: PlanNode) -> Tuple[PlanNode, Partitioning]:
-        if isinstance(node, (TableScanNode,)):
-            return node, Partitioning.SOURCE
+    def visit_inner(node: PlanNode) -> Prop:
+        if isinstance(node, TableScanNode):
+            return node, (Partitioning.SOURCE, ())
         if isinstance(node, ValuesNode):
             # Emitted on device 0 only (see dist executor) — a single
             # stream, exchanged when a consumer needs otherwise.
-            return node, Partitioning.SINGLE
+            return node, (Partitioning.SINGLE, ())
 
-        if isinstance(node, (FilterNode, ProjectNode, AssignUniqueIdNode)):
-            src, part = visit(node.source)
-            return dataclasses.replace(node, source=src), part
+        if isinstance(node, (FilterNode, AssignUniqueIdNode)):
+            src, prop = visit(node.source)
+            return dataclasses.replace(node, source=src), prop
+
+        if isinstance(node, ProjectNode):
+            src, prop = visit(node.source)
+            out = dataclasses.replace(node, source=src)
+            kind, keys = prop
+            if kind == Partitioning.HASH:
+                # Remap key channels through pure-InputRef projections;
+                # anything else destroys the property.
+                from presto_tpu.expr.nodes import InputRef
+                pos = {}
+                for i, e in enumerate(node.expressions):
+                    if isinstance(e, InputRef) and e.field not in pos:
+                        pos[e.field] = i
+                if all(k in pos for k in keys):
+                    return out, (Partitioning.HASH,
+                                 tuple(pos[k] for k in keys))
+                return out, (Partitioning.SOURCE, ())
+            return out, prop
 
         if isinstance(node, AggregationNode):
-            src, part = visit(node.source)
+            src, prop = visit(node.source)
             assert node.step == Step.SINGLE, "re-fragmenting a split agg"
+            k = len(node.group_fields)
+            if k and hash_satisfied(prop, tuple(node.group_fields),
+                                    subset_ok=True):
+                # Groups are device-local already: aggregate in one step.
+                single_node = dataclasses.replace(node, source=src)
+                kind, keys = prop
+                remap = {f: i for i, f in enumerate(node.group_fields)}
+                return single_node, (Partitioning.HASH,
+                                     tuple(remap[f] for f in keys))
             partial, final, pnames, ptypes = _partial_agg_layout(node)
             part_node = AggregationNode(
                 pnames, ptypes, source=src,
                 group_fields=node.group_fields, aggs=tuple(partial),
                 step=Step.PARTIAL, group_count_hint=node.group_count_hint)
-            k = len(node.group_fields)
             if k == 0:
                 exch = exchange(part_node, Partitioning.SINGLE)
-                out_part = Partitioning.SINGLE
+                out_prop = (Partitioning.SINGLE, ())
             else:
                 exch = exchange(part_node, Partitioning.HASH,
                                 tuple(range(k)))
-                out_part = Partitioning.HASH
+                out_prop = (Partitioning.HASH, tuple(range(k)))
             final_node = AggregationNode(
                 node.output_names, node.output_types, source=exch,
                 group_fields=tuple(range(k)), aggs=tuple(final),
                 step=Step.FINAL, group_count_hint=node.group_count_hint)
-            return final_node, out_part
+            return final_node, out_prop
 
         if isinstance(node, JoinNode):
-            probe, _pp = visit(node.probe)
-            build, _bp = visit(node.build)
+            probe, pprop = visit(node.probe)
+            build, bprop = visit(node.build)
             string_keys = any(
                 node.probe.output_types[f].is_string
                 for f in node.probe_keys)
@@ -125,24 +167,34 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
                 # NOT IN null-globalization (whole build side visible).
                 b = exchange(build, Partitioning.BROADCAST)
                 return (dataclasses.replace(node, probe=probe, build=b),
-                        Partitioning.SOURCE)
-            p = exchange(probe, Partitioning.HASH, tuple(node.probe_keys))
-            b = exchange(build, Partitioning.HASH, tuple(node.build_keys))
-            return (dataclasses.replace(node, probe=p, build=b),
-                    Partitioning.HASH)
+                        pprop)
+            pk, bk = tuple(node.probe_keys), tuple(node.build_keys)
+            if not hash_satisfied(pprop, pk):
+                probe = exchange(probe, Partitioning.HASH, pk)
+            if not hash_satisfied(bprop, bk):
+                build = exchange(build, Partitioning.HASH, bk)
+            out = dataclasses.replace(node, probe=probe, build=build)
+            if node.join_type in (JoinType.SEMI, JoinType.ANTI,
+                                  JoinType.ANTI_EXISTS):
+                out_keys = pk          # output = probe columns (+ flag)
+            else:
+                out_keys = pk          # probe cols first, same positions
+            return out, (Partitioning.HASH, out_keys)
 
         if isinstance(node, (SortNode, TopNNode, LimitNode)):
-            src, part = visit(node.source)
-            return (dataclasses.replace(node, source=single(src, part)),
-                    Partitioning.SINGLE)
+            src, prop = visit(node.source)
+            if prop[0] != Partitioning.SINGLE:
+                src = exchange(src, Partitioning.SINGLE)
+            return (dataclasses.replace(node, source=src),
+                    (Partitioning.SINGLE, ()))
 
         if isinstance(node, OutputNode):
-            src, part = visit(node.source)
-            return (dataclasses.replace(node, source=src), part)
+            src, prop = visit(node.source)
+            return dataclasses.replace(node, source=src), prop
 
         raise NotImplementedError(f"add_exchanges: {type(node).__name__}")
 
-    out, _part = visit(plan)
+    out, _prop = visit(plan)
     return out
 
 

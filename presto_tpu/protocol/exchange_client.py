@@ -1,0 +1,79 @@
+"""Exchange client — the pull side of the page-stream protocol.
+
+Reference roles: operator/ExchangeClient.java:71,255,322 +
+presto_cpp/main/PrestoExchangeSource.cpp: sequenced GET
+/v1/task/{id}/results/{buffer}/{token}, acknowledge, DELETE on close; the
+X-Presto-* headers carry token progression and completion. This client is
+synchronous (one upstream at a time per call site); the worker's own
+RemoteSource lowering fans out over upstream locations."""
+
+from __future__ import annotations
+
+import urllib.request
+from typing import List, Optional, Tuple
+
+
+class PageStream:
+    """Pull all SerializedPage frames from one upstream buffer."""
+
+    def __init__(self, task_uri: str, buffer_id: str = "0",
+                 max_wait: str = "1s"):
+        self.base = task_uri.rstrip("/")
+        self.buffer_id = buffer_id
+        self.max_wait = max_wait
+        self.token = 0
+        self.complete = False
+        self.task_instance_id: Optional[str] = None
+
+    def _get(self, url: str) -> Tuple[bytes, dict]:
+        req = urllib.request.Request(
+            url, headers={"X-Presto-Max-Wait": self.max_wait})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.read(), dict(resp.headers)
+
+    def fetch(self) -> bytes:
+        """One round: GET next frames, acknowledge, advance the token."""
+        url = f"{self.base}/results/{self.buffer_id}/{self.token}"
+        body, headers = self._get(url)
+        instance = headers.get("X-Presto-Task-Instance-Id")
+        if self.task_instance_id is None:
+            self.task_instance_id = instance
+        elif instance != self.task_instance_id:
+            raise RuntimeError("task instance changed mid-stream "
+                               "(worker restarted)")
+        nxt = int(headers.get("X-Presto-Page-End-Sequence-Id",
+                              self.token))
+        self.complete = (headers.get("X-Presto-Buffer-Complete",
+                                     "false") == "true")
+        if nxt > self.token:
+            self._get(f"{self.base}/results/{self.buffer_id}/{nxt}"
+                      f"/acknowledge")
+            self.token = nxt
+        return body
+
+    def drain(self) -> bytes:
+        out = b""
+        while not self.complete:
+            out += self.fetch()
+        # release the buffer (reference: abortResults DELETE)
+        req = urllib.request.Request(
+            f"{self.base}/results/{self.buffer_id}", method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception:            # noqa: BLE001 — abort is best-effort
+            pass
+        return out
+
+
+def decode_pages(data: bytes, types) -> List:
+    """Concatenated wire frames -> engine Pages."""
+    from presto_tpu.protocol.serde import (
+        decode_serialized_page, wire_blocks_to_page,
+    )
+
+    pages = []
+    off = 0
+    while off < len(data):
+        blocks, n, off = decode_serialized_page(data, off)
+        pages.append(wire_blocks_to_page(blocks, types, n))
+    return pages

@@ -1,0 +1,11 @@
+"""Worker server: the HTTP shell that grafts this engine onto an
+unmodified Presto coordinator — endpoints, task manager, output buffers,
+announcer. Reference: presto-native-execution/presto_cpp/main
+(TaskResource.cpp:115-180, TaskManager.cpp, PrestoServer.cpp:497-562,
+Announcer.cpp:64)."""
+
+from presto_tpu.server.buffers import OutputBufferManager
+from presto_tpu.server.task_manager import TpuTaskManager
+from presto_tpu.server.http import TpuWorkerServer
+
+__all__ = ["OutputBufferManager", "TpuTaskManager", "TpuWorkerServer"]

@@ -1,0 +1,68 @@
+"""Discovery announcer: periodic PUT /v1/announcement/{nodeId}.
+
+Reference: presto_cpp/main/Announcer.cpp:64 — the worker announces itself
+to the coordinator's embedded discovery service with its services payload;
+DiscoveryNodeManager (presto-main/.../metadata/DiscoveryNodeManager.java:88)
+turns announcements into the active worker set."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+
+class Announcer:
+    def __init__(self, coordinator_uri: str, self_uri: str, node_id: str,
+                 environment: str = "tpu", interval_s: float = 5.0):
+        self.coordinator_uri = coordinator_uri.rstrip("/")
+        self.self_uri = self_uri
+        self.node_id = node_id
+        self.environment = environment
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self.announcements = 0
+        self.last_error = None
+
+    def payload(self) -> dict:
+        return {
+            "environment": self.environment,
+            "pool": "general",
+            "location": f"/{self.node_id}",
+            "services": [{
+                "id": self.node_id,
+                "type": "presto",
+                "properties": {
+                    "node_version": "presto-tpu-0.2",
+                    "coordinator": "false",
+                    "connectorIds": "tpch",
+                    "http": self.self_uri,
+                },
+            }],
+        }
+
+    def announce_once(self) -> bool:
+        url = f"{self.coordinator_uri}/v1/announcement/{self.node_id}"
+        body = json.dumps(self.payload()).encode()
+        req = urllib.request.Request(
+            url, data=body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5):
+                self.announcements += 1
+                return True
+        except Exception as e:               # noqa: BLE001 — keep retrying
+            self.last_error = str(e)
+            return False
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.announce_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()

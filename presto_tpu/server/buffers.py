@@ -1,0 +1,83 @@
+"""Task output buffers: the pull-protocol server side.
+
+Reference roles: PartitionedOutputBuffer / ClientBuffer
+(presto-main-base/.../execution/buffer/PartitionedOutputBuffer.java:44,
+buffer/ClientBuffer.java) — per-destination queues of SerializedPages,
+consumed by sequenced GET .../results/{buffer}/{token} with acknowledge
+semantics (at-least-once; tokens make re-reads idempotent)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class ClientBuffer:
+    """One destination's page queue with token bookkeeping. Acknowledged
+    frames are dropped (tokens stay monotonically global: `base` is the
+    token of pages[0]) — the at-least-once window is [acked, produced)."""
+
+    def __init__(self):
+        self.pages: List[bytes] = []     # frames for tokens base..
+        self.base = 0                    # token of pages[0]
+        self.no_more_pages = False
+        self.aborted = False
+
+    @property
+    def end_token(self) -> int:
+        return self.base + len(self.pages)
+
+    def add(self, frame: bytes):
+        self.pages.append(frame)
+
+    def get(self, token: int, max_bytes: int
+            ) -> Tuple[List[bytes], int, bool]:
+        """(frames, next_token, complete) starting at `token`. Tokens
+        below `base` were acknowledged and dropped — re-reads of those are
+        a protocol violation and return nothing at the current position."""
+        out: List[bytes] = []
+        size = 0
+        t = max(token, self.base)
+        while t < self.end_token:
+            f = self.pages[t - self.base]
+            if out and size + len(f) > max_bytes:
+                break
+            out.append(f)
+            size += len(f)
+            t += 1
+        complete = self.no_more_pages and t >= self.end_token
+        return out, t, complete
+
+    def acknowledge(self, token: int):
+        if token > self.base:
+            drop = min(token, self.end_token) - self.base
+            del self.pages[:drop]
+            self.base += drop
+
+
+class OutputBufferManager:
+    """All buffers of one task (OutputBuffers.type PARTITIONED etc.)."""
+
+    def __init__(self, buffer_ids: List[str]):
+        self.buffers: Dict[str, ClientBuffer] = {
+            b: ClientBuffer() for b in buffer_ids}
+        self.lock = threading.Lock()
+
+    def buffer(self, buffer_id: str) -> Optional[ClientBuffer]:
+        return self.buffers.get(buffer_id)
+
+    def add_page(self, buffer_id: str, frame: bytes):
+        with self.lock:
+            self.buffers[buffer_id].add(frame)
+
+    def set_no_more_pages(self):
+        with self.lock:
+            for b in self.buffers.values():
+                b.no_more_pages = True
+
+    def abort(self, buffer_id: str):
+        with self.lock:
+            b = self.buffers.get(buffer_id)
+            if b is not None:
+                b.aborted = True
+                b.pages = []

@@ -1,0 +1,229 @@
+"""Worker HTTP endpoints — the exact surface the coordinator drives.
+
+Reference: presto_cpp/main/TaskResource.cpp:115-180 (regex-routed task
+endpoints), PrestoServer.cpp:497-562 (/v1/info, /v1/info/state,
+/v1/status, /v1/memory), http/HttpServer.cpp. Python stdlib HTTP serves as
+the shell here (threads block on IO only; all compute is inside XLA), with
+the same routes, headers and long-poll semantics:
+
+  POST   /v1/task/{id}                          TaskUpdateRequest -> TaskInfo
+  GET    /v1/task/{id}                          TaskInfo
+  GET    /v1/task/{id}/status                   TaskStatus (long-poll)
+  GET    /v1/task/{id}/results/{buffer}/{token} SerializedPage frames
+  GET    /v1/task/{id}/results/{buffer}/{token}/acknowledge
+  DELETE /v1/task/{id}/results/{buffer}         abort buffer
+  DELETE /v1/task/{id}                          delete task
+  GET    /v1/info | /v1/info/state | /v1/status | /v1/memory
+
+Page-stream headers (reference PrestoHeaders.java:51-54):
+  X-Presto-Page-Sequence-Id / X-Presto-Page-End-Sequence-Id /
+  X-Presto-Buffer-Complete / X-Presto-Task-Instance-Id
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from presto_tpu.protocol import structs as S
+from presto_tpu.server.task_manager import TpuTaskManager
+
+_TASK = re.compile(r"^/v1/task/([^/?]+)$")
+_STATUS = re.compile(r"^/v1/task/([^/?]+)/status$")
+_RESULTS = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)/(\d+)$")
+_ACK = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)/(\d+)/acknowledge$")
+_ABORT = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)$")
+
+_SERVER_START = time.time()
+
+
+def _parse_duration(s: Optional[str], default: float) -> float:
+    if not s:
+        return default
+    m = re.match(r"([\d.]+)\s*(ms|s|m)?", s)
+    if not m:
+        return default
+    v = float(m.group(1))
+    unit = m.group(2) or "s"
+    return v / 1000 if unit == "ms" else v * 60 if unit == "m" else v
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "presto-tpu-worker"
+
+    # quiet the default stderr access log
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def tm(self) -> TpuTaskManager:
+        return self.server.task_manager
+
+    def _json(self, code: int, obj, headers=None):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bytes(self, code: int, body: bytes, headers=None):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/x-presto-pages")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ------------------------------------------------------------- POST
+    def do_POST(self):
+        m = _TASK.match(self.path.split("?")[0])
+        if m:
+            n = int(self.headers.get("Content-Length", 0))
+            req = S.TaskUpdateRequest.loads(self.rfile.read(n).decode())
+            info = self.tm.create_or_update(m.group(1), req)
+            return self._json(200, S.TaskInfo.to_json(info))
+        self._json(404, {"error": f"no route {self.path}"})
+
+    # -------------------------------------------------------------- GET
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        m = _ACK.match(path)
+        if m:
+            task = self.tm.get(m.group(1))
+            if task is None or task.buffers is None:
+                return self._json(404, {"error": "no task"})
+            buf = task.buffers.buffer(m.group(2))
+            if buf is not None:
+                buf.acknowledge(int(m.group(3)))
+            return self._bytes(200, b"")
+        m = _RESULTS.match(path)
+        if m:
+            return self._results(*m.groups())
+        m = _STATUS.match(path)
+        if m:
+            cur = self.headers.get("X-Presto-Current-State")
+            wait = _parse_duration(
+                self.headers.get("X-Presto-Max-Wait"), 1.0)
+            st = self.tm.get_status(m.group(1), cur, wait)
+            if st is None:
+                return self._json(404, {"error": "no task"})
+            return self._json(200, S.TaskStatus.to_json(st))
+        m = _TASK.match(path)
+        if m:
+            task = self.tm.get(m.group(1))
+            if task is None:
+                return self._json(404, {"error": "no task"})
+            return self._json(200, S.TaskInfo.to_json(
+                task.info(self.tm.base_uri)))
+        if path == "/v1/info":
+            return self._json(200, {
+                "nodeVersion": {"version": "presto-tpu-0.2"},
+                "environment": "tpu", "coordinator": False,
+                "starting": False,
+                "uptime": f"{time.time() - _SERVER_START:.2f}s"})
+        if path == "/v1/info/state":
+            return self._json(200, "ACTIVE")
+        if path == "/v1/status":
+            tasks = self.tm.tasks
+            return self._json(200, {
+                "nodeId": "tpu-worker-0", "environment": "tpu",
+                "uptime": f"{time.time() - _SERVER_START:.2f}s",
+                "externalAddress": "127.0.0.1",
+                "internalAddress": "127.0.0.1",
+                "taskCount": len(tasks),
+                "memoryInfo": {"availableProcessors": 1},
+                "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
+                "heapUsed": self.tm.memory_bytes(),
+                "heapAvailable": 16 << 30, "nonHeapUsed": 0})
+        if path == "/v1/memory":
+            return self._json(200, {
+                "pools": {"general": {
+                    "maxBytes": 16 << 30,
+                    "reservedBytes": self.tm.memory_bytes(),
+                    "reservedRevocableBytes": 0,
+                    "queryMemoryReservations": {},
+                    "queryMemoryAllocations": {},
+                    "queryMemoryRevocableReservations": {}}}})
+        self._json(404, {"error": f"no route {path}"})
+
+    def _results(self, task_id: str, buffer_id: str, token: str):
+        task = self.tm.get(task_id)
+        if task is None or task.buffers is None:
+            return self._json(404, {"error": "no task/buffers"})
+        buf = task.buffers.buffer(buffer_id)
+        if buf is None:
+            return self._json(404, {"error": "no buffer"})
+        max_bytes = 16 << 20
+        tok = int(token)
+        # Long-poll until a page (or completion) is available.
+        deadline = time.time() + _parse_duration(
+            self.headers.get("X-Presto-Max-Wait"), 1.0)
+        while True:
+            frames, nxt, complete = buf.get(tok, max_bytes)
+            if frames or complete or time.time() >= deadline:
+                break
+            time.sleep(0.01)
+        headers = {
+            "X-Presto-Task-Instance-Id": str(task.instance_id),
+            "X-Presto-Page-Sequence-Id": str(tok),
+            "X-Presto-Page-End-Sequence-Id": str(nxt),
+            "X-Presto-Buffer-Complete": "true" if complete else "false",
+        }
+        return self._bytes(200, b"".join(frames), headers)
+
+    # ----------------------------------------------------------- DELETE
+    def do_DELETE(self):
+        path = self.path.split("?")[0]
+        m = _ABORT.match(path)
+        if m:
+            task = self.tm.get(m.group(1))
+            if task is not None and task.buffers is not None:
+                task.buffers.abort(m.group(2))
+            return self._json(200, {})
+        m = _TASK.match(path)
+        if m:
+            info = self.tm.delete(m.group(1))
+            if info is None:
+                return self._json(404, {"error": "no task"})
+            return self._json(200, S.TaskInfo.to_json(info))
+        self._json(404, {"error": f"no route {path}"})
+
+
+class TpuWorkerServer:
+    """Bind + serve on a background thread; .port is assigned (0 = any)."""
+
+    def __init__(self, connector, host: str = "127.0.0.1", port: int = 0,
+                 coordinator_uri: Optional[str] = None,
+                 node_id: str = "tpu-worker-0"):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        base = f"http://{host}:{self.port}"
+        self.task_manager = TpuTaskManager(connector, base_uri=base)
+        self.httpd.task_manager = self.task_manager
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.announcer = None
+        if coordinator_uri:
+            from presto_tpu.server.announcer import Announcer
+            self.announcer = Announcer(coordinator_uri, base, node_id)
+
+    def start(self):
+        self.thread.start()
+        if self.announcer:
+            self.announcer.start()
+        return self
+
+    def stop(self):
+        if self.announcer:
+            self.announcer.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()

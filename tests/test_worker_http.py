@@ -1,0 +1,179 @@
+"""In-process fake coordinator driving the worker's REAL HTTP endpoints.
+
+Round-2 acceptance (VERDICT.md #4): POST a TaskUpdateRequest, long-poll
+status, pull SerializedPages token/ack through the results endpoints,
+check lifecycle endpoints and the announcer loop. Reference harness role:
+PrestoNativeQueryRunnerUtils + TestingPrestoServer (SURVEY.md §4) — here
+the coordinator half is this test."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.exchange_client import PageStream, decode_pages
+from presto_tpu.server import TpuWorkerServer
+from presto_tpu.types import DOUBLE
+from tests.protocol_fixtures import q1_like_fragment, q6_fragment, \
+    task_update_request
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def worker():
+    srv = TpuWorkerServer(TpchConnector(SF)).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LocalEngine(TpchConnector(SF))
+
+
+def _post_task(worker, task_id, tur):
+    body = tur.dumps().encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{worker.port}/v1/task/{task_id}", data=body,
+        method="POST", headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _get(worker, path, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{worker.port}{path}", headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def _await_finish(worker, task_id):
+    state = "PLANNED"
+    for _ in range(600):
+        st, _h = _get(worker, f"/v1/task/{task_id}/status",
+                      {"X-Presto-Current-State": state,
+                       "X-Presto-Max-Wait": "1s"})
+        state = st["state"]
+        if state in ("FINISHED", "FAILED", "ABORTED"):
+            return st
+    raise TimeoutError("task did not finish")
+
+
+def test_task_lifecycle_and_page_pull(worker, engine):
+    tur = task_update_request(q6_fragment(SF), n_splits=4, sf=SF)
+    info = _post_task(worker, "q6.0.0.0.0", tur)
+    assert info["taskId"] == "q6.0.0.0.0"
+    st = _await_finish(worker, "q6.0.0.0.0")
+    assert st["state"] == "FINISHED", st
+
+    stream = PageStream(
+        f"http://127.0.0.1:{worker.port}/v1/task/q6.0.0.0.0")
+    data = stream.drain()
+    pages = decode_pages(data, [DOUBLE])
+    rows = [r for p in pages for r in p.to_pylist()]
+    exp = engine.execute_sql(
+        "select sum(l_extendedprice * l_discount) from lineitem"
+        " where l_shipdate >= date '1995-01-01'"
+        " and l_shipdate < date '1996-01-01'"
+        " and l_discount between 0.05 and 0.07 and l_quantity < 24")
+    assert len(rows) == 1
+    assert abs(rows[0][0] - exp[0][0]) <= 1e-6 * max(abs(exp[0][0]), 1.0)
+
+    # DELETE the task; a second DELETE 404s.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{worker.port}/v1/task/q6.0.0.0.0",
+        method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert json.loads(resp.read())["taskId"] == "q6.0.0.0.0"
+
+
+def test_grouped_task_with_strings(worker, engine):
+    from presto_tpu.types import BIGINT, VARCHAR
+    tur = task_update_request(q1_like_fragment(SF), n_splits=2, sf=SF)
+    _post_task(worker, "q1.0.0.0.0", tur)
+    st = _await_finish(worker, "q1.0.0.0.0")
+    assert st["state"] == "FINISHED", st
+    stream = PageStream(
+        f"http://127.0.0.1:{worker.port}/v1/task/q1.0.0.0.0")
+    pages = decode_pages(stream.drain(),
+                         [VARCHAR, VARCHAR, DOUBLE, BIGINT])
+    rows = [r for p in pages for r in p.to_pylist()]
+    exp = engine.execute_sql(
+        "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+        "from lineitem where l_shipdate <= date '1998-09-02' "
+        "group by l_returnflag, l_linestatus "
+        "order by l_returnflag, l_linestatus")
+    assert len(rows) == len(exp)
+    for g, e in zip(rows, exp):
+        assert g[0] == e[0] and g[1] == e[1] and g[3] == e[3]
+        assert abs(g[2] - e[2]) <= 1e-6 * max(abs(e[2]), 1.0)
+
+
+def test_lifecycle_endpoints(worker):
+    info, _ = _get(worker, "/v1/info")
+    assert info["coordinator"] is False
+    state, _ = _get(worker, "/v1/info/state")
+    assert state == "ACTIVE"
+    status, _ = _get(worker, "/v1/status")
+    assert status["nodeId"] == "tpu-worker-0"
+    mem, _ = _get(worker, "/v1/memory")
+    assert "general" in mem["pools"]
+
+
+def test_failed_task_reports_failure(worker):
+    # A fragment over an unknown table must FAIL, not hang.
+    frag = q6_fragment(SF)
+    bad = S.PlanFragment.from_bytes(frag.to_bytes())
+    # poison the scan's table name
+    node = bad.root
+    while not isinstance(node, S.TableScanNode):
+        node = node.source
+    node.table["connectorHandle"]["tableName"] = "nope"
+    tur = task_update_request(bad, n_splits=1, sf=SF)
+    _post_task(worker, "bad.0.0.0.0", tur)
+    st = _await_finish(worker, "bad.0.0.0.0")
+    assert st["state"] == "FAILED"
+    assert st["failures"]
+
+
+class _FakeDiscovery(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        self.server.announcements.append((self.path, body))
+        self.send_response(202)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+def test_announcer_loop():
+    disc = HTTPServer(("127.0.0.1", 0), _FakeDiscovery)
+    disc.announcements = []
+    t = threading.Thread(target=disc.serve_forever, daemon=True)
+    t.start()
+    try:
+        srv = TpuWorkerServer(
+            TpchConnector(SF),
+            coordinator_uri=f"http://127.0.0.1:{disc.server_address[1]}",
+            node_id="tpu-worker-9").start()
+        try:
+            assert srv.announcer.announce_once()
+            path, body = disc.announcements[-1]
+            assert path == "/v1/announcement/tpu-worker-9"
+            svc = body["services"][0]
+            assert svc["type"] == "presto"
+            assert svc["properties"]["coordinator"] == "false"
+        finally:
+            srv.stop()
+    finally:
+        disc.shutdown()
+        disc.server_close()
