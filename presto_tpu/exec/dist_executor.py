@@ -102,7 +102,13 @@ class DistExecutor(Executor):
 
     def _lower_exchange(self, node, nid, src, cap, caps, watch, _needed):
         ndev = self.ndev
-        if node.partitioning == Partitioning.HASH:
+        if node.partitioning in (Partitioning.HASH, Partitioning.RANGE):
+            from presto_tpu.parallel.shuffle import range_partition_ids
+            if node.partitioning == Partitioning.HASH:
+                pid_fn = lambda p: partition_ids(p, node.keys, ndev)  # noqa: E731
+            else:
+                pid_fn = lambda p: range_partition_ids(  # noqa: E731
+                    p, node.sort_keys[0], ndev)
             out_cap = caps.get((nid, "cap")) or bucket_capacity(2 * cap)
             chunk = caps.get((nid, "chunk")) or max(2 * cap // ndev, 64)
             caps[(nid, "cap")] = out_cap
@@ -110,15 +116,14 @@ class DistExecutor(Executor):
             watch.append((nid, "cap"))
             watch.append((nid, "chunk"))
 
-            def hash_fn(pages, node=node, out_cap=out_cap, chunk=chunk):
+            def repart_fn(pages, node=node, out_cap=out_cap, chunk=chunk):
                 p = src(pages)
-                pid = partition_ids(p, node.keys, ndev)
                 out, total, max_send = repartition_page(
-                    p, pid, ndev, out_cap, chunk)
+                    p, pid_fn(p), ndev, out_cap, chunk)
                 _needed.append(total)
                 _needed.append(max_send)
                 return Page(out.columns, out.num_rows, node.output_names)
-            return hash_fn, out_cap
+            return repart_fn, out_cap
 
         if node.partitioning == Partitioning.BROADCAST:
             def bcast_fn(pages, node=node):

@@ -35,7 +35,7 @@ from presto_tpu.ops.sort import limit_page, sort_page, top_n
 from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
     JoinType, LimitNode, OutputNode, PlanNode, ProjectNode, SortNode,
-    TableScanNode, TopNNode, ValuesNode,
+    TableScanNode, TopNNode, ValuesNode, WindowNode,
 )
 
 
@@ -311,7 +311,7 @@ class Executor:
                     def semi_fn(pages, node=node):
                         p = psrc(pages)
                         b = bsrc(pages)
-                        out, _dup = merge_join(
+                        out, _dup, _m = merge_join(
                             p, b, node.probe_keys, node.build_keys,
                             node.join_type.value)
                         if node.emit_flag:
@@ -334,7 +334,8 @@ class Executor:
                 # onto the expansion hash_join below.
                 use_merge = (bool(node.probe_keys)
                              and node.join_type in (JoinType.INNER,
-                                                    JoinType.LEFT)
+                                                    JoinType.LEFT,
+                                                    JoinType.FULL)
                              and caps.get(-nid, 0) == 0)
                 if use_merge:
                     caps[-nid] = 0
@@ -343,27 +344,62 @@ class Executor:
                     def mjoin_fn(pages, node=node):
                         p = psrc(pages)
                         b = bsrc(pages)
-                        out, dup = merge_join(
+                        residual = (compile_expr(node.filter)
+                                    if node.filter is not None else None)
+                        if (residual is not None
+                                and node.join_type == JoinType.LEFT):
+                            # Residual failure demotes a match to a
+                            # null-extension (SQL outer-join ON clause):
+                            # evaluate over the pre-filter join, then
+                            # null out the build side where it fails.
+                            out, dup, match = merge_join(
+                                p, b, node.probe_keys, node.build_keys,
+                                "left")
+                            _needed.append(dup)
+                            out = Page(out.columns, out.num_rows,
+                                       node.output_names)
+                            c = residual(out)
+                            ok = match & ~c.nulls & c.values.astype(bool)
+                            cols = list(out.columns[:len(p.columns)])
+                            for bc in out.columns[len(p.columns):]:
+                                sent = jnp.asarray(
+                                    bc.type.null_sentinel(),
+                                    dtype=bc.values.dtype)
+                                cols.append(Column(
+                                    jnp.where(ok, bc.values, sent),
+                                    jnp.where(ok, bc.nulls, True),
+                                    bc.type, bc.dictionary))
+                            return Page(tuple(cols), out.num_rows,
+                                        node.output_names)
+                        out, dup, _match = merge_join(
                             p, b, node.probe_keys, node.build_keys,
                             node.join_type.value)
                         _needed.append(dup)
                         out = Page(out.columns, out.num_rows,
                                    node.output_names)
                         if node.filter is not None:
-                            c = compile_expr(node.filter)(out)
-                            if node.join_type == JoinType.LEFT:
+                            if node.join_type == JoinType.FULL:
                                 raise NotImplementedError(
-                                    "residual filter on outer join")
+                                    "residual filter on full outer join")
+                            c = compile_expr(node.filter)(out)
                             out = compact(out,
                                           ~c.nulls & c.values.astype(bool))
                         return out
-                    return mjoin_fn, pcap
+                    # FULL appends the unmatched build rows: capacity grows
+                    out_cap = pcap + (bcap if node.join_type
+                                      == JoinType.FULL else 0)
+                    return mjoin_fn, out_cap
 
                 fan = max(node.fanout_hint, 1.0)
                 out_cap = caps.get(nid) or bucket_capacity(
                     min(int(pcap * fan), 2**26))
                 caps[nid] = out_cap
                 watch.append(nid)
+
+                if node.join_type == JoinType.FULL:
+                    raise NotImplementedError(
+                        "full outer join with duplicate build keys (the "
+                        "expansion path has no full-outer form yet)")
 
                 def join_fn(pages, node=node, out_cap=out_cap):
                     p = psrc(pages)
@@ -394,6 +430,17 @@ class Executor:
                     return Page(p.columns + (col,), p.num_rows,
                                 node.output_names)
                 return rowid_fn, cap
+            if isinstance(node, WindowNode):
+                src, cap = build(node.source)
+
+                def window_fn(pages, node=node):
+                    from presto_tpu.ops.window import window_page
+                    p = src(pages)
+                    out = window_page(p, node.partition_fields,
+                                      node.order_keys, node.specs)
+                    return Page(out.columns, out.num_rows,
+                                node.output_names)
+                return window_fn, cap
             if isinstance(node, SortNode):
                 src, cap = build(node.source)
                 return (lambda pages: sort_page(src(pages), node.keys)), cap

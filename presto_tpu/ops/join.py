@@ -59,11 +59,15 @@ def merge_join(probe: Page, build: Page,
       3. A second sort restores probe order carrying only the per-probe
          results; probe columns never move at all.
 
-    Returns (page, dup_count) where dup_count > 0 means the build side
-    had duplicate live keys: for inner/left the caller must fall back to
-    the expansion join (hash_join); semi/anti results stay valid. Output
-    layout matches hash_join: probe cols ++ build cols (inner/left), or
-    probe cols ++ match flag (semi/anti/anti_exists).
+    Returns (page, dup_count, match) where dup_count > 0 means the build
+    side had duplicate live keys: for inner/left/full the caller must
+    fall back to the expansion join (hash_join); semi/anti results stay
+    valid. `match` is the per-probe-row match flag in probe order for
+    left/full (None otherwise) — outer-join residual filters need it to
+    demote failed matches to null-extensions. Output layout matches
+    hash_join: probe cols ++ build cols (inner/left/full; full appends
+    the unmatched build rows null-extended on the probe side), or probe
+    cols ++ match flag (semi/anti/anti_exists).
 
     Reference roles: MergeJoinNode / sorted-exchange MergeOperator
     (presto-main-base/.../operator/MergeOperator.java) fused with the
@@ -101,10 +105,10 @@ def merge_join(probe: Page, build: Page,
     key_ops.append(tag)
 
     present = cat(b_present, jnp.zeros((pcap,), bool))
-    src_pos = cat(jnp.zeros((bcap,), jnp.int32),
+    src_pos = cat(jnp.arange(bcap, dtype=jnp.int32),
                   jnp.arange(pcap, dtype=jnp.int32))
     operands = tuple(key_ops) + (present, src_pos)
-    carry_build = join_type in ("inner", "left")
+    carry_build = join_type in ("inner", "left", "full")
     if carry_build:
         for c in build.columns:
             operands += (cat(c.values, jnp.zeros((pcap,), c.values.dtype)),
@@ -143,6 +147,41 @@ def merge_join(probe: Page, build: Page,
             ff_payload.append((fill_forward(vals, s_present),
                                fill_forward(nulls, s_present)))
 
+    # FULL outer also needs per-BUILD-row matched flags: a present build
+    # row is matched iff its key run contains a live non-null-key probe
+    # row. Runs are contiguous after the sort, so count probes per run
+    # with blocked scans — no gathers.
+    b_matched = None
+    if join_type == "full":
+        from presto_tpu.ops.scan import cumsum as bl_cumsum
+
+        any_key_null = jnp.zeros((cap,), bool)
+        for i in range(len(probe_fields)):
+            any_key_null = any_key_null | s[1 + 2 * i].astype(bool)
+        run_start = jnp.zeros((cap,), bool).at[0].set(True)
+        for i in range(len(probe_fields)):
+            kv = s[2 + 2 * i]
+            kn = s[1 + 2 * i].astype(bool)
+            same = ((kv == jnp.roll(kv, 1)) & ~kn & ~jnp.roll(kn, 1)) \
+                | (kn & jnp.roll(kn, 1))
+            run_start = run_start | ~same
+        run_start = run_start.at[0].set(True)
+        s_live = s[0] == 0                 # dead-last rank, sorted
+        probe_contrib = (is_probe & s_live & ~any_key_null
+                         ).astype(jnp.int32)
+        cs_p = bl_cumsum(probe_contrib)
+        from presto_tpu.ops.scan import fill_forward as ff
+        before_run = ff(jnp.where(run_start, cs_p - probe_contrib, 0),
+                        run_start)
+        run_end = jnp.roll(run_start, -1).at[-1].set(True)
+        at_end_rev = jnp.flip(ff(jnp.flip(jnp.where(run_end, cs_p, 0)),
+                                 jnp.flip(run_end)))
+        probes_in_run = at_end_rev - before_run
+        b_matched_cat = s_present & (probes_in_run > 0)
+        back_ops_b = ((1 - s_tag).astype(jnp.int8), s_src, b_matched_cat)
+        bb = jax.lax.sort(back_ops_b, num_keys=2, is_stable=False)
+        b_matched = bb[2][pcap:]           # build rows, original order
+
     # Restore probe order; carry only per-probe results.
     back_keys = ((1 - s_tag).astype(jnp.int8), s_src)
     back_ops = back_keys + (match,)
@@ -161,7 +200,7 @@ def merge_join(probe: Page, build: Page,
             flag = ~match_p & ~p_null & ~b_has_null & p_live
         col = Column(flag, jnp.zeros((pcap,), bool), _bool_type(), None)
         out = Page(probe.columns + (col,), probe.num_rows, ())
-        return out, dup_count
+        return out, dup_count, None
 
     build_valid = match_p
     out_cols = list(probe.columns)
@@ -174,11 +213,45 @@ def merge_join(probe: Page, build: Page,
         out_cols.append(Column(vals, nulls, c.type, c.dictionary))
 
     if join_type == "left":
-        return Page(tuple(out_cols), probe.num_rows, ()), dup_count
+        return Page(tuple(out_cols), probe.num_rows, ()), dup_count, \
+            match_p
+    if join_type == "full":
+        page = Page(tuple(out_cols), probe.num_rows, ())
+        unmatched = build.row_valid() & ~b_matched
+        out = full_outer_append(page, probe, build, unmatched)
+        return out, dup_count, match_p
     # inner: keep only matched probe rows.
     from presto_tpu.data.column import compact
     page = Page(tuple(out_cols), probe.num_rows, ())
-    return compact(page, match_p), dup_count
+    return compact(page, match_p), dup_count, None
+
+
+def full_outer_append(left_page: Page, probe: Page, build: Page,
+                      unmatched_build: jnp.ndarray) -> Page:
+    """Append unmatched build rows (probe side null) to a left-join page.
+    Output capacity = pcap + bcap, survivors compacted with one sort."""
+    from presto_tpu.data.column import compact
+
+    pcap, bcap = probe.capacity, build.capacity
+    cols = []
+    for i, c in enumerate(left_page.columns):
+        if i < len(probe.columns):
+            t = probe.columns[i].type
+            pad_v = jnp.full((bcap,), t.null_sentinel(), dtype=c.values.dtype)
+            vals = jnp.concatenate([c.values, pad_v])
+            nulls = jnp.concatenate([c.nulls, jnp.ones((bcap,), bool)])
+        else:
+            b = build.columns[i - len(probe.columns)]
+            vals = jnp.concatenate([c.values, b.values])
+            nulls = jnp.concatenate([c.nulls, b.nulls])
+        cols.append(Column(vals, nulls, c.type, c.dictionary))
+    keep = jnp.concatenate([
+        jnp.arange(pcap, dtype=jnp.int32) < left_page.num_rows,
+        unmatched_build])
+    n = jnp.sum(keep).astype(jnp.int32)
+    page = Page(tuple(cols), jnp.asarray(pcap + bcap, jnp.int32), ())
+    out = compact(page, keep)
+    return Page(out.columns, n, ())
 
 
 def hash_join(probe: Page, build: Page,

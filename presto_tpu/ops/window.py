@@ -1,0 +1,218 @@
+"""Window functions — sort-carry + blocked scans, no gathers.
+
+Reference role: WindowOperator (presto-main-base/.../operator/
+WindowOperator.java:68 over PagesIndex sort + per-frame evaluation).
+TPU-first redesign: ONE multi-operand lax.sort by (partition keys, order
+keys) carrying every column plus the original row index; partition/peer
+boundaries come from adjacent compares; ranks and running aggregates are
+blocked fill-forward/backward scans (ops/scan.py); a second sort restores
+the original row order carrying only the computed window columns.
+
+Supported: row_number, rank, dense_rank, and sum/count/avg/min/max over
+the partition — cumulative (peer-aware RANGE UNBOUNDED PRECEDING ..
+CURRENT ROW, the SQL default when ORDER BY is present) or whole-partition
+(no ORDER BY).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Column, Page
+from presto_tpu.ops import scan as pscan
+from presto_tpu.ops.keys import SortKey, _orderable_values, group_values
+from presto_tpu.types import BIGINT, DOUBLE, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    """One window function: kind in {row_number, rank, dense_rank, sum,
+    count, count_star, avg, min, max}. `field` is the argument column."""
+    kind: str
+    field: Optional[int]
+    output_type: Type
+
+
+def _fill_backward(vals, present):
+    rev = lambda a: jnp.flip(a, axis=0)          # noqa: E731
+    return rev(pscan.fill_forward(rev(vals), rev(present)))
+
+
+def window_page(page: Page, partition_fields: Sequence[int],
+                order_keys: Sequence[SortKey],
+                specs: Sequence[WindowSpec]) -> Page:
+    """Append one column per spec to `page` (original row order kept)."""
+    cap = page.capacity
+    valid = page.row_valid()
+    idx = jnp.arange(cap, dtype=jnp.int32)
+
+    # ---- sort by (valid, partition keys, order keys), carrying inputs
+    key_ops = [(~valid).astype(jnp.int8)]
+    n_part_ops = 0
+    for f in partition_fields:
+        c = page.columns[f]
+        key_ops.append(c.nulls.astype(jnp.int8))
+        key_ops.append(group_values(c))
+        n_part_ops += 2
+    n_order_ops = 0
+    null_rank_of_null = []   # per order key: the rank value NULL rows get
+    for k in order_keys:
+        c = page.columns[k.field]
+        nr = jnp.int8(0 if k.nulls_sort_first else 1)
+        null_rank_of_null.append(int(0 if k.nulls_sort_first else 1))
+        key_ops.append(jnp.where(c.nulls, nr, jnp.int8(1) - nr))
+        v = _orderable_values(c)
+        if not k.ascending:
+            v = -v.astype(jnp.int64) if not jnp.issubdtype(
+                v.dtype, jnp.floating) else -v
+        key_ops.append(v)
+        n_order_ops += 2
+
+    arg_fields = sorted({s.field for s in specs if s.field is not None})
+    operands = tuple(key_ops) + (idx, valid)
+    for f in arg_fields:
+        operands += (page.columns[f].values, page.columns[f].nulls)
+    s = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=True)
+    nk = len(key_ops)
+    s_idx = s[nk]
+    s_valid = s[nk + 1]
+    s_args = {f: (s[nk + 2 + 2 * i], s[nk + 3 + 2 * i])
+              for i, f in enumerate(arg_fields)}
+
+    # ---- partition / peer boundaries from adjacent key compares.
+    # The rank operand encodes nulls as `null_rank` (0 when nulls sort
+    # first, else 1) — decode before comparing.
+    def changed(ops_start: int, count: int, null_ranks) -> jnp.ndarray:
+        ch = jnp.zeros((cap,), bool).at[0].set(True)
+        for i in range(count // 2):
+            n = s[ops_start + 2 * i] == null_ranks[i]
+            v = s[ops_start + 2 * i + 1]
+            same = ((v == jnp.roll(v, 1)) & ~n & ~jnp.roll(n, 1)) \
+                | (n & jnp.roll(n, 1))
+            ch = ch | ~same
+        return ch.at[0].set(True)
+
+    part_start = changed(1, n_part_ops, [1] * len(partition_fields)) \
+        if n_part_ops else jnp.zeros((cap,), bool).at[0].set(True)
+    peer_start = part_start | (
+        changed(1 + n_part_ops, n_order_ops, null_rank_of_null)
+        if n_order_ops else jnp.zeros((cap,), bool))
+    has_order = bool(order_keys)
+
+    part_start_idx = pscan.fill_forward(
+        jnp.where(part_start, idx, 0), part_start)
+    peer_start_idx = pscan.fill_forward(
+        jnp.where(peer_start, idx, 0), peer_start)
+    # last row of my peer group / partition (for running + totals)
+    peer_end = jnp.roll(peer_start, -1).at[-1].set(True)
+    part_end = jnp.roll(part_start, -1).at[-1].set(True)
+
+    out_cols = []
+    for spec in specs:
+        kind = spec.kind
+        t = spec.output_type
+        if kind == "row_number":
+            w = (idx - part_start_idx + 1).astype(jnp.int64)
+            wn = jnp.zeros((cap,), bool)
+        elif kind == "rank":
+            w = (peer_start_idx - part_start_idx + 1).astype(jnp.int64)
+            wn = jnp.zeros((cap,), bool)
+        elif kind == "dense_rank":
+            cs_peer = pscan.cumsum(peer_start.astype(jnp.int32))
+            at_part = pscan.fill_forward(
+                jnp.where(part_start, cs_peer, 0), part_start)
+            w = (cs_peer - at_part + 1).astype(jnp.int64)
+            wn = jnp.zeros((cap,), bool)
+        elif kind in ("sum", "count", "count_star", "avg"):
+            if spec.field is not None:
+                vals, nulls = s_args[spec.field]
+                live = s_valid & ~nulls
+            else:
+                vals = jnp.ones((cap,), jnp.int64)
+                live = s_valid
+            acc = jnp.float64 if (t.is_floating or kind == "avg") \
+                else jnp.int64
+            contrib = jnp.where(live, vals, 0).astype(acc)
+            cs = pscan.cumsum(contrib)
+            cnt = pscan.cumsum(live.astype(jnp.int64))
+            before_part = pscan.fill_forward(
+                jnp.where(part_start, cs - contrib, 0), part_start)
+            cnt_before = pscan.fill_forward(
+                jnp.where(part_start, cnt - live.astype(jnp.int64), 0),
+                part_start)
+            if has_order:   # cumulative to the end of my peer group
+                upto = _fill_backward(jnp.where(peer_end, cs, 0), peer_end)
+                n_upto = _fill_backward(jnp.where(peer_end, cnt, 0),
+                                        peer_end)
+            else:           # whole partition
+                upto = _fill_backward(jnp.where(part_end, cs, 0), part_end)
+                n_upto = _fill_backward(jnp.where(part_end, cnt, 0),
+                                        part_end)
+            total = upto - before_part
+            n = n_upto - cnt_before
+            if kind in ("count", "count_star"):
+                w, wn = n, jnp.zeros((cap,), bool)
+            elif kind == "sum":
+                w, wn = total, n == 0
+            else:  # avg — DECIMAL args are unscaled ints: descale
+                w = total / jnp.maximum(n, 1)
+                if spec.field is not None:
+                    arg_t = page.columns[spec.field].type
+                    if arg_t.is_decimal:
+                        w = w / (10 ** arg_t.scale)
+                wn = n == 0
+        elif kind in ("min", "max"):
+            if has_order:
+                raise NotImplementedError(
+                    f"running {kind} window (frame with ORDER BY)")
+            vals, nulls = s_args[spec.field]
+            live = s_valid & ~nulls
+            v = vals
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                ident = jnp.inf if kind == "min" else -jnp.inf
+            else:
+                info = jnp.iinfo(v.dtype) if v.dtype != jnp.bool_ else None
+                v = v.astype(jnp.int32) if info is None else v
+                info = jnp.iinfo(v.dtype)
+                ident = info.max if kind == "min" else info.min
+            masked = jnp.where(live, v, ident)
+            # extra sort keyed (partition run id via part_start cumsum,
+            # value) puts the winner at each partition start
+            pid = pscan.cumsum(part_start.astype(jnp.int32))
+            sort_v = masked if kind == "min" else (
+                -masked if jnp.issubdtype(masked.dtype, jnp.floating)
+                else -masked.astype(jnp.int64))
+            s2 = jax.lax.sort((pid, sort_v, masked, live.astype(jnp.int8)),
+                              num_keys=2, is_stable=False)
+            win = pscan.fill_forward(
+                jnp.where(part_start, s2[2], 0), part_start)
+            any_live = pscan.fill_forward(
+                jnp.where(part_start, s2[3], 0), part_start) > 0
+            w, wn = win, ~any_live
+        else:
+            raise NotImplementedError(f"window function {kind}")
+        out_cols.append((w, wn | ~s_valid))
+
+    # ---- restore original row order, carrying only the window outputs
+    back = ((1 - s_valid.astype(jnp.int8)), s_idx)
+    for w, wn in out_cols:
+        back += (w, wn)
+    b = jax.lax.sort(back, num_keys=2, is_stable=False)
+    cols = list(page.columns)
+    for i, spec in enumerate(specs):
+        w = b[2 + 2 * i]
+        wn = b[3 + 2 * i]
+        t = spec.output_type
+        # min/max over strings operate on dictionary codes (code order ==
+        # lexicographic); the output column must keep the dictionary.
+        dictionary = (page.columns[spec.field].dictionary
+                      if spec.field is not None and t.is_string else None)
+        sent = jnp.asarray(t.null_sentinel(), dtype=t.dtype)
+        vals = jnp.where(wn, sent, w.astype(t.dtype))
+        cols.append(Column(vals, wn, t, dictionary))
+    return Page(tuple(cols), page.num_rows,
+                page.names + tuple(f"_w{i}" for i in range(len(specs))))

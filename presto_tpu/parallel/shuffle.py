@@ -143,6 +143,53 @@ def _compact_flat(flat_cols, live: jnp.ndarray, out_capacity: int,
     return Page(tuple(cols), jnp.minimum(n, out_capacity), names)
 
 
+def range_partition_ids(page: Page, sort_key, ndev: int,
+                        samples_per_dev: int = 256,
+                        axis: str = AXIS) -> jnp.ndarray:
+    """Partition ids for a sampled range partition on the FIRST sort key:
+    device d receives the d-th key range, so local sorts compose into a
+    global order by device index (the distributed-sort exchange;
+    reference role: MergeOperator's ordered exchange + benchto
+    distributed_sort.yaml). Rows with equal keys always map to one
+    device, so ties never straddle a boundary. Must run inside shard_map.
+
+    Keys are reduced to a monotone f64 rank (nulls/direction folded in):
+    monotonicity is all correctness needs — rounding only shifts split
+    boundaries, never reorders."""
+    from presto_tpu.ops.keys import _orderable_values
+
+    col = page.columns[sort_key.field]
+    v = _orderable_values(col).astype(jnp.float64)
+    if not sort_key.ascending:
+        v = -v
+    null_v = jnp.float64(-jnp.inf if sort_key.nulls_sort_first else jnp.inf)
+    v = jnp.where(col.nulls, null_v, v)
+    valid = page.row_valid()
+
+    cap = page.capacity
+    stride = max(cap // samples_per_dev, 1)
+    sample_idx = jnp.arange(samples_per_dev, dtype=jnp.int32) * stride
+    sample_idx = jnp.clip(sample_idx, 0, cap - 1)
+    s_vals = jnp.take(v, sample_idx, mode="clip")
+    s_ok = jnp.take(valid, sample_idx, mode="clip")
+    s_vals = jnp.where(s_ok, s_vals, jnp.inf)      # invalid samples last
+
+    all_vals = jax.lax.all_gather(s_vals, axis).reshape(-1)
+    all_ok = jax.lax.all_gather(s_ok, axis).reshape(-1)
+    n_samples = all_vals.shape[0]
+    sorted_vals = jax.lax.sort(all_vals)
+    n_ok = jnp.sum(all_ok)
+    # ndev-1 splitters at sample quantiles of the valid prefix
+    q = (jnp.arange(1, ndev, dtype=jnp.int32)
+         * jnp.maximum(n_ok, 1)) // ndev
+    splitters = jnp.take(sorted_vals,
+                         jnp.clip(q, 0, n_samples - 1), mode="clip")
+    pid = jnp.zeros((cap,), jnp.int32)
+    for i in range(ndev - 1):
+        pid = pid + (v >= splitters[i]).astype(jnp.int32)
+    return jnp.where(valid, pid, ndev)
+
+
 def all_gather_page(page: Page, ndev: int, axis: str = AXIS) -> Page:
     """Replicate all rows of a sharded page onto every device (broadcast
     build side of a join). Output capacity is ndev * local capacity, rows

@@ -27,7 +27,7 @@ from typing import Dict, List, Tuple
 from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
     JoinType, LimitNode, OutputNode, Partitioning, PlanNode, ProjectNode,
-    SortNode, Step, TableScanNode, TopNNode, ValuesNode,
+    SortNode, Step, TableScanNode, TopNNode, ValuesNode, WindowNode,
 )
 from presto_tpu.types import BIGINT, DOUBLE
 
@@ -160,6 +160,18 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
             string_keys = any(
                 node.probe.output_types[f].is_string
                 for f in node.probe_keys)
+            if node.join_type == JoinType.FULL and (string_keys
+                                                    or not node.probe_keys):
+                # FULL must see the whole probe side per build row (a
+                # replicated build would emit its unmatched rows once per
+                # device); without a consistent hash, gather both sides.
+                if pprop[0] != Partitioning.SINGLE:
+                    probe = exchange(probe, Partitioning.SINGLE)
+                if bprop[0] != Partitioning.SINGLE:
+                    build = exchange(build, Partitioning.SINGLE)
+                return (dataclasses.replace(node, probe=probe,
+                                            build=build),
+                        (Partitioning.SINGLE, ()))
             broadcast = (not node.probe_keys or string_keys
                          or node.join_type == JoinType.ANTI)
             if broadcast:
@@ -174,14 +186,45 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
             if not hash_satisfied(bprop, bk):
                 build = exchange(build, Partitioning.HASH, bk)
             out = dataclasses.replace(node, probe=probe, build=build)
-            if node.join_type in (JoinType.SEMI, JoinType.ANTI,
-                                  JoinType.ANTI_EXISTS):
-                out_keys = pk          # output = probe columns (+ flag)
-            else:
-                out_keys = pk          # probe cols first, same positions
-            return out, (Partitioning.HASH, out_keys)
+            if node.join_type == JoinType.FULL:
+                # Unmatched build rows carry NULL probe keys on whatever
+                # device held them — the hash property does not survive.
+                return out, (Partitioning.SOURCE, ())
+            # Probe columns keep their positions (probe cols first), so
+            # the co-partitioning survives on the probe keys.
+            return out, (Partitioning.HASH, pk)
 
-        if isinstance(node, (SortNode, TopNNode, LimitNode)):
+        if isinstance(node, WindowNode):
+            # Partitions must be device-local: hash by the partition keys
+            # (or a compatible existing partitioning); a window without
+            # PARTITION BY is a single global ordering -> SINGLE.
+            src, prop = visit(node.source)
+            pf = tuple(node.partition_fields)
+            if not pf:
+                if prop[0] != Partitioning.SINGLE:
+                    src = exchange(src, Partitioning.SINGLE)
+                return (dataclasses.replace(node, source=src),
+                        (Partitioning.SINGLE, ()))
+            if not hash_satisfied(prop, pf, subset_ok=True):
+                src = exchange(src, Partitioning.HASH, pf)
+                prop = (Partitioning.HASH, pf)
+            return dataclasses.replace(node, source=src), prop
+
+        if isinstance(node, SortNode):
+            # Distributed sort: sampled range partition on the leading
+            # sort key, then local sorts — device order == global order
+            # (the merge-exchange role, MergeOperator.java).
+            src, prop = visit(node.source)
+            if prop[0] != Partitioning.SINGLE:
+                src = ExchangeNode(
+                    src.output_names, src.output_types, source=src,
+                    partitioning=Partitioning.RANGE,
+                    keys=tuple(k.field for k in node.keys),
+                    sort_keys=tuple(node.keys))
+            return (dataclasses.replace(node, source=src),
+                    (Partitioning.RANGE, ()))
+
+        if isinstance(node, (TopNNode, LimitNode)):
             src, prop = visit(node.source)
             if prop[0] != Partitioning.SINGLE:
                 src = exchange(src, Partitioning.SINGLE)

@@ -35,6 +35,7 @@ class Step(enum.Enum):
 class JoinType(enum.Enum):
     INNER = "inner"
     LEFT = "left"
+    FULL = "full"
     SEMI = "semi"
     # ANTI implements NOT IN three-valued logic (any NULL build key empties
     # the result); ANTI_EXISTS implements NOT EXISTS (nulls never match,
@@ -45,11 +46,15 @@ class JoinType(enum.Enum):
 
 
 class Partitioning(enum.Enum):
-    """Reference: SystemPartitioningHandle kinds (SURVEY.md §2.5)."""
+    """Reference: SystemPartitioningHandle kinds (SURVEY.md §2.5).
+    RANGE is the distributed-sort exchange (sampled splitters; device d
+    holds the d-th global key range — the reference's merge-exchange
+    OrderingScheme role, MergeOperator.java)."""
     SINGLE = "single"
     HASH = "hash"
     BROADCAST = "broadcast"
     SOURCE = "source"
+    RANGE = "range"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,6 +142,20 @@ class AssignUniqueIdNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowNode(PlanNode):
+    """Appends one column per window function (reference:
+    spi/plan/WindowNode -> operator/WindowOperator.java:68). Output =
+    source columns ++ one column per spec."""
+    source: PlanNode = None
+    partition_fields: Tuple[int, ...] = ()
+    order_keys: Tuple[SortKey, ...] = ()
+    specs: Tuple = ()                      # ops.window.WindowSpec
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class SortNode(PlanNode):
     source: PlanNode = None
     keys: Tuple[SortKey, ...] = ()
@@ -173,6 +192,8 @@ class ExchangeNode(PlanNode):
     source: PlanNode = None
     partitioning: Partitioning = Partitioning.SINGLE
     keys: Tuple[int, ...] = ()
+    # RANGE only: the ordering whose first key ranges define the split
+    sort_keys: Tuple[SortKey, ...] = ()
 
     def children(self):
         return (self.source,)

@@ -34,7 +34,7 @@ from presto_tpu.ops.keys import SortKey
 from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, FilterNode, JoinNode, JoinType,
     LimitNode, OutputNode, PlanNode, ProjectNode, SortNode, Step,
-    TableScanNode, TopNNode,
+    TableScanNode, TopNNode, WindowNode,
 )
 from presto_tpu.sql import ast
 from presto_tpu.types import (
@@ -169,6 +169,47 @@ def _rewrite_idents(e, mapping):
     if isinstance(e, tuple):
         return tuple(_rewrite_idents(x, mapping) for x in e)
     return e
+
+
+def _collect_window_calls(items) -> List[ast.WindowCall]:
+    out: List[ast.WindowCall] = []
+
+    def walk(x):
+        if isinstance(x, ast.WindowCall):
+            if x not in out:
+                out.append(x)
+        elif dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+    for it in items:
+        walk(it.expr)
+    return out
+
+
+def _replace_window_calls(e, mapping: Dict[ast.WindowCall, str]):
+    if isinstance(e, ast.WindowCall):
+        name = mapping.get(e)
+        return ast.Ident((name,)) if name is not None else e
+    if isinstance(e, ast.Select):
+        return e
+    if dataclasses.is_dataclass(e):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            nv = _replace_window_calls(v, mapping)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+    if isinstance(e, tuple):
+        return tuple(_replace_window_calls(x, mapping) for x in e)
+    return e
+
+
+_WINDOW_RANKING = {"row_number", "rank", "dense_rank"}
+_WINDOW_AGGS = {"sum", "count", "avg", "min", "max"}
 
 
 def _null_preserving_item(e) -> bool:
@@ -623,8 +664,12 @@ class Planner:
                 if bc:
                     right = self._apply_filter(right, bc)
                     conds = [c for c in conds if c not in bc]
-                return self._join(left, right, conds, outer=True,
+                return self._join(left, right, conds, outer="left",
                                   preserve_order=(r.kind == "left"))
+            if r.kind == "full":
+                # FULL OUTER: ON conditions never filter either side —
+                # they only decide matching; both sides' rows survive.
+                return self._join(left, right, conds, outer="full")
             raise AnalysisError(f"join kind {r.kind}")
         raise AnalysisError(f"relation {r}")
 
@@ -710,7 +755,8 @@ class Planner:
         build, bk = self._maybe_project_keys(build, bk)
         fields = probe.fields + build.fields
 
-        jt = JoinType.LEFT if outer else JoinType.INNER
+        jt = {False: JoinType.INNER, "left": JoinType.LEFT,
+              True: JoinType.LEFT, "full": JoinType.FULL}[outer]
         res_expr = None
         if residual:
             for c in residual:
@@ -869,6 +915,11 @@ class Planner:
             nonlocal found
             if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
                 found = True
+            elif isinstance(x, ast.WindowCall):
+                # sum(x) OVER (...) is a window, not an aggregation.
+                # (Aggregates inside a window's ORDER BY — rank() over
+                # (order by sum(x)) — are not yet supported.)
+                pass
             elif dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
                 for f in dataclasses.fields(x):
                     walk(getattr(x, f.name))
@@ -1100,6 +1151,14 @@ class Planner:
 
     def _plan_plain_select(self, q: ast.Select, rp: RelationPlan
                            ) -> RelationPlan:
+        wcalls = _collect_window_calls(q.items)
+        if wcalls:
+            rp, wc_names = self._plan_window(wcalls, rp)
+            mapping = {wc: name for wc, name in zip(wcalls, wc_names)}
+            q = dataclasses.replace(q, items=tuple(
+                ast.SelectItem(_replace_window_calls(it.expr, mapping),
+                               it.alias or self._default_name(it.expr, i))
+                for i, it in enumerate(q.items)))
         fields = rp.fields
         out_exprs: List[RowExpression] = []
         out_names: List[str] = []
@@ -1128,6 +1187,94 @@ class Planner:
         return f"_col{i}"
 
     # ========================================================= order/limit
+    def _plan_window(self, wcalls: List[ast.WindowCall], rp: RelationPlan
+                     ) -> Tuple[RelationPlan, List[str]]:
+        """Plan the window functions over `rp`: a pre-projection computes
+        any non-column partition/order/argument expressions, then one
+        WindowNode per distinct (partition, order) window appends the
+        function columns. Reference: QueryPlanner window planning ->
+        spi/plan/WindowNode."""
+        from presto_tpu.ops.window import WindowSpec
+
+        ext_fields = list(rp.fields)
+        ext_exprs: List[RowExpression] = [
+            InputRef(i, f.type) for i, f in enumerate(rp.fields)]
+        extended = False
+
+        def channel(expr_ast) -> int:
+            nonlocal extended
+            e = self.analyze(expr_ast, tuple(ext_fields))
+            if isinstance(e, InputRef):
+                return e.field
+            ext_exprs.append(e)
+            ext_fields.append(Field(f"_wx{len(ext_exprs)}", e.type))
+            extended = True
+            return len(ext_exprs) - 1
+
+        resolved = []          # (window key, WindowSpec) per wcall
+        for wc in wcalls:
+            fn = wc.func
+            if fn.distinct:
+                raise AnalysisError("DISTINCT window arguments")
+            parts = tuple(channel(p) for p in wc.partition_by)
+            orders = tuple(SortKey(channel(o.expr), o.ascending,
+                                   o.nulls_first) for o in wc.order_by)
+            kind = fn.name
+            field = None
+            if kind == "count" and (fn.is_star or not fn.args):
+                kind, out_t = "count_star", BIGINT
+            elif kind in _WINDOW_RANKING:
+                if not orders:
+                    raise AnalysisError(f"{kind}() requires ORDER BY")
+                out_t = BIGINT
+            elif kind in _WINDOW_AGGS:
+                field = channel(fn.args[0])
+                arg_t = ext_fields[field].type
+                if arg_t.is_string and kind in ("sum", "avg"):
+                    raise AnalysisError(f"{kind}() over varchar")
+                if kind == "count":
+                    out_t = BIGINT
+                elif kind == "avg":
+                    out_t = DOUBLE
+                elif kind == "sum":
+                    out_t = BIGINT if arg_t.is_integer else arg_t
+                else:
+                    out_t = arg_t
+            else:
+                raise AnalysisError(f"unsupported window function {kind}")
+            resolved.append(((parts, orders),
+                             WindowSpec(kind, field, out_t)))
+
+        node = rp.node
+        if extended:
+            node = ProjectNode(tuple(f.name for f in ext_fields),
+                               tuple(f.type for f in ext_fields), node,
+                               tuple(ext_exprs))
+        fields = list(ext_fields)
+
+        # One WindowNode per distinct window, chained; record each
+        # wcall's output column name.
+        wc_names = [None] * len(wcalls)
+        by_window: Dict = {}
+        for i, (wkey, spec) in enumerate(resolved):
+            by_window.setdefault(wkey, []).append((i, spec))
+        for (parts, orders), members in by_window.items():
+            names = []
+            for i, spec in members:
+                name = f"_w{i}"
+                wc_names[i] = name
+                names.append((name, spec))
+            out_names = tuple(f.name for f in fields) + tuple(
+                n for n, _s in names)
+            out_types = tuple(f.type for f in fields) + tuple(
+                s.output_type for _n, s in names)
+            node = WindowNode(out_names, out_types, source=node,
+                              partition_fields=parts, order_keys=orders,
+                              specs=tuple(s for _n, s in names))
+            fields += [Field(n, s.output_type) for n, s in names]
+        return (RelationPlan(node, tuple(fields), rp.est_rows),
+                wc_names)
+
     def _plan_order_limit(self, q: ast.Select, rp: RelationPlan
                           ) -> RelationPlan:
         node = rp.node

@@ -132,13 +132,23 @@ class FuncCall:
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...) — reference:
+    sql/tree/FunctionCall with a Window."""
+    func: "FuncCall"
+    partition_by: Tuple["Expr", ...] = ()
+    order_by: Tuple["OrderItem", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class ScalarSubquery:
     query: "Select"
 
 
 Expr = Union[Ident, NumberLit, StringLit, DateLit, IntervalLit, NullLit,
              UnaryOp, BinaryOp, Between, InList, InSubquery, Exists, Like,
-             IsNull, Case, Cast, Extract, FuncCall, ScalarSubquery, Star]
+             IsNull, Case, Cast, Extract, FuncCall, WindowCall,
+             ScalarSubquery, Star]
 
 
 # ---- relations ------------------------------------------------------------

@@ -30,6 +30,7 @@ _KEYWORDS = {
     "date", "interval", "join", "inner", "left", "right", "outer", "cross",
     "on", "asc", "desc", "nulls", "first", "last", "distinct", "all",
     "union", "year", "month", "day", "substring", "for", "count", "with",
+    "over", "partition", "full",
 }
 
 
@@ -218,7 +219,7 @@ class Parser:
             elif self.accept_kw("inner"):
                 self.expect_kw("join")
                 kind = "inner"
-            elif self.peek().text in ("left", "right") and \
+            elif self.peek().text in ("left", "right", "full") and \
                     self.peek().kind == "keyword":
                 kind = self.next().text
                 self.accept_kw("outer")
@@ -430,29 +431,52 @@ class Parser:
                 self.expect("op", "(")
                 if self.accept("op", "*"):
                     self.expect("op", ")")
-                    return ast.FuncCall("count", (), is_star=True)
+                    return self._maybe_over(
+                        ast.FuncCall("count", (), is_star=True))
                 distinct = bool(self.accept_kw("distinct"))
                 arg = self.expr()
                 self.expect("op", ")")
-                return ast.FuncCall("count", (arg,), distinct=distinct)
+                return self._maybe_over(
+                    ast.FuncCall("count", (arg,), distinct=distinct))
         if t.kind in ("ident", "keyword"):
             name = self.ident_text()
             if self.peek().kind == "op" and self.peek().text == "(":
                 self.next()
                 if self.accept("op", ")"):
-                    return ast.FuncCall(name, ())
+                    return self._maybe_over(ast.FuncCall(name, ()))
                 distinct = bool(self.accept_kw("distinct"))
                 args = [self.expr()]
                 while self.accept("op", ","):
                     args.append(self.expr())
                 self.expect("op", ")")
-                return ast.FuncCall(name, tuple(args), distinct=distinct)
+                return self._maybe_over(
+                    ast.FuncCall(name, tuple(args), distinct=distinct))
             parts = [name]
             while self.peek().text == "." and self.peek().kind == "op":
                 self.next()
                 parts.append(self.ident_text())
             return ast.Ident(tuple(parts))
         raise SyntaxError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _maybe_over(self, fc: ast.FuncCall) -> ast.Expr:
+        """fn(...) [OVER (PARTITION BY ... ORDER BY ...)]."""
+        if not self.accept_kw("over"):
+            return fc
+        self.expect("op", "(")
+        partition: list = []
+        order: list = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept("op", ","):
+                partition.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.order_item())
+            while self.accept("op", ","):
+                order.append(self.order_item())
+        self.expect("op", ")")
+        return ast.WindowCall(fc, tuple(partition), tuple(order))
 
     def case_expr(self) -> ast.Expr:
         self.expect_kw("case")

@@ -49,7 +49,7 @@ def test_segment_sums_contiguous():
 # ------------------------------------------------------------- merge join
 
 def _mj(probe, build, jt):
-    out, dup = merge_join(probe, build, [0], [0], jt)
+    out, dup, _match = merge_join(probe, build, [0], [0], jt)
     return out, int(dup)
 
 

@@ -27,3 +27,20 @@ def engine():
 @pytest.mark.parametrize("qnum", sorted(QUERIES))
 def test_tpch_distributed(qnum, engine, oracle):  # noqa: F811
     run_case(qnum, engine, oracle)
+
+
+def test_distributed_order_by_row_identical(engine):
+    """VERDICT.md #7: distributed ORDER BY (range exchange + local sorts)
+    must produce row-identical ordered output — device order is global
+    order, no gather-then-sort on one device."""
+    from tests.test_tpch_full import SF as _SF
+    from presto_tpu.exec import LocalEngine
+
+    local = LocalEngine(TpchConnector(_SF))
+    for q in (
+        "select c_custkey, c_acctbal from customer "
+        "order by c_acctbal desc, c_custkey",
+        "select o_orderdate, count(*) from orders group by o_orderdate "
+        "order by o_orderdate",
+    ):
+        assert engine.execute_sql(q) == local.execute_sql(q)

@@ -1,0 +1,149 @@
+"""Window functions + outer-join completeness vs the sqlite oracle.
+
+Round-2 acceptance (VERDICT.md #6): WindowNode (rank/row_number/
+aggregates-over-partition via the sort+scan machinery), right/full outer
+joins, residual filters on outer joins — all checked row-for-row against
+sqlite over identical data."""
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from tests.test_tpch_full import SF, oracle, to_sqlite  # noqa: F401
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LocalEngine(TpchConnector(SF))
+
+
+def check(engine, oracle, sql, sqlite_sql=None):  # noqa: F811
+    got = engine.execute_sql(sql)
+    exp = oracle.execute(to_sqlite(sqlite_sql or sql)).fetchall()
+    key = lambda r: tuple((v is None, v) for v in r)  # noqa: E731
+    got_s, exp_s = sorted(got, key=key), sorted(exp, key=key)
+    assert len(got_s) == len(exp_s), \
+        f"{len(got_s)} != {len(exp_s)}\n{got_s[:4]}\n{exp_s[:4]}"
+    for g, e in zip(got_s, exp_s):
+        for x, y in zip(g, e):
+            if isinstance(x, float) or isinstance(y, float):
+                assert x is not None and y is not None \
+                    and abs(x - y) <= 1e-6 * max(abs(float(y)), 1.0), (g, e)
+            else:
+                assert x == y, (g, e)
+
+
+# ------------------------------------------------------------- windows
+
+WINDOW_QUERIES = [
+    # ranking per partition
+    "select n_name, n_regionkey, "
+    " rank() over (partition by n_regionkey order by n_name) rk, "
+    " row_number() over (order by n_nationkey desc) rn "
+    "from nation",
+    # dense_rank with duplicate order values
+    "select o_orderpriority, o_orderstatus, "
+    " dense_rank() over (partition by o_orderstatus "
+    "                    order by o_orderpriority) dr "
+    "from orders where o_orderkey <= 200",
+    # whole-partition aggregates
+    "select c_custkey, c_mktsegment, "
+    " sum(c_acctbal) over (partition by c_mktsegment) seg_total, "
+    " count(*) over (partition by c_mktsegment) seg_n, "
+    " min(c_acctbal) over (partition by c_mktsegment) seg_min, "
+    " max(c_acctbal) over (partition by c_mktsegment) seg_max "
+    "from customer where c_custkey <= 300",
+    # running (peer-aware) aggregates — the SQL default frame
+    "select o_orderkey, o_custkey, "
+    " sum(o_totalprice) over (partition by o_custkey "
+    "                         order by o_orderkey) running, "
+    " avg(o_totalprice) over (partition by o_custkey "
+    "                         order by o_orderkey) running_avg, "
+    " count(*) over (partition by o_custkey order by o_orderkey) rcnt "
+    "from orders where o_orderkey <= 500",
+    # window over an expression argument + expression partition key
+    "select l_orderkey, l_linenumber, "
+    " sum(l_extendedprice * (1 - l_discount)) over "
+    "   (partition by l_orderkey) order_rev, "
+    " rank() over (partition by l_orderkey "
+    "              order by l_extendedprice desc) price_rank "
+    "from lineitem where l_orderkey <= 100",
+    # no partition (global window)
+    "select n_nationkey, "
+    " rank() over (order by n_regionkey) rk, "
+    " count(*) over () total "
+    "from nation",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(WINDOW_QUERIES)))
+def test_window(qi, engine, oracle):  # noqa: F811
+    check(engine, oracle, WINDOW_QUERIES[qi])
+
+
+# --------------------------------------------------------- outer joins
+
+OUTER_QUERIES = [
+    # right join = swapped left
+    "select n_name, r_name from region right join nation "
+    "on n_regionkey = r_regionkey",
+    # left join: customers without orders survive (Q13 shape)
+    "select c_custkey, o_orderkey from customer left join orders "
+    "on c_custkey = o_custkey where c_custkey <= 100",
+    # left join with residual non-equi ON condition (null-extends,
+    # does not filter probe rows)
+    "select n_nationkey, r_regionkey from nation "
+    "left join region on n_regionkey = r_regionkey "
+    "and n_nationkey < 5",
+    # full outer with disjoint + overlapping keys
+    "select a.n_nationkey ak, b.n_nationkey bk from "
+    "(select n_nationkey from nation where n_nationkey < 10) a "
+    "full outer join "
+    "(select n_nationkey from nation where n_nationkey >= 5) b "
+    "on a.n_nationkey = b.n_nationkey",
+    # full outer via derived aggregates (group-by on both sides)
+    "select a.k, a.n, b.n from "
+    "(select n_regionkey k, count(*) n from nation group by 1) a "
+    "full outer join "
+    "(select o_shippriority k, count(*) n from orders group by 1) b "
+    "on a.k = b.k",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(OUTER_QUERIES)))
+def test_outer_join(qi, engine, oracle):  # noqa: F811
+    check(engine, oracle, OUTER_QUERIES[qi])
+
+
+def test_window_string_minmax_and_decimal_avg(engine, oracle):  # noqa: F811
+    check(engine, oracle,
+          "select n_regionkey, min(n_name) over (partition by n_regionkey)"
+          " mn, max(n_name) over (partition by n_regionkey) mx from nation")
+    got = engine.execute_sql(
+        "select avg(cast(c_acctbal as decimal(12,2))) over "
+        "(partition by c_mktsegment) a from customer where c_custkey = 1")
+    raw = engine.execute_sql(
+        "select avg(c_acctbal) over (partition by c_mktsegment) a "
+        "from customer where c_custkey = 1")
+    assert abs(got[0][0] - raw[0][0]) < 1e-2
+
+
+def test_distributed_windows_and_full_outer():
+    from presto_tpu.exec.dist_executor import DistEngine
+    from presto_tpu.parallel import device_mesh
+
+    local = LocalEngine(TpchConnector(SF))
+    dist = DistEngine(TpchConnector(SF), device_mesh(8))
+    for q in (
+        "select c_custkey, rank() over (partition by c_mktsegment "
+        "order by c_acctbal desc) rk from customer "
+        "where c_custkey <= 200 order by 1",
+        "select n_nationkey, count(*) over () from nation order by 1",
+        # string-keyed FULL outer: must gather, not broadcast
+        "select a.n_name, b.n_name from "
+        "(select n_name from nation where n_nationkey < 10) a "
+        "full outer join "
+        "(select n_name from nation where n_nationkey >= 5) b "
+        "on a.n_name = b.n_name order by 1, 2",
+    ):
+        assert dist.execute_sql(q) == local.execute_sql(q), q
