@@ -113,55 +113,19 @@ def main() -> None:
 
     detail = {}
     for qid in qids:
-        plan = engine.planner.plan_query(parse_sql(QUERIES[qid]))
-        plan = engine.executor._resolve_subqueries(plan)
-        # Converge capacities (overflow retries) before timing.
-        caps = {}
-        for _ in range(8):
-            fn, scans, watch = engine.executor._lower(plan, caps)
-            jitted = jax.jit(fn)
-            pages = [engine.executor._fetch(s) for s in scans]
-            out, needed = jitted(pages)
-            import numpy as np
-            needed = np.asarray(needed)
-            grew = False
-            for nid, need in zip(watch, needed):
-                if int(need) > caps[nid]:
-                    from presto_tpu.data.column import bucket_capacity
-                    caps[nid] = bucket_capacity(int(need))
-                    grew = True
-            if not grew:
-                break
-        else:
-            raise RuntimeError(
-                f"q{qid}: capacity retries did not converge; refusing to "
-                "time a truncated fragment")
-        in_rows = sum(int(p.num_rows) for p in pages)
-        for _ in range(warmup):
-            out, _n = jitted(pages)
-            jax.block_until_ready(out.num_rows)
-        times = []
-        for _ in range(runs):
-            t0 = time.perf_counter()
-            out, _n = jitted(pages)
-            jax.block_until_ready((out.columns[0].values if out.columns
-                                   else out.num_rows, out.num_rows))
-            times.append(time.perf_counter() - t0)
-        med = statistics.median(times)
-        base_s = baseline.get(str(qid), 0.0)
-        detail[f"q{qid:02d}"] = {
-            "median_s": round(med, 4),
-            "rows_per_sec": round(in_rows / med, 1),
-            "input_rows": in_rows,
-            "sqlite_baseline_s": round(base_s, 4),
-            "vs_baseline": round(base_s / med, 3) if base_s else 0.0,
-        }
-        print(f"# q{qid:02d}: median={med:.4f}s rows={in_rows} "
-              f"sqlite={base_s:.2f}s speedup={base_s/med if base_s else 0:.1f}x",
-              file=sys.stderr)
+        try:
+            _bench_one(engine, qid, QUERIES[qid], baseline, runs, warmup,
+                       detail)
+        except Exception as e:  # noqa: BLE001 — a failed query must not
+            # take down the whole benchmark report
+            detail[f"q{qid:02d}"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# q{qid:02d}: FAILED {type(e).__name__}: {e}",
+                  file=sys.stderr)
 
     head_name = "q01" if "q01" in detail else next(iter(detail))
     head = detail[head_name]
+    if "error" in head:
+        head = {"rows_per_sec": 0.0, "vs_baseline": 0.0}
     print(json.dumps({
         "metric": f"tpch_{head_name}_sf{sf:g}_rows_per_sec",
         "value": head["rows_per_sec"],
@@ -169,6 +133,59 @@ def main() -> None:
         "vs_baseline": head["vs_baseline"],
         "detail": detail,
     }))
+
+
+def _bench_one(engine, qid, sql, baseline, runs, warmup, detail):
+    import jax
+
+    from presto_tpu.sql.parser import parse_sql
+
+    plan = engine.planner.plan_query(parse_sql(sql))
+    plan = engine.executor._resolve_subqueries(plan)
+    # Converge capacities (overflow retries) before timing.
+    caps = {}
+    for _ in range(8):
+        fn, scans, watch = engine.executor._lower(plan, caps)
+        jitted = jax.jit(fn)
+        pages = [engine.executor._fetch(s) for s in scans]
+        out, needed = jitted(pages)
+        import numpy as np
+        needed = np.asarray(needed)
+        grew = False
+        for nid, need in zip(watch, needed):
+            if int(need) > caps[nid]:
+                from presto_tpu.data.column import bucket_capacity
+                caps[nid] = bucket_capacity(int(need))
+                grew = True
+        if not grew:
+            break
+    else:
+        raise RuntimeError(
+            f"q{qid}: capacity retries did not converge; refusing to "
+            "time a truncated fragment")
+    in_rows = sum(int(p.num_rows) for p in pages)
+    for _ in range(warmup):
+        out, _n = jitted(pages)
+        jax.block_until_ready(out.num_rows)
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out, _n = jitted(pages)
+        jax.block_until_ready((out.columns[0].values if out.columns
+                               else out.num_rows, out.num_rows))
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    base_s = baseline.get(str(qid), 0.0)
+    detail[f"q{qid:02d}"] = {
+        "median_s": round(med, 4),
+        "rows_per_sec": round(in_rows / med, 1),
+        "input_rows": in_rows,
+        "sqlite_baseline_s": round(base_s, 4),
+        "vs_baseline": round(base_s / med, 3) if base_s else 0.0,
+    }
+    print(f"# q{qid:02d}: median={med:.4f}s rows={in_rows} "
+          f"sqlite={base_s:.2f}s speedup={base_s/med if base_s else 0:.1f}x",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -29,6 +29,18 @@ import jax
 # f64/i64; the hot paths (filter masks, hashes, group codes) stay in 32-bit.
 jax.config.update("jax_enable_x64", True)
 
+# XLA's CPU compiler recurses deeply on large fragment programs (multi-join
+# TPC-H fragments segfault at the default 8 MiB stack); the main-thread
+# stack grows on demand up to RLIMIT_STACK, so raise it to the hard limit.
+try:
+    import resource
+
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    if _soft != resource.RLIM_INFINITY:
+        resource.setrlimit(resource.RLIMIT_STACK, (_hard, _hard))
+except (ImportError, ValueError, OSError):  # non-POSIX or locked down
+    pass
+
 from presto_tpu.types import (  # noqa: E402
     BOOLEAN, TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE, VARCHAR, DATE,
     TIMESTAMP, DecimalType, Type,

@@ -48,6 +48,41 @@ class DistExecutor(Executor):
         self.mesh = mesh
         self.ndev = int(mesh.devices.size)
 
+    # ---- fragment-by-fragment execution ---------------------------------
+    # One XLA program per fragment (not one giant fused program): compile
+    # sizes stay bounded — mirroring the reference's per-stage tasks —
+    # and every cut exchange becomes a consumer-side collective over the
+    # producer fragment's materialized sharded page (the pull model).
+    def execute(self, plan: PlanNode) -> Page:
+        plan = self._resolve_subqueries(plan)
+        plan = self._prepare(plan)
+        from presto_tpu.plan.fragment import create_fragments
+        frags = create_fragments(plan)
+        by_id = {f.fragment_id: f for f in frags}
+        self._frag_results = {}
+        done = set()
+
+        def run(fid: int):
+            if fid in done:
+                return
+            for c in by_id[fid].remote_sources:
+                run(c)
+            self._frag_results[fid] = self._execute_tree(by_id[fid].root)
+            done.add(fid)
+
+        try:
+            run(0)
+            return self._frag_results[0]
+        finally:
+            self._frag_results = {}
+
+    def _remote_input(self, node, scans):
+        from presto_tpu.exec.executor import RemoteSpec
+        page = self._frag_results[node.remote_fragment]
+        idx = len(scans)
+        scans.append(RemoteSpec(node.remote_fragment, page.capacity))
+        return (lambda pages: pages[idx]), page.capacity
+
     # ---- hook overrides -------------------------------------------------
     def _prepare(self, plan: PlanNode) -> PlanNode:
         return add_exchanges(plan)
@@ -74,7 +109,10 @@ class DistExecutor(Executor):
         per = (t.num_rows + self.ndev - 1) // self.ndev
         return max(per, 1)
 
-    def _fetch(self, s: ScanSpec) -> Page:
+    def _fetch(self, s) -> Page:
+        from presto_tpu.exec.executor import RemoteSpec
+        if isinstance(s, RemoteSpec):
+            return self._frag_results[s.fragment_id]
         pages = [self.connector.table(s.table, part=d,
                                       num_parts=self.ndev)
                  .page(columns=list(s.columns), capacity=s.capacity)

@@ -46,10 +46,38 @@ class ScanSpec:
     capacity: int
 
 
+@dataclasses.dataclass
+class RemoteSpec:
+    """Input read from another fragment's result (the consumer side of a
+    cut exchange; reference: RemoteSourceNode -> ExchangeOperator)."""
+    fragment_id: int
+    capacity: int
+
+
 class Overflow(Exception):
     def __init__(self, node_id: int, needed: int):
         self.node_id = node_id
         self.needed = needed
+
+
+class MemoryLimitExceeded(Exception):
+    """Static plan footprint exceeds the executor's memory limit —
+    the caller should batch (exec/lifespan.py) or reject the query.
+    Reference role: MemoryPool reservation failure -> OOM kill
+    (presto-main-base/.../memory/MemoryPool.java)."""
+
+    def __init__(self, estimated: int, limit: int):
+        super().__init__(
+            f"plan needs ~{estimated // (1 << 20)} MiB device memory, "
+            f"limit is {limit // (1 << 20)} MiB")
+        self.estimated = estimated
+        self.limit = limit
+
+
+def _row_bytes(types) -> int:
+    """Bytes per row of a page with these column types (values + null
+    mask lane) — the static footprint unit of capacity accounting."""
+    return sum(t.dtype.itemsize + 1 for t in types)
 
 
 class Executor:
@@ -60,10 +88,18 @@ class Executor:
         self.connector = connector
         self._compiled: Dict = {}   # (plan, caps) -> (jitted, scans, watch)
         self._learned: Dict = {}    # plan -> learned capacity assignment
+        # Static memory accounting (reference: memory/MemoryPool.java —
+        # here capacities are static, so the whole footprint is known at
+        # lower time). None = unlimited.
+        self.memory_limit_bytes = None
+        self.last_memory_estimate = 0
 
     def execute(self, plan: PlanNode) -> Page:
         plan = self._resolve_subqueries(plan)
         plan = self._prepare(plan)
+        return self._execute_tree(plan)
+
+    def _execute_tree(self, plan: PlanNode) -> Page:
         # Learned capacities persist per plan: overflow retries and
         # merge-join duplicate fallbacks are paid once, not per execution.
         caps: Dict = self._learned.setdefault(plan, {})
@@ -111,6 +147,11 @@ class Executor:
 
     def _finish_values(self, out: Page) -> Page:
         return out
+
+    def _remote_input(self, node, scans):
+        raise RuntimeError(
+            "cut exchange in a single-process plan (fragments are only "
+            "executed separately by the distributed executor)")
 
     def _lower_exchange(self, node, nid, src, cap, caps, watch, _needed):
         """Single-process executor: an exchange is a no-op relabel (all
@@ -199,11 +240,14 @@ class Executor:
         memo: Dict[int, Tuple[Callable, int]] = {}
         run_cache: Dict[int, Page] = {}
 
+        mem_bytes = [0]
+
         def build(node: PlanNode):
             key = id(node)
             if key in memo:
                 return memo[key]
             fn, cap = build_inner(node)
+            mem_bytes[0] += cap * _row_bytes(node.output_types)
 
             def cached(pages, fn=fn, key=key):
                 if key in run_cache:
@@ -414,7 +458,12 @@ class Executor:
                         c = compile_expr(node.filter)(out)
                         if node.join_type == JoinType.LEFT:
                             raise NotImplementedError(
-                                "residual filter on outer join")
+                                "residual ON filter on a LEFT join whose "
+                                "build side has duplicate keys (the "
+                                "expansion fallback cannot null-extend "
+                                "per probe row yet; build-side-only "
+                                "conditions are pre-filtered by the "
+                                "planner and never reach here)")
                         out = compact(out,
                                       ~c.nulls & c.values.astype(bool))
                     return out
@@ -453,7 +502,10 @@ class Executor:
                 return (lambda pages: limit_page(src(pages),
                                                  node.count)), cap
             if isinstance(node, ExchangeNode):
-                src, cap = build(node.source)
+                if node.source is None:      # cut: reads another fragment
+                    src, cap = self._remote_input(node, scans)
+                else:
+                    src, cap = build(node.source)
                 return self._lower_exchange(node, nid, src, cap, caps,
                                             watch, _needed)
             if isinstance(node, OutputNode):
@@ -467,6 +519,11 @@ class Executor:
 
         _needed: List = []
         root, _cap = build(plan)
+        self.last_memory_estimate = mem_bytes[0]
+        if self.memory_limit_bytes is not None \
+                and mem_bytes[0] > self.memory_limit_bytes:
+            raise MemoryLimitExceeded(mem_bytes[0],
+                                      self.memory_limit_bytes)
 
         def run(pages):
             _needed.clear()

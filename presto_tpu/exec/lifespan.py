@@ -1,0 +1,204 @@
+"""Lifespan-batched execution: bounded working sets for big scans.
+
+Reference roles: grouped execution over bucket lifespans
+(presto-main-base/.../execution/Lifespan.java,
+sql/planner/GroupedExecutionTagger.java) and the split-streaming driver
+loop (SqlTaskExecution.java:509): instead of materializing the whole
+driving table, stream K row-range lifespans of it through the compiled
+fragment, accumulating PARTIAL aggregation states, and finish with one
+FINAL aggregation over the concatenated partials. Memory is bounded by
+the per-lifespan capacity — the executor's static accounting
+(MemoryLimitExceeded) decides when batching is needed.
+
+Applies to plans whose root path is
+Output -> [Sort|TopN|Limit]* -> Aggregation(single) -> <pipeline over the
+driving scan> — the shape of every aggregation-rooted TPC-H query.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.data.column import Column, Page, bucket_capacity
+from presto_tpu.exec.executor import MemoryLimitExceeded
+from presto_tpu.exec.split_executor import SplitExecutor
+from presto_tpu.ops.aggregate import grouped_aggregate
+from presto_tpu.ops.sort import limit_page, sort_page, top_n
+from presto_tpu.plan.nodes import (
+    AggregationNode, FilterNode, LimitNode, OutputNode, PlanNode,
+    ProjectNode, SortNode, Step, TableScanNode, TopNNode,
+)
+
+
+def _root_chain(plan: PlanNode):
+    """(above_chain, agg) where above_chain are the row-wise/ordering
+    nodes over the root aggregation (Output, Sort, TopN, Limit, the final
+    projection, HAVING filters); None if the plan has no such shape."""
+    above: List[PlanNode] = []
+    node = plan
+    while isinstance(node, (OutputNode, SortNode, TopNNode, LimitNode,
+                            ProjectNode, FilterNode)):
+        above.append(node)
+        node = node.source
+    if isinstance(node, AggregationNode) and node.step == Step.SINGLE:
+        return above, node
+    return None
+
+
+def _driving_scan(connector, plan: PlanNode) -> Optional[str]:
+    """The largest table scanned — the one worth streaming."""
+    best, best_rows = None, -1
+
+    def walk(n):
+        nonlocal best, best_rows
+        if isinstance(n, TableScanNode):
+            rows = connector.table(n.table).num_rows
+            if rows > best_rows:
+                best, best_rows = n.table, rows
+        for c in n.children():
+            if c is not None:
+                walk(c)
+    walk(plan)
+    return best
+
+
+def _streamable(below_agg: PlanNode, driving: str) -> bool:
+    """True iff every occurrence of the driving scan reaches the root
+    aggregation only through row-preserving paths: filters, projections
+    and the PROBE side of inner/left joins. A driving scan under a nested
+    aggregation, a join build/filtering side, a window or a sort would
+    make per-batch partials non-additive — batching would silently
+    corrupt results, so those shapes fall back to single-shot."""
+    from presto_tpu.plan.nodes import JoinNode, JoinType
+
+    def scans_driving(n) -> bool:
+        if isinstance(n, TableScanNode):
+            return n.table == driving
+        return any(c is not None and scans_driving(c)
+                   for c in n.children())
+
+    def ok(n) -> bool:
+        if isinstance(n, TableScanNode):
+            return True
+        if isinstance(n, (FilterNode, ProjectNode)):
+            return ok(n.source)
+        if isinstance(n, JoinNode):
+            if scans_driving(n.build):
+                return False
+            if n.join_type not in (JoinType.INNER, JoinType.LEFT,
+                                   JoinType.SEMI, JoinType.ANTI,
+                                   JoinType.ANTI_EXISTS):
+                return False
+            return ok(n.probe)
+        # Any other node (nested aggregation, window, sort, unique-id)
+        # between the driving scan and the root agg is non-streamable.
+        return not scans_driving(n)
+
+    return ok(below_agg)
+
+
+def _concat_pages(pages: List[Page]) -> Page:
+    """Host-side concatenation of the valid rows of several pages with
+    identical schemas (partial-state pages are small)."""
+    total = sum(int(p.num_rows) for p in pages)
+    cap = bucket_capacity(max(total, 1))
+    cols = []
+    for i, c0 in enumerate(pages[0].columns):
+        vals = np.concatenate([
+            np.asarray(p.columns[i].values)[:int(p.num_rows)]
+            for p in pages])
+        nulls = np.concatenate([
+            np.asarray(p.columns[i].nulls)[:int(p.num_rows)]
+            for p in pages])
+        cols.append(Column.from_numpy(vals, c0.type, nulls=nulls,
+                                      dictionary=c0.dictionary,
+                                      capacity=cap))
+    return Page.from_columns(cols, total, pages[0].names)
+
+
+def execute_batched(connector, plan: PlanNode, num_batches: int,
+                    memory_limit_bytes: Optional[int] = None) -> Page:
+    """Execute `plan` streaming the driving scan in `num_batches`
+    lifespans. Falls back to single-shot execution when the plan shape
+    does not support batching (no root aggregation)."""
+    from presto_tpu.plan.fragment import _partial_agg_layout
+
+    # Resolve scalar subqueries ONCE over the full tables (a per-batch
+    # resolution would compute them over split slices).
+    resolver = SplitExecutor(connector)
+    plan = resolver._resolve_subqueries(plan)
+
+    chain = _root_chain(plan)
+    driving = _driving_scan(connector, plan)
+    if (chain is None or driving is None or num_batches <= 1
+            or not _streamable(chain[1].source, driving)):
+        ex = SplitExecutor(connector)
+        ex.memory_limit_bytes = memory_limit_bytes
+        return ex.execute(plan)
+
+    above, agg = chain
+    partial_specs, final_specs, pnames, ptypes = _partial_agg_layout(agg)
+    partial_plan = AggregationNode(
+        pnames, ptypes, source=agg.source,
+        group_fields=agg.group_fields, aggs=tuple(partial_specs),
+        step=Step.PARTIAL, group_count_hint=agg.group_count_hint)
+
+    ex = SplitExecutor(connector)
+    ex.memory_limit_bytes = memory_limit_bytes
+    partials: List[Page] = []
+    for b in range(num_batches):
+        ex.set_splits({driving: [(b, num_batches)]})
+        partials.append(ex.execute(partial_plan))
+
+    merged = _concat_pages(partials)
+    k = len(agg.group_fields)
+    out_cap = bucket_capacity(max(int(merged.num_rows), 256))
+    page, _groups = grouped_aggregate(merged, tuple(range(k)),
+                                      tuple(final_specs), out_cap)
+    page = Page(page.columns, page.num_rows, agg.output_names)
+
+    # Interpret the small chain above the aggregation.
+    from presto_tpu.data.column import compact
+    from presto_tpu.expr.compile import compile_expr
+
+    for node in reversed(above):
+        if isinstance(node, SortNode):
+            page = sort_page(page, node.keys)
+        elif isinstance(node, TopNNode):
+            page = top_n(page, node.keys, node.count)
+        elif isinstance(node, LimitNode):
+            page = limit_page(page, node.count)
+        elif isinstance(node, ProjectNode):
+            cols = tuple(compile_expr(e)(page) for e in node.expressions)
+            page = Page(cols, page.num_rows, node.output_names)
+        elif isinstance(node, FilterNode):         # HAVING
+            c = compile_expr(node.predicate)(page)
+            page = compact(page, ~c.nulls & c.values.astype(bool))
+        else:  # OutputNode
+            page = Page(page.columns, page.num_rows, node.output_names)
+    return page
+
+
+def execute_bounded(connector, plan: PlanNode,
+                    memory_limit_bytes: int,
+                    max_batches: int = 64) -> Tuple[Page, int]:
+    """Execute under a hard memory limit, doubling the lifespan count
+    until the static plan footprint fits. Returns (page, batches_used).
+    Reference role: the memory-pool + grouped-execution pairing that lets
+    a bounded worker run arbitrarily large scans."""
+    chain = _root_chain(plan)
+    driving = _driving_scan(connector, plan)
+    batchable = (chain is not None and driving is not None
+                 and _streamable(chain[1].source, driving))
+    batches = 1
+    while True:
+        try:
+            return (execute_batched(connector, plan, batches,
+                                    memory_limit_bytes), batches)
+        except MemoryLimitExceeded:
+            if not batchable or batches >= max_batches:
+                raise
+            batches *= 2

@@ -1,0 +1,46 @@
+"""SplitExecutor — scans read ASSIGNED splits (row ranges), not whole
+tables: the worker-side contract (splits arrive in
+TaskUpdateRequest.sources; reference ScheduledSplit / ConnectorSplit) and
+the building block of lifespan-batched execution (exec/lifespan.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from presto_tpu.data.column import Column, Page
+from presto_tpu.exec.executor import Executor, ScanSpec
+
+
+class SplitExecutor(Executor):
+    def __init__(self, connector):
+        super().__init__(connector)
+        self.splits: Dict[str, List[Tuple[int, int]]] = {}
+
+    def set_splits(self, by_table: Dict[str, List[Tuple[int, int]]]):
+        self.splits = by_table
+
+    def _scan_rows(self, node) -> int:
+        parts = self.splits.get(node.table)
+        if parts is None:
+            return self.connector.table(node.table).num_rows
+        return max(1, sum(
+            self.connector.table(node.table, part=p, num_parts=n).num_rows
+            for p, n in parts))
+
+    def _fetch(self, s: ScanSpec) -> Page:
+        parts = self.splits.get(s.table)
+        if parts is None:
+            return super()._fetch(s)
+        tables = [self.connector.table(s.table, part=p, num_parts=n)
+                  for p, n in parts]
+        n_rows = sum(t.num_rows for t in tables)
+        cols = []
+        for c in s.columns:
+            t0 = tables[0]
+            arr = np.concatenate([t.arrays[c][:t.num_rows] for t in tables])
+            cols.append(Column.from_numpy(
+                arr, t0.types[c], dictionary=t0.dicts.get(c),
+                capacity=s.capacity))
+        return Page.from_columns(cols, n_rows, s.columns)

@@ -170,13 +170,12 @@ def merge_join(probe: Page, build: Page,
         probe_contrib = (is_probe & s_live & ~any_key_null
                          ).astype(jnp.int32)
         cs_p = bl_cumsum(probe_contrib)
-        from presto_tpu.ops.scan import fill_forward as ff
+        from presto_tpu.ops.scan import fill_backward, fill_forward as ff
         before_run = ff(jnp.where(run_start, cs_p - probe_contrib, 0),
                         run_start)
         run_end = jnp.roll(run_start, -1).at[-1].set(True)
-        at_end_rev = jnp.flip(ff(jnp.flip(jnp.where(run_end, cs_p, 0)),
-                                 jnp.flip(run_end)))
-        probes_in_run = at_end_rev - before_run
+        at_run_end = fill_backward(jnp.where(run_end, cs_p, 0), run_end)
+        probes_in_run = at_run_end - before_run
         b_matched_cat = s_present & (probes_in_run > 0)
         back_ops_b = ((1 - s_tag).astype(jnp.int8), s_src, b_matched_cat)
         bb = jax.lax.sort(back_ops_b, num_keys=2, is_stable=False)

@@ -74,6 +74,13 @@ def fill_forward(vals: jnp.ndarray, present: jnp.ndarray,
     return out.reshape(-1)[:n]
 
 
+def fill_backward(vals: jnp.ndarray, present: jnp.ndarray, init=None):
+    """Per-slot next `present` value at or after the slot (reversed
+    fill_forward; flips lower to strided slices, not gathers)."""
+    rev = lambda a: jnp.flip(a, axis=0)          # noqa: E731
+    return rev(fill_forward(rev(vals), rev(present), init))
+
+
 def segment_sums(vals: jnp.ndarray, starts: jnp.ndarray,
                  ends: jnp.ndarray) -> jnp.ndarray:
     """Per-segment sums over *contiguous* segments (rows pre-sorted by

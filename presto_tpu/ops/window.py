@@ -37,9 +37,7 @@ class WindowSpec:
     output_type: Type
 
 
-def _fill_backward(vals, present):
-    rev = lambda a: jnp.flip(a, axis=0)          # noqa: E731
-    return rev(pscan.fill_forward(rev(vals), rev(present)))
+_fill_backward = pscan.fill_backward
 
 
 def window_page(page: Page, partition_fields: Sequence[int],

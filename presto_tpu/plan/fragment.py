@@ -255,20 +255,28 @@ def create_fragments(plan: PlanNode) -> List[PlanFragment]:
     """Cut the exchanged plan at ExchangeNodes (reference:
     PlanFragmenter.createSubPlans). Fragment 0 is the root. Each
     ExchangeNode becomes the boundary: its source subtree moves into a new
-    fragment whose id the parent fragment records as a remote source."""
+    fragment whose id the exchange records (`remote_fragment`) and the
+    parent fragment lists as a remote source. Shared subtrees (mark
+    joins) become ONE producer fragment referenced by several exchanges."""
     fragments: List[PlanFragment] = []
     counter = [0]
+    shared: Dict[int, int] = {}       # id(subtree) -> fragment id
 
     def cut(node: PlanNode, sources: List[int]) -> PlanNode:
         if isinstance(node, ExchangeNode):
-            child_sources: List[int] = []
-            child_root = cut(node.source, child_sources)
-            fid = counter[0] = counter[0] + 1
-            fragments.append(PlanFragment(
-                fid, child_root, node.partitioning,
-                tuple(child_sources)))
+            key = id(node.source)
+            fid = shared.get(key)
+            if fid is None:
+                child_sources: List[int] = []
+                child_root = cut(node.source, child_sources)
+                fid = counter[0] = counter[0] + 1
+                shared[key] = fid
+                fragments.append(PlanFragment(
+                    fid, child_root, node.partitioning,
+                    tuple(child_sources)))
             sources.append(fid)
-            return dataclasses.replace(node, source=None)
+            return dataclasses.replace(node, source=None,
+                                       remote_fragment=fid)
         kids = node.children()
         if not kids:
             return node

@@ -194,9 +194,13 @@ class ExchangeNode(PlanNode):
     keys: Tuple[int, ...] = ()
     # RANGE only: the ordering whose first key ranges define the split
     sort_keys: Tuple[SortKey, ...] = ()
+    # set by the fragmenter when the source subtree was cut into its own
+    # fragment: the producer fragment id this exchange pulls from
+    # (reference: RemoteSourceNode.sourceFragmentIds)
+    remote_fragment: Optional[int] = None
 
     def children(self):
-        return (self.source,)
+        return (self.source,) if self.source is not None else ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,10 +16,8 @@ import traceback
 import uuid
 from typing import Dict, List, Optional, Tuple
 
-import numpy as np
-
-from presto_tpu.data.column import Column, Page, bucket_capacity
-from presto_tpu.exec.executor import Executor, ScanSpec
+from presto_tpu.data.column import Page
+from presto_tpu.exec.split_executor import SplitExecutor
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.serde import (
     encode_serialized_page, page_to_wire_blocks,
@@ -27,42 +25,6 @@ from presto_tpu.protocol.serde import (
 from presto_tpu.protocol.translate import translate_fragment
 from presto_tpu.server.buffers import OutputBufferManager
 
-
-class SplitExecutor(Executor):
-    """Executor whose scans read the task's ASSIGNED splits (row ranges),
-    not whole tables — the worker-side contract (splits arrive in
-    TaskUpdateRequest.sources, reference ScheduledSplit)."""
-
-    def __init__(self, connector):
-        super().__init__(connector)
-        self.splits: Dict[str, List[Tuple[int, int]]] = {}
-
-    def set_splits(self, by_table: Dict[str, List[Tuple[int, int]]]):
-        self.splits = by_table
-
-    def _scan_rows(self, node) -> int:
-        parts = self.splits.get(node.table)
-        if parts is None:
-            return self.connector.table(node.table).num_rows
-        return max(1, sum(
-            self.connector.table(node.table, part=p, num_parts=n).num_rows
-            for p, n in parts))
-
-    def _fetch(self, s: ScanSpec) -> Page:
-        parts = self.splits.get(s.table)
-        if parts is None:
-            return super()._fetch(s)
-        tables = [self.connector.table(s.table, part=p, num_parts=n)
-                  for p, n in parts]
-        n_rows = sum(t.num_rows for t in tables)
-        cols = []
-        for c in s.columns:
-            t0 = tables[0]
-            arr = np.concatenate([t.arrays[c][:t.num_rows] for t in tables])
-            cols.append(Column.from_numpy(
-                arr, t0.types[c], dictionary=t0.dicts.get(c),
-                capacity=s.capacity))
-        return Page.from_columns(cols, n_rows, s.columns)
 
 
 def _scan_tables(frag: S.PlanFragment) -> Dict[str, str]:

@@ -1151,6 +1151,7 @@ class Planner:
 
     def _plan_plain_select(self, q: ast.Select, rp: RelationPlan
                            ) -> RelationPlan:
+        base_fields = rp.fields        # pre-window: what SELECT * expands
         wcalls = _collect_window_calls(q.items)
         if wcalls:
             rp, wc_names = self._plan_window(wcalls, rp)
@@ -1164,7 +1165,10 @@ class Planner:
         out_names: List[str] = []
         for i, it in enumerate(q.items):
             if isinstance(it.expr, ast.Star):
-                for j, f in enumerate(fields):
+                # Expand over the PRE-window fields only (the window and
+                # helper columns appended behind them are internal; their
+                # positions are unchanged by the window node).
+                for j, f in enumerate(base_fields):
                     if it.expr.qualifier in (None, f.qualifier):
                         out_exprs.append(InputRef(j, f.type))
                         out_names.append(f.name)

@@ -1,0 +1,79 @@
+"""Lifespan-batched execution + memory accounting tests.
+
+VERDICT.md #5: stream connector splits through the compiled fragment
+(partial-agg accumulation per batch), static memory accounting with an
+enforced per-query limit, bounded working sets."""
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.exec.executor import MemoryLimitExceeded
+from presto_tpu.exec.lifespan import execute_batched, execute_bounded
+from presto_tpu.exec.split_executor import SplitExecutor
+
+SF = 0.02
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LocalEngine(TpchConnector(SF))
+
+
+Q1 = ("select l_returnflag, l_linestatus, sum(l_quantity), "
+      "sum(l_extendedprice), avg(l_discount), count(*) from lineitem "
+      "where l_shipdate <= date '1998-09-02' "
+      "group by l_returnflag, l_linestatus order by 1, 2")
+Q6 = ("select sum(l_extendedprice * l_discount) from lineitem "
+      "where l_discount between 0.05 and 0.07 and l_quantity < 24")
+Q3ISH = ("select o_orderpriority, count(*), sum(l_extendedprice) "
+         "from lineitem, orders where l_orderkey = o_orderkey "
+         "and o_totalprice > 100000 group by o_orderpriority "
+         "order by 1")
+
+
+def _match(a, b):
+    assert len(a) == len(b), (a[:3], b[:3])
+    for ra, rb in zip(a, b):
+        for x, y in zip(ra, rb):
+            if isinstance(x, float):
+                assert abs(x - y) <= 1e-6 * max(abs(y), 1.0), (ra, rb)
+            else:
+                assert x == y, (ra, rb)
+
+
+@pytest.mark.parametrize("sql", [Q1, Q6, Q3ISH])
+@pytest.mark.parametrize("batches", [3, 8])
+def test_batched_matches_single_shot(engine, sql, batches):
+    plan = engine.plan_sql(sql)
+    plan = engine.executor._resolve_subqueries(plan)
+    whole = engine.execute_sql(sql)
+    batched = execute_batched(engine.connector, plan, batches).to_pylist()
+    _match(batched, whole)
+
+
+def test_memory_limit_enforced(engine):
+    plan = engine.executor._resolve_subqueries(engine.plan_sql(Q1))
+    ex = SplitExecutor(engine.connector)
+    ex.memory_limit_bytes = 1 << 20          # 1 MiB: far too small
+    with pytest.raises(MemoryLimitExceeded):
+        ex.execute(plan)
+    assert ex.last_memory_estimate > 1 << 20
+
+
+def test_bounded_execution_batches_until_it_fits(engine):
+    plan = engine.executor._resolve_subqueries(engine.plan_sql(Q1))
+    # Whole-table footprint at SF0.02 overflows this limit; a few
+    # lifespans fit. Result must still be exact.
+    page, batches = execute_bounded(engine.connector, plan,
+                                    memory_limit_bytes=6 << 20)
+    assert batches > 1
+    _match(page.to_pylist(), engine.execute_sql(Q1))
+
+
+def test_memory_estimate_reported(engine):
+    engine.execute_sql(Q6)
+    est = engine.executor.last_memory_estimate
+    # lineitem SF0.02 ~ 120k rows -> bucket 131072; the fused Q6 plan
+    # touches a handful of columns: estimate must be plausible, not zero.
+    assert est > 1 << 20
