@@ -1,0 +1,100 @@
+"""Session properties + engine configuration.
+
+Reference roles: SystemSessionProperties (presto-main-base/.../
+SystemSessionProperties.java — 305 typed, per-query-overridable knobs in
+one registry) and the native worker's SystemConfig
+(presto_cpp/main/common/Configs.h:162). Scoped to the knobs this engine
+actually consumes; each property declares a type and default, values
+parse from strings exactly like session properties on the wire
+(SessionRepresentation.systemProperties).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes", "on")
+
+
+def _parse_bytes(s: str) -> int:
+    s = s.strip().upper()
+    for suffix, mult in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10),
+                         ("B", 1)):
+        if s.endswith(suffix):
+            return int(float(s[:-len(suffix)]) * mult)
+    return int(s)
+
+
+@dataclasses.dataclass(frozen=True)
+class Property:
+    name: str
+    description: str
+    parse: Callable[[str], Any]
+    default: Any
+
+
+# The registry — one row per knob, like SystemSessionProperties' list.
+PROPERTIES = [
+    Property("query_max_memory_per_node",
+             "Static plan-footprint limit per query; exceeding it raises "
+             "MemoryLimitExceeded (or triggers lifespan batching)",
+             _parse_bytes, None),
+    Property("lifespan_batches",
+             "Row-range lifespans to stream the driving scan in "
+             "(0 = single shot)", int, 0),
+    Property("group_count_hint",
+             "Default aggregation output-capacity hint when the planner "
+             "has no estimate", int, 65536),
+    Property("merge_join_enabled",
+             "Use the sort-merge join fast path for unique build keys",
+             _parse_bool, True),
+    Property("direct_agg_max_bins",
+             "Max mixed-radix bins for the scatter-free small-domain "
+             "aggregation path", int, 64),
+    Property("exchange_chunk_factor",
+             "Per-peer exchange chunk = factor * capacity / n_devices",
+             int, 2),
+    Property("collect_stats",
+             "Record per-node output row counts for EXPLAIN ANALYZE",
+             _parse_bool, False),
+]
+
+_BY_NAME = {p.name: p for p in PROPERTIES}
+
+
+class Session:
+    """One query session: defaults overridden by string-typed properties
+    (the wire form). Unknown properties are rejected loudly, like the
+    coordinator does."""
+
+    def __init__(self, properties: Optional[Dict[str, str]] = None,
+                 user: str = "user", catalog: str = "tpch",
+                 schema: str = "default"):
+        self.user = user
+        self.catalog = catalog
+        self.schema = schema
+        self.values: Dict[str, Any] = {
+            p.name: p.default for p in PROPERTIES}
+        for name, raw in (properties or {}).items():
+            prop = _BY_NAME.get(name)
+            if prop is None:
+                raise KeyError(f"unknown session property {name!r}")
+            self.values[name] = prop.parse(raw)
+
+    def __getitem__(self, name: str):
+        return self.values[name]
+
+    def get(self, name: str, default=None):
+        return self.values.get(name, default)
+
+    @staticmethod
+    def describe() -> str:
+        """SHOW SESSION analog."""
+        out = []
+        for p in PROPERTIES:
+            out.append(f"{p.name} (default {p.default!r}): "
+                       f"{p.description}")
+        return "\n".join(out)

@@ -148,7 +148,9 @@ class DistExecutor(Executor):
                 pid_fn = lambda p: range_partition_ids(  # noqa: E731
                     p, node.sort_keys[0], ndev)
             out_cap = caps.get((nid, "cap")) or bucket_capacity(2 * cap)
-            chunk = caps.get((nid, "chunk")) or max(2 * cap // ndev, 64)
+            factor = self.session["exchange_chunk_factor"]
+            chunk = caps.get((nid, "chunk")) \
+                or max(factor * cap // ndev, 64)
             caps[(nid, "cap")] = out_cap
             caps[(nid, "chunk")] = chunk
             watch.append((nid, "cap"))

@@ -16,11 +16,15 @@ from presto_tpu.sql.parser import parse_sql
 
 
 class LocalEngine:
-    def __init__(self, connector):
+    def __init__(self, connector, session=None):
         self.connector = connector
         self.planner = Planner(connector)
-        self.executor = Executor(connector)
+        self.executor = Executor(connector, session=session)
         self._plans = {}
+
+    @property
+    def session(self):
+        return self.executor.session
 
     def plan_sql(self, sql: str) -> PlanNode:
         if sql not in self._plans:
@@ -31,8 +35,19 @@ class LocalEngine:
         return explain(self.plan_sql(sql))
 
     def execute_sql(self, sql: str) -> List[tuple]:
-        page = self.executor.execute(self.plan_sql(sql))
+        n = self.session["lifespan_batches"]
+        if n and n > 1:
+            from presto_tpu.exec.lifespan import execute_batched
+            page = execute_batched(
+                self.connector, self.plan_sql(sql), n,
+                self.session["query_max_memory_per_node"])
+        else:
+            page = self.executor.execute(self.plan_sql(sql))
         return page.to_pylist()
+
+    def explain_analyze_sql(self, sql: str) -> str:
+        from presto_tpu.exec.stats import explain_analyze
+        return explain_analyze(self, sql)
 
     def column_names(self, sql: str) -> Tuple[str, ...]:
         return self.plan_sql(sql).output_names

@@ -84,15 +84,23 @@ class Executor:
     """Executes a plan against a connector. Compiles once per (plan,
     capacity assignment); overflow retries bump capacities."""
 
-    def __init__(self, connector):
+    def __init__(self, connector, session=None):
+        from presto_tpu.config import Session
+
         self.connector = connector
+        self.session = session or Session()
         self._compiled: Dict = {}   # (plan, caps) -> (jitted, scans, watch)
         self._learned: Dict = {}    # plan -> learned capacity assignment
         # Static memory accounting (reference: memory/MemoryPool.java —
         # here capacities are static, so the whole footprint is known at
         # lower time). None = unlimited.
-        self.memory_limit_bytes = None
+        self.memory_limit_bytes = self.session["query_max_memory_per_node"]
         self.last_memory_estimate = 0
+        # EXPLAIN ANALYZE support (collect_stats session property):
+        # per-node output row counts from the last execution.
+        self.last_node_rows: Dict[int, int] = {}
+        self._node_map: Dict[int, tuple] = {}   # nid -> (plan node, cap)
+        self._stats_ids: List[int] = []
 
     def execute(self, plan: PlanNode) -> Page:
         plan = self._resolve_subqueries(plan)
@@ -107,14 +115,20 @@ class Executor:
             # _lower is cheap (no tracing) and fills `caps` with its chosen
             # capacities, which completes the compilation cache key.
             fn, scans, watch = self._lower(plan, caps)
-            key = (plan, tuple(sorted(caps.items(), key=repr)))
+            key = (plan, tuple(sorted(caps.items(), key=repr)),
+                   bool(self.session["collect_stats"]))
             entry = self._compiled.get(key)
             if entry is None:
-                entry = (jax.jit(self._wrap(fn)), scans, watch)
+                # stats_box is filled at this entry's first execution
+                # (trace time fixes the node-id order for its lifetime).
+                entry = (jax.jit(self._wrap(fn)), scans, watch, [])
                 self._compiled[key] = entry
-            fn, scans, watch = entry
+            fn, scans, watch, stats_box = entry
             pages = [self._fetch(s) for s in scans]
+            self._stats_ids = []
             out, needed = fn(pages)
+            if self._stats_ids and not stats_box:
+                stats_box.extend(self._stats_ids)
             needed = __import__("numpy").asarray(needed)   # single sync
             grew = False
             for nid, need in zip(watch, needed):
@@ -123,6 +137,10 @@ class Executor:
                     caps[nid] = bucket_capacity(need)
                     grew = True
             if not grew:
+                if stats_box:
+                    stats = needed[len(watch):]
+                    self.last_node_rows = {
+                        nid: int(r) for nid, r in zip(stats_box, stats)}
                 return out
         raise RuntimeError("capacity retry loop did not converge")
 
@@ -241,18 +259,25 @@ class Executor:
         run_cache: Dict[int, Page] = {}
 
         mem_bytes = [0]
+        collect_stats = bool(self.session["collect_stats"])
+        _node_rows: List = []
+        self._node_map = {}
 
         def build(node: PlanNode):
             key = id(node)
             if key in memo:
                 return memo[key]
+            nid_stats = counter[0] + 1       # id build_inner will assign
             fn, cap = build_inner(node)
             mem_bytes[0] += cap * _row_bytes(node.output_types)
+            self._node_map[nid_stats] = (node, cap)
 
-            def cached(pages, fn=fn, key=key):
+            def cached(pages, fn=fn, key=key, nid=nid_stats):
                 if key in run_cache:
                     return run_cache[key]
                 out = fn(pages)
+                if collect_stats:
+                    _node_rows.append((nid, out.num_rows))
                 run_cache[key] = out
                 return out
             memo[key] = (cached, cap)
@@ -320,7 +345,8 @@ class Executor:
                     source = source.source
                 steps.reverse()
                 src, cap = build(source)
-                hint = node.group_count_hint or 65536
+                hint = node.group_count_hint \
+                    or self.session["group_count_hint"]
                 out_cap = caps.get(nid) or min(
                     cap, bucket_capacity(hint))
                 if not node.group_fields:
@@ -341,7 +367,9 @@ class Executor:
                             p = Page(cols, p.num_rows, names)
                     out, true_groups = grouped_aggregate(
                         p, node.group_fields, node.aggs, out_cap,
-                        row_mask=mask)
+                        row_mask=mask,
+                        direct_max_bins=self.session[
+                            "direct_agg_max_bins"])
                     _needed.append(true_groups)
                     return self._finish_agg(node, out)
                 return agg_fn, out_cap
@@ -377,6 +405,7 @@ class Executor:
                 # negated node id: any duplicate live build key re-lowers
                 # onto the expansion hash_join below.
                 use_merge = (bool(node.probe_keys)
+                             and self.session["merge_join_enabled"]
                              and node.join_type in (JoinType.INNER,
                                                     JoinType.LEFT,
                                                     JoinType.FULL)
@@ -528,12 +557,17 @@ class Executor:
         def run(pages):
             _needed.clear()
             run_cache.clear()
+            _node_rows.clear()
             out = root(pages)
-            # One stacked array => one host transfer for all overflow
-            # counters (each scalar fetch pays a full host sync).
-            if _needed:
+            # Stats ride behind the overflow counters in the same stacked
+            # array (one host transfer); their node-id order is fixed at
+            # trace time.
+            self._stats_ids = [nid for nid, _ in _node_rows]
+            extras = [r for _nid, r in _node_rows]
+            all_counters = list(_needed) + extras
+            if all_counters:
                 counters = jnp.stack(
-                    [jnp.asarray(n, jnp.int64) for n in _needed])
+                    [jnp.asarray(n, jnp.int64) for n in all_counters])
             else:
                 counters = jnp.zeros((0,), jnp.int64)
             return out, counters

@@ -14,8 +14,8 @@ from presto_tpu.exec.executor import Executor, ScanSpec
 
 
 class SplitExecutor(Executor):
-    def __init__(self, connector):
-        super().__init__(connector)
+    def __init__(self, connector, session=None):
+        super().__init__(connector, session=session)
         self.splits: Dict[str, List[Tuple[int, int]]] = {}
 
     def set_splits(self, by_table: Dict[str, List[Tuple[int, int]]]):

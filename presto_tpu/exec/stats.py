@@ -1,0 +1,92 @@
+"""EXPLAIN ANALYZE — the executed plan annotated with measured stats.
+
+Reference roles: the QueryStats -> OperatorStats tree
+(presto-main-base/.../operator/OperatorStats.java) rendered by
+ExplainAnalyzeOperator. TPU reinterpretation: operators fuse into one XLA
+program per fragment, so per-operator WALL TIME does not exist — what is
+real and reported is per-node output cardinality (traced counters riding
+the overflow-counter transfer), static capacity/memory footprint per
+node, and per-execution wall/compile time. Fused nodes (filter/project
+chains absorbed into aggregations) are marked as such.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from presto_tpu.exec.executor import _row_bytes
+from presto_tpu.plan import nodes as P
+
+
+def _detail(node) -> str:
+    if isinstance(node, P.TableScanNode):
+        return f" {node.table}{list(node.columns)}"
+    if isinstance(node, P.FilterNode):
+        return f" {node.predicate}"
+    if isinstance(node, P.AggregationNode):
+        return (f" keys={list(node.group_fields)} "
+                f"aggs={[a.kind for a in node.aggs]} "
+                f"step={node.step.value}")
+    if isinstance(node, P.JoinNode):
+        return (f" {node.join_type.value} "
+                f"probe{list(node.probe_keys)}=build{list(node.build_keys)}")
+    if isinstance(node, P.WindowNode):
+        return (f" partition={list(node.partition_fields)} "
+                f"fns={[s.kind for s in node.specs]}")
+    if isinstance(node, (P.TopNNode, P.LimitNode)):
+        return f" n={node.count}"
+    if isinstance(node, P.ExchangeNode):
+        return f" {node.partitioning.value} keys={list(node.keys)}"
+    return ""
+
+
+def render_analyzed(plan, node_map: Dict[int, tuple],
+                    node_rows: Dict[int, int], wall_s: float,
+                    memory_bytes: int) -> str:
+    """Annotate the plan tree with executed row counts + footprints."""
+    by_identity = {id(n): (nid, cap) for nid, (n, cap) in node_map.items()}
+    lines = []
+
+    def walk(node, depth):
+        pad = "  " * depth
+        name = type(node).__name__.replace("Node", "")
+        info = by_identity.get(id(node))
+        if info is None:
+            annot = "(fused into parent)"
+        else:
+            nid, cap = info
+            rows = node_rows.get(nid)
+            bytes_ = cap * _row_bytes(node.output_types)
+            annot = (f"rows={rows if rows is not None else '?'} "
+                     f"cap={cap} ~{bytes_ // 1024} KiB")
+        lines.append(f"{pad}{name}{_detail(node)}  [{annot}]")
+        for c in node.children():
+            if c is not None:
+                walk(c, depth + 1)
+
+    walk(plan, 0)
+    lines.append(f"-- wall {wall_s * 1000:.1f} ms, "
+                 f"plan footprint ~{memory_bytes // (1 << 20)} MiB")
+    return "\n".join(lines)
+
+
+def explain_analyze(engine, sql: str) -> str:
+    """Execute `sql` with stats collection and render the analyzed plan
+    (reference: EXPLAIN ANALYZE via ExplainAnalyzeOperator)."""
+    ex = engine.executor
+    plan = ex._resolve_subqueries(engine.plan_sql(sql))
+    plan = ex._prepare(plan)
+    old = ex.session.values["collect_stats"]
+    ex.session.values["collect_stats"] = True
+    # collect_stats changes the traced program: bypass stale compiles.
+    compiled, ex._compiled = ex._compiled, {}
+    try:
+        t0 = time.perf_counter()
+        ex._execute_tree(plan)
+        wall = time.perf_counter() - t0
+        return render_analyzed(plan, ex._node_map, ex.last_node_rows,
+                               wall, ex.last_memory_estimate)
+    finally:
+        ex.session.values["collect_stats"] = old
+        ex._compiled = compiled

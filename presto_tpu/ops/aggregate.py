@@ -65,7 +65,8 @@ class AggSpec:
 _DIRECT_MAX_BINS = 64
 
 
-def _direct_domains(page: Page, group_fields: Sequence[int]):
+def _direct_domains(page: Page, group_fields: Sequence[int],
+                    max_bins: int = _DIRECT_MAX_BINS):
     """Per-key domain sizes if the direct path applies, else None."""
     domains = []
     for f in group_fields:
@@ -79,7 +80,7 @@ def _direct_domains(page: Page, group_fields: Sequence[int]):
     prod = 1
     for d in domains:
         prod *= d + 1                      # +1: per-key NULL bin
-        if prod > _DIRECT_MAX_BINS:
+        if prod > max_bins:
             return None
     return domains, prod
 
@@ -212,7 +213,8 @@ def _agg_inputs(a: AggSpec, page: Page):
 def grouped_aggregate(page: Page, group_fields: Sequence[int],
                       aggs: Sequence[AggSpec],
                       out_capacity: Optional[int] = None,
-                      row_mask: Optional[jnp.ndarray] = None):
+                      row_mask: Optional[jnp.ndarray] = None,
+                      direct_max_bins: int = _DIRECT_MAX_BINS):
     """Group `page` by `group_fields` and evaluate `aggs`. Output columns:
     group keys (in order) then one column per agg (avg_partial emits two).
     With no group fields, emits exactly one row (SQL global aggregation).
@@ -235,7 +237,7 @@ def grouped_aggregate(page: Page, group_fields: Sequence[int],
         return _direct_grouped_aggregate(page, (), aggs, out_cap, valid,
                                          [], 1, min_groups=1)
 
-    d = _direct_domains(page, group_fields)
+    d = _direct_domains(page, group_fields, direct_max_bins)
     if d is not None:
         domains, prod = d
         return _direct_grouped_aggregate(

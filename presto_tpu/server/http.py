@@ -144,6 +144,35 @@ class _Handler(BaseHTTPRequestHandler):
                 "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
                 "heapUsed": self.tm.memory_bytes(),
                 "heapAvailable": 16 << 30, "nonHeapUsed": 0})
+        if path == "/v1/info/metrics":
+            # Prometheus text exposition (reference:
+            # presto_cpp/main/runtime-metrics/PrometheusStatsReporter.cpp,
+            # registered at PrestoServer.cpp:562).
+            tasks = list(self.tm.tasks.values())
+            by_state: dict = {}
+            for t in tasks:
+                by_state[t.state] = by_state.get(t.state, 0) + 1
+            lines = [
+                "# TYPE presto_tpu_tasks gauge",
+                f"presto_tpu_tasks {len(tasks)}",
+                "# TYPE presto_tpu_task_bytes_out counter",
+                f"presto_tpu_task_bytes_out {self.tm.total_bytes_out}",
+                "# TYPE presto_tpu_uptime_seconds counter",
+                f"presto_tpu_uptime_seconds "
+                f"{time.time() - _SERVER_START:.1f}",
+                "# TYPE presto_tpu_tasks_by_state gauge",
+            ]
+            for state, n in sorted(by_state.items()):
+                lines.append(
+                    f'presto_tpu_tasks_by_state{{state="{state}"}} {n}')
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if path == "/v1/memory":
             return self._json(200, {
                 "pools": {"general": {

@@ -62,6 +62,7 @@ class Task:
         self.seen_splits: set = set()
         self.pending_splits: List[S.ScheduledSplit] = []
         self.no_more_splits = False
+        self.session_properties: Dict[str, str] = {}
         self.update_lock = threading.Lock()
         self.state_change = threading.Condition()
         self.bytes_out = 0
@@ -104,6 +105,7 @@ class TpuTaskManager:
         self.connector = connector
         self.base_uri = base_uri
         self.tasks: Dict[str, Task] = {}
+        self.total_bytes_out = 0      # monotonic (survives task delete)
         self.lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -123,6 +125,8 @@ class TpuTaskManager:
             if req.outputIds is not None and task.buffers is None:
                 task.buffers = OutputBufferManager(
                     sorted(req.outputIds.buffers))
+            if req.session is not None and req.session.systemProperties:
+                task.session_properties.update(req.session.systemProperties)
             if req.fragment is not None and task.fragment is None:
                 task.fragment = S.PlanFragment.from_bytes(req.fragment)
                 task.scan_tables = _scan_tables(task.fragment)
@@ -157,12 +161,24 @@ class TpuTaskManager:
     # ------------------------------------------------------------------
     def _run(self, task: Task):
         try:
+            from presto_tpu.config import PROPERTIES, Session
+
             plan = translate_fragment(task.fragment)
-            ex = SplitExecutor(self.connector)
+            # Session properties arrive on the wire as strings
+            # (SessionRepresentation.systemProperties); unknown ones are
+            # coordinator-side and ignored here, like the C++ worker's
+            # PrestoToVeloxQueryConfig mapping.
+            known = {p.name for p in PROPERTIES}
+            props = {k: v for k, v in
+                     (task.session_properties or {}).items()
+                     if k in known}
+            ex = SplitExecutor(self.connector, session=Session(props))
             ex.set_splits(task.splits)
             page = ex.execute(plan)
             frame = self._serialize(page)
             task.bytes_out = len(frame)
+            with self.lock:
+                self.total_bytes_out += len(frame)
             first = sorted(task.buffers.buffers)[0]
             task.buffers.add_page(first, frame)
             task.buffers.set_no_more_pages()
