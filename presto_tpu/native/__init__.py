@@ -1,0 +1,108 @@
+"""Native (C++) data-plane helpers, loaded via ctypes.
+
+The reference's worker data plane is native (presto_cpp + Velox
+serializers); this package provides the equivalent native hot path for
+the SerializedPage codec — null-bitmap packing and the page CRC — built
+lazily with the system toolchain and cached next to the source. Callers
+(protocol/serde.py) fall back to the numpy implementations when no
+compiler is available, so the wire format is identical either way."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "page_codec.cc")
+_LIB = os.path.join(_DIR, "libpagecodec.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    # Per-pid tmp name: concurrent first-use builds from several
+    # processes must not write the same file (os.replace stays atomic).
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:            # noqa: BLE001 — no toolchain: fallback
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:     # steady-state: lock-free
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            lib.pt_pack_nulls.restype = ctypes.c_int
+            lib.pt_pack_nulls.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p]
+            lib.pt_unpack_nulls.restype = None
+            lib.pt_unpack_nulls.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p]
+            lib.pt_crc32.restype = ctypes.c_uint32
+            lib.pt_crc32.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def pack_nulls(nulls: np.ndarray) -> Optional[bytes]:
+    """MSB-first null bitmap, or None if the native library is absent."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(nulls)
+    src = np.ascontiguousarray(nulls, dtype=np.uint8)
+    out = np.zeros((n + 7) // 8, dtype=np.uint8)
+    lib.pt_pack_nulls(src.ctypes.data, n, out.ctypes.data)
+    return out.tobytes()
+
+
+def unpack_nulls(bits: bytes, n: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None or len(bits) < (n + 7) // 8:
+        # short/corrupt bitmap: let the numpy fallback raise, never hand
+        # an under-sized buffer to C
+        return None
+    src = np.frombuffer(bits, dtype=np.uint8)
+    out = np.empty(n, dtype=np.uint8)
+    lib.pt_unpack_nulls(src.ctypes.data, n, out.ctypes.data)
+    return out.astype(bool)
+
+
+def crc32(data: bytes, crc: int = 0) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    ptr = buf.ctypes.data if len(buf) else None
+    return int(lib.pt_crc32(ptr, len(buf), crc & 0xFFFFFFFF))

@@ -1,0 +1,69 @@
+// Native data-plane marshalling for the SerializedPage wire codec.
+//
+// Reference parity: the worker-side page marshalling is native C++ in the
+// reference (presto_cpp uses Velox's serializers +
+// presto-spi/.../page/PagesSerdeUtil.java defines the frame layout); this
+// module is the equivalent native hot path for presto-tpu, loaded via
+// ctypes with a numpy fallback (protocol/serde.py).
+//
+// Exposed (extern "C", plain buffers — no Python API dependency):
+//   pt_pack_nulls    bools -> MSB-first bitmap (EncoderUtil.encodeNullsAsBits)
+//   pt_unpack_nulls  bitmap -> bools
+//   pt_crc32         zlib-compatible CRC32 (the page checksum primitive)
+//
+// Build: g++ -O3 -shared -fPIC page_codec.cc -o libpagecodec.so
+// (presto_tpu/native/__init__.py compiles lazily and caches).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// bools (one byte each, nonzero = null) -> MSB-first packed bits.
+// `out` must hold (n + 7) / 8 bytes. Returns 1 if any null was set.
+int pt_pack_nulls(const uint8_t* nulls, size_t n, uint8_t* out) {
+    size_t nbytes = (n + 7) / 8;
+    std::memset(out, 0, nbytes);
+    int any = 0;
+    for (size_t i = 0; i < n; i++) {
+        if (nulls[i]) {
+            out[i >> 3] |= (uint8_t)(0x80u >> (i & 7));
+            any = 1;
+        }
+    }
+    return any;
+}
+
+// MSB-first packed bits -> bools (one byte each).
+void pt_unpack_nulls(const uint8_t* bits, size_t n, uint8_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        out[i] = (bits[i >> 3] >> (7 - (i & 7))) & 1u;
+    }
+}
+
+// zlib-compatible CRC32 (reflected, poly 0xEDB88320), slice-by-8-free
+// table variant — matches java.util.zip.CRC32 / Python zlib.crc32.
+// Table built by a static initializer: dlopen runs it single-threaded
+// before any pt_crc32 call, so there is no lazy-init data race.
+struct CrcTable {
+    uint32_t t[256];
+    CrcTable() {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
+            t[i] = c;
+        }
+    }
+};
+static const CrcTable crc_table;
+
+uint32_t pt_crc32(const uint8_t* data, size_t n, uint32_t crc) {
+    crc = ~crc;
+    for (size_t i = 0; i < n; i++)
+        crc = crc_table.t[(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
+    return ~crc;
+}
+
+}  // extern "C"

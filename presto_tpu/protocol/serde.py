@@ -62,11 +62,17 @@ class WireBlock:
 # ---------------------------------------------------------------------------
 
 def _encode_nulls(out: bytearray, nulls: Optional[np.ndarray], n: int):
-    """EncoderUtil.encodeNullsAsBits: hasNulls byte then MSB-first bits."""
+    """EncoderUtil.encodeNullsAsBits: hasNulls byte then MSB-first bits.
+    Uses the native (C++) packer when available (presto_tpu/native)."""
     if nulls is None or not nulls.any():
         out.append(0)
         return
     out.append(1)
+    from presto_tpu import native
+    packed = native.pack_nulls(np.asarray(nulls[:n]))
+    if packed is not None:
+        out.extend(packed)
+        return
     bits = np.packbits(nulls[:n].astype(np.uint8))  # MSB-first, matches
     out.extend(bits.tobytes())
 
@@ -78,8 +84,11 @@ def _decode_nulls(buf: memoryview, off: int, n: int
     if not has:
         return None, off
     nbytes = (n + 7) // 8
-    bits = np.frombuffer(buf[off:off + nbytes], dtype=np.uint8)
-    nulls = np.unpackbits(bits, count=n).astype(bool)
+    from presto_tpu import native
+    nulls = native.unpack_nulls(bytes(buf[off:off + nbytes]), n)
+    if nulls is None:
+        bits = np.frombuffer(buf[off:off + nbytes], dtype=np.uint8)
+        nulls = np.unpackbits(bits, count=n).astype(bool)
     return nulls, off + nbytes
 
 
@@ -226,12 +235,14 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
 
 def _checksum(payload: bytes, markers: int, position_count: int,
               uncompressed: int) -> int:
-    crc = zlib.crc32(payload)
-    crc = zlib.crc32(bytes([markers & 0xFF]), crc)
+    from presto_tpu import native
+    tail = bytes([markers & 0xFF]) + struct.pack("<i", position_count) \
+        + struct.pack("<i", uncompressed)
+    crc = native.crc32(payload)
+    if crc is not None:
+        return native.crc32(tail, crc)
     # Java updateCrc: 4 low-order bytes, little-endian order
-    crc = zlib.crc32(struct.pack("<i", position_count), crc)
-    crc = zlib.crc32(struct.pack("<i", uncompressed), crc)
-    return crc
+    return zlib.crc32(tail, zlib.crc32(payload))
 
 
 def encode_serialized_page(blocks: List[WireBlock],

@@ -128,3 +128,30 @@ def test_engine_page_round_trip():
     page2 = wire_blocks_to_page(blocks2, [BIGINT, VARCHAR, DOUBLE,
                                           BOOLEAN, INTEGER], n)
     assert page2.to_pylist() == page.to_pylist()
+
+
+def test_native_codec_matches_numpy():
+    """The C++ marshalling path (presto_tpu/native) must be bit-identical
+    to the numpy fallback: null bitmaps, CRC, and full page frames."""
+    import zlib
+
+    import numpy as np
+
+    from presto_tpu import native
+
+    lib = native.load()
+    if lib is None:
+        import pytest
+        pytest.skip("no C++ toolchain available")
+
+    rng = np.random.RandomState(0)
+    for n in (1, 7, 8, 9, 1000):
+        nulls = rng.rand(n) < 0.3
+        packed = native.pack_nulls(nulls)
+        assert packed == np.packbits(nulls.astype(np.uint8)).tobytes()
+        back = native.unpack_nulls(packed, n)
+        assert (back == nulls).all()
+    data = rng.bytes(100000)
+    assert native.crc32(data) == zlib.crc32(data)
+    assert native.crc32(data, 12345) == zlib.crc32(data, 12345)
+    assert native.crc32(b"") == zlib.crc32(b"")

@@ -23,6 +23,18 @@ NDEV = 8
 def engine():
     return DistEngine(TpchConnector(SF), device_mesh(NDEV))
 
+@pytest.fixture(autouse=True)
+def _drop_compile_caches(engine):
+    """Each distributed query compiles several fragment programs; keeping
+    22 queries' worth of XLA CPU executables live in one process starves
+    the compiler (observed segfaults partway through the suite). Queries
+    don't re-execute each other's plans here, so drop everything."""
+    yield
+    import jax
+    engine.executor._compiled.clear()
+    engine.executor._learned.clear()
+    jax.clear_caches()
+
 
 @pytest.mark.parametrize("qnum", sorted(QUERIES))
 def test_tpch_distributed(qnum, engine, oracle):  # noqa: F811
