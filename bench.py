@@ -96,6 +96,8 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     qids = [int(q) for q in
             os.environ.get("BENCH_QUERIES", "1,6,3,18").split(",")]
+    if os.environ.get("BENCH_CHILD") != "1":
+        return _main_orchestrator(sf, qids)
 
     import jax
 
@@ -122,6 +124,49 @@ def main() -> None:
             print(f"# q{qid:02d}: FAILED {type(e).__name__}: {e}",
                   file=sys.stderr)
 
+    head_name = "q01" if "q01" in detail else next(iter(detail))
+    head = detail[head_name]
+    if "error" in head:
+        head = {"rows_per_sec": 0.0, "vs_baseline": 0.0}
+    print(json.dumps({
+        "metric": f"tpch_{head_name}_sf{sf:g}_rows_per_sec",
+        "value": head["rows_per_sec"],
+        "unit": "rows/s",
+        "vs_baseline": head["vs_baseline"],
+        "detail": detail,
+    }))
+
+
+def _main_orchestrator(sf, qids) -> None:
+    """Run each query in its own subprocess with a hard timeout: a wedged
+    accelerator tunnel or a compiler crash on one query must not take
+    down the whole benchmark report (the driver consumes the final JSON
+    line unconditionally)."""
+    import subprocess
+
+    timeout_s = float(os.environ.get("BENCH_QUERY_TIMEOUT", "900"))
+    detail = {}
+    for qid in qids:
+        env = dict(os.environ, BENCH_CHILD="1", BENCH_QUERIES=str(qid))
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=timeout_s)
+            sys.stderr.write(r.stderr.splitlines()[-1] + "\n"
+                             if r.stderr.splitlines() else "")
+            line = next((ln for ln in r.stdout.splitlines()
+                         if ln.startswith("{")), None)
+            if line is None:
+                detail[f"q{qid:02d}"] = {
+                    "error": f"no output (rc={r.returncode})"}
+            else:
+                detail.update(json.loads(line).get("detail", {}))
+        except subprocess.TimeoutExpired:
+            detail[f"q{qid:02d}"] = {
+                "error": f"timeout after {timeout_s:.0f}s "
+                         "(accelerator tunnel wedged?)"}
+            print(f"# q{qid:02d}: TIMEOUT after {timeout_s:.0f}s",
+                  file=sys.stderr)
     head_name = "q01" if "q01" in detail else next(iter(detail))
     head = detail[head_name]
     if "error" in head:
