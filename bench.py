@@ -124,10 +124,7 @@ def main() -> None:
             print(f"# q{qid:02d}: FAILED {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    head_name = "q01" if "q01" in detail else next(iter(detail))
-    head = detail[head_name]
-    if "error" in head:
-        head = {"rows_per_sec": 0.0, "vs_baseline": 0.0}
+    head_name, head = _headline(detail)
     print(json.dumps({
         "metric": f"tpch_{head_name}_sf{sf:g}_rows_per_sec",
         "value": head["rows_per_sec"],
@@ -135,6 +132,19 @@ def main() -> None:
         "vs_baseline": head["vs_baseline"],
         "detail": detail,
     }))
+
+
+def _headline(detail):
+    """Prefer q01; fall back to the first query that actually ran (a
+    timed-out compile must not zero out the whole report)."""
+    clean = {k: v for k, v in detail.items() if "error" not in v}
+    if "q01" in clean:
+        return "q01", clean["q01"]
+    if clean:
+        k = sorted(clean)[0]
+        return k, clean[k]
+    k = sorted(detail)[0]
+    return k, {"rows_per_sec": 0.0, "vs_baseline": 0.0}
 
 
 def _main_orchestrator(sf, qids) -> None:
@@ -167,10 +177,7 @@ def _main_orchestrator(sf, qids) -> None:
                          "(accelerator tunnel wedged?)"}
             print(f"# q{qid:02d}: TIMEOUT after {timeout_s:.0f}s",
                   file=sys.stderr)
-    head_name = "q01" if "q01" in detail else next(iter(detail))
-    head = detail[head_name]
-    if "error" in head:
-        head = {"rows_per_sec": 0.0, "vs_baseline": 0.0}
+    head_name, head = _headline(detail)
     print(json.dumps({
         "metric": f"tpch_{head_name}_sf{sf:g}_rows_per_sec",
         "value": head["rows_per_sec"],
