@@ -60,6 +60,20 @@ PROPERTIES = [
     Property("collect_stats",
              "Record per-node output row counts for EXPLAIN ANALYZE",
              _parse_bool, False),
+    Property("spill_enabled",
+             "Offload accumulated lifespan partials from device HBM to "
+             "host RAM (reference: spiller/ + revocable memory)",
+             _parse_bool, True),
+    Property("broadcast_join_threshold_rows",
+             "Estimated build-side rows under which a join replicates "
+             "its build instead of hash-exchanging both sides "
+             "(reference: join_distribution_type AUTOMATIC + "
+             "join_max_broadcast_table_size)", int, 50_000),
+    Property("dynamic_filtering_enabled",
+             "Prune driving-scan lifespans whose join-key range cannot "
+             "match the build side (reference: "
+             "enable_dynamic_filtering / DynamicFilterSourceOperator)",
+             _parse_bool, True),
 ]
 
 _BY_NAME = {p.name: p for p in PROPERTIES}

@@ -176,15 +176,24 @@ def _part_suppliers(partkey: np.ndarray, j: np.ndarray, num_supp: int
 class HostTable:
     """Host-side generated table: numeric numpy arrays (string columns
     stored as int32 codes) + shared StringDicts. `page()` uploads a
-    column-pruned, bucket-padded device Page."""
+    column-pruned, bucket-padded device Page. `nulls` is optional (the
+    TPC fixtures are null-free; written tables — connectors/memory.py —
+    carry real null masks)."""
     name: str
     num_rows: int
     arrays: Dict[str, np.ndarray]
     types: Dict[str, Type]
     dicts: Dict[str, StringDict]
+    nulls: Optional[Dict[str, np.ndarray]] = None
 
     def column_names(self) -> List[str]:
-        return [c for c, _ in TPCH_SCHEMA[self.name]]
+        return list(self.types)      # schema insertion order
+
+    def null_mask(self, c: str) -> Optional[np.ndarray]:
+        if self.nulls is None:
+            return None
+        m = self.nulls.get(c)
+        return m[:self.num_rows] if m is not None else None
 
     def page(self, columns: Optional[Sequence[str]] = None,
              capacity: Optional[int] = None) -> Page:
@@ -194,6 +203,7 @@ class HostTable:
         for c in cols:
             t = self.types[c]
             out.append(Column.from_numpy(self.arrays[c][:self.num_rows], t,
+                                         nulls=self.null_mask(c),
                                          dictionary=self.dicts.get(c),
                                          capacity=cap))
         return Page.from_columns(out, self.num_rows, cols)

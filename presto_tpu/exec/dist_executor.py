@@ -43,8 +43,8 @@ class DistExecutor(Executor):
     """Executes plans distributed over an N-device mesh (CPU mesh in
     tests, TPU ICI in production)."""
 
-    def __init__(self, connector, mesh):
-        super().__init__(connector)
+    def __init__(self, connector, mesh, session=None):
+        super().__init__(connector, session=session)
         self.mesh = mesh
         self.ndev = int(mesh.devices.size)
 
@@ -85,7 +85,8 @@ class DistExecutor(Executor):
 
     # ---- hook overrides -------------------------------------------------
     def _prepare(self, plan: PlanNode) -> PlanNode:
-        return add_exchanges(plan)
+        return add_exchanges(plan, self.connector, self.session,
+                             getattr(self, "history", None))
 
     def _wrap(self, fn: Callable) -> Callable:
         def wrapped(pages):
@@ -190,12 +191,12 @@ class DistEngine:
     DistributedQueryRunner (presto-tests/.../DistributedQueryRunner.java:114)
     — N workers in one process, real exchanges between them."""
 
-    def __init__(self, connector, mesh):
+    def __init__(self, connector, mesh, session=None):
         from presto_tpu.sql.analyzer import Planner
 
         self.connector = connector
         self.planner = Planner(connector)
-        self.executor = DistExecutor(connector, mesh)
+        self.executor = DistExecutor(connector, mesh, session=session)
         self._plans = {}
 
     def plan_sql(self, sql: str) -> PlanNode:

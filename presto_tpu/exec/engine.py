@@ -16,11 +16,14 @@ from presto_tpu.sql.parser import parse_sql
 
 
 class LocalEngine:
-    def __init__(self, connector, session=None):
+    def __init__(self, connector, session=None, history=None):
         self.connector = connector
         self.planner = Planner(connector)
         self.executor = Executor(connector, session=session)
         self._plans = {}
+        # HBO store (plan/stats.HistoryStore): observed node row counts
+        # recorded after execution, consulted by the next planning
+        self.history = history
 
     @property
     def session(self):
@@ -35,15 +38,113 @@ class LocalEngine:
         return explain(self.plan_sql(sql))
 
     def execute_sql(self, sql: str) -> List[tuple]:
+        head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
+        if head in ("create", "insert", "drop"):
+            return self._execute_statement(sql)
         n = self.session["lifespan_batches"]
         if n and n > 1:
             from presto_tpu.exec.lifespan import execute_batched
+            self.last_lifespan_stats = {}
             page = execute_batched(
                 self.connector, self.plan_sql(sql), n,
-                self.session["query_max_memory_per_node"])
+                self.session["query_max_memory_per_node"],
+                session=self.session, stats=self.last_lifespan_stats)
+            # batched runs use their own executors — no per-node counters
+            # here, and stale ones from an earlier direct execution must
+            # not be re-recorded against this query
+            self.executor.last_node_rows = {}
         else:
             page = self.executor.execute(self.plan_sql(sql))
+            self._record_history()
         return page.to_pylist()
+
+    def _record_history(self):
+        """Feed observed per-node output rows into the HBO store
+        (reference: HistoryBasedPlanStatisticsTracker.java:78 hooking
+        query completion). Requires collect_stats (the EXPLAIN ANALYZE
+        counters are the measurement source)."""
+        if self.history is None or not self.executor.last_node_rows:
+            return
+        from presto_tpu.plan.stats import canonical_key
+        for nid, rows in self.executor.last_node_rows.items():
+            entry = self.executor._node_map.get(nid)
+            if entry is not None:
+                self.history.record(canonical_key(entry[0]), rows)
+
+    # ------------------------------------------------------------ DDL/DML
+    def _execute_statement(self, sql: str) -> List[tuple]:
+        """CREATE TABLE [AS] / INSERT / DROP TABLE against a writable
+        connector (connectors/memory.py). Reference roles: the engine DDL
+        tasks (execution/CreateTableTask.java, coordinator-planned
+        TableWriterNode/TableFinishNode -> ConnectorPageSink); the write
+        itself is a host-side sink outside the jit fragment, fed by the
+        inner query's result page."""
+        from presto_tpu.expr.nodes import Literal
+        from presto_tpu.protocol.translate import parse_type
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.analyzer import AnalysisError
+        from presto_tpu.sql.parser import parse_statement
+
+        stmt = parse_statement(sql)
+        conn = self.connector
+        writable = hasattr(conn, "create")
+        if isinstance(stmt, A.DropTable):
+            if not writable:
+                raise AnalysisError("connector is not writable")
+            conn.drop(stmt.name, if_exists=stmt.if_exists)
+            return [(0,)]
+        if not writable:
+            raise AnalysisError("connector is not writable")
+
+        if isinstance(stmt, A.CreateTable):
+            if stmt.if_not_exists and conn.exists(stmt.name):
+                return [(0,)]
+            conn.create(stmt.name, [(c, parse_type(sig))
+                                    for c, sig in stmt.columns])
+            return [(0,)]
+
+        if isinstance(stmt, A.CreateTableAs):
+            if stmt.if_not_exists and conn.exists(stmt.name):
+                return [(0,)]
+            plan = self.planner.plan_query(stmt.query)
+            rows = self.executor._page_rows(self.executor.execute(plan))
+            conn.create(stmt.name, list(zip(plan.output_names,
+                                            plan.output_types)))
+            n = conn.append_rows(stmt.name, rows)
+            return [(n,)]
+
+        if isinstance(stmt, A.Insert):
+            schema = conn.schema(stmt.name)
+            names = [c for c, _t in schema]
+            if stmt.query is not None:
+                plan = self.planner.plan_query(stmt.query)
+                rows = self.executor._page_rows(
+                    self.executor.execute(plan))
+            else:
+                rows = []
+                for r in stmt.rows:
+                    vals = []
+                    for e in r:
+                        lit = self.planner.analyze(e, ())
+                        if not isinstance(lit, Literal):
+                            raise AnalysisError(
+                                "INSERT VALUES must be literals")
+                        v = lit.value
+                        if v is not None and lit.type.is_decimal:
+                            v = v / 10 ** lit.type.scale
+                        vals.append(v)
+                    rows.append(tuple(vals))
+            if stmt.columns:
+                pos = {c: i for i, c in enumerate(stmt.columns)}
+                rows = [tuple(r[pos[c]] if c in pos else None
+                              for c in names) for r in rows]
+            elif rows and len(rows[0]) != len(names):
+                raise AnalysisError(
+                    f"INSERT arity {len(rows[0])} != table {len(names)}")
+            n = conn.append_rows(stmt.name, rows)
+            return [(n,)]
+
+        raise AnalysisError(f"unsupported statement {type(stmt).__name__}")
 
     def explain_analyze_sql(self, sql: str) -> str:
         from presto_tpu.exec.stats import explain_analyze

@@ -33,9 +33,10 @@ from presto_tpu.ops.aggregate import grouped_aggregate
 from presto_tpu.ops.join import hash_join, merge_join
 from presto_tpu.ops.sort import limit_page, sort_page, top_n
 from presto_tpu.plan.nodes import (
-    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
-    JoinType, LimitNode, OutputNode, PlanNode, ProjectNode, SortNode,
-    TableScanNode, TopNNode, ValuesNode, WindowNode,
+    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
+    GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, PlanNode,
+    ProjectNode, RemoteSourceNode, SortNode, TableScanNode, TopNNode,
+    ValuesNode, WindowNode,
 )
 
 
@@ -171,6 +172,11 @@ class Executor:
             "cut exchange in a single-process plan (fragments are only "
             "executed separately by the distributed executor)")
 
+    def _remote_source(self, node, scans):
+        raise RuntimeError(
+            "RemoteSourceNode outside a protocol-driven task (the worker "
+            "TaskManager binds remote splits before execution)")
+
     def _lower_exchange(self, node, nid, src, cap, caps, watch, _needed):
         """Single-process executor: an exchange is a no-op relabel (all
         rows already live in one page). The distributed executor overrides
@@ -293,6 +299,8 @@ class Executor:
                 idx = len(scans)
                 scans.append(ScanSpec(node.table, node.columns, cap))
                 return lambda pages: pages[idx], cap
+            if isinstance(node, RemoteSourceNode):
+                return self._remote_source(node, scans)
             if isinstance(node, ValuesNode):
                 def values_fn(pages, node=node):
                     n = len(node.rows)
@@ -497,6 +505,49 @@ class Executor:
                                       ~c.nulls & c.values.astype(bool))
                     return out
                 return join_fn, out_cap
+            if isinstance(node, GroupIdNode):
+                src, cap = build(node.source)
+                nsets = len(node.grouping_sets)
+                out_cap = nsets * cap
+                # membership[s, c]: does column c survive in set s?
+                # (non-key columns always do)
+                member_np = __import__("numpy").ones(
+                    (nsets, node.arity - 1), dtype=bool)
+                for s, keep in enumerate(node.grouping_sets):
+                    for c in node.key_fields:
+                        member_np[s, c] = c in keep
+
+                def gid_fn(pages, node=node, nsets=nsets,
+                           member_np=member_np):
+                    p = src(pages)
+                    n = p.num_rows
+                    ocap = nsets * p.capacity
+                    r = jnp.arange(ocap, dtype=jnp.int32)
+                    n1 = jnp.maximum(n, 1)
+                    set_id = jnp.clip(r // n1, 0, nsets - 1)
+                    srci = r - set_id * n1
+                    valid = r < nsets * n
+                    member = jnp.asarray(member_np)
+                    cols = []
+                    for ci, c in enumerate(p.columns):
+                        keep = member[:, ci][set_id] & valid
+                        vals = jnp.take(c.values, srci, mode="clip")
+                        nulls = jnp.take(c.nulls, srci, mode="clip")
+                        sent = jnp.asarray(c.type.null_sentinel(),
+                                           dtype=vals.dtype)
+                        cols.append(Column(
+                            jnp.where(keep, vals, sent),
+                            jnp.where(keep, nulls, True),
+                            c.type, c.dictionary))
+                    gsent = jnp.asarray(
+                        node.output_types[-1].null_sentinel(), jnp.int64)
+                    gid = Column(
+                        jnp.where(valid, set_id.astype(jnp.int64), gsent),
+                        ~valid, node.output_types[-1], None)
+                    return Page(tuple(cols) + (gid,),
+                                (nsets * n).astype(jnp.int32),
+                                node.output_names)
+                return gid_fn, out_cap
             if isinstance(node, AssignUniqueIdNode):
                 src, cap = build(node.source)
 

@@ -100,30 +100,128 @@ def _streamable(below_agg: PlanNode, driving: str) -> bool:
     return ok(below_agg)
 
 
-def _concat_pages(pages: List[Page]) -> Page:
-    """Host-side concatenation of the valid rows of several pages with
-    identical schemas (partial-state pages are small)."""
+def _dynamic_filter(connector, ex: SplitExecutor, agg_source: PlanNode,
+                    driving: str):
+    """Build-side dynamic filter (reference: DynamicFilterSourceOperator +
+    LocalDynamicFilter feeding probe-side scans). TPU-shaped realization:
+    the compiled fragment's shapes are static, so the win is HOST-side —
+    execute the topmost non-driving build subtree once, take its join-key
+    [min, max], and skip whole lifespans whose driving-scan key slice
+    cannot intersect. Returns (scan column name, lo, hi, build_empty) or
+    None when no eligible join exists."""
+    from presto_tpu.expr.nodes import InputRef
+    from presto_tpu.plan.nodes import JoinNode, JoinType
+
+    def scans_driving(n) -> bool:
+        if isinstance(n, TableScanNode):
+            return n.table == driving
+        return any(c is not None and scans_driving(c)
+                   for c in n.children())
+
+    def scan_column(n, channel: int):
+        """Resolve `channel` of n's output to a raw driving-scan column
+        name through Filter/Project/probe-side-join chains."""
+        if isinstance(n, TableScanNode):
+            return n.columns[channel] if n.table == driving else None
+        if isinstance(n, FilterNode):
+            return scan_column(n.source, channel)
+        if isinstance(n, ProjectNode):
+            e = n.expressions[channel]
+            if isinstance(e, InputRef):
+                return scan_column(n.source, e.field)
+            return None
+        if isinstance(n, JoinNode):
+            if channel < len(n.probe.output_types):
+                return scan_column(n.probe, channel)
+            return None
+        return None
+
+    def find(n):
+        if isinstance(n, JoinNode) \
+                and n.join_type in (JoinType.INNER, JoinType.SEMI) \
+                and len(n.probe_keys) >= 1 \
+                and not scans_driving(n.build):
+            col = scan_column(n.probe, n.probe_keys[0])
+            if col is not None:
+                return n, col
+        for c in n.children():
+            if c is not None and scans_driving(c):
+                r = find(c)
+                if r is not None:
+                    return r
+        return None
+
+    hit = find(agg_source)
+    if hit is None:
+        return None
+    join, col = hit
+    # string keys: dictionary codes are only comparable for aligned
+    # dictionaries; restrict the filter to numeric/date keys
+    if join.build.output_types[join.build_keys[0]].is_string:
+        return None
+    build_page = ex.execute(join.build)
+    key = build_page.columns[join.build_keys[0]]
+    n = int(build_page.num_rows)
+    if n == 0:
+        return (col, 0, -1, True)
+    vals, nulls = key.values, key.nulls
+    v = np.asarray(vals)[:n][~np.asarray(nulls)[:n]]
+    if len(v) == 0:
+        return (col, 0, -1, True)
+    return (col, v.min(), v.max(), False)
+
+
+@dataclasses.dataclass
+class _HostPartial:
+    """A spilled partial: plain numpy, no device residency. The TPU spill
+    analog (reference: spiller/FileSingleStreamSpiller +
+    MemoryRevokingScheduler): HBM holds only the in-flight lifespan;
+    accumulated partials live in host RAM until the final merge."""
+    columns: List[tuple]       # (values np, nulls np, Type, StringDict)
+    num_rows: int
+    names: tuple
+
+
+def _spill_to_host(p: Page) -> _HostPartial:
+    n = int(p.num_rows)
+    cols = []
+    for c in p.columns:
+        v, nl = c.to_numpy(n)
+        cols.append((np.array(v), np.array(nl), c.type, c.dictionary))
+    return _HostPartial(cols, n, p.names)
+
+
+def _part_cols(p):
+    if isinstance(p, _HostPartial):
+        return p.columns
+    n = int(p.num_rows)
+    return [(np.asarray(c.values)[:n], np.asarray(c.nulls)[:n], c.type,
+             c.dictionary) for c in p.columns]
+
+
+def _concat_pages(pages: List) -> Page:
+    """Host-side concatenation of the valid rows of several partials
+    (device Pages or spilled _HostPartials) with identical schemas."""
+    parts = [_part_cols(p) for p in pages]
     total = sum(int(p.num_rows) for p in pages)
     cap = bucket_capacity(max(total, 1))
     cols = []
-    for i, c0 in enumerate(pages[0].columns):
-        vals = np.concatenate([
-            np.asarray(p.columns[i].values)[:int(p.num_rows)]
-            for p in pages])
-        nulls = np.concatenate([
-            np.asarray(p.columns[i].nulls)[:int(p.num_rows)]
-            for p in pages])
-        cols.append(Column.from_numpy(vals, c0.type, nulls=nulls,
-                                      dictionary=c0.dictionary,
-                                      capacity=cap))
+    for i, (_v0, _n0, t0, d0) in enumerate(parts[0]):
+        vals = np.concatenate([pc[i][0] for pc in parts])
+        nulls = np.concatenate([pc[i][1] for pc in parts])
+        cols.append(Column.from_numpy(vals, t0, nulls=nulls,
+                                      dictionary=d0, capacity=cap))
     return Page.from_columns(cols, total, pages[0].names)
 
 
 def execute_batched(connector, plan: PlanNode, num_batches: int,
-                    memory_limit_bytes: Optional[int] = None) -> Page:
+                    memory_limit_bytes: Optional[int] = None,
+                    session=None,
+                    stats: Optional[dict] = None) -> Page:
     """Execute `plan` streaming the driving scan in `num_batches`
     lifespans. Falls back to single-shot execution when the plan shape
-    does not support batching (no root aggregation)."""
+    does not support batching (no root aggregation). `stats` (if given)
+    records {"batches", "skipped"} — dynamic-filter effectiveness."""
     from presto_tpu.plan.fragment import _partial_agg_layout
 
     # Resolve scalar subqueries ONCE over the full tables (a per-batch
@@ -131,11 +229,16 @@ def execute_batched(connector, plan: PlanNode, num_batches: int,
     resolver = SplitExecutor(connector)
     plan = resolver._resolve_subqueries(plan)
 
+    from presto_tpu.plan.fragment import _UNSPLITTABLE
+
     chain = _root_chain(plan)
     driving = _driving_scan(connector, plan)
     if (chain is None or driving is None or num_batches <= 1
-            or not _streamable(chain[1].source, driving)):
-        ex = SplitExecutor(connector)
+            or not _streamable(chain[1].source, driving)
+            # sketch aggregates have no column-shaped partial state —
+            # same rule as the fragmenter's reshard-instead-of-split
+            or any(a.kind in _UNSPLITTABLE for a in chain[1].aggs)):
+        ex = SplitExecutor(connector, session=session)
         ex.memory_limit_bytes = memory_limit_bytes
         return ex.execute(plan)
 
@@ -146,11 +249,35 @@ def execute_batched(connector, plan: PlanNode, num_batches: int,
         group_fields=agg.group_fields, aggs=tuple(partial_specs),
         step=Step.PARTIAL, group_count_hint=agg.group_count_hint)
 
-    ex = SplitExecutor(connector)
+    ex = SplitExecutor(connector, session=session)
     ex.memory_limit_bytes = memory_limit_bytes
+    dyn = None
+    if ex.session["dynamic_filtering_enabled"]:
+        dyn = _dynamic_filter(connector, ex, agg.source, driving)
+    spill = bool(ex.session["spill_enabled"])
+    skipped = 0
     partials: List[Page] = []
     for b in range(num_batches):
+        if dyn is not None:
+            col, lo, hi, empty = dyn
+            t = connector.table(driving, part=b, num_parts=num_batches)
+            if t.num_rows:
+                sv = t.arrays[col][:t.num_rows]
+                if empty or sv.min() > hi or sv.max() < lo:
+                    skipped += 1
+                    continue
         ex.set_splits({driving: [(b, num_batches)]})
+        p = ex.execute(partial_plan)
+        if spill:
+            p = _spill_to_host(p)
+        partials.append(p)
+    if stats is not None:
+        stats.update(batches=num_batches, skipped=skipped)
+    if not partials:
+        # every lifespan pruned: run one anyway — pruned means its join
+        # cannot match, so it yields the correct zero-state partial
+        # (global aggregates still emit their count=0 row)
+        ex.set_splits({driving: [(0, num_batches)]})
         partials.append(ex.execute(partial_plan))
 
     merged = _concat_pages(partials)

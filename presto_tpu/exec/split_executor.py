@@ -5,6 +5,7 @@ the building block of lifespan-batched execution (exec/lifespan.py)."""
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -13,13 +14,36 @@ from presto_tpu.data.column import Column, Page
 from presto_tpu.exec.executor import Executor, ScanSpec
 
 
+@dataclasses.dataclass
+class RemotePageSpec:
+    """Scan-slot placeholder for an input pulled from upstream tasks
+    (bound by node id; reference: RemoteSourceNode -> ExchangeOperator)."""
+    node_id: str
+    capacity: int
+
+
 class SplitExecutor(Executor):
     def __init__(self, connector, session=None):
         super().__init__(connector, session=session)
         self.splits: Dict[str, List[Tuple[int, int]]] = {}
+        # node_id -> concatenated engine Page pulled over the HTTP
+        # exchange before execution (data/column.concat_pages_host).
+        self.remote_pages: Dict[str, "Page"] = {}
 
     def set_splits(self, by_table: Dict[str, List[Tuple[int, int]]]):
         self.splits = by_table
+
+    def set_remote_pages(self, by_node: Dict[str, Page]):
+        self.remote_pages = by_node
+
+    def _remote_source(self, node, scans):
+        page = self.remote_pages.get(node.node_id)
+        if page is None:
+            raise RuntimeError(
+                f"no remote pages bound for plan node {node.node_id!r}")
+        idx = len(scans)
+        scans.append(RemotePageSpec(node.node_id, page.capacity))
+        return (lambda pages: pages[idx]), page.capacity
 
     def _scan_rows(self, node) -> int:
         parts = self.splits.get(node.table)
@@ -29,7 +53,9 @@ class SplitExecutor(Executor):
             self.connector.table(node.table, part=p, num_parts=n).num_rows
             for p, n in parts))
 
-    def _fetch(self, s: ScanSpec) -> Page:
+    def _fetch(self, s) -> Page:
+        if isinstance(s, RemotePageSpec):
+            return self.remote_pages[s.node_id]
         parts = self.splits.get(s.table)
         if parts is None:
             return super()._fetch(s)
@@ -40,7 +66,12 @@ class SplitExecutor(Executor):
         for c in s.columns:
             t0 = tables[0]
             arr = np.concatenate([t.arrays[c][:t.num_rows] for t in tables])
+            masks = [t.null_mask(c) for t in tables]
+            nulls = (np.concatenate(
+                [m if m is not None else np.zeros(t.num_rows, bool)
+                 for m, t in zip(masks, tables)])
+                if any(m is not None for m in masks) else None)
             cols.append(Column.from_numpy(
-                arr, t0.types[c], dictionary=t0.dicts.get(c),
+                arr, t0.types[c], nulls=nulls, dictionary=t0.dicts.get(c),
                 capacity=s.capacity))
         return Page.from_columns(cols, n_rows, s.columns)

@@ -451,7 +451,29 @@ def _call(e: Call, page: Page, ev) -> Column:
             return _dict_transform(a, lambda w: w + e.args[1].value)
         if isinstance(e.args[0], Literal):
             return _dict_transform(b, lambda w: e.args[0].value + w)
-        raise NotImplementedError("concat of two non-literal strings")
+        # General column || column: the result dictionary is the sorted
+        # cross product of both dictionaries (|A| x |B| words — bounded;
+        # code-like columns keep this tiny) with a host-built (ca, cb) ->
+        # combined-code LUT; the per-row work is one gather. Concatenated
+        # strings do NOT sort in (a, b)-code order, hence the re-sort.
+        aw = a.dictionary.words if a.dictionary else ("",)
+        bw = b.dictionary.words if b.dictionary else ("",)
+        if len(aw) * len(bw) > 1_000_000:
+            raise NotImplementedError(
+                f"concat dictionary product too large "
+                f"({len(aw)}x{len(bw)})")
+        from presto_tpu.data.column import StringDict
+        pairs = [x + y for x in aw for y in bw]
+        union = sorted(set(pairs))
+        uarr = np.asarray(union, dtype=object).astype(str)
+        lut = np.searchsorted(
+            uarr, np.asarray(pairs, dtype=object).astype(str)
+        ).astype(np.int32)
+        d = StringDict(union)
+        ca = jnp.clip(a.values, 0, len(aw) - 1).astype(jnp.int32)
+        cb = jnp.clip(b.values, 0, len(bw) - 1).astype(jnp.int32)
+        v = jnp.take(jnp.asarray(lut), ca * len(bw) + cb, mode="clip")
+        return Column(v, a.nulls | b.nulls, VARCHAR, d)
     if name in ("sqrt", "ln", "log10", "exp", "floor", "ceil", "round"):
         c = ev(e.args[0], page)
         if name == "round" and len(e.args) > 1:

@@ -49,6 +49,60 @@ class AggSpec:
     output_type: Type
     field2: Optional[int] = None  # second state input (avg_final: count)
     mask_field: Optional[int] = None  # FILTER / mask channel (bool column)
+    param: Optional[float] = None  # extra literal (approx_percentile p)
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog pieces (approx_distinct)
+#
+# Reference: operator/aggregation/ApproximateCountDistinctAggregation +
+# airlift HLL (dense). TPU-first shape: never a per-row register-table
+# scatter — registers materialize as (register, rank) pairs carried through
+# the SAME multi-operand sorts the rest of the aggregation uses; the max
+# rank per register is whoever sorts first in its (group, register) run.
+# Default precision matches Presto's 2.3% standard error tier.
+# ---------------------------------------------------------------------------
+
+_HLL_P = 11
+_HLL_M = 1 << _HLL_P
+_HLL_ALPHA = 0.7213 / (1.0 + 1.079 / _HLL_M)
+
+
+def _hll_reg_rank(vals: jnp.ndarray):
+    """Per-row (register id int32, rank int32). rank = leading-zero count
+    of the hash's top 64-p bits, + 1."""
+    import jax
+
+    from presto_tpu.ops.keys import _GOLDEN, _mix64
+
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        # bitcast, not a numeric cast — distinct floats must hash apart
+        # (i32-pair form: direct 64-bit bitcasts don't lower on TPU)
+        from presto_tpu.ops.keys import jax_bitcast_f64_i64
+        bits = jax_bitcast_f64_i64(
+            vals.astype(jnp.float64)).astype(jnp.uint64)
+    else:
+        bits = vals.astype(jnp.uint64)
+    h = _mix64(bits + _GOLDEN)
+    reg = (h & jnp.uint64(_HLL_M - 1)).astype(jnp.int32)
+    w = h >> jnp.uint64(_HLL_P)
+    # floor(log2(w)) via frexp (exact: w < 2**53)
+    _mant, exp = jnp.frexp(w.astype(jnp.float64))
+    rank = jnp.where(w == 0, 64 - _HLL_P + 1,
+                     (64 - _HLL_P) - (exp - 1)).astype(jnp.int32)
+    return reg, rank
+
+
+def _hll_estimate(present_sum: jnp.ndarray, zeros: jnp.ndarray):
+    """Registers -> cardinality: raw harmonic-mean estimate with the
+    standard linear-counting small-range correction."""
+    m = float(_HLL_M)
+    zeros_f = zeros.astype(jnp.float64)
+    raw = _HLL_ALPHA * m * m / jnp.maximum(
+        present_sum + zeros_f, 1e-12)
+    small = m * jnp.log(m / jnp.maximum(zeros_f, 1.0))
+    use_small = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_small, small, raw)
 
 
 # Direct (sort-free, scatter-free) grouping.
@@ -188,6 +242,56 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
                 r = jnp.stack([jnp.all(~lv | vals.astype(bool))
                                for lv in live])
             cols.append(widen(r, t, (n_per == 0)[take]))
+        elif kind == "approx_distinct":
+            import jax
+
+            live_all = valid & ~nulls
+            reg, rank = _hll_reg_rank(vals)
+            # sort (bin, register, rank desc): the first row of each
+            # (bin, register) run holds that register's max rank
+            code_s = jnp.where(live_all, code, prod)
+            s_ops = jax.lax.sort((code_s, reg, -rank, rank),
+                                 num_keys=3, is_stable=False)
+            sc, sreg, _nr, srank = s_ops
+            first = (jnp.roll(sc, 1) != sc) | (jnp.roll(sreg, 1) != sreg)
+            first = first.at[0].set(True)
+            first = first & (sc < prod)
+            contrib = jnp.where(first,
+                                jnp.exp2(-srank.astype(jnp.float64)), 0.0)
+            present = jnp.stack([
+                jnp.sum(jnp.where(first & (sc == b), contrib, 0.0))
+                for b in range(prod)])
+            dregs = jnp.stack([jnp.sum(first & (sc == b))
+                               for b in range(prod)])
+            est = _hll_estimate(present, _HLL_M - dregs)
+            est = jnp.where(n_per == 0, 0, jnp.round(est))
+            cols.append(widen(est.astype(jnp.int64), t, false_w))
+        elif kind == "approx_percentile":
+            import jax
+
+            from presto_tpu.ops.keys import _orderable_values
+
+            frac = float(a.param if a.param is not None else 0.5)
+            src_t = (page.columns[a.field].type
+                     if a.field is not None else t)
+            live_all = valid & ~nulls
+            ov = _orderable_values(Column(vals, nulls, src_t, dictionary))
+            if ov.dtype == jnp.bool_:
+                ov = ov.astype(jnp.int32)
+            code_s = jnp.where(live_all, code, prod)
+            s_ops = jax.lax.sort((code_s, ov, vals), num_keys=2,
+                                 is_stable=False)
+            svals = s_ops[2]
+            live_counts = jnp.stack([jnp.sum(live_all & (code == b))
+                                     for b in range(prod)])
+            bin_starts = jnp.cumsum(live_counts) - live_counts
+            idx = bin_starts + jnp.floor(
+                frac * jnp.maximum(live_counts - 1, 0)
+                .astype(jnp.float64)).astype(live_counts.dtype)
+            picked = jnp.take(svals, jnp.clip(idx, 0, cap - 1),
+                              mode="clip")
+            cols.append(widen(picked, t, (live_counts == 0)[take],
+                              dictionary))
         else:
             raise NotImplementedError(f"aggregate {kind}")
 
@@ -398,4 +502,53 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
         n = seg_count(~nulls)
         r = (trues > 0) if kind == "bool_or" else (trues == n)
         return [out(r, n == 0)]
+    if kind == "approx_distinct":
+        import jax
+
+        live = ~nulls
+        reg, rank = _hll_reg_rank(vals)
+        # rows re-sorted by (gid, register, rank desc); group runs stay
+        # contiguous (gid is the primary key), so the original
+        # starts/ends still delimit them. Dead rows sort to register M.
+        reg_s = jnp.where(live, reg, _HLL_M)
+        s_ops = jax.lax.sort((gid, reg_s, -rank, rank, live),
+                             num_keys=3, is_stable=False)
+        sgid, sreg, _nr, srank, slive = s_ops
+        first = jnp.roll(sgid, 1) != sgid
+        first = first | (jnp.roll(sreg, 1) != sreg)
+        first = first.at[0].set(True)
+        first = first & slive
+        contrib = jnp.where(first, jnp.exp2(-srank.astype(jnp.float64)),
+                            0.0)
+        present = pscan.segment_sums(contrib, starts, ends)
+        distinct_regs = pscan.segment_sums(first.astype(jnp.int32),
+                                           starts, ends)
+        est = _hll_estimate(present, _HLL_M - distinct_regs)
+        n = seg_count(live)
+        # empty group => 0 (Presto approx_distinct over no rows)
+        return [out(jnp.where(n == 0, 0,
+                              jnp.round(est)).astype(jnp.int64),
+                    jnp.zeros_like(out_valid))]
+    if kind == "approx_percentile":
+        import jax
+
+        from presto_tpu.ops.keys import _orderable_values
+
+        frac = float(a.param if a.param is not None else 0.5)
+        v = _orderable_values(Column(vals, nulls, sp.columns[a.field].type,
+                                     dictionary))
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        s_ops = jax.lax.sort((gid, nulls.astype(jnp.int8), v, vals),
+                             num_keys=3, is_stable=False)
+        svals = s_ops[3]
+        n = seg_count(~nulls)
+        # lower nearest-rank: the element at floor(p * (n-1)) of the
+        # group's sorted non-null run (approx contract; exact quantile)
+        idx = starts + jnp.floor(
+            frac * jnp.maximum(n - 1, 0).astype(jnp.float64)
+        ).astype(jnp.int32)
+        picked = jnp.take(svals, jnp.clip(idx, 0, sp.capacity - 1),
+                          mode="clip")
+        return [out(picked, n == 0)]
     raise NotImplementedError(f"aggregate {kind}")

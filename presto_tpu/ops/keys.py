@@ -58,8 +58,17 @@ def group_values(col: Column) -> jnp.ndarray:
 
 
 def jax_bitcast_f64_i64(x: jnp.ndarray) -> jnp.ndarray:
+    """Bit-exact f64 -> i64 via an i32-pair bitcast. A direct s64
+    bitcast-convert is unimplemented in the TPU backend's X64-rewriting
+    pass ("While rewriting computation to not contain X64 element
+    types..."); bitcasting to the next-smaller type adds a minor [2]
+    dimension of i32 lanes, which rewrites fine, and the i64 recombine is
+    ordinary (emulated) arithmetic."""
     import jax
-    return jax.lax.bitcast_convert_type(x, jnp.int64)
+    pair = jax.lax.bitcast_convert_type(x, jnp.int32)   # [..., 2]
+    lo = pair[..., 0].astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
+    hi = pair[..., 1].astype(jnp.int64)
+    return (hi << jnp.int64(32)) | lo
 
 
 def sort_perm(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:

@@ -25,11 +25,17 @@ import dataclasses
 from typing import Dict, List, Tuple
 
 from presto_tpu.plan.nodes import (
-    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode, JoinNode,
-    JoinType, LimitNode, OutputNode, Partitioning, PlanNode, ProjectNode,
-    SortNode, Step, TableScanNode, TopNNode, ValuesNode, WindowNode,
+    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
+    GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, Partitioning,
+    PlanNode, ProjectNode, SortNode, Step, TableScanNode, TopNNode,
+    ValuesNode, WindowNode,
 )
 from presto_tpu.types import BIGINT, DOUBLE
+
+
+# Aggregates whose state has no fixed-width column form (sketches/runs):
+# distributed by resharding rows, not by splitting into partial+final.
+_UNSPLITTABLE = {"approx_distinct", "approx_percentile"}
 
 
 def _partial_agg_layout(node: AggregationNode):
@@ -54,7 +60,8 @@ def _partial_agg_layout(node: AggregationNode):
     return partial, final, tuple(names), tuple(types)
 
 
-def add_exchanges(plan: PlanNode) -> PlanNode:
+def add_exchanges(plan: PlanNode, connector=None, session=None,
+                  history=None) -> PlanNode:
     """Insert ExchangeNodes so every operator sees the distribution it
     needs. Tracks each subtree's partitioning PROPERTY — (kind, hash key
     positions) — exactly like the reference pass, so data already
@@ -62,7 +69,22 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
     join output hash-partitioned on the needed keys flows straight into
     the next join/aggregation). Shared subtrees (mark joins) are rewritten
     once (id-memoized) so execution-time memoization still evaluates them
-    once."""
+    once.
+
+    With a `connector`, the broadcast-vs-repartition choice is COST-BASED
+    (reference: AddExchanges consulting the CBO, join_distribution_type
+    AUTOMATIC): a build side estimated under the broadcast threshold is
+    replicated instead of hash-exchanged; HBO history sharpens the
+    estimate after the first execution."""
+    est = None
+    if connector is not None:
+        from presto_tpu.plan.stats import estimate_rows
+        est = lambda n: estimate_rows(n, connector, history)  # noqa: E731
+    if session is not None:
+        threshold = session["broadcast_join_threshold_rows"]
+    else:
+        from presto_tpu.config import _BY_NAME
+        threshold = _BY_NAME["broadcast_join_threshold_rows"].default
     # property: (Partitioning, keys) — keys are positions in the node's
     # output, meaningful for HASH only.
     Prop = Tuple[PlanNode, Tuple[Partitioning, Tuple[int, ...]]]
@@ -106,6 +128,13 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
             src, prop = visit(node.source)
             return dataclasses.replace(node, source=src), prop
 
+        if isinstance(node, GroupIdNode):
+            # Key columns are selectively nulled per set — any existing
+            # hash property on them no longer routes rows correctly.
+            src, _prop = visit(node.source)
+            return (dataclasses.replace(node, source=src),
+                    (Partitioning.SOURCE, ()))
+
         if isinstance(node, ProjectNode):
             src, prop = visit(node.source)
             out = dataclasses.replace(node, source=src)
@@ -136,6 +165,22 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
                 remap = {f: i for i, f in enumerate(node.group_fields)}
                 return single_node, (Partitioning.HASH,
                                      tuple(remap[f] for f in keys))
+            if any(a.kind in _UNSPLITTABLE for a in node.aggs):
+                # Sketch-state aggregates (HLL registers, percentile runs)
+                # have no column-shaped partial: reshard rows so every
+                # group is whole on one device, then aggregate SINGLE-step
+                # (reference: these ship binary intermediates; SURVEY.md
+                # §7.3 hard part #7 keeps states engine-homogeneous).
+                if k:
+                    exch = exchange(src, Partitioning.HASH,
+                                    tuple(node.group_fields))
+                    out_prop = (Partitioning.HASH,
+                                tuple(range(k)))
+                else:
+                    exch = exchange(src, Partitioning.SINGLE)
+                    out_prop = (Partitioning.SINGLE, ())
+                return (dataclasses.replace(node, source=exch),
+                        out_prop)
             partial, final, pnames, ptypes = _partial_agg_layout(node)
             part_node = AggregationNode(
                 pnames, ptypes, source=src,
@@ -174,6 +219,14 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
                         (Partitioning.SINGLE, ()))
             broadcast = (not node.probe_keys or string_keys
                          or node.join_type == JoinType.ANTI)
+            if (not broadcast and est is not None
+                    and node.join_type in (JoinType.INNER, JoinType.LEFT,
+                                           JoinType.SEMI,
+                                           JoinType.ANTI_EXISTS)
+                    and est(node.build) <= threshold):
+                # cost-based replicated build: skips both hash exchanges
+                # when the build side is small
+                broadcast = True
             if broadcast:
                 # Replicated build: correct for every join type incl. the
                 # NOT IN null-globalization (whole build side visible).
@@ -244,11 +297,14 @@ def add_exchanges(plan: PlanNode) -> PlanNode:
 @dataclasses.dataclass(frozen=True)
 class PlanFragment:
     """One fragment of the distributed plan (reference: PlanFragment.java:52
-    — root node, partitioning handle, remote source fragment ids)."""
+    — root node, partitioning handle, remote source fragment ids).
+    `partition_keys` are the cut exchange's hash key channels into this
+    fragment's root output (the producer-side PartitioningScheme)."""
     fragment_id: int
     root: PlanNode
     partitioning: Partitioning
     remote_sources: Tuple[int, ...]
+    partition_keys: Tuple[int, ...] = ()
 
 
 def create_fragments(plan: PlanNode) -> List[PlanFragment]:
@@ -273,7 +329,7 @@ def create_fragments(plan: PlanNode) -> List[PlanFragment]:
                 shared[key] = fid
                 fragments.append(PlanFragment(
                     fid, child_root, node.partitioning,
-                    tuple(child_sources)))
+                    tuple(child_sources), tuple(node.keys)))
             sources.append(fid)
             return dataclasses.replace(node, source=None,
                                        remote_fragment=fid)

@@ -131,6 +131,23 @@ class JoinNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class GroupIdNode(PlanNode):
+    """GROUPING SETS expansion (reference: spi/plan/GroupIdNode ->
+    operator/GroupIdOperator.java): replicates the source once per
+    grouping set, nulling the group-key columns absent from each set, and
+    appends a BIGINT `_gid` column (the set ordinal). Output = source
+    columns ++ _gid; |out| = |sets| * |src|."""
+    source: PlanNode = None
+    # each set: positions (into source output) of the keys it keeps;
+    # key_fields = union of all sets (columns subject to nulling)
+    grouping_sets: Tuple[Tuple[int, ...], ...] = ()
+    key_fields: Tuple[int, ...] = ()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class AssignUniqueIdNode(PlanNode):
     """Appends a BIGINT row-id column unique within the task (reference:
     spi/plan/AssignUniqueIdNode). Used by the mark-join decorrelation of
@@ -201,6 +218,16 @@ class ExchangeNode(PlanNode):
 
     def children(self):
         return (self.source,) if self.source is not None else ()
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoteSourceNode(PlanNode):
+    """Worker-side input pulled from upstream tasks over the HTTP exchange
+    (reference: sql/planner/plan/RemoteSourceNode -> ExchangeOperator.java:36).
+    `node_id` binds the remote splits (task locations) the coordinator sends
+    in TaskUpdateRequest.sources; `source_fragment_ids` is provenance."""
+    node_id: str = ""
+    source_fragment_ids: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

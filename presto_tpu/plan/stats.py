@@ -1,0 +1,193 @@
+"""Plan statistics: rule-based cardinality estimation + a history store.
+
+Reference roles:
+  - cost/FilterStatsCalculator.java + cost/CostCalculatorUsingExchanges:
+    per-node output-row estimation driving physical decisions (here: the
+    broadcast-vs-repartition choice in plan/fragment.add_exchanges and
+    aggregation capacity hints).
+  - cost/HistoryBasedPlanStatisticsCalculator.java:58 / ...Tracker.java:78
+    (HBO): actual row counts observed at execution (the executor's
+    EXPLAIN ANALYZE counters) are recorded per canonical plan node and
+    override the rule-based guess on the next planning of an equivalent
+    node.
+
+Estimates are deliberately coarse — the capacity-bucket/overflow-retry
+execution model only needs order-of-magnitude guidance, and HBO replaces
+guesses with measurements after the first run."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+from presto_tpu.expr.nodes import (
+    Call, InputRef, Literal, RowExpression, SpecialForm, Form,
+)
+from presto_tpu.plan.nodes import (
+    AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
+    GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, PlanNode,
+    ProjectNode, RemoteSourceNode, SortNode, TableScanNode, TopNNode,
+    ValuesNode, WindowNode,
+)
+
+
+# --------------------------------------------------------------- canonical
+
+def canonical_key(node: PlanNode) -> str:
+    """Stable structural digest of a plan subtree — the HBO lookup key
+    (reference: sql/planner/CanonicalPlanGenerator.java). Captures shape,
+    tables, predicates and keys; excludes capacities/hints so re-planned
+    equivalents match."""
+    h = hashlib.sha256()
+
+    def feed(n: PlanNode):
+        h.update(type(n).__name__.encode())
+        if isinstance(n, TableScanNode):
+            h.update(n.table.encode())
+            h.update(",".join(n.columns).encode())
+        elif isinstance(n, FilterNode):
+            h.update(str(n.predicate).encode())
+        elif isinstance(n, ProjectNode):
+            h.update(";".join(str(e) for e in n.expressions).encode())
+        elif isinstance(n, AggregationNode):
+            h.update(str(n.group_fields).encode())
+            h.update(",".join(a.kind for a in n.aggs).encode())
+            h.update(n.step.value.encode())
+        elif isinstance(n, JoinNode):
+            h.update(n.join_type.value.encode())
+            h.update(str((n.probe_keys, n.build_keys)).encode())
+            h.update(str(n.filter).encode())
+        elif isinstance(n, (TopNNode, LimitNode)):
+            h.update(str(n.count).encode())
+        elif isinstance(n, ExchangeNode):
+            h.update(n.partitioning.value.encode())
+        for c in n.children():
+            if c is not None:
+                feed(c)
+    feed(node)
+    return h.hexdigest()[:24]
+
+
+class HistoryStore:
+    """Observed output row counts per canonical plan key (HBO). Optional
+    JSON persistence (reference: redis-hbo-provider's role)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.rows: Dict[str, int] = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.rows = {k: int(v)
+                                 for k, v in json.load(f).items()}
+            except Exception:     # noqa: BLE001 — corrupt history: start over
+                self.rows = {}
+
+    def record(self, key: str, rows: int):
+        self.rows[key] = int(rows)
+
+    def get(self, key: str) -> Optional[int]:
+        return self.rows.get(key)
+
+    def save(self):
+        if self.path:
+            with open(self.path, "w") as f:
+                json.dump(self.rows, f)
+
+
+# -------------------------------------------------------------- estimation
+
+def _filter_selectivity(e: RowExpression) -> float:
+    """Reference FilterStatsCalculator's shapes, reduced to the forms the
+    planner emits."""
+    if isinstance(e, SpecialForm):
+        if e.form == Form.AND:
+            s = 1.0
+            for a in e.args:
+                s *= _filter_selectivity(a)
+            return s
+        if e.form == Form.OR:
+            s = 0.0
+            for a in e.args:
+                s = s + _filter_selectivity(a) * (1.0 - s)
+            return min(s, 1.0)
+        if e.form == Form.IN:
+            return min(0.05 * max(len(e.args) - 1, 1), 0.5)
+        if e.form == Form.BETWEEN:
+            return 0.25
+        if e.form == Form.IS_NULL:
+            return 0.05
+    if isinstance(e, Call):
+        if e.name == "eq":
+            return 0.05
+        if e.name in ("lt", "le", "gt", "ge"):
+            return 0.35
+        if e.name == "ne":
+            return 0.95
+        if e.name == "not":
+            return 1.0 - _filter_selectivity(e.args[0])
+        if e.name == "like":
+            return 0.25
+    return 0.5
+
+
+def estimate_rows(node: PlanNode, connector,
+                  history: Optional[HistoryStore] = None) -> float:
+    """Estimated output rows of `node`. History (observed counts) wins
+    over rules when available."""
+    memo: Dict[int, float] = {}
+
+    def est(n: PlanNode) -> float:
+        k = id(n)
+        if k in memo:
+            return memo[k]
+        if history is not None:
+            h = history.get(canonical_key(n))
+            if h is not None:
+                memo[k] = float(max(h, 1))
+                return memo[k]
+        memo[k] = rules(n)
+        return memo[k]
+
+    def rules(n: PlanNode) -> float:
+        if isinstance(n, TableScanNode):
+            try:
+                return float(connector.row_count(n.table))
+            except Exception:     # noqa: BLE001 — stats-less connector
+                return 1e6
+        if isinstance(n, ValuesNode):
+            return float(max(len(n.rows), 1))
+        if isinstance(n, FilterNode):
+            return max(est(n.source)
+                       * _filter_selectivity(n.predicate), 1.0)
+        if isinstance(n, (ProjectNode, AssignUniqueIdNode, OutputNode,
+                          SortNode, WindowNode, ExchangeNode)):
+            src = n.source
+            return est(src) if src is not None else 1e6
+        if isinstance(n, RemoteSourceNode):
+            return 1e6
+        if isinstance(n, GroupIdNode):
+            return est(n.source) * max(len(n.grouping_sets), 1)
+        if isinstance(n, AggregationNode):
+            if not n.group_fields:
+                return 1.0
+            return max(est(n.source) / 20.0, 1.0)
+        if isinstance(n, (TopNNode, LimitNode)):
+            return float(min(n.count, est(n.source)))
+        if isinstance(n, JoinNode):
+            p, b = est(n.probe), est(n.build)
+            if n.join_type in (JoinType.SEMI, JoinType.ANTI,
+                               JoinType.ANTI_EXISTS):
+                return p
+            if not n.probe_keys:
+                return p * b
+            # FK-join assumption: |out| ~ the larger side
+            out = max(p, b)
+            if n.join_type in (JoinType.LEFT, JoinType.FULL):
+                out = max(out, p)
+            return out
+        return 1e6
+
+    return est(node)

@@ -73,7 +73,8 @@ class RelationPlan:
     est_rows: float
 
 
-_AGG_FUNCS = {"sum", "avg", "count", "min", "max", "bool_or", "bool_and"}
+_AGG_FUNCS = {"sum", "avg", "count", "min", "max", "bool_or", "bool_and",
+              "approx_distinct", "approx_percentile"}
 
 _SCALAR_FUNCS = {"substr", "length", "lower", "upper", "trim", "ltrim",
                  "rtrim", "abs", "sqrt", "ln", "log10", "exp", "floor",
@@ -916,10 +917,15 @@ class Planner:
             if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
                 found = True
             elif isinstance(x, ast.WindowCall):
-                # sum(x) OVER (...) is a window, not an aggregation.
-                # (Aggregates inside a window's ORDER BY — rank() over
-                # (order by sum(x)) — are not yet supported.)
-                pass
+                # sum(x) OVER (...) is a window, not an aggregation —
+                # but aggregates may appear INSIDE it (TPC-DS
+                # revenueratio: sum(sum(x)) over (partition by ...))
+                for a in x.func.args:
+                    walk(a)
+                for p in x.partition_by:
+                    walk(p)
+                for o in x.order_by:
+                    walk(o.expr)
             elif dataclasses.is_dataclass(x) and not isinstance(x, ast.Select):
                 for f in dataclasses.fields(x):
                     walk(getattr(x, f.name))
@@ -955,6 +961,16 @@ class Planner:
         agg_calls: List[ast.FuncCall] = []
 
         def collect(x):
+            if isinstance(x, ast.WindowCall):
+                # the window function itself is NOT an aggregate here;
+                # aggregates inside its args/partition/order are
+                for a in x.func.args:
+                    collect(a)
+                for p in x.partition_by:
+                    collect(p)
+                for o in x.order_by:
+                    collect(o.expr)
+                return
             if isinstance(x, ast.FuncCall) and x.name in _AGG_FUNCS:
                 if x not in agg_calls:
                     agg_calls.append(x)
@@ -993,16 +1009,29 @@ class Planner:
                     pre_exprs.append(arg)
                 f = arg_pos[arg]
                 kind = call.name
-                if kind == "count":
+                param = None
+                if kind in ("count", "approx_distinct"):
                     out_t = BIGINT
                 elif kind == "avg":
                     out_t = DOUBLE
                 elif kind in ("bool_or", "bool_and"):
                     out_t = BOOLEAN
+                elif kind == "approx_percentile":
+                    out_t = arg.type
+                    if len(call.args) < 2:
+                        raise AnalysisError(
+                            "approx_percentile needs a percentile")
+                    lit = self.analyze(call.args[1], fields)
+                    if not isinstance(lit, Literal):
+                        raise AnalysisError(
+                            "approx_percentile percentile must be a "
+                            "literal")
+                    param = (lit.value / 10 ** lit.type.scale
+                             if lit.type.is_decimal else float(lit.value))
                 else:  # sum/min/max keep arg type (sum: int widens to int64)
                     out_t = arg.type if kind != "sum" or \
                         not arg.type.is_integer else BIGINT
-                spec = AggSpec(kind, f, out_t)
+                spec = AggSpec(kind, f, out_t, param=param)
             agg_to_output[call] = len(key_exprs) + len(agg_specs)
             agg_specs.append(spec)
             agg_types.append(spec.output_type)
@@ -1014,30 +1043,72 @@ class Planner:
         pre = ProjectNode(tuple(f"_c{i}" for i in range(len(pre_exprs))),
                           tuple(e.type for e in pre_exprs), rp.node,
                           tuple(pre_exprs))
-        agg_out_names = tuple(key_names +
+        k = len(key_exprs)
+        gsets = q.grouping_sets
+        if gsets is not None:
+            # GROUPING SETS: expand rows per set (GroupIdNode), then group
+            # by (keys..., _gid) — nulled-out keys group per set, and the
+            # _gid key keeps a genuine NULL key value distinct from a
+            # rolled-up one (reference: GroupIdOperator + the planner's
+            # grouping-set rewrite in QueryPlanner).
+            from presto_tpu.plan.nodes import GroupIdNode
+            gid = GroupIdNode(
+                pre.output_names + ("_gid",),
+                pre.output_types + (BIGINT,), source=pre,
+                grouping_sets=tuple(tuple(s) for s in gsets),
+                key_fields=tuple(range(k)))
+            agg_src = gid
+            group_fields = tuple(range(k)) + (len(pre_exprs),)
+            mid = ["_gid"]
+            mid_t = [BIGINT]
+            # agg outputs shift right by the _gid key column
+            agg_to_output = {c: p + 1 for c, p in agg_to_output.items()}
+        else:
+            agg_src = pre
+            group_fields = tuple(range(k))
+            mid, mid_t = [], []
+        agg_out_names = tuple(key_names + mid +
                               [f"_agg{i}" for i in range(len(agg_specs))])
-        agg_out_types = tuple([e.type for e in key_exprs] + agg_types)
-        agg = AggregationNode(agg_out_names, agg_out_types, pre,
-                              tuple(range(len(key_exprs))),
+        agg_out_types = tuple([e.type for e in key_exprs] + mid_t
+                              + agg_types)
+        agg = AggregationNode(agg_out_names, agg_out_types, agg_src,
+                              group_fields,
                               tuple(agg_specs), Step.SINGLE)
         est = max(rp.est_rows / 100.0, 1.0) if key_exprs else 1.0
+        if gsets is not None:
+            est *= len(gsets)
         arp = RelationPlan(agg, tuple(
             Field(n, t) for n, t in zip(agg_out_names, agg_out_types)), est)
 
         # 4. post-projection of select items over (keys ++ aggs)
         rewriter = _AggRewriter(self, fields, key_exprs, agg_to_output,
-                                agg_out_types)
-        out_exprs, out_names = [], []
-        for i, it in enumerate(q.items):
-            e = rewriter.rewrite(it.expr)
-            out_exprs.append(e)
-            out_names.append(it.alias or self._default_name(it.expr, i))
-
+                                agg_out_types, grouping_sets=gsets)
         if q.having is not None:
             h = rewriter.rewrite(q.having)
             arp = RelationPlan(
                 FilterNode(agg_out_names, agg_out_types, arp.node, h),
                 arp.fields, arp.est_rows)
+
+        # windows over the aggregation's output (e.g. TPC-DS revenueratio:
+        # sum(sum(x)) over (partition by class)) — plan them over `arp`,
+        # resolving their contents through the agg rewriter
+        wcalls = _collect_window_calls(q.items)
+        if wcalls:
+            arp, wc_names = self._plan_window(wcalls, arp,
+                                              analyze_fn=rewriter.rewrite)
+            rewriter.extra_fields = {
+                f.name: (i, f.type) for i, f in enumerate(arp.fields)}
+            mapping = {wc: nm for wc, nm in zip(wcalls, wc_names)}
+            q = dataclasses.replace(q, items=tuple(
+                ast.SelectItem(_replace_window_calls(it.expr, mapping),
+                               it.alias or self._default_name(it.expr, i))
+                for i, it in enumerate(q.items)))
+
+        out_exprs, out_names = [], []
+        for i, it in enumerate(q.items):
+            e = rewriter.rewrite(it.expr)
+            out_exprs.append(e)
+            out_names.append(it.alias or self._default_name(it.expr, i))
 
         # ORDER BY handled on the post-projection: remember mapping
         self._order_scope = (rewriter, out_exprs, out_names)
@@ -1191,8 +1262,8 @@ class Planner:
         return f"_col{i}"
 
     # ========================================================= order/limit
-    def _plan_window(self, wcalls: List[ast.WindowCall], rp: RelationPlan
-                     ) -> Tuple[RelationPlan, List[str]]:
+    def _plan_window(self, wcalls: List[ast.WindowCall], rp: RelationPlan,
+                     analyze_fn=None) -> Tuple[RelationPlan, List[str]]:
         """Plan the window functions over `rp`: a pre-projection computes
         any non-column partition/order/argument expressions, then one
         WindowNode per distinct (partition, order) window appends the
@@ -1207,7 +1278,13 @@ class Planner:
 
         def channel(expr_ast) -> int:
             nonlocal extended
-            e = self.analyze(expr_ast, tuple(ext_fields))
+            # analyze_fn: windows over an aggregation's output resolve
+            # their arguments/partition/order through the agg rewriter
+            # (SQL: window functions evaluate after GROUP BY/HAVING)
+            if analyze_fn is not None:
+                e = analyze_fn(expr_ast)
+            else:
+                e = self.analyze(expr_ast, tuple(ext_fields))
             if isinstance(e, InputRef):
                 return e.field
             ext_exprs.append(e)
@@ -1550,14 +1627,24 @@ class _AggRewriter:
     AggregationAnalyzer)."""
 
     def __init__(self, planner: Planner, src_fields, key_exprs,
-                 agg_to_output, out_types):
+                 agg_to_output, out_types, grouping_sets=None):
         self.p = planner
         self.src_fields = src_fields
         self.key_exprs = list(key_exprs)
         self.agg_to_output = agg_to_output
         self.out_types = out_types
+        self.grouping_sets = grouping_sets
+        # name -> (channel, type): window/helper columns appended behind
+        # the agg output (set by _plan_aggregation's window step)
+        self.extra_fields: Dict[str, tuple] = {}
 
     def rewrite(self, e: ast.Expr) -> RowExpression:
+        if isinstance(e, ast.Ident) and len(e.parts) == 1 \
+                and e.parts[0] in self.extra_fields:
+            pos, t = self.extra_fields[e.parts[0]]
+            return InputRef(pos, t)
+        if isinstance(e, ast.FuncCall) and e.name == "grouping":
+            return self._rewrite_grouping(e)
         if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
             pos = self._find_agg(e)
             return InputRef(pos, self.out_types[pos])
@@ -1636,6 +1723,36 @@ class _AggRewriter:
             return analyzed
         raise AnalysisError(
             f"expression references non-grouped columns: {e}")
+
+    def _rewrite_grouping(self, e: ast.FuncCall) -> RowExpression:
+        """GROUPING(k1, k2, ...) -> bitmask by set ordinal: bit i is 1 when
+        argument i is rolled up (absent from the row's grouping set).
+        Lowered as a static lookup over the _gid key column (nested IFs —
+        set counts are tiny). Reference: spi GroupingOperationRewriter."""
+        if self.grouping_sets is None:
+            raise AnalysisError("GROUPING() without GROUPING SETS")
+        positions = []
+        for a in e.args:
+            analyzed = self.p.analyze(a, self.src_fields)
+            for i, k in enumerate(self.key_exprs):
+                if k == analyzed:
+                    positions.append(i)
+                    break
+            else:
+                raise AnalysisError(
+                    "GROUPING() argument is not a grouping key")
+        gid = InputRef(len(self.key_exprs), BIGINT)
+        out: RowExpression = Literal(0, BIGINT)
+        for s, members in enumerate(self.grouping_sets):
+            v = 0
+            for bit, pos in enumerate(positions):
+                if pos not in members:
+                    v |= 1 << (len(positions) - 1 - bit)
+            out = SpecialForm(Form.IF,
+                              (Call("eq", (gid, Literal(s, BIGINT)),
+                                    BOOLEAN),
+                               Literal(v, BIGINT), out), BIGINT)
+        return out
 
     def _find_agg(self, call: ast.FuncCall) -> int:
         if call in self.agg_to_output:

@@ -204,3 +204,43 @@ class Select:
     # WITH clause: ((name, query), ...); names visible to relations and
     # subqueries of this Select (reference: sql/tree/With.java)
     ctes: Tuple[Tuple[str, "Select"], ...] = ()
+    # GROUPING SETS / ROLLUP / CUBE: index tuples into group_by (the full
+    # distinct key list); None = plain GROUP BY (one implicit set).
+    # Reference: sql/tree/GroupingSets.java + spi/plan GroupIdNode.
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+
+# --------------------------------------------------------------------- DDL/DML
+# Reference: sql/tree/CreateTableAsSelect.java, Insert.java, CreateTable,
+# DropTable — the statement surface beyond queries (engine DDL tasks live
+# in presto-main-base/.../execution/*Task.java).
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAs:
+    name: str
+    query: Select
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable:
+    name: str
+    columns: Tuple[Tuple[str, str], ...]      # (name, type signature)
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert:
+    name: str
+    query: Optional[Select]                   # None for VALUES form
+    columns: Tuple[str, ...] = ()             # () = table order
+    rows: Tuple[Tuple["Expr", ...], ...] = ()  # INSERT ... VALUES rows
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+Statement = object   # Select | CreateTableAs | CreateTable | Insert | DropTable

@@ -72,6 +72,24 @@ def tokenize(sql: str) -> List[Token]:
     return out
 
 
+def _compose_grouping(elements):
+    """Cross-product composition of GROUP BY elements (SQL spec 7.9: the
+    result grouping sets are the product of each element's sets). Returns
+    (distinct key exprs in first-appearance order, index-tuple sets)."""
+    import itertools
+
+    lists = [[(v,)] if kind == "plain" else v for kind, v in elements]
+    combos = [sum(parts, ()) for parts in itertools.product(*lists)]
+    keys: List[ast.Expr] = []
+    for c in combos:
+        for e in c:
+            if e not in keys:
+                keys.append(e)
+    sets = tuple(tuple(sorted({keys.index(e) for e in c}))
+                 for c in combos)
+    return tuple(keys), sets
+
+
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql
@@ -121,6 +139,110 @@ class Parser:
         self.expect("eof")
         return q
 
+    def parse_statement(self):
+        """SELECT | CREATE TABLE [AS] | INSERT INTO | DROP TABLE
+        (reference grammar: SqlBase.g4 statement alternatives)."""
+        t = self.peek()
+        word = t.text if t.kind == "ident" else None
+        if word == "create":
+            self.next()
+            tw = self.next()
+            if tw.text != "table":
+                raise SyntaxError(f"expected TABLE, got {tw.text!r}")
+            ine = False
+            if self.peek().text == "if":
+                self.next()
+                if self.next().text != "not":
+                    raise SyntaxError("expected NOT")
+                exists_t = self.next()
+                if exists_t.kind != "keyword" or \
+                        exists_t.text != "exists":
+                    raise SyntaxError("expected EXISTS")
+                ine = True
+            name = self.ident_text()
+            if self.accept_kw("as"):
+                q = self.query()
+                self.accept("op", ";")
+                self.expect("eof")
+                return ast.CreateTableAs(name, q, ine)
+            self.expect("op", "(")
+            cols = []
+            while True:
+                cn = self.ident_text()
+                sig = self.ident_text()
+                if self.peek().text == "(" and self.peek().kind == "op":
+                    # type arguments: varchar(25), decimal(12,2)
+                    depth = 0
+                    sig_extra = ""
+                    while True:
+                        tk = self.next()
+                        sig_extra += tk.text
+                        if tk.text == "(":
+                            depth += 1
+                        elif tk.text == ")":
+                            depth -= 1
+                            if depth == 0:
+                                break
+                    sig += sig_extra
+                cols.append((cn, sig))
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+            self.accept("op", ";")
+            self.expect("eof")
+            return ast.CreateTable(name, tuple(cols), ine)
+        if word == "insert":
+            self.next()
+            into = self.next()
+            if into.text != "into":
+                raise SyntaxError(f"expected INTO, got {into.text!r}")
+            name = self.ident_text()
+            cols: tuple = ()
+            if self.peek().text == "(" and self.peek(1).kind == "ident" \
+                    and self.peek(2).text in (",", ")"):
+                self.next()
+                cl = [self.ident_text()]
+                while self.accept("op", ","):
+                    cl.append(self.ident_text())
+                self.expect("op", ")")
+                cols = tuple(cl)
+            if self.peek().kind == "ident" and self.peek().text == "values":
+                self.next()
+                rows = []
+                while True:
+                    self.expect("op", "(")
+                    row = [self.expr()]
+                    while self.accept("op", ","):
+                        row.append(self.expr())
+                    self.expect("op", ")")
+                    rows.append(tuple(row))
+                    if not self.accept("op", ","):
+                        break
+                self.accept("op", ";")
+                self.expect("eof")
+                return ast.Insert(name, None, cols, tuple(rows))
+            q = self.query()
+            self.accept("op", ";")
+            self.expect("eof")
+            return ast.Insert(name, q, cols)
+        if word == "drop":
+            self.next()
+            tw = self.next()
+            if tw.text != "table":
+                raise SyntaxError(f"expected TABLE, got {tw.text!r}")
+            ife = False
+            if self.peek().text == "if":
+                self.next()
+                ex = self.next()
+                if ex.kind != "keyword" or ex.text != "exists":
+                    raise SyntaxError("expected EXISTS")
+                ife = True
+            name = self.ident_text()
+            self.accept("op", ";")
+            self.expect("eof")
+            return ast.DropTable(name, ife)
+        return self.parse()
+
     def query(self) -> ast.Select:
         ctes = []
         if self.accept_kw("with"):
@@ -151,12 +273,16 @@ class Parser:
 
         where = self.expr() if self.accept_kw("where") else None
         group_by: Tuple[ast.Expr, ...] = ()
+        grouping_sets = None
         if self.accept_kw("group"):
             self.expect_kw("by")
-            g = [self.expr()]
+            elements = [self._group_element()]
             while self.accept("op", ","):
-                g.append(self.expr())
-            group_by = tuple(g)
+                elements.append(self._group_element())
+            if all(kind == "plain" for kind, _ in elements):
+                group_by = tuple(v for _, v in elements)
+            else:
+                group_by, grouping_sets = _compose_grouping(elements)
         having = self.expr() if self.accept_kw("having") else None
         order_by: Tuple[ast.OrderItem, ...] = ()
         if self.accept_kw("order"):
@@ -169,7 +295,52 @@ class Parser:
         if self.accept_kw("limit"):
             limit = int(self.expect("number").text)
         return ast.Select(tuple(items), tuple(relations), where, group_by,
-                          having, order_by, limit, distinct)
+                          having, order_by, limit, distinct,
+                          grouping_sets=grouping_sets)
+
+    def _group_element(self):
+        """One GROUP BY element: plain expr, ROLLUP(...), CUBE(...), or
+        GROUPING SETS ((a,b), c, ()) — reference grammar SqlBase.g4
+        groupingElement. Returns ("plain", expr) | ("sets", [exprtuple])."""
+        t = self.peek()
+        word = t.text if t.kind == "ident" else None
+        if word in ("rollup", "cube") and self.peek(1).text == "(":
+            self.next()
+            self.expect("op", "(")
+            exprs = [self.expr()]
+            while self.accept("op", ","):
+                exprs.append(self.expr())
+            self.expect("op", ")")
+            if word == "rollup":
+                sets = [tuple(exprs[:i]) for i in range(len(exprs), -1, -1)]
+            else:
+                sets = []
+                for mask in range(1 << len(exprs)):
+                    sets.append(tuple(e for i, e in enumerate(exprs)
+                                      if mask & (1 << i)))
+                sets.sort(key=len, reverse=True)
+            return ("sets", sets)
+        if word == "grouping" and self.peek(1).text == "sets":
+            self.next()
+            self.next()
+            self.expect("op", "(")
+            sets = [self._grouping_set()]
+            while self.accept("op", ","):
+                sets.append(self._grouping_set())
+            self.expect("op", ")")
+            return ("sets", sets)
+        return ("plain", self.expr())
+
+    def _grouping_set(self) -> tuple:
+        if self.accept("op", "("):
+            if self.accept("op", ")"):
+                return ()
+            exprs = [self.expr()]
+            while self.accept("op", ","):
+                exprs.append(self.expr())
+            self.expect("op", ")")
+            return tuple(exprs)
+        return (self.expr(),)
 
     def select_item(self) -> ast.SelectItem:
         if self.peek().kind == "op" and self.peek().text == "*":
@@ -506,3 +677,9 @@ class Parser:
 
 def parse_sql(sql: str) -> ast.Select:
     return Parser(sql).parse()
+
+
+def parse_statement(sql: str):
+    """Full statement surface: SELECT | CREATE TABLE [AS] | INSERT |
+    DROP TABLE."""
+    return Parser(sql).parse_statement()
