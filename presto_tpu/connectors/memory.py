@@ -1,0 +1,132 @@
+"""Memory connector — writable in-memory tables.
+
+Reference role: presto-memory (presto-memory/src/main/java/com/facebook/
+presto/plugin/memory/ — MemoryMetadata/MemoryPagesStore), the standard
+writable test backend. Tables live as host numpy arrays in the same
+HostTable shape scans use, so written tables are immediately scannable
+with the table-wide-StringDict invariant preserved.
+
+An optional `fallback` connector provides read-through for names not
+written here (the multi-catalog surface collapsed into one facade: CTAS
+from tpch into memory works through a single engine connector)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors.tpch import HostTable, _slice_rows
+from presto_tpu.data.column import StringDict
+from presto_tpu.types import Type
+
+
+class MemoryConnector:
+    def __init__(self, fallback=None):
+        self.fallback = fallback
+        self.tables: Dict[str, HostTable] = {}
+
+    # ------------------------------------------------------------- reads
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        t = self.tables.get(table)
+        if t is not None:
+            return [(c, t.types[c]) for c in t.column_names()]
+        if self.fallback is not None:
+            return self.fallback.schema(table)
+        raise KeyError(f"unknown table {table}")
+
+    def row_count(self, table: str) -> int:
+        t = self.tables.get(table)
+        if t is not None:
+            return t.num_rows
+        if self.fallback is not None:
+            return self.fallback.row_count(table)
+        raise KeyError(f"unknown table {table}")
+
+    def table(self, name: str, part: int = 0, num_parts: int = 1
+              ) -> HostTable:
+        full = self.tables.get(name)
+        if full is None:
+            if self.fallback is not None:
+                return self.fallback.table(name, part, num_parts)
+            raise KeyError(f"unknown table {name}")
+        if num_parts == 1:
+            return full
+        lo, hi = _slice_rows(full.num_rows, part, num_parts)
+        arrays = {c: a[lo:hi] for c, a in full.arrays.items()}
+        nulls = ({c: m[lo:hi] for c, m in full.nulls.items()}
+                 if full.nulls is not None else None)
+        return HostTable(name, hi - lo, arrays, full.types, full.dicts,
+                         nulls)
+
+    # ------------------------------------------------------------ writes
+    def exists(self, name: str) -> bool:
+        return name in self.tables
+
+    def create(self, name: str, schema: Sequence[Tuple[str, Type]]):
+        if name in self.tables:
+            raise ValueError(f"table {name} already exists")
+        arrays: Dict[str, np.ndarray] = {}
+        dicts: Dict[str, StringDict] = {}
+        types = {}
+        for c, t in schema:
+            types[c] = t
+            if t.is_string:
+                arrays[c] = np.zeros(0, np.int32)
+                dicts[c] = StringDict([])
+            else:
+                arrays[c] = np.zeros(0, t.dtype)
+        self.tables[name] = HostTable(name, 0, arrays, types, dicts)
+
+    def drop(self, name: str, if_exists: bool = False):
+        if name not in self.tables and not if_exists:
+            raise KeyError(f"unknown table {name}")
+        self.tables.pop(name, None)
+
+    def append_rows(self, name: str, rows: List[tuple]) -> int:
+        """Append python rows (strings decoded, decimals as python
+        floats — the engine's to_pylist() shape). Reference role:
+        ConnectorPageSink.appendPage (MemoryPagesStore.add)."""
+        t = self.tables[name]
+        cols = t.column_names()
+        n_new = len(rows)
+        if n_new == 0:
+            return 0
+        new_arrays: Dict[str, np.ndarray] = {}
+        new_dicts: Dict[str, StringDict] = dict(t.dicts)
+        new_nulls: Dict[str, np.ndarray] = {}
+        for i, c in enumerate(cols):
+            typ = t.types[c]
+            vals = [r[i] for r in rows]
+            old_null = (t.nulls or {}).get(
+                c, np.zeros(t.num_rows, dtype=bool))[:t.num_rows]
+            new_nulls[c] = np.concatenate(
+                [old_null, np.asarray([v is None for v in vals], bool)])
+            if typ.is_string:
+                # merge into one table-wide sorted dictionary, remapping
+                # existing codes (the shared cross-page dictionary
+                # machinery, data/column.merge_string_dicts)
+                from presto_tpu.data.column import merge_string_dicts
+                new_words, new_codes = StringDict.build(
+                    ["" if v is None else v for v in vals])
+                union, (remap_old, remap_new) = merge_string_dicts(
+                    [t.dicts[c], new_words])
+                old_codes = t.arrays[c][:t.num_rows]
+                old_new = (remap_old[old_codes] if len(remap_old)
+                           else old_codes)
+                new_arrays[c] = np.concatenate(
+                    [old_new, remap_new[new_codes]])
+                new_dicts[c] = union
+            else:
+                filled = [0 if v is None else v for v in vals]
+                if typ.is_decimal:
+                    arr = np.round(np.asarray(filled, np.float64)
+                                   * 10 ** typ.scale).astype(np.int64)
+                else:
+                    arr = np.asarray(filled, dtype=typ.dtype)
+                new_arrays[c] = np.concatenate(
+                    [t.arrays[c][:t.num_rows], arr])
+        self.tables[name] = HostTable(name, t.num_rows + n_new,
+                                      new_arrays, t.types, new_dicts,
+                                      new_nulls)
+        return n_new

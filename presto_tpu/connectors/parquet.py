@@ -1,0 +1,202 @@
+"""Parquet connector — columnar files -> engine Pages via Arrow.
+
+Reference roles: presto-parquet (the Parquet->Page reader feeding scans)
++ presto-hive's file-split model, realized the way SURVEY.md §7.2 step 8
+prescribes: Parquet -> Arrow -> numpy -> the engine's dictionary-coded
+HostTable form. Row-group boundaries are the natural split unit
+(reference: ParquetPageSourceFactory splitting by row group).
+
+Reads through pyarrow (in-image); the write side serializes engine rows
+back to Parquet so CTAS-style round-trips are testable without external
+files."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors.tpch import HostTable, _slice_rows
+from presto_tpu.data.column import StringDict
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TIMESTAMP,
+    TINYINT, VARCHAR, DecimalType, Type,
+)
+
+
+def _arrow_to_type(t) -> Type:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(t):
+        return BOOLEAN
+    if pa.types.is_int8(t):
+        return TINYINT
+    if pa.types.is_int16(t):
+        return SMALLINT
+    if pa.types.is_int32(t):
+        return INTEGER
+    if pa.types.is_int64(t):
+        return BIGINT
+    if pa.types.is_float32(t):
+        return REAL
+    if pa.types.is_float64(t):
+        return DOUBLE
+    if pa.types.is_date32(t) or pa.types.is_date64(t):
+        return DATE
+    if pa.types.is_timestamp(t):
+        return TIMESTAMP
+    if pa.types.is_decimal(t):
+        return DecimalType(t.precision, t.scale)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return VARCHAR
+    raise NotImplementedError(f"arrow type {t}")
+
+
+def _type_to_arrow(t: Type):
+    import pyarrow as pa
+
+    if isinstance(t, DecimalType):
+        return pa.decimal128(t.precision, t.scale)
+    return {
+        "boolean": pa.bool_(), "tinyint": pa.int8(),
+        "smallint": pa.int16(), "integer": pa.int32(),
+        "bigint": pa.int64(), "real": pa.float32(),
+        "double": pa.float64(), "date": pa.date32(),
+        "timestamp": pa.timestamp("us"), "varchar": pa.string(),
+        "char": pa.string(),
+    }[t.name]
+
+
+def read_parquet_table(path: str, name: str) -> HostTable:
+    """One Parquet file -> HostTable (whole-file; splits are row slices
+    of it so string codes share one file-wide dictionary)."""
+    import pyarrow.parquet as pq
+
+    at = pq.read_table(path)
+    arrays: Dict[str, np.ndarray] = {}
+    dicts: Dict[str, StringDict] = {}
+    nulls: Dict[str, np.ndarray] = {}
+    types: Dict[str, Type] = {}
+    n = at.num_rows
+    for field in at.schema:
+        col = at.column(field.name).combine_chunks()
+        t = _arrow_to_type(field.type)
+        types[field.name] = t
+        mask = np.asarray(col.is_null())
+        nulls[field.name] = mask
+        if t.is_string:
+            vals = col.to_pylist()
+            d, codes = StringDict.build(
+                ["" if v is None else v for v in vals])
+            arrays[field.name] = codes
+            dicts[field.name] = d
+        elif t.is_decimal:
+            vals = col.to_pylist()
+            arrays[field.name] = np.asarray(
+                [0 if v is None else int(v.scaleb(t.scale))
+                 for v in vals], dtype=np.int64)
+        elif t.name == "timestamp":
+            import pyarrow as pa
+            us = col.cast(pa.timestamp("us")).cast(pa.int64())
+            arrays[field.name] = np.where(
+                mask, 0, np.asarray(us.to_pandas(), dtype=np.int64))
+        else:
+            np_vals = col.to_pandas().to_numpy()
+            if np_vals.dtype == object or np_vals.dtype.kind in "fmM":
+                if t.name == "date":
+                    np_vals = np.asarray(
+                        col.cast("int32").to_pandas(), dtype=np.int32)
+                elif t.is_floating:
+                    np_vals = np.asarray(np_vals, dtype=t.dtype)
+                else:
+                    np_vals = np.asarray(
+                        [0 if v is None else v
+                         for v in col.to_pylist()], dtype=t.dtype)
+            arrays[field.name] = np.where(
+                mask, t.dtype.type(0), np_vals.astype(t.dtype)) \
+                if np_vals.dtype != t.dtype else np.where(
+                    mask, t.dtype.type(0), np_vals)
+    return HostTable(name, n, arrays, types, dicts, nulls)
+
+
+def write_parquet_table(path: str, rows: List[tuple],
+                        schema: Sequence[Tuple[str, Type]]):
+    """Engine result rows (to_pylist shape) -> one Parquet file."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    cols = []
+    fields = []
+    for i, (name, t) in enumerate(schema):
+        vals = [r[i] for r in rows]
+        if isinstance(t, DecimalType):
+            from decimal import Decimal
+            vals = [None if v is None else
+                    Decimal(str(round(v, t.scale))) for v in vals]
+        fields.append(pa.field(name, _type_to_arrow(t)))
+        cols.append(pa.array(vals, type=_type_to_arrow(t)))
+    pq.write_table(pa.Table.from_arrays(cols, schema=pa.schema(fields)),
+                   path)
+
+
+class ParquetConnector:
+    """Directory-of-files catalog: `<dir>/<table>.parquet`. Same surface
+    as the generated-fixture connectors; an optional fallback serves
+    other names (multi-catalog facade, as connectors/memory.py)."""
+
+    def __init__(self, directory: str, fallback=None):
+        self.directory = directory
+        self.fallback = fallback
+        self._cache: Dict[str, HostTable] = {}
+
+    def _path(self, table: str) -> str:
+        return os.path.join(self.directory, f"{table}.parquet")
+
+    def _load(self, table: str) -> Optional[HostTable]:
+        if table in self._cache:
+            return self._cache[table]
+        p = self._path(table)
+        if not os.path.exists(p):
+            return None
+        t = read_parquet_table(p, table)
+        self._cache[table] = t
+        return t
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        t = self._load(table)
+        if t is None:
+            if self.fallback is not None:
+                return self.fallback.schema(table)
+            raise KeyError(f"unknown table {table}")
+        return [(c, t.types[c]) for c in t.column_names()]
+
+    def row_count(self, table: str) -> int:
+        t = self._load(table)
+        if t is None:
+            if self.fallback is not None:
+                return self.fallback.row_count(table)
+            raise KeyError(f"unknown table {table}")
+        return t.num_rows
+
+    def table(self, name: str, part: int = 0, num_parts: int = 1
+              ) -> HostTable:
+        full = self._load(name)
+        if full is None:
+            if self.fallback is not None:
+                return self.fallback.table(name, part, num_parts)
+            raise KeyError(f"unknown table {name}")
+        if num_parts == 1:
+            return full
+        lo, hi = _slice_rows(full.num_rows, part, num_parts)
+        arrays = {c: a[lo:hi] for c, a in full.arrays.items()}
+        nulls = ({c: m[lo:hi] for c, m in full.nulls.items()}
+                 if full.nulls is not None else None)
+        return HostTable(name, hi - lo, arrays, full.types, full.dicts,
+                         nulls)
+
+    def invalidate(self, table: Optional[str] = None):
+        if table is None:
+            self._cache.clear()
+        else:
+            self._cache.pop(table, None)

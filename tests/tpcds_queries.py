@@ -1,0 +1,244 @@
+"""TPC-DS query subset, dialect-adapted by hand from the spec templates
+with the standard qualification parameter bindings (reference:
+presto-benchto-benchmarks/src/main/resources/sql/presto/tpcds/ and the
+TPC-DS specification). Date filters are bound to ranges this generator's
+fact tables cover (1998-2002).
+
+Queries chosen to exercise: star joins over date/item/store dims,
+demographics cross-products, windows over aggregations (q12/q20/q98
+revenueratio), ROLLUP (q22), CASE pivots (q43), time/household dims
+(q96), inventory (q37/q22)."""
+
+QUERIES = {
+    3: """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manufact_id = 128
+  and dt.d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, brand_id
+limit 100
+""",
+    7: """
+select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    12: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) as itemrevenue,
+       sum(ws_ext_sales_price) * 100 /
+         sum(sum(ws_ext_sales_price)) over (partition by i_class)
+         as revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ws_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    15: """
+select ca_zip, sum(cs_sales_price)
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                '86475', '85392', '85460', '80348',
+                                '81792')
+       or ca_state in ('CA', 'WA', 'GA')
+       or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip
+order by ca_zip
+limit 100
+""",
+    20: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) as itemrevenue,
+       sum(cs_ext_sales_price) * 100 /
+         sum(sum(cs_ext_sales_price)) over (partition by i_class)
+         as revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    22: """
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1176 and 1176 + 11
+group by rollup(i_product_name, i_brand, i_class, i_category)
+order by qoh, i_product_name, i_brand, i_class, i_category
+""",
+    26: """
+select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    37: """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 30 and 30 + 30
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '1998-02-01' and date '1998-04-02'
+  and i_manufact_id in (677, 940, 694, 808)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+""",
+    42: """
+select d_year, i_category_id, i_category, sum(ss_ext_sales_price)
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by d_year, i_category_id, i_category
+order by sum(ss_ext_sales_price) desc, d_year, i_category_id, i_category
+limit 100
+""",
+    43: """
+select s_store_name, s_store_id,
+  sum(case when (d_day_name = 'Sunday') then ss_sales_price
+      else null end) sun_sales,
+  sum(case when (d_day_name = 'Monday') then ss_sales_price
+      else null end) mon_sales,
+  sum(case when (d_day_name = 'Tuesday') then ss_sales_price
+      else null end) tue_sales,
+  sum(case when (d_day_name = 'Wednesday') then ss_sales_price
+      else null end) wed_sales,
+  sum(case when (d_day_name = 'Thursday') then ss_sales_price
+      else null end) thu_sales,
+  sum(case when (d_day_name = 'Friday') then ss_sales_price
+      else null end) fri_sales,
+  sum(case when (d_day_name = 'Saturday') then ss_sales_price
+      else null end) sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and s_gmt_offset = -5 and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+""",
+    52: """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, brand_id
+limit 100
+""",
+    55: """
+select i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11
+  and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id
+limit 100
+""",
+    96: """
+select count(*)
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = time_dim.t_time_sk
+  and ss_hdemo_sk = household_demographics.hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and time_dim.t_hour = 20
+  and time_dim.t_minute >= 30
+  and household_demographics.hd_dep_count = 7
+  and store.s_store_name = 'ese'
+""",
+    98: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100 /
+         sum(sum(ss_ext_sales_price)) over (partition by i_class)
+         as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ss_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+""",
+}
+
+# q22's ROLLUP spelled as explicit union-all sets for the sqlite oracle
+Q22_SQLITE = """
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1176 and 1187
+group by i_product_name, i_brand, i_class, i_category
+union all
+select i_product_name, i_brand, i_class, null,
+       avg(inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1176 and 1187
+group by i_product_name, i_brand, i_class
+union all
+select i_product_name, i_brand, null, null,
+       avg(inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1176 and 1187
+group by i_product_name, i_brand
+union all
+select i_product_name, null, null, null,
+       avg(inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1176 and 1187
+group by i_product_name
+union all
+select null, null, null, null, avg(inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1176 and 1187
+"""
