@@ -97,7 +97,10 @@ class Struct:
         return cls(**kwargs)
 
     def dumps(self) -> str:
-        return json.dumps(type(self).to_json(self), sort_keys=True)
+        # Insertion order is semantic for Assignments/aggregations maps
+        # (Jackson serializes LinkedHashMap in order; translate resolves
+        # output layouts positionally from it) — never sort_keys here.
+        return json.dumps(type(self).to_json(self))
 
     @classmethod
     def loads(cls, s: str):
@@ -465,6 +468,15 @@ class SemiJoinNode(PlanNode):
     distributionType: Optional[str] = None
     dynamicFilters: Dict[str, Variable] = dataclasses.field(
         default_factory=dict)
+    # Engine extensions (absent in coordinator JSON, defaulting to Presto
+    # semantics): xSemiKind SEMI|ANTI|ANTI_EXISTS carries the NOT-IN /
+    # NOT-EXISTS null semantics this engine plans as distinct join kinds
+    # (the Java planner expresses them as SemiJoin + surrounding
+    # filters); xEmitFlag=False means the worker filters internally and
+    # omits the semiJoinOutput column. Precedent: the C++ worker's
+    # extension operators (presto_cpp/main/operators/).
+    xSemiKind: Optional[str] = None
+    xEmitFlag: Optional[bool] = None
     _SCHEMA = [
         ("id", "id", None),
         ("source", "source", PlanNode),
@@ -478,6 +490,8 @@ class SemiJoinNode(PlanNode):
          ("opt", Variable)),
         ("distributionType", "distributionType", ("opt", None)),
         ("dynamicFilters", "dynamicFilters", ("map", Variable)),
+        ("xSemiKind", "xSemiKind", ("opt", None)),
+        ("xEmitFlag", "xEmitFlag", ("opt", None)),
     ]
 
 
@@ -590,6 +604,75 @@ class RemoteSourceNode(PlanNode):
         ("exchangeType", "exchangeType", None),
         ("encoding", "encoding", None),
         ("transportType", "transportType", ("opt", None)),
+    ]
+
+
+@PlanNode.register(".GroupIdNode")
+@dataclasses.dataclass
+class GroupIdNode(PlanNode):
+    """spi/plan/GroupIdNode (simplified to this engine's pass-through
+    layout): output = inputVariables (group keys nulled per set) ++
+    groupIdVariable; groupingSets name subsets of inputVariables."""
+    id: str = ""
+    source: Any = None
+    inputVariables: List[Variable] = dataclasses.field(default_factory=list)
+    groupingSets: List[List[Variable]] = dataclasses.field(
+        default_factory=list)
+    groupIdVariable: Variable = None
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("inputVariables", "inputVariables", ("list", Variable)),
+        ("groupingSets", "groupingSets", ("listlist", Variable)),
+        ("groupIdVariable", "groupIdVariable", Variable),
+    ]
+
+
+@dataclasses.dataclass
+class WindowFunction(Struct):
+    """spi/plan/WindowNode.Function — functionCall + frame (frame fixed to
+    the engine's supported RANGE UNBOUNDED PRECEDING..CURRENT ROW)."""
+    functionCall: Call = None
+    frame: Any = None
+    ignoreNulls: bool = False
+    _SCHEMA = [
+        ("functionCall", "functionCall", Call),
+        ("frame", "frame", ("opt", None)),
+        ("ignoreNulls", "ignoreNulls", None),
+    ]
+
+
+@dataclasses.dataclass
+class WindowSpecification(Struct):
+    partitionBy: List[Variable] = dataclasses.field(default_factory=list)
+    orderingScheme: Optional[OrderingScheme] = None
+    _SCHEMA = [
+        ("partitionBy", "partitionBy", ("list", Variable)),
+        ("orderingScheme", "orderingScheme", ("opt", OrderingScheme)),
+    ]
+
+
+@PlanNode.register(".WindowNode")
+@dataclasses.dataclass
+class WindowNode(PlanNode):
+    id: str = ""
+    source: Any = None
+    specification: WindowSpecification = None
+    windowFunctions: Dict[str, WindowFunction] = dataclasses.field(
+        default_factory=dict)
+    hashVariable: Optional[Variable] = None
+    prePartitionedInputs: List[Variable] = dataclasses.field(
+        default_factory=list)
+    preSortedOrderPrefix: int = 0
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("specification", "specification", WindowSpecification),
+        ("windowFunctions", "windowFunctions", ("map", WindowFunction)),
+        ("hashVariable", "hashVariable", ("opt", Variable)),
+        ("prePartitionedInputs", "prePartitionedInputs",
+         ("list", Variable)),
+        ("preSortedOrderPrefix", "preSortedOrderPrefix", None),
     ]
 
 

@@ -1,0 +1,385 @@
+"""Engine plan fragments -> coordinator-protocol PlanFragments.
+
+The coordinator side of the wire: the inverse of translate.py. The Java
+coordinator builds PlanFragment JSON from its plan IR
+(presto-main-base/.../sql/planner/PlanFragment.java:52, serialized in
+HttpRemoteTaskWithEventLoop.java:1011); this module plays that role for
+the engine's own fragmenter output (plan/fragment.py) so the multi-worker
+scheduler (server/cluster.py) can drive TPU workers through the real
+TaskUpdateRequest/PlanFragment protocol.
+
+Conventions mirrored from the Java side:
+  - every plan node gets a string id; scans and remote sources keep their
+    ids in FragmentSpec so the scheduler can bind splits to them
+    (ScheduledSplit.planNodeId).
+  - variables are name+type pairs; names here are generated unique
+    ("{base}__{n}") since engine nodes reference inputs positionally.
+  - a PARTIAL avg travels as sum+count aggregations and the FINAL side
+    as the 2-arg engine extension "avg_final" (Presto carries the same
+    pair as a ROW intermediate type; SURVEY.md §7.3 hard part #7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.expr import nodes as E
+from presto_tpu.ops.keys import SortKey
+from presto_tpu.plan import nodes as P
+from presto_tpu.plan.fragment import PlanFragment as EngineFragment
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.translate import encode_constant
+from presto_tpu.types import DecimalType, Type
+
+# reverse of translate._FN_MAP (first binding wins for aliases)
+_FN_REV = {
+    "eq": "$operator$equal", "ne": "$operator$not_equal",
+    "lt": "$operator$less_than", "le": "$operator$less_than_or_equal",
+    "gt": "$operator$greater_than",
+    "ge": "$operator$greater_than_or_equal",
+    "add": "$operator$add", "subtract": "$operator$subtract",
+    "multiply": "$operator$multiply", "divide": "$operator$divide",
+    "modulus": "$operator$modulus", "negate": "$operator$negation",
+    "cast": "$operator$cast", "extract_year": "year",
+    "extract_month": "month", "extract_day": "day",
+}
+
+
+def type_sig(t: Type) -> str:
+    if isinstance(t, DecimalType):
+        return f"decimal({t.precision},{t.scale})"
+    return t.name
+
+
+def _fn_handle(name: str, arg_sigs: List[str], ret: str,
+               kind: str = "SCALAR") -> dict:
+    return {"@type": "$static", "signature": {
+        "name": f"presto.default.{name}", "kind": kind,
+        "argumentTypes": list(arg_sigs), "returnType": ret,
+        "typeVariableConstraints": [], "longVariableConstraints": [],
+        "variableArity": False}}
+
+
+class _Names:
+    def __init__(self):
+        self.n = 0
+
+    def var(self, base: str, t: Type) -> S.Variable:
+        self.n += 1
+        base = (base or "c").replace("<", "_").replace(">", "_")
+        # zero-padded counter prefix: lexicographic order == creation
+        # order, so even an order-losing JSON reserialization keeps map
+        # entries in output-layout order
+        return S.Variable(f"e{self.n:04d}_{base}", type_sig(t))
+
+    def node_id(self) -> str:
+        self.n += 1
+        return str(self.n)
+
+
+def expr_to_protocol(e: E.RowExpression, in_vars: List[S.Variable]):
+    if isinstance(e, E.InputRef):
+        return in_vars[e.field]
+    if isinstance(e, E.Literal):
+        return encode_constant(e.value, e.type)
+    if isinstance(e, E.Call):
+        args = [expr_to_protocol(a, in_vars) for a in e.args]
+        fname = _FN_REV.get(e.name, e.name)
+        ret = type_sig(e.type)
+        arg_sigs = [type_sig(a.type) for a in e.args]
+        return S.Call(displayName=e.name.upper(),
+                      functionHandle=_fn_handle(fname, arg_sigs, ret),
+                      returnType=ret, arguments=args)
+    if isinstance(e, E.SpecialForm):
+        args = [expr_to_protocol(a, in_vars) for a in e.args]
+        return S.SpecialForm(form=e.form.name, returnType=type_sig(e.type),
+                             arguments=args)
+    raise NotImplementedError(
+        f"to_protocol expression {type(e).__name__}")
+
+
+def _agg_call(kind: str, args: List[S.Variable], ret: str) -> S.Call:
+    arg_sigs = [a.type for a in args]
+    c = S.Call(displayName=kind, returnType=ret, arguments=list(args),
+               functionHandle=_fn_handle(kind, arg_sigs, ret,
+                                         kind="AGGREGATE"))
+    return c
+
+
+def _ordering(keys: Tuple[SortKey, ...],
+              in_vars: List[S.Variable]) -> S.OrderingScheme:
+    orderings = []
+    for k in keys:
+        order = ("ASC" if k.ascending else "DESC") + \
+            ("_NULLS_FIRST" if k.nulls_first else "_NULLS_LAST")
+        orderings.append(S.Ordering(in_vars[k.field], order))
+    return S.OrderingScheme(orderings)
+
+
+@dataclasses.dataclass
+class FragmentSpec:
+    """A protocol fragment plus the scheduling metadata the cluster needs
+    (reference: the coordinator keeps the same info in SqlStageExecution /
+    StageExecutionPlan rather than on the wire)."""
+    fragment: S.PlanFragment
+    engine_id: int
+    scan_nodes: Dict[str, str]            # planNodeId -> table
+    remote_nodes: Dict[str, int]          # planNodeId -> producer engine id
+    output_partitioning: P.Partitioning
+    # hash channels into the root output (producer-side partitioned output)
+    output_keys: Tuple[int, ...]
+
+
+class _FragmentConverter:
+    def __init__(self, names: _Names):
+        self.names = names
+        self.scan_nodes: Dict[str, str] = {}
+        self.remote_nodes: Dict[str, int] = {}
+        self.scan_order: List[str] = []
+
+    def convert(self, node: P.PlanNode
+                ) -> Tuple[S.PlanNode, List[S.Variable]]:
+        nid = self.names.node_id()
+        names = self.names
+
+        if isinstance(node, P.TableScanNode):
+            out = [names.var(n, t) for n, t in zip(node.output_names,
+                                                   node.output_types)]
+            assigns = {f"{v.name}<{v.type}>":
+                       {"@type": "tpch", "columnName": col,
+                        "typeSignature": v.type}
+                       for v, col in zip(out, node.columns)}
+            self.scan_nodes[nid] = node.table
+            self.scan_order.append(nid)
+            return S.TableScanNode(
+                id=nid,
+                table={"connectorId": "tpch",
+                       "connectorHandle": {"@type": "tpch",
+                                           "tableName": node.table}},
+                outputVariables=out, assignments=assigns), out
+
+        if isinstance(node, P.ExchangeNode) and node.source is None:
+            # a cut exchange: the consumer half is a RemoteSourceNode
+            out = [names.var(n, t) for n, t in zip(node.output_names,
+                                                   node.output_types)]
+            self.remote_nodes[nid] = node.remote_fragment
+            return S.RemoteSourceNode(
+                id=nid, sourceFragmentIds=[str(node.remote_fragment)],
+                outputVariables=out), out
+
+        if isinstance(node, P.ValuesNode):
+            out = [names.var(n, t) for n, t in zip(node.output_names,
+                                                   node.output_types)]
+            rows = [[encode_constant(v, t)
+                     for v, t in zip(row, node.output_types)]
+                    for row in node.rows]
+            return S.ValuesNode(id=nid, outputVariables=out,
+                                rows=rows), out
+
+        if isinstance(node, P.FilterNode):
+            src, in_vars = self.convert(node.source)
+            pred = expr_to_protocol(node.predicate, in_vars)
+            return S.FilterNode(id=nid, source=src,
+                                predicate=pred), in_vars
+
+        if isinstance(node, P.ProjectNode):
+            src, in_vars = self.convert(node.source)
+            out, assigns = [], {}
+            for name, t, e in zip(node.output_names, node.output_types,
+                                  node.expressions):
+                v = names.var(name, t)
+                out.append(v)
+                assigns[f"{v.name}<{v.type}>"] = expr_to_protocol(
+                    e, in_vars)
+            return S.ProjectNode(id=nid, source=src,
+                                 assignments=S.Assignments(assigns)), out
+
+        if isinstance(node, P.AggregationNode):
+            src, in_vars = self.convert(node.source)
+            k = len(node.group_fields)
+            gk = [in_vars[f] for f in node.group_fields]
+            out = list(gk)
+            aggregations: Dict[str, S.Aggregation] = {}
+            col = k                         # engine output column cursor
+            for spec in node.aggs:
+                mask = (in_vars[spec.mask_field]
+                        if spec.mask_field is not None else None)
+                if spec.kind == "avg_partial":
+                    # two engine columns: (sum double, count bigint)
+                    a = in_vars[spec.field]
+                    for kind, ret in (("sum", "double"),
+                                      ("count", "bigint")):
+                        v = names.var(node.output_names[col], Type(
+                            "double" if kind == "sum" else "bigint"))
+                        aggregations[f"{v.name}<{v.type}>"] = \
+                            S.Aggregation(call=_agg_call(kind, [a], ret),
+                                          mask=mask)
+                        out.append(v)
+                        col += 1
+                    continue
+                t = node.output_types[col]
+                v = names.var(node.output_names[col], t)
+                if spec.kind == "count_star":
+                    call = _agg_call("count", [], type_sig(t))
+                elif spec.kind == "avg_final":
+                    call = _agg_call("avg_final",
+                                     [in_vars[spec.field],
+                                      in_vars[spec.field2]], type_sig(t))
+                elif spec.kind == "approx_percentile":
+                    from presto_tpu.types import DOUBLE
+                    call = _agg_call(spec.kind, [in_vars[spec.field]],
+                                     type_sig(t))
+                    call.arguments.append(
+                        encode_constant(float(spec.param or 0.5), DOUBLE))
+                else:
+                    call = _agg_call(spec.kind, [in_vars[spec.field]],
+                                     type_sig(t))
+                aggregations[f"{v.name}<{v.type}>"] = S.Aggregation(
+                    call=call, mask=mask)
+                out.append(v)
+                col += 1
+            step = {P.Step.SINGLE: "SINGLE", P.Step.PARTIAL: "PARTIAL",
+                    P.Step.FINAL: "FINAL"}[node.step]
+            return S.AggregationNode(
+                id=nid, source=src, aggregations=aggregations,
+                groupingSets=S.GroupingSetDescriptor(
+                    groupingKeys=gk, groupingSetCount=1,
+                    globalGroupingSets=[0] if k == 0 else []),
+                step=step), out
+
+        if isinstance(node, P.JoinNode):
+            if node.join_type in (P.JoinType.SEMI, P.JoinType.ANTI,
+                                  P.JoinType.ANTI_EXISTS):
+                src, s_vars = self.convert(node.probe)
+                filt, f_vars = self.convert(node.build)
+                if len(node.probe_keys) != 1:
+                    raise NotImplementedError(
+                        "multi-key semi join on the wire")
+                flag = self.names.var("semiflag", Type("boolean"))
+                out = list(s_vars) + ([flag] if node.emit_flag else [])
+                return S.SemiJoinNode(
+                    id=nid, source=src, filteringSource=filt,
+                    sourceJoinVariable=s_vars[node.probe_keys[0]],
+                    filteringSourceJoinVariable=f_vars[node.build_keys[0]],
+                    semiJoinOutput=flag,
+                    xSemiKind=node.join_type.value.upper(),
+                    xEmitFlag=bool(node.emit_flag)), out
+            jt = {P.JoinType.INNER: "INNER", P.JoinType.LEFT: "LEFT",
+                  P.JoinType.FULL: "FULL"}[node.join_type]
+            left, l_vars = self.convert(node.probe)
+            right, r_vars = self.convert(node.build)
+            joined = list(l_vars) + list(r_vars)
+            criteria = [S.EquiJoinClause(l_vars[p], r_vars[b])
+                        for p, b in zip(node.probe_keys, node.build_keys)]
+            filt = (expr_to_protocol(node.filter, joined)
+                    if node.filter is not None else None)
+            return S.JoinNode(id=nid, type=jt, left=left, right=right,
+                              criteria=criteria, outputVariables=joined,
+                              filter=filt), joined
+
+        if isinstance(node, P.GroupIdNode):
+            src, in_vars = self.convert(node.source)
+            gid = names.var(node.output_names[-1], node.output_types[-1])
+            sets = [[in_vars[f] for f in s] for s in node.grouping_sets]
+            return S.GroupIdNode(id=nid, source=src,
+                                 inputVariables=list(in_vars),
+                                 groupingSets=sets,
+                                 groupIdVariable=gid), in_vars + [gid]
+
+        if isinstance(node, P.AssignUniqueIdNode):
+            src, in_vars = self.convert(node.source)
+            v = names.var(node.output_names[-1], node.output_types[-1])
+            return S.AssignUniqueIdNode(id=nid, source=src,
+                                        idVariable=v), in_vars + [v]
+
+        if isinstance(node, P.WindowNode):
+            src, in_vars = self.convert(node.source)
+            spec = S.WindowSpecification(
+                partitionBy=[in_vars[f] for f in node.partition_fields],
+                orderingScheme=(_ordering(node.order_keys, in_vars)
+                                if node.order_keys else None))
+            k = len(node.source.output_types)
+            fns: Dict[str, S.WindowFunction] = {}
+            out = list(in_vars)
+            for i, w in enumerate(node.specs):
+                t = node.output_types[k + i]
+                v = names.var(node.output_names[k + i], t)
+                if w.kind == "count_star":
+                    call = _agg_call("count", [], type_sig(t))
+                else:
+                    args = ([in_vars[w.field]]
+                            if w.field is not None else [])
+                    call = _agg_call(w.kind, args, type_sig(t))
+                fns[f"{v.name}<{v.type}>"] = S.WindowFunction(
+                    functionCall=call)
+                out.append(v)
+            return S.WindowNode(id=nid, source=src, specification=spec,
+                                windowFunctions=fns), out
+
+        if isinstance(node, P.SortNode):
+            src, in_vars = self.convert(node.source)
+            return S.SortNode(id=nid, source=src,
+                              orderingScheme=_ordering(node.keys, in_vars)
+                              ), in_vars
+
+        if isinstance(node, P.TopNNode):
+            src, in_vars = self.convert(node.source)
+            return S.TopNNode(id=nid, source=src, count=node.count,
+                              orderingScheme=_ordering(node.keys, in_vars)
+                              ), in_vars
+
+        if isinstance(node, P.LimitNode):
+            src, in_vars = self.convert(node.source)
+            return S.LimitNode(id=nid, source=src,
+                               count=node.count), in_vars
+
+        if isinstance(node, P.OutputNode):
+            src, in_vars = self.convert(node.source)
+            return S.OutputNode(
+                id=nid, source=src,
+                columnNames=list(node.output_names),
+                outputVariables=in_vars), in_vars
+
+        raise NotImplementedError(
+            f"to_protocol node {type(node).__name__}")
+
+
+_PART_NAMES = {
+    P.Partitioning.SINGLE: "SINGLE",
+    P.Partitioning.HASH: "FIXED_HASH_DISTRIBUTION",
+    P.Partitioning.BROADCAST: "FIXED_BROADCAST_DISTRIBUTION",
+    P.Partitioning.SOURCE: "SOURCE_DISTRIBUTED",
+    P.Partitioning.RANGE: "FIXED_RANGE_DISTRIBUTION",
+}
+
+
+def fragment_to_protocol(frag: EngineFragment) -> FragmentSpec:
+    """One engine fragment -> protocol fragment + scheduling metadata."""
+    conv = _FragmentConverter(_Names())
+    root, out_vars = conv.convert(frag.root)
+    handle = S.PartitioningHandle(connectorHandle={
+        "@type": "$remote",
+        "partitioning": _PART_NAMES[frag.partitioning],
+        "function": ("HASH" if frag.partitioning == P.Partitioning.HASH
+                     else "SINGLE")})
+    scheme = S.PartitioningScheme(
+        partitioning=S.PartitioningScheme_Partitioning(
+            handle=handle,
+            arguments=[out_vars[k] for k in frag.partition_keys]),
+        outputLayout=list(out_vars))
+    pfrag = S.PlanFragment(
+        id=str(frag.fragment_id), root=root, variables=list(out_vars),
+        partitioning=S.PartitioningHandle(connectorHandle={
+            "@type": "$remote",
+            "partitioning": ("SOURCE_DISTRIBUTED" if conv.scan_nodes
+                             else "FIXED_HASH_DISTRIBUTION"),
+            "function": "UNKNOWN"}),
+        tableScanSchedulingOrder=list(conv.scan_order),
+        partitioningScheme=scheme,
+        stageExecutionDescriptor=S.StageExecutionDescriptor())
+    return FragmentSpec(
+        fragment=pfrag, engine_id=frag.fragment_id,
+        scan_nodes=conv.scan_nodes, remote_nodes=conv.remote_nodes,
+        output_partitioning=frag.partitioning,
+        output_keys=tuple(frag.partition_keys))

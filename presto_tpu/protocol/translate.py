@@ -193,9 +193,14 @@ def translate_expr(x, scope: Scope) -> E.RowExpression:
 
 # ------------------------------------------------------------- plan nodes
 
-_AGG_KINDS = {"sum", "count", "min", "max", "avg", "bool_or", "bool_and"}
+_AGG_KINDS = {"sum", "count", "min", "max", "avg", "bool_or", "bool_and",
+              "avg_partial", "approx_distinct", "approx_percentile"}
 
-_JOIN_TYPES = {"INNER": P.JoinType.INNER, "LEFT": P.JoinType.LEFT}
+_JOIN_TYPES = {"INNER": P.JoinType.INNER, "LEFT": P.JoinType.LEFT,
+               "FULL": P.JoinType.FULL}
+
+_SEMI_KINDS = {"SEMI": P.JoinType.SEMI, "ANTI": P.JoinType.ANTI,
+               "ANTI_EXISTS": P.JoinType.ANTI_EXISTS}
 
 
 def _scan_info(node: S.TableScanNode):
@@ -248,7 +253,15 @@ def _out_vars(node) -> List[S.Variable]:
     if isinstance(node, S.JoinNode):
         return node.outputVariables
     if isinstance(node, S.SemiJoinNode):
+        if node.xEmitFlag is False:
+            return _out_vars(node.source)
         return _out_vars(node.source) + [node.semiJoinOutput]
+    if isinstance(node, S.WindowNode):
+        return _out_vars(node.source) + [
+            S.Variable(_var_key_name(k), k.split("<", 1)[1][:-1])
+            for k in node.windowFunctions]
+    if isinstance(node, S.GroupIdNode):
+        return _out_vars(node.source) + [node.groupIdVariable]
     if isinstance(node, (S.LimitNode, S.TopNNode, S.SortNode,
                          S.EnforceSingleRowNode)):
         return _out_vars(node.source)
@@ -308,7 +321,7 @@ def _node(n) -> P.PlanNode:
             if kind == "count" and not agg.call.arguments:
                 kind = "count_star"
             out_t = parse_type(agg.call.returnType)
-            field = None
+            field = field2 = None
             if agg.call.arguments:
                 a0 = agg.call.arguments[0]
                 if not isinstance(a0, S.Variable):
@@ -316,11 +329,25 @@ def _node(n) -> P.PlanNode:
                         "aggregate over non-variable input (planner "
                         "projects arguments first)")
                 field = scope.index[a0.name]
+            param = None
+            if kind == "avg_final":
+                # Engine-extension two-state final: avg_final(sum, count)
+                # (the split the fragmenter makes; Presto carries the same
+                # pair as a ROW intermediate — SURVEY §7.3 hard part #7).
+                a1 = agg.call.arguments[1]
+                field2 = scope.index[a1.name]
+            elif kind == "approx_percentile" \
+                    and len(agg.call.arguments) > 1:
+                lit = decode_constant(agg.call.arguments[1])
+                param = (lit.value / 10 ** lit.type.scale
+                         if lit.type.is_decimal else float(lit.value))
             mask = (scope.index[agg.mask.name]
                     if agg.mask is not None else None)
-            if kind not in _AGG_KINDS and kind != "count_star":
+            if kind not in _AGG_KINDS and kind not in (
+                    "count_star", "avg_final"):
                 raise NotImplementedError(f"aggregate {kind}")
-            aggs.append(AggSpec(kind, field, out_t, mask_field=mask))
+            aggs.append(AggSpec(kind, field, out_t, field2=field2,
+                                mask_field=mask, param=param))
             names.append(_var_key_name(key))
             types.append(out_t)
         out_names = tuple(v.name for v in n.groupingSets.groupingKeys) \
@@ -361,17 +388,24 @@ def _node(n) -> P.PlanNode:
         filt = _node(n.filteringSource)
         sscope = Scope(_out_vars(n.source))
         fscope = Scope(_out_vars(n.filteringSource))
-        out_names = src.output_names + (n.semiJoinOutput.name,)
-        out_types = src.output_types + (BOOLEAN,)
-        # emit_flag: the coordinator consumes semiJoinOutput in its own
-        # FilterNode/projection above, so every probe row must survive
-        # with the match flag as a trailing BOOLEAN column.
+        kind = _SEMI_KINDS[n.xSemiKind or "SEMI"]
+        emit = True if n.xEmitFlag is None else bool(n.xEmitFlag)
+        if emit:
+            out_names = src.output_names + (n.semiJoinOutput.name,)
+            out_types = src.output_types + (BOOLEAN,)
+        else:
+            out_names = src.output_names
+            out_types = src.output_types
+        # emit_flag (Presto semantics): the coordinator consumes
+        # semiJoinOutput in its own FilterNode/projection above, so every
+        # probe row survives with the match flag as a trailing BOOLEAN
+        # column. xEmitFlag=False = engine plans that filter internally.
         return P.JoinNode(
             out_names, out_types, probe=src, build=filt,
-            join_type=P.JoinType.SEMI,
+            join_type=kind,
             probe_keys=(sscope.index[n.sourceJoinVariable.name],),
             build_keys=(fscope.index[n.filteringSourceJoinVariable.name],),
-            filter=None, emit_flag=True)
+            filter=None, emit_flag=emit)
 
     if isinstance(n, S.LimitNode):
         src = _node(n.source)
@@ -411,6 +445,53 @@ def _node(n) -> P.PlanNode:
         return P.AssignUniqueIdNode(
             src.output_names + (n.idVariable.name,),
             src.output_types + (BIGINT,), source=src)
+
+    if isinstance(n, S.GroupIdNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        sets = tuple(tuple(scope.index[v.name] for v in s)
+                     for s in n.groupingSets)
+        union = tuple(sorted({f for s in sets for f in s}))
+        return P.GroupIdNode(
+            src.output_names + (n.groupIdVariable.name,),
+            src.output_types + (parse_type(n.groupIdVariable.type),),
+            source=src, grouping_sets=sets, key_fields=union)
+
+    if isinstance(n, S.RemoteSourceNode):
+        names = tuple(v.name for v in n.outputVariables)
+        types = tuple(parse_type(v.type) for v in n.outputVariables)
+        return P.RemoteSourceNode(names, types, node_id=n.id,
+                                  source_fragment_ids=tuple(
+                                      n.sourceFragmentIds))
+
+    if isinstance(n, S.WindowNode):
+        from presto_tpu.ops.window import WindowSpec
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        spec = n.specification or S.WindowSpecification()
+        pf = tuple(scope.index[v.name] for v in spec.partitionBy)
+        order = (_sort_keys(spec.orderingScheme, scope)
+                 if spec.orderingScheme is not None else ())
+        specs, names, types = [], [], []
+        for key, wf in n.windowFunctions.items():
+            kind = _fn_name(wf.functionCall)
+            if kind == "count" and not wf.functionCall.arguments:
+                kind = "count_star"
+            out_t = parse_type(wf.functionCall.returnType)
+            field = None
+            if wf.functionCall.arguments:
+                a0 = wf.functionCall.arguments[0]
+                if not isinstance(a0, S.Variable):
+                    raise NotImplementedError(
+                        "window function over non-variable input")
+                field = scope.index[a0.name]
+            specs.append(WindowSpec(kind, field, out_t))
+            names.append(_var_key_name(key))
+            types.append(out_t)
+        return P.WindowNode(
+            src.output_names + tuple(names),
+            src.output_types + tuple(types), source=src,
+            partition_fields=pf, order_keys=order, specs=tuple(specs))
 
     if isinstance(n, S.ExchangeNode):
         # Local exchanges are no-ops for a whole-fragment jit executor;

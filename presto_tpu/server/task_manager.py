@@ -14,10 +14,14 @@ import threading
 import time
 import traceback
 import uuid
+import zlib
 from typing import Dict, List, Optional, Tuple
 
-from presto_tpu.data.column import Page
+import numpy as np
+
+from presto_tpu.data.column import Page, concat_pages_host, select_page_host
 from presto_tpu.exec.split_executor import SplitExecutor
+from presto_tpu.plan.nodes import RemoteSourceNode
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.serde import (
     encode_serialized_page, page_to_wire_blocks,
@@ -47,6 +51,57 @@ def _scan_tables(frag: S.PlanFragment) -> Dict[str, str]:
     return out
 
 
+def _remote_source_nodes(plan) -> List[RemoteSourceNode]:
+    """Engine-plan walk: every RemoteSourceNode (pull inputs)."""
+    out: List[RemoteSourceNode] = []
+
+    def walk(n):
+        if isinstance(n, RemoteSourceNode):
+            out.append(n)
+        for c in n.children():
+            walk(c)
+    walk(plan)
+    return out
+
+
+def _hash_partition_ids(page: Page, channels: Tuple[int, ...],
+                        nbuf: int) -> np.ndarray:
+    """Host-side row -> destination partition. Any hash works as long as
+    every producer task of a stage agrees (reference:
+    operator/InterpretedHashGenerator.java — consistency matters, the
+    exact function only matters for bucketed-table interop). Strings hash
+    their *bytes* (crc32), not dictionary codes — codes are per-task."""
+    n = int(page.num_rows)
+    acc = np.zeros(n, np.uint64)
+    mult = np.uint64(0x9E3779B97F4A7C15)
+    for ch in channels:
+        c = page.columns[ch]
+        v, nl = c.to_numpy(n)
+        if c.type.is_string and c.dictionary is not None:
+            words = c.dictionary.words
+            wh = np.array([zlib.crc32(w.encode()) for w in words]
+                          or [0], dtype=np.uint64)
+            h = wh[np.clip(v, 0, len(wh) - 1)]
+        elif v.dtype.kind == "f":
+            # canonicalize like ops/keys.group_values so SQL-equal floats
+            # hash equal across producers (-0.0 == 0.0; one NaN class)
+            vf = np.asarray(v, dtype=np.float64).copy()
+            vf[vf == 0.0] = 0.0
+            vf[np.isnan(vf)] = np.nan
+            h = vf.view(np.uint64).copy()
+        elif v.dtype.kind == "b":
+            h = v.astype(np.uint64)
+        else:
+            h = v.astype(np.int64).view(np.uint64)
+        h = np.where(nl, np.uint64(0), h)
+        acc = acc * mult + h
+    # splittable-mix finish so low-entropy keys spread
+    acc ^= acc >> np.uint64(33)
+    acc *= np.uint64(0xFF51AFD7ED558CCD)
+    acc ^= acc >> np.uint64(33)
+    return (acc % np.uint64(max(nbuf, 1))).astype(np.int64)
+
+
 class Task:
     def __init__(self, task_id: str):
         self.task_id = task_id
@@ -58,6 +113,9 @@ class Task:
         self.buffers: Optional[OutputBufferManager] = None
         self.fragment: Optional[S.PlanFragment] = None
         self.splits: Dict[str, List[Tuple[int, int]]] = {}
+        # planNodeId -> [(upstream task uri, buffer id)] (RemoteSplit role:
+        # presto-main-base/.../split/RemoteSplit.java — location + token)
+        self.remote_splits: Dict[str, List[Tuple[str, str]]] = {}
         self.scan_tables: Dict[str, str] = {}
         self.seen_splits: set = set()
         self.pending_splits: List[S.ScheduledSplit] = []
@@ -142,6 +200,11 @@ class TpuTaskManager:
             if task.fragment is not None:
                 for ss in task.pending_splits:
                     cs = ss.split.connectorSplit or {}
+                    if "location" in cs:
+                        task.remote_splits.setdefault(
+                            ss.planNodeId, []).append(
+                            (cs["location"], str(cs.get("bufferId", "0"))))
+                        continue
                     table = task.scan_tables.get(ss.planNodeId)
                     if table is not None:
                         task.splits.setdefault(table, []).append(
@@ -174,13 +237,10 @@ class TpuTaskManager:
                      if k in known}
             ex = SplitExecutor(self.connector, session=Session(props))
             ex.set_splits(task.splits)
+            remote = self._pull_remote_inputs(task, plan)
+            ex.set_remote_pages(remote)
             page = ex.execute(plan)
-            frame = self._serialize(page)
-            task.bytes_out = len(frame)
-            with self.lock:
-                self.total_bytes_out += len(frame)
-            first = sorted(task.buffers.buffers)[0]
-            task.buffers.add_page(first, frame)
+            self._emit_output(task, page)
             task.buffers.set_no_more_pages()
             task.set_state("FINISHED")
         except Exception:
@@ -188,6 +248,107 @@ class TpuTaskManager:
             if task.buffers is not None:
                 task.buffers.set_no_more_pages()
             task.set_state("FAILED")
+
+    def _pull_remote_inputs(self, task: Task, plan) -> Dict[str, Page]:
+        """Drain every upstream page stream this task's remote splits name
+        and fuse them into one engine Page per RemoteSourceNode (consumer
+        side of the pull protocol — ExchangeClient.java:255 semantics,
+        batch-materialized for the jit engine)."""
+        from presto_tpu.protocol.exchange_client import (
+            PageStream, decode_pages,
+        )
+
+        out: Dict[str, Page] = {}
+        for node in _remote_source_nodes(plan):
+            splits = task.remote_splits.get(node.node_id, [])
+            # concurrent drains (reference: ExchangeClient's parallel
+            # PageBufferClients) — producer latencies overlap
+            datas: List[Optional[bytes]] = [None] * len(splits)
+            errs: List[Optional[BaseException]] = [None] * len(splits)
+
+            def pull(i, location, buffer_id):
+                try:
+                    datas[i] = PageStream(
+                        location, buffer_id=buffer_id).drain()
+                except BaseException as e:   # noqa: BLE001 — re-raised
+                    errs[i] = e
+            threads = [threading.Thread(target=pull, args=(i, loc, b))
+                       for i, (loc, b) in enumerate(splits)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for e in errs:
+                if e is not None:
+                    raise e
+            pages = []
+            for data in datas:
+                pages.extend(decode_pages(data, list(node.output_types)))
+            if not pages:
+                # no producer emitted rows: empty page of the right shape
+                from presto_tpu.data.column import Column
+                cols = [Column.from_numpy(
+                    np.zeros(0, t.dtype), t, capacity=256)
+                    for t in node.output_types]
+                out[node.node_id] = Page.from_columns(
+                    cols, 0, node.output_names)
+                continue
+            for p in pages:
+                p.names = node.output_names
+            out[node.node_id] = concat_pages_host(pages)
+        return out
+
+    def _emit_output(self, task: Task, page: Page):
+        """Route the fragment result into output buffers per the
+        fragment's PartitioningScheme (producer side of the exchange:
+        PartitionedOutputOperator.java:57 hash split,
+        BroadcastOutputBuffer replication, TaskOutputOperator single)."""
+        scheme = task.fragment.partitioningScheme
+        handle = ((scheme.partitioning.handle.connectorHandle or {})
+                  if scheme and scheme.partitioning else {})
+        kind = handle.get("partitioning", "SINGLE")
+        buffer_ids = sorted(
+            task.buffers.buffers,
+            key=lambda b: (0, int(b)) if b.isdigit() else (1, b))
+        nbuf = len(buffer_ids)
+
+        def emit(buffer_id: str, frame: bytes):
+            task.bytes_out += len(frame)
+            with self.lock:
+                self.total_bytes_out += len(frame)
+            task.buffers.add_page(buffer_id, frame)
+
+        if kind in ("FIXED_BROADCAST_DISTRIBUTION", "SINGLE") \
+                and nbuf > 1:
+            # BROADCAST — and SINGLE gathers shared by several consumers:
+            # every buffer receives the full output (each consumer task
+            # owns one buffer; token/ack state is per-buffer).
+            frame = self._serialize(page)
+            for b in buffer_ids:
+                emit(b, frame)
+            return
+        if kind in ("FIXED_ARBITRARY_DISTRIBUTION",
+                    "ARBITRARY_DISTRIBUTION") and nbuf > 1:
+            # round-robin repartition (reference: ArbitraryOutputBuffer)
+            n = int(page.num_rows)
+            for b_idx, b in enumerate(buffer_ids):
+                idx = np.arange(b_idx, n, nbuf)
+                emit(b, self._serialize(select_page_host(page, idx)))
+            return
+        if kind != "FIXED_HASH_DISTRIBUTION" and nbuf > 1:
+            raise NotImplementedError(
+                f"output partitioning {kind} with {nbuf} buffers")
+        if kind == "FIXED_HASH_DISTRIBUTION" and nbuf > 1:
+            layout = {v.name: i for i, v in enumerate(scheme.outputLayout)}
+            channels = tuple(layout[v.name]
+                             for v in scheme.partitioning.arguments)
+            pid = _hash_partition_ids(page, channels, nbuf)
+            for b_idx, b in enumerate(buffer_ids):
+                idx = np.nonzero(pid == b_idx)[0]
+                emit(b, self._serialize(select_page_host(page, idx)))
+            return
+        # SINGLE (and the 1-buffer degenerate of every other scheme)
+        emit(buffer_ids[0], self._serialize(page))
 
     def _serialize(self, page: Page) -> bytes:
         blocks = page_to_wire_blocks(page)

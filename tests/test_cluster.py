@@ -1,0 +1,88 @@
+"""Multi-worker cluster: TPC-H through N real workers over HTTP.
+
+Round-2+ acceptance: the coordinator-side scheduler (server/cluster.py)
+fragments each query, POSTs TaskUpdateRequests to worker HTTP servers,
+wires remote-source splits to producer task locations, workers
+hash-partition output across buffers and pull upstream streams token/ack
+— the full Presto task/exchange protocol end-to-end, then results are
+checked against the same sqlite oracle as the local suite.
+
+Reference harness role: DistributedQueryRunner + externalWorkerLauncher
+(PrestoNativeQueryRunnerUtils.java:306) — N servers, real wire traffic.
+
+The full 22-query run works (verified out-of-band) but costs ~30 min of
+XLA CPU compiles; the default suite runs a representative subset that
+still covers every exchange kind (hash repartition, broadcast, single
+gather, partial/final aggregation, semi join, scalar subquery). Set
+PRESTO_TPU_CLUSTER_FULL=1 for all 22.
+"""
+
+import os
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.server.cluster import TpuCluster
+from tests.test_tpch_full import SF, oracle, run_case  # noqa: F401
+from tests.tpch_queries import QUERIES
+
+# hash+broadcast joins (3, 10), global agg (6), grouped agg (1), LEFT
+# join + agg (13), semi/anti (4, 16, 22), subquery literal (14, 15)
+_SUBSET = (1, 3, 4, 6, 10, 13, 14, 15, 16, 22)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = TpuCluster(TpchConnector(SF), n_workers=2)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(autouse=True)
+def _drop_compile_caches():
+    yield
+    import jax
+    jax.clear_caches()
+
+
+_QS = sorted(QUERIES) if os.environ.get("PRESTO_TPU_CLUSTER_FULL") \
+    else _SUBSET
+
+
+@pytest.mark.parametrize("qnum", _QS)
+def test_tpch_cluster(qnum, cluster, oracle):  # noqa: F811
+    run_case(qnum, cluster, oracle)
+
+
+def test_worker_failure_recovery(oracle):  # noqa: F811
+    """Failure detection + query retry (reference:
+    HeartbeatFailureDetector + dispatcher-level retry): killing a worker
+    mid-cluster excludes it and the query succeeds on the survivors."""
+    c = TpuCluster(TpchConnector(SF), n_workers=3)
+    try:
+        sql = ("select l_returnflag, count(*) from lineitem "
+               "group by l_returnflag order by l_returnflag")
+        before = c.execute_sql(sql)
+        assert len(c.worker_uris) == 3
+        c.workers[2].stop()                  # node dies
+        after = c.execute_sql(sql)           # retried on survivors
+        assert after == before
+        assert len(c.worker_uris) == 2
+    finally:
+        for w in c.workers[:2]:
+            w.stop()
+
+
+def test_worker_task_accounting(cluster, oracle):  # noqa: F811
+    """After queries ran, workers report lifecycle/metrics state."""
+    import json
+    import urllib.request
+
+    for uri in cluster.worker_uris:
+        with urllib.request.urlopen(f"{uri}/v1/status", timeout=10) as r:
+            st = json.loads(r.read())
+        assert "taskCount" in st
+        with urllib.request.urlopen(f"{uri}/v1/info/metrics",
+                                    timeout=10) as r:
+            body = r.read().decode()
+        assert "presto_tpu_task_bytes_out" in body
