@@ -154,7 +154,7 @@ def _main_orchestrator(sf, qids) -> None:
     line unconditionally)."""
     import subprocess
 
-    timeout_s = float(os.environ.get("BENCH_QUERY_TIMEOUT", "900"))
+    timeout_s = float(os.environ.get("BENCH_QUERY_TIMEOUT", "2400"))
     detail = {}
     for qid in qids:
         env = dict(os.environ, BENCH_CHILD="1", BENCH_QUERIES=str(qid))

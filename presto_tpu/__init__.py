@@ -41,6 +41,28 @@ try:
 except (ImportError, ValueError, OSError):  # non-POSIX or locked down
     pass
 
+# Persistent compilation cache: TPU compiles of big fragment programs run
+# minutes through the remote-compile service (Q1's direct-aggregation
+# program: ~18 min cold); cached executables load in <1 s, so a process
+# restart (bench per-query subprocesses, worker restarts) doesn't repay
+# the compile. Reference role: the JVM's C2-warmed operator factories
+# simply persist in-process; here the cache file is the analog.
+# Opt out with PRESTO_TPU_NO_COMPILE_CACHE=1.
+import os as _os
+
+if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = _os.environ.get(
+        "PRESTO_TPU_COMPILE_CACHE",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      _os.pardir, ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:   # noqa: BLE001 — cache is best-effort
+        pass
+
 from presto_tpu.types import (  # noqa: E402
     BOOLEAN, TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE, VARCHAR, DATE,
     TIMESTAMP, DecimalType, Type,
