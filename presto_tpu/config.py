@@ -60,6 +60,11 @@ PROPERTIES = [
     Property("collect_stats",
              "Record per-node output row counts for EXPLAIN ANALYZE",
              _parse_bool, False),
+    Property("cte_materialization_enabled",
+             "Execute WITH subqueries referenced more than once into "
+             "temp tables instead of inlining per reference (reference: "
+             "PhysicalCteOptimizer / cte_materialization_strategy)",
+             _parse_bool, False),
     Property("spill_enabled",
              "Offload accumulated lifespan partials from device HBM to "
              "host RAM (reference: spiller/ + revocable memory)",

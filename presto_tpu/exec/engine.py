@@ -17,9 +17,19 @@ from presto_tpu.sql.parser import parse_sql
 
 class LocalEngine:
     def __init__(self, connector, session=None, history=None):
+        from presto_tpu.config import Session
+
+        s = session or Session()
+        if s["cte_materialization_enabled"]:
+            # temp tables for materialized CTEs live in a memory overlay
+            # over the catalog (reference: PhysicalCteOptimizer writing
+            # to the configured temp-table storage)
+            from presto_tpu.connectors.memory import MemoryConnector
+            if not hasattr(connector, "create"):
+                connector = MemoryConnector(fallback=connector)
         self.connector = connector
         self.planner = Planner(connector)
-        self.executor = Executor(connector, session=session)
+        self.executor = Executor(connector, session=s)
         self._plans = {}
         # HBO store (plan/stats.HistoryStore): observed node row counts
         # recorded after execution, consulted by the next planning
@@ -38,23 +48,45 @@ class LocalEngine:
         return explain(self.plan_sql(sql))
 
     def execute_sql(self, sql: str) -> List[tuple]:
+        from presto_tpu.utils.tracing import query_lifecycle
+
+        LocalEngine._qid += 1
+        qid = f"local_{LocalEngine._qid}"
+        with query_lifecycle(qid, sql) as box:
+            box[0] = self._execute_sql_inner(sql, qid)
+        return box[0]
+
+    _qid = 0
+
+    def _execute_sql_inner(self, sql: str, qid: str) -> List[tuple]:
+        from presto_tpu.utils import TRACER
+
         head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
         if head in ("create", "insert", "drop"):
             return self._execute_statement(sql)
+        if self.session["cte_materialization_enabled"] \
+                and parse_sql(sql).ctes:
+            # CTE-free queries keep the normal path (lifespan batching,
+            # HBO recording); only WITH queries take the rewrite
+            return self._execute_with_cte_materialization(sql, qid)
+        with TRACER.span(qid, "plan"):
+            plan = self.plan_sql(sql)
         n = self.session["lifespan_batches"]
         if n and n > 1:
             from presto_tpu.exec.lifespan import execute_batched
             self.last_lifespan_stats = {}
-            page = execute_batched(
-                self.connector, self.plan_sql(sql), n,
-                self.session["query_max_memory_per_node"],
-                session=self.session, stats=self.last_lifespan_stats)
+            with TRACER.span(qid, "execute", mode="lifespan", batches=n):
+                page = execute_batched(
+                    self.connector, plan, n,
+                    self.session["query_max_memory_per_node"],
+                    session=self.session, stats=self.last_lifespan_stats)
             # batched runs use their own executors — no per-node counters
             # here, and stale ones from an earlier direct execution must
             # not be re-recorded against this query
             self.executor.last_node_rows = {}
         else:
-            page = self.executor.execute(self.plan_sql(sql))
+            with TRACER.span(qid, "execute", mode="direct"):
+                page = self.executor.execute(plan)
             self._record_history()
         return page.to_pylist()
 
@@ -70,6 +102,35 @@ class LocalEngine:
             entry = self.executor._node_map.get(nid)
             if entry is not None:
                 self.history.record(canonical_key(entry[0]), rows)
+
+    def _execute_with_cte_materialization(self, sql: str, qid: str
+                                          ) -> List[tuple]:
+        """Multiply-referenced CTEs execute once into memory-overlay temp
+        tables (exec/cte.py; reference PhysicalCteOptimizer.java:126)."""
+        from presto_tpu.exec.cte import materialize_ctes
+        from presto_tpu.utils import TRACER
+
+        q = parse_sql(sql)
+
+        def run_select(sub_q):
+            plan = self.planner.plan_query(sub_q)
+            page = self.executor.execute(plan)
+            return (page.to_pylist(), list(plan.output_names),
+                    list(plan.output_types))
+
+        with TRACER.span(qid, "materialize_ctes"):
+            q, temps = materialize_ctes(q, run_select, self.connector)
+        try:
+            with TRACER.span(qid, "plan"):
+                plan = self.planner.plan_query(q)
+            with TRACER.span(qid, "execute", mode="direct",
+                             materialized_ctes=len(temps)):
+                page = self.executor.execute(plan)
+            self._record_history()
+            return page.to_pylist()
+        finally:
+            for t in temps:
+                self.connector.drop(t, if_exists=True)
 
     # ------------------------------------------------------------ DDL/DML
     def _execute_statement(self, sql: str) -> List[tuple]:

@@ -130,12 +130,17 @@ class TpuCluster:
     attached to externally-started servers via `worker_uris`."""
 
     def __init__(self, connector, n_workers: int = 2,
-                 session_properties: Optional[Dict[str, str]] = None):
+                 session_properties: Optional[Dict[str, str]] = None,
+                 resource_groups=None):
+        from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
 
         self.connector = connector
         self.planner = Planner(connector)
         self.session_properties = dict(session_properties or {})
+        # admission control (reference: InternalResourceGroupManager
+        # gating DispatchManager.createQueryInternal)
+        self.resource_groups = resource_groups or ResourceGroupManager()
         self.workers: List[TpuWorkerServer] = [
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}").start()
             for i in range(n_workers)]
@@ -180,7 +185,18 @@ class TpuCluster:
         return self._plans[sql]
 
     def execute_sql(self, sql: str) -> List[tuple]:
-        return self._execute_plan(self.plan_sql(sql))
+        from presto_tpu.utils.tracing import query_lifecycle
+
+        with self._lock:
+            self._query_counter += 1
+            qid = f"cluster_q{self._query_counter}"
+        with query_lifecycle(qid, sql) as box:
+            group = self.resource_groups.select(
+                user=self.session_properties.get("user", ""),
+                source=self.session_properties.get("source", ""))
+            with group.acquire(timeout_s=600):
+                box[0] = self._execute_plan(self.plan_sql(sql))
+        return box[0]
 
     def _execute_plan(self, plan: PlanNode, _retried: bool = False
                       ) -> List[tuple]:

@@ -1,0 +1,104 @@
+"""Resource groups — admission control for cluster queries.
+
+Reference: execution/resourceGroups/InternalResourceGroupManager.java:86 +
+InternalResourceGroup (hierarchical groups, per-group concurrency and
+queue limits, selector rules mapping sessions to groups;
+presto-resource-group-managers' file-based config). Collapsed to its
+functional core: flat named groups with hard-concurrency / max-queued
+limits and first-match selectors on (user, source); queries block FIFO
+for a slot or are rejected with QUERY_QUEUE_FULL."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import List, Optional, Tuple
+
+
+class QueryQueueFull(RuntimeError):
+    """Reference: QUERY_QUEUE_FULL StandardErrorCode."""
+
+
+@dataclasses.dataclass
+class ResourceGroup:
+    name: str
+    hard_concurrency: int = 4
+    max_queued: int = 16
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._slots = threading.Semaphore(self.hard_concurrency)
+        self._queued = 0
+        self.stats = {"admitted": 0, "rejected": 0, "peak_queued": 0}
+
+    def acquire(self, timeout_s: Optional[float] = None):
+        # a free slot admits immediately — max_queued only limits WAITING
+        # queries (max_queued=0 == run-or-reject, the reference semantics)
+        if self._slots.acquire(blocking=False):
+            with self._lock:
+                self.stats["admitted"] += 1
+            return _Slot(self)
+        with self._lock:
+            if self._queued >= self.max_queued:
+                self.stats["rejected"] += 1
+                raise QueryQueueFull(
+                    f"group {self.name}: {self._queued} queued "
+                    f">= max_queued {self.max_queued}")
+            self._queued += 1
+            self.stats["peak_queued"] = max(self.stats["peak_queued"],
+                                            self._queued)
+        ok = self._slots.acquire(timeout=timeout_s)
+        with self._lock:
+            self._queued -= 1
+        if not ok:
+            raise QueryQueueFull(
+                f"group {self.name}: no slot within {timeout_s}s")
+        with self._lock:
+            self.stats["admitted"] += 1
+        return _Slot(self)
+
+
+class _Slot:
+    def __init__(self, group: ResourceGroup):
+        self.group = group
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.group._slots.release()
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Selector:
+    """First-match rule (reference: StaticSelector user/source regexes)."""
+    group: str
+    user_regex: Optional[str] = None
+    source_regex: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user_regex and not re.fullmatch(self.user_regex, user):
+            return False
+        if self.source_regex and not re.fullmatch(self.source_regex,
+                                                  source):
+            return False
+        return True
+
+
+class ResourceGroupManager:
+    def __init__(self, groups: Optional[List[ResourceGroup]] = None,
+                 selectors: Optional[List[Selector]] = None):
+        gs = groups or [ResourceGroup("global")]
+        self.groups = {g.name: g for g in gs}
+        self.selectors = selectors or [Selector(gs[0].name)]
+
+    def select(self, user: str = "", source: str = "") -> ResourceGroup:
+        for s in self.selectors:
+            if s.matches(user, source):
+                return self.groups[s.group]
+        raise QueryQueueFull(f"no resource group matches user={user!r}")
+
+    def info(self) -> List[Tuple[str, dict]]:
+        return [(n, dict(g.stats)) for n, g in sorted(self.groups.items())]

@@ -1,0 +1,11 @@
+from presto_tpu.utils.tracing import (
+    EVENTS, TRACER, EventListenerManager, QueryEvent, Span, Tracer,
+)
+
+__all__ = ["EVENTS", "TRACER", "EventListenerManager", "QueryEvent",
+           "Span", "Tracer"]
+from presto_tpu.utils.verifier import (  # noqa: E402
+    ColumnChecksum, VerificationResult, Verifier,
+)
+
+__all__ += ["ColumnChecksum", "VerificationResult", "Verifier"]

@@ -1,0 +1,127 @@
+"""Tracing + query-event pipeline.
+
+Reference roles:
+  - spi/tracing/Tracer.java + TracerProvider (SURVEY.md §5.1): named
+    spans with wall-time points, queryable per query. SimpleTracer's
+    add-point/get-points surface, W3C-style nesting flattened to
+    (name, start, end, attributes) records.
+  - spi/eventlistener (QueryCreatedEvent / QueryCompletedEvent /
+    SplitCompletedEvent -> eventlistener/EventListenerManager.java +
+    event/QueryMonitor.java, SURVEY.md §5.5): registered listeners get
+    lifecycle events with timing/stats payloads.
+
+Engines call `tracer.span(...)` around phases (plan/lower/execute) and
+`emit_query_event(...)` at lifecycle edges; listeners are plain
+callables (the plugin surface collapsed to its functional core)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    start: float
+    end: Optional[float] = None
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+
+class Tracer:
+    """Per-process tracer: spans grouped by trace id (query id). Bounded:
+    only the most recent `max_traces` query traces are retained (the
+    reference's QueryTracker similarly caps finished-query history)."""
+
+    def __init__(self, max_traces: int = 256):
+        self._lock = threading.Lock()
+        self.max_traces = max_traces
+        self.spans: Dict[str, List[Span]] = {}
+
+    @contextmanager
+    def span(self, trace_id: str, name: str, **attributes):
+        s = Span(name, time.time(), attributes=dict(attributes))
+        with self._lock:
+            self.spans.setdefault(trace_id, []).append(s)
+            while len(self.spans) > self.max_traces:
+                self.spans.pop(next(iter(self.spans)))   # oldest insert
+        try:
+            yield s
+        finally:
+            s.end = time.time()
+
+    def get(self, trace_id: str) -> List[Span]:
+        with self._lock:
+            return list(self.spans.get(trace_id, []))
+
+    def render(self, trace_id: str) -> str:
+        out = []
+        for s in self.get(trace_id):
+            d = f"{s.duration_s * 1000:.1f}ms" if s.end else "…"
+            attrs = " ".join(f"{k}={v}" for k, v in s.attributes.items())
+            out.append(f"{s.name:<24} {d:>10} {attrs}")
+        return "\n".join(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryEvent:
+    """QueryCreated/QueryCompleted payload subset (reference:
+    spi/eventlistener/QueryCompletedEvent.java)."""
+    kind: str                 # "created" | "completed" | "failed"
+    query_id: str
+    sql: str
+    wall_s: Optional[float] = None
+    rows: Optional[int] = None
+    error: Optional[str] = None
+
+
+class EventListenerManager:
+    def __init__(self):
+        self._listeners: List[Callable[[QueryEvent], None]] = []
+        self._lock = threading.Lock()
+
+    def register(self, listener: Callable[[QueryEvent], None]):
+        with self._lock:
+            self._listeners.append(listener)
+
+    def emit(self, event: QueryEvent):
+        with self._lock:
+            listeners = list(self._listeners)
+        for cb in listeners:
+            try:
+                cb(event)
+            except Exception:   # noqa: BLE001 — listeners must not kill queries
+                pass
+
+
+# process-wide defaults (the Guice-singleton analog)
+TRACER = Tracer()
+EVENTS = EventListenerManager()
+
+
+@contextmanager
+def query_lifecycle(qid: str, sql: str):
+    """Shared created/failed/completed emission around one query's
+    execution (used by LocalEngine and TpuCluster). Yields a one-slot
+    list the body fills with the result rows so `completed` can report
+    the row count."""
+    t0 = time.time()
+    EVENTS.emit(QueryEvent("created", qid, sql))
+    box: List[Any] = [None]
+    try:
+        yield box
+    except Exception as e:
+        EVENTS.emit(QueryEvent("failed", qid, sql,
+                               wall_s=time.time() - t0, error=str(e)))
+        raise
+    rows = box[0]
+    EVENTS.emit(QueryEvent(
+        "completed", qid, sql, wall_s=time.time() - t0,
+        rows=len(rows) if rows is not None else None))

@@ -1,0 +1,128 @@
+"""Verifier — A/B query verification between two engines.
+
+Reference: presto-verifier (framework/AbstractVerification.java:74 +
+checksum/): replay queries against a *control* and a *test* engine and
+compare per-column checksums rather than raw row dumps, with
+floating-point tolerance and row-count checks; emit a structured
+VerificationResult per query.
+
+Here the two engines are any objects with `execute_sql` +`plan_sql`
+(LocalEngine / DistEngine / TpuCluster), which is exactly how the
+reference verifies the C++ worker against the Java engine — and how this
+framework pins its distributed paths against the single-device engine."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+import zlib
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class ColumnChecksum:
+    """Per-column order-insensitive checksum (reference:
+    checksum/ChecksumValidator's per-type column checksums)."""
+    count: int
+    null_count: int
+    # SUM (mod 2^64) of per-value crcs — additive, so even multiplicities
+    # cannot cancel (XOR would report crc(x)^crc(x) == crc(y)^crc(y))
+    checksum: int
+    float_sum: Optional[float]    # sum for approx comparison (floats)
+
+    def matches(self, other: "ColumnChecksum",
+                rel_tol: float = 1e-6) -> bool:
+        if (self.count, self.null_count) != (other.count,
+                                             other.null_count):
+            return False
+        if self.float_sum is not None or other.float_sum is not None:
+            a = self.float_sum or 0.0
+            b = other.float_sum or 0.0
+            return math.isclose(a, b, rel_tol=rel_tol,
+                                abs_tol=rel_tol)
+        return self.checksum == other.checksum
+
+
+def column_checksums(rows: Sequence[tuple]) -> List[ColumnChecksum]:
+    if not rows:
+        return []
+    ncol = len(rows[0])
+    out = []
+    for c in range(ncol):
+        vals = [r[c] for r in rows]
+        nulls = sum(1 for v in vals if v is None)
+        is_float = any(isinstance(v, float) for v in vals)
+        if is_float:
+            s = sum(v for v in vals if v is not None)
+            out.append(ColumnChecksum(len(vals), nulls, 0, float(s)))
+        else:
+            x = 0
+            for v in vals:
+                if v is not None:
+                    x = (x + zlib.crc32(repr(v).encode())) % (1 << 64)
+            out.append(ColumnChecksum(len(vals), nulls, x, None))
+    return out
+
+
+@dataclasses.dataclass
+class VerificationResult:
+    sql: str
+    status: str                   # MATCH | MISMATCH | CONTROL_FAILED |
+    #                               TEST_FAILED
+    control_rows: Optional[int] = None
+    test_rows: Optional[int] = None
+    control_s: Optional[float] = None
+    test_s: Optional[float] = None
+    detail: str = ""
+
+
+class Verifier:
+    def __init__(self, control, test, rel_tol: float = 1e-6):
+        self.control = control
+        self.test = test
+        self.rel_tol = rel_tol
+
+    def verify(self, sql: str) -> VerificationResult:
+        try:
+            t0 = time.time()
+            control_rows = self.control.execute_sql(sql)
+            control_s = time.time() - t0
+        except Exception as e:    # noqa: BLE001 — reported, not raised
+            return VerificationResult(sql, "CONTROL_FAILED",
+                                      detail=str(e)[:500])
+        try:
+            t0 = time.time()
+            test_rows = self.test.execute_sql(sql)
+            test_s = time.time() - t0
+        except Exception as e:    # noqa: BLE001 — reported, not raised
+            return VerificationResult(
+                sql, "TEST_FAILED", control_rows=len(control_rows),
+                control_s=control_s, detail=str(e)[:500])
+
+        r = VerificationResult(sql, "MATCH", len(control_rows),
+                               len(test_rows), control_s, test_s)
+        if len(control_rows) != len(test_rows):
+            r.status = "MISMATCH"
+            r.detail = f"row count {len(control_rows)} != {len(test_rows)}"
+            return r
+        a = column_checksums(sorted(control_rows, key=_row_key))
+        b = column_checksums(sorted(test_rows, key=_row_key))
+        if len(a) != len(b):
+            r.status = "MISMATCH"
+            r.detail = f"column count {len(a)} != {len(b)}"
+            return r
+        for i, (x, y) in enumerate(zip(a, b)):
+            if not x.matches(y, self.rel_tol):
+                r.status = "MISMATCH"
+                r.detail = f"column {i} checksum mismatch ({x} vs {y})"
+                return r
+        return r
+
+    def verify_suite(self, queries: Sequence[str]
+                     ) -> List[VerificationResult]:
+        return [self.verify(q) for q in queries]
+
+
+def _row_key(row):
+    return tuple((v is None, str(type(v)), v) for v in row)

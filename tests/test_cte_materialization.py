@@ -1,0 +1,67 @@
+"""CTE materialization: WITH subqueries referenced >1 time execute once
+into memory-overlay temp tables (reference:
+PhysicalCteOptimizer.java:126 + CTEMaterializationTracker)."""
+
+import pytest
+
+from presto_tpu.config import Session
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+
+SF = 0.01
+
+Q15_STYLE = """
+with revenue as (
+  select l_suppkey as supplier_no, sum(l_extendedprice * l_discount)
+    as total_revenue
+  from lineitem group by l_suppkey
+)
+select count(*), sum(r1.total_revenue)
+from revenue r1, revenue r2
+where r1.supplier_no = r2.supplier_no
+"""
+
+SINGLE_REF = """
+with big as (select * from orders where o_totalprice > 100000)
+select count(*) from big
+"""
+
+
+@pytest.fixture(scope="module")
+def inline_engine():
+    return LocalEngine(TpchConnector(SF))
+
+
+@pytest.fixture(scope="module")
+def mat_engine():
+    return LocalEngine(TpchConnector(SF), session=Session(
+        {"cte_materialization_enabled": "true"}))
+
+
+def test_multi_ref_cte_matches_inlined(inline_engine, mat_engine):
+    a = inline_engine.execute_sql(Q15_STYLE)
+    b = mat_engine.execute_sql(Q15_STYLE)
+    assert len(a) == len(b) == 1
+    assert a[0][0] == b[0][0]
+    assert abs(a[0][1] - b[0][1]) <= 1e-6 * abs(a[0][1])
+    # temp tables were dropped afterwards
+    assert not [t for t in mat_engine.connector.tables
+                if t.startswith("__cte_")]
+
+
+def test_single_ref_cte_still_inlines(inline_engine, mat_engine):
+    assert mat_engine.execute_sql(SINGLE_REF) == \
+        inline_engine.execute_sql(SINGLE_REF)
+
+
+def test_chained_ctes(inline_engine, mat_engine):
+    sql = """
+    with a as (select o_custkey, count(*) c from orders
+               group by o_custkey),
+         b as (select * from a where c > 1)
+    select (select count(*) from b), sum(x.c + y.c)
+    from b x, b y where x.o_custkey = y.o_custkey
+    """
+    ia = inline_engine.execute_sql(sql)
+    mb = mat_engine.execute_sql(sql)
+    assert ia == mb
