@@ -58,11 +58,7 @@ def _rename_refs(node, old: str, new: str):
     if isinstance(node, ast.Select) and \
             any(n == old for n, _q in node.ctes):
         return node             # shadowed: leave subtree untouched
-    if dataclasses.is_dataclass(node) and not isinstance(node, ast.Select):
-        return dataclasses.replace(node, **{
-            f.name: _rename_refs(getattr(node, f.name), old, new)
-            for f in dataclasses.fields(node)})
-    if isinstance(node, ast.Select):
+    if dataclasses.is_dataclass(node):
         return dataclasses.replace(node, **{
             f.name: _rename_refs(getattr(node, f.name), old, new)
             for f in dataclasses.fields(node)})

@@ -64,11 +64,12 @@ class LocalEngine:
         head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
         if head in ("create", "insert", "drop"):
             return self._execute_statement(sql)
-        if self.session["cte_materialization_enabled"] \
-                and parse_sql(sql).ctes:
-            # CTE-free queries keep the normal path (lifespan batching,
-            # HBO recording); only WITH queries take the rewrite
-            return self._execute_with_cte_materialization(sql, qid)
+        if self.session["cte_materialization_enabled"]:
+            q = parse_sql(sql)
+            if q.ctes:
+                # only WITH queries take the rewrite; CTE-free ones keep
+                # the normal path (lifespan batching, HBO recording)
+                return self._execute_with_cte_materialization(q, qid)
         with TRACER.span(qid, "plan"):
             plan = self.plan_sql(sql)
         n = self.session["lifespan_batches"]
@@ -103,14 +104,13 @@ class LocalEngine:
             if entry is not None:
                 self.history.record(canonical_key(entry[0]), rows)
 
-    def _execute_with_cte_materialization(self, sql: str, qid: str
+    def _execute_with_cte_materialization(self, q, qid: str
                                           ) -> List[tuple]:
         """Multiply-referenced CTEs execute once into memory-overlay temp
-        tables (exec/cte.py; reference PhysicalCteOptimizer.java:126)."""
+        tables (exec/cte.py; reference PhysicalCteOptimizer.java:126).
+        `q` is the already-parsed ast.Select."""
         from presto_tpu.exec.cte import materialize_ctes
         from presto_tpu.utils import TRACER
-
-        q = parse_sql(sql)
 
         def run_select(sub_q):
             plan = self.planner.plan_query(sub_q)

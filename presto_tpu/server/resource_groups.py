@@ -33,9 +33,13 @@ class ResourceGroup:
         self.stats = {"admitted": 0, "rejected": 0, "peak_queued": 0}
 
     def acquire(self, timeout_s: Optional[float] = None):
-        # a free slot admits immediately — max_queued only limits WAITING
-        # queries (max_queued=0 == run-or-reject, the reference semantics)
-        if self._slots.acquire(blocking=False):
+        # a free slot admits immediately — but only when nothing is
+        # already waiting (FIFO: arrivals must not overtake the queue);
+        # max_queued only limits WAITING queries (max_queued=0 ==
+        # run-or-reject, the reference semantics)
+        with self._lock:
+            fast = self._queued == 0
+        if fast and self._slots.acquire(blocking=False):
             with self._lock:
                 self.stats["admitted"] += 1
             return _Slot(self)
@@ -51,11 +55,13 @@ class ResourceGroup:
         ok = self._slots.acquire(timeout=timeout_s)
         with self._lock:
             self._queued -= 1
+            if not ok:
+                self.stats["rejected"] += 1
+            else:
+                self.stats["admitted"] += 1
         if not ok:
             raise QueryQueueFull(
                 f"group {self.name}: no slot within {timeout_s}s")
-        with self._lock:
-            self.stats["admitted"] += 1
         return _Slot(self)
 
 

@@ -23,25 +23,35 @@ from typing import List, Optional, Sequence
 @dataclasses.dataclass
 class ColumnChecksum:
     """Per-column order-insensitive checksum (reference:
-    checksum/ChecksumValidator's per-type column checksums)."""
+    checksum/ChecksumValidator's per-type column checksums).
+
+    `checksum` is the SUM (mod 2^64) of per-value crcs — additive, so
+    even multiplicities cannot cancel (XOR would report
+    crc(x)^crc(x) == crc(y)^crc(y)). Floats have no exact checksum
+    (cross-engine rounding); they compare by first AND second moments
+    (sum + sum of squares) so equal-sum different multisets like
+    [2, 0] vs [1, 1] still mismatch. Numeric columns carry BOTH forms so
+    an int column verifies tolerantly against a float column (engines
+    may widen types differently)."""
     count: int
     null_count: int
-    # SUM (mod 2^64) of per-value crcs — additive, so even multiplicities
-    # cannot cancel (XOR would report crc(x)^crc(x) == crc(y)^crc(y))
-    checksum: int
-    float_sum: Optional[float]    # sum for approx comparison (floats)
+    checksum: Optional[int]
+    float_sum: Optional[float]
+    float_sum_sq: Optional[float]
 
     def matches(self, other: "ColumnChecksum",
                 rel_tol: float = 1e-6) -> bool:
         if (self.count, self.null_count) != (other.count,
                                              other.null_count):
             return False
-        if self.float_sum is not None or other.float_sum is not None:
-            a = self.float_sum or 0.0
-            b = other.float_sum or 0.0
-            return math.isclose(a, b, rel_tol=rel_tol,
-                                abs_tol=rel_tol)
-        return self.checksum == other.checksum
+        if self.checksum is not None and other.checksum is not None:
+            return self.checksum == other.checksum
+        if self.float_sum is None or other.float_sum is None:
+            return False           # numeric vs non-numeric: structural
+        return (math.isclose(self.float_sum, other.float_sum,
+                             rel_tol=rel_tol, abs_tol=rel_tol)
+                and math.isclose(self.float_sum_sq, other.float_sum_sq,
+                                 rel_tol=rel_tol, abs_tol=rel_tol))
 
 
 def column_checksums(rows: Sequence[tuple]) -> List[ColumnChecksum]:
@@ -52,16 +62,22 @@ def column_checksums(rows: Sequence[tuple]) -> List[ColumnChecksum]:
     for c in range(ncol):
         vals = [r[c] for r in rows]
         nulls = sum(1 for v in vals if v is None)
-        is_float = any(isinstance(v, float) for v in vals)
+        live = [v for v in vals if v is not None]
+        is_float = any(isinstance(v, float) for v in live)
+        numeric = live and all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in live)
+        fs = fss = None
+        if numeric:
+            fs = float(sum(live))
+            fss = float(sum(v * v for v in live))
         if is_float:
-            s = sum(v for v in vals if v is not None)
-            out.append(ColumnChecksum(len(vals), nulls, 0, float(s)))
+            out.append(ColumnChecksum(len(vals), nulls, None, fs, fss))
         else:
             x = 0
-            for v in vals:
-                if v is not None:
-                    x = (x + zlib.crc32(repr(v).encode())) % (1 << 64)
-            out.append(ColumnChecksum(len(vals), nulls, x, None))
+            for v in live:
+                x = (x + zlib.crc32(repr(v).encode())) % (1 << 64)
+            out.append(ColumnChecksum(len(vals), nulls, x, fs, fss))
     return out
 
 
@@ -106,8 +122,9 @@ class Verifier:
             r.status = "MISMATCH"
             r.detail = f"row count {len(control_rows)} != {len(test_rows)}"
             return r
-        a = column_checksums(sorted(control_rows, key=_row_key))
-        b = column_checksums(sorted(test_rows, key=_row_key))
+        # checksums are commutative sums — no sort needed
+        a = column_checksums(control_rows)
+        b = column_checksums(test_rows)
         if len(a) != len(b):
             r.status = "MISMATCH"
             r.detail = f"column count {len(a)} != {len(b)}"
@@ -122,7 +139,3 @@ class Verifier:
     def verify_suite(self, queries: Sequence[str]
                      ) -> List[VerificationResult]:
         return [self.verify(q) for q in queries]
-
-
-def _row_key(row):
-    return tuple((v is None, str(type(v)), v) for v in row)

@@ -93,3 +93,33 @@ def test_column_count_mismatch():
             return [(1, 2, 3)]
 
     assert Verifier(A(), B()).verify("q").status == "MISMATCH"
+
+
+def test_equal_sum_different_floats_mismatch():
+    """Second moment catches equal-sum float multisets: [2,0] vs [1,1]."""
+    from presto_tpu.utils import Verifier
+
+    class A:
+        def execute_sql(self, sql):
+            return [(2.0,), (0.0,)]
+
+    class B:
+        def execute_sql(self, sql):
+            return [(1.0,), (1.0,)]
+
+    assert Verifier(A(), B()).verify("q").status == "MISMATCH"
+
+
+def test_int_vs_float_column_tolerant():
+    """Cross-engine type widening (ints vs equal floats) must MATCH."""
+    from presto_tpu.utils import Verifier
+
+    class A:
+        def execute_sql(self, sql):
+            return [(10, 3), (20, 4)]
+
+    class B:
+        def execute_sql(self, sql):
+            return [(10.0, 3), (20.0, 4)]
+
+    assert Verifier(A(), B()).verify("q").status == "MATCH"

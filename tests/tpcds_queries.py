@@ -91,6 +91,163 @@ where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
 group by rollup(i_product_name, i_brand, i_class, i_category)
 order by qoh, i_product_name, i_brand, i_class, i_category
 """,
+    27: """
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state in ('TN', 'TX', 'CA', 'NY', 'OH', 'GA')
+group by rollup(i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+""",
+    34: """
+select c_last_name, c_first_name, ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and (date_dim.d_dom between 1 and 3
+             or date_dim.d_dom between 25 and 28)
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county in ('Ziebach County', 'Walker County',
+                               'Daviess County', 'Barrow County')
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by c_last_name, c_first_name, cnt desc, ss_ticket_number
+""",
+    48: """
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address,
+     date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100 and 150)
+       or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'D'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 50 and 100)
+       or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 150 and 200))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('CO', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+       or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+           and ca_state in ('OR', 'MN', 'KY')
+           and ss_net_profit between 150 and 3000)
+       or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+           and ca_state in ('VA', 'CA', 'MS')
+           and ss_net_profit between 50 and 25000))
+""",
+    61: """
+select promotions, total,
+       cast(promotions as double) / cast(total as double) * 100
+from (select sum(ss_ext_sales_price) promotions
+      from store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_promo_sk = p_promo_sk and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk
+        and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5 and i_category = 'Jewelry'
+        and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+             or p_channel_tv = 'Y')
+        and s_gmt_offset = -5 and d_year = 1998 and d_moy = 11
+     ) promotional_sales,
+     (select sum(ss_ext_sales_price) total
+      from store_sales, store, date_dim, customer, customer_address,
+           item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk
+        and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5 and i_category = 'Jewelry'
+        and s_gmt_offset = -5 and d_year = 1998 and d_moy = 11
+     ) all_sales
+order by promotions, total
+""",
+    73: """
+select c_last_name, c_first_name, c_birth_year, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county in ('Ziebach County', 'Walker County')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by cnt desc, c_last_name asc
+""",
+    79: """
+select c_last_name, c_first_name, s_city, profit, ss_ticket_number, amt
+from (select ss_ticket_number, ss_customer_sk, store.s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and (household_demographics.hd_dep_count = 6
+             or household_demographics.hd_vehicle_count > 2)
+        and date_dim.d_dow = 1
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, store.s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, s_city, profit, ss_ticket_number
+limit 100
+""",
+    88: """
+select *
+from (select count(*) h8_30_to_9 from store_sales, household_demographics,
+      time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 8 and time_dim.t_minute >= 30
+        and household_demographics.hd_dep_count = 4
+        and store.s_store_name = 'ese') s1,
+     (select count(*) h9_to_9_30 from store_sales,
+      household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute < 30
+        and household_demographics.hd_dep_count = 4
+        and store.s_store_name = 'ese') s2,
+     (select count(*) h9_30_to_10 from store_sales,
+      household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute >= 30
+        and household_demographics.hd_dep_count = 4
+        and store.s_store_name = 'ese') s3,
+     (select count(*) h10_to_10_30 from store_sales,
+      household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 10 and time_dim.t_minute < 30
+        and household_demographics.hd_dep_count = 4
+        and store.s_store_name = 'ese') s4
+""",
     26: """
 select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
        avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
@@ -206,6 +363,32 @@ group by i_item_id, i_item_desc, i_category, i_class, i_current_price
 order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 """,
 }
+
+# q27's ROLLUP spelled as explicit union-all sets for the sqlite oracle
+_Q27_BODY = """
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state in ('TN', 'TX', 'CA', 'NY', 'OH', 'GA')
+"""
+Q27_SQLITE = f"""
+select * from (
+select i_item_id, s_state, 0 g_state, avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2, avg(ss_coupon_amt) agg3,
+       avg(ss_sales_price) agg4 {_Q27_BODY}
+group by i_item_id, s_state
+union all
+select i_item_id, null, 1, avg(ss_quantity), avg(ss_list_price),
+       avg(ss_coupon_amt), avg(ss_sales_price) {_Q27_BODY}
+group by i_item_id
+union all
+select null, null, 1, avg(ss_quantity), avg(ss_list_price),
+       avg(ss_coupon_amt), avg(ss_sales_price) {_Q27_BODY}
+) order by i_item_id, s_state limit 100
+"""
 
 # q22's ROLLUP spelled as explicit union-all sets for the sqlite oracle
 Q22_SQLITE = """
