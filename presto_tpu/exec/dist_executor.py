@@ -84,11 +84,20 @@ class DistExecutor(Executor):
         return (lambda pages: pages[idx]), page.capacity
 
     # ---- hook overrides -------------------------------------------------
+    # Every device-mesh hook delegates to the single-device base path
+    # when ndev == 1: a 1-device "mesh" still executes FRAGMENT-WISE
+    # (bounded program sizes — the compile-service-friendly mode bench
+    # uses for join-heavy queries) but needs no shard_map or collectives,
+    # which matters on backends that only lower a subset of them (the
+    # axon TPU tunnel supports Sum all-reduce only).
     def _prepare(self, plan: PlanNode) -> PlanNode:
         return add_exchanges(plan, self.connector, self.session,
                              getattr(self, "history", None))
 
     def _wrap(self, fn: Callable) -> Callable:
+        if self.ndev == 1:
+            return super()._wrap(fn)
+
         def wrapped(pages):
             def local_fn(*locals_):
                 out, counters = fn(list(locals_))
@@ -100,12 +109,16 @@ class DistExecutor(Executor):
         return wrapped
 
     def _page_rows(self, page: Page) -> List[tuple]:
+        if self.ndev == 1:
+            return super()._page_rows(page)
         rows: List[tuple] = []
         for p in unstack_page(page):
             rows.extend(p.to_pylist())
         return rows
 
     def _scan_rows(self, node) -> int:
+        if self.ndev == 1:
+            return super()._scan_rows(node)
         t = self.connector.table(node.table)
         per = (t.num_rows + self.ndev - 1) // self.ndev
         return max(per, 1)
@@ -114,6 +127,8 @@ class DistExecutor(Executor):
         from presto_tpu.exec.executor import RemoteSpec
         if isinstance(s, RemoteSpec):
             return self._frag_results[s.fragment_id]
+        if self.ndev == 1:
+            return super()._fetch(s)
         pages = [self.connector.table(s.table, part=d,
                                       num_parts=self.ndev)
                  .page(columns=list(s.columns), capacity=s.capacity)
@@ -121,16 +136,22 @@ class DistExecutor(Executor):
         return stack_pages(pages)
 
     def _unique_ids(self, p: Page) -> jnp.ndarray:
+        if self.ndev == 1:
+            return super()._unique_ids(p)
         d = jax.lax.axis_index(AXIS).astype(jnp.int64)
         return d * p.capacity + jnp.arange(p.capacity, dtype=jnp.int64)
 
     def _finish_values(self, out: Page) -> Page:
+        if self.ndev == 1:
+            return super()._finish_values(out)
         # VALUES is a single stream: device 0 emits, the rest are empty
         # (the fragmenter marks it SINGLE-partitioned).
         on0 = jnp.where(jax.lax.axis_index(AXIS) == 0, out.num_rows, 0)
         return Page(out.columns, on0.astype(jnp.int32), out.names)
 
     def _finish_agg(self, node, out: Page) -> Page:
+        if self.ndev == 1:
+            return super()._finish_agg(node, out)
         if node.group_fields or node.step == Step.PARTIAL:
             return out
         # Global FINAL aggregation after a SINGLE exchange: every device
@@ -140,6 +161,11 @@ class DistExecutor(Executor):
         return Page(out.columns, on0.astype(jnp.int32), out.names)
 
     def _lower_exchange(self, node, nid, src, cap, caps, watch, _needed):
+        if self.ndev == 1:
+            # exchanges between fragments are identity relabels on one
+            # device; the fragment-wise materialization still happens
+            return super()._lower_exchange(node, nid, src, cap, caps,
+                                           watch, _needed)
         ndev = self.ndev
         if node.partitioning in (Partitioning.HASH, Partitioning.RANGE):
             from presto_tpu.parallel.shuffle import range_partition_ids

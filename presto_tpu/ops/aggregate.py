@@ -76,18 +76,21 @@ def _hll_reg_rank(vals: jnp.ndarray):
     from presto_tpu.ops.keys import _GOLDEN, _mix64
 
     if jnp.issubdtype(vals.dtype, jnp.floating):
-        # bitcast, not a numeric cast — distinct floats must hash apart
-        # (i32-pair form: direct 64-bit bitcasts don't lower on TPU)
-        from presto_tpu.ops.keys import jax_bitcast_f64_i64
-        bits = jax_bitcast_f64_i64(
-            vals.astype(jnp.float64)).astype(jnp.uint64)
+        # scale-aware arithmetic lanes (no 64-bit bitcasts on TPU);
+        # values equal to ~32 significant bits collide, slightly
+        # undercounting only when a column has >2^32-fine distinctions
+        from presto_tpu.ops.keys import f64_hash_lanes
+        bits = f64_hash_lanes(vals.astype(jnp.float64))
     else:
         bits = vals.astype(jnp.uint64)
     h = _mix64(bits + _GOLDEN)
     reg = (h & jnp.uint64(_HLL_M - 1)).astype(jnp.int32)
     w = h >> jnp.uint64(_HLL_P)
-    # floor(log2(w)) via frexp (exact: w < 2**53)
-    _mant, exp = jnp.frexp(w.astype(jnp.float64))
+    # floor(log2(w)) via f32 frexp — f64 frexp would need a 64-bit
+    # bitcast, which the TPU X64-rewriting pass cannot lower. The f32
+    # rounding can bump w across a power of two for ~2^-24 of values,
+    # nudging one rank — noise far below the sketch's 2.3% error.
+    _mant, exp = jnp.frexp(w.astype(jnp.float32))
     rank = jnp.where(w == 0, 64 - _HLL_P + 1,
                      (64 - _HLL_P) - (exp - 1)).astype(jnp.int32)
     return reg, rank
@@ -364,7 +367,7 @@ def _sorted_grouped_aggregate(page: Page, group_fields: Sequence[int],
     import jax
 
     from presto_tpu.ops import scan as pscan
-    from presto_tpu.ops.keys import group_values
+    from presto_tpu.ops.keys import group_values, values_equal
 
     cap = page.capacity
 
@@ -395,7 +398,8 @@ def _sorted_grouped_aggregate(page: Page, group_fields: Sequence[int],
         v = sorted_ops[2 + 2 * i]
         prev_n = jnp.roll(n, 1)
         prev_v = jnp.roll(v, 1)
-        same = ((v == prev_v) & ~n & ~prev_n) | (n & prev_n)
+        # values_equal: NaN group keys compare equal (SQL grouping)
+        same = (values_equal(v, prev_v) & ~n & ~prev_n) | (n & prev_n)
         flags = flags | ~same
     flags = flags.at[0].set(True)
 

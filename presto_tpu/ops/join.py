@@ -26,7 +26,8 @@ import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
 from presto_tpu.expr.compile import align_string_columns
-from presto_tpu.ops.keys import group_values, hash_columns
+from presto_tpu.ops.keys import group_values, hash_columns, \
+    values_equal
 
 
 def _aligned_keys(probe: Page, build: Page, probe_fields, build_fields):
@@ -126,7 +127,7 @@ def merge_join(probe: Page, build: Page,
     for i in range(len(probe_fields)):
         kv = s[2 + 2 * i]
         kn = s[1 + 2 * i].astype(bool)
-        same_key = same_key & (kv == jnp.roll(kv, 1)) & ~kn \
+        same_key = same_key & values_equal(kv, jnp.roll(kv, 1)) & ~kn \
             & ~jnp.roll(kn, 1)
     dup_count = jnp.sum(s_present & prev_present & same_key
                         ).astype(jnp.int64)
@@ -138,7 +139,7 @@ def merge_join(probe: Page, build: Page,
         kv = s[2 + 2 * i]
         kn = s[1 + 2 * i].astype(bool)
         ffv = fill_forward(kv, s_present)
-        match = match & (ffv == kv) & ~kn
+        match = match & values_equal(ffv, kv) & ~kn
     ff_payload = []
     if carry_build:
         for j in range(len(build.columns)):
@@ -162,7 +163,8 @@ def merge_join(probe: Page, build: Page,
         for i in range(len(probe_fields)):
             kv = s[2 + 2 * i]
             kn = s[1 + 2 * i].astype(bool)
-            same = ((kv == jnp.roll(kv, 1)) & ~kn & ~jnp.roll(kn, 1)) \
+            same = (values_equal(kv, jnp.roll(kv, 1))
+                    & ~kn & ~jnp.roll(kn, 1)) \
                 | (kn & jnp.roll(kn, 1))
             run_start = run_start | ~same
         run_start = run_start.at[0].set(True)
@@ -339,7 +341,7 @@ def hash_join(probe: Page, build: Page,
     for pc, bc in zip(pcols, bcols):
         pv = group_values(pc)[pidx_c]
         bv = group_values(bc)[bidx]
-        key_eq = key_eq & (pv == bv)
+        key_eq = key_eq & values_equal(pv, bv)
     match = pair_valid & real_candidate & key_eq
 
     if join_type == "inner":
@@ -390,7 +392,7 @@ def _window_any_match(pcols, bcols, order, lo, counts):
         bpos = jnp.clip(lo + k, 0, bcap - 1).astype(jnp.int32)
         eq = in_win
         for pv, pn, bv, bn in zip(pvals, pnulls, bvals, bnulls):
-            eq = eq & (pv == bv[bpos]) & ~pn & ~bn[bpos]
+            eq = eq & values_equal(pv, bv[bpos]) & ~pn & ~bn[bpos]
         return matched | eq
 
     matched = jnp.zeros((pcap,), dtype=bool)

@@ -44,31 +44,57 @@ def _orderable_values(col: Column) -> jnp.ndarray:
 
 
 def group_values(col: Column) -> jnp.ndarray:
-    """Per-type int64 array where equality == SQL group equality.
-    Floats are bit-canonicalized (-0.0 == 0.0, all NaNs equal)."""
+    """Per-type array where equality/order == SQL group equality/order.
+    Floats stay raw f64 — NO canonicalization and NO 64-bit bitcasts
+    (the TPU backend's X64-rewriting pass cannot lower bitcast-convert
+    on 64-bit element types in either direction). Float keys sort and
+    compare as floats: XLA's sort is total-order with every NaN last,
+    IEEE == already treats -0.0 == +0.0, and equality sites must use
+    `values_equal` for NaN == NaN; `f64_hash_lanes` collapses NaN/zero
+    classes itself for hashing."""
     v = col.values
     if v.dtype == jnp.float64 or v.dtype == jnp.float32:
-        v64 = v.astype(jnp.float64)
-        v64 = jnp.where(v64 == 0.0, 0.0, v64)          # -0.0 -> +0.0
-        v64 = jnp.where(jnp.isnan(v64), jnp.nan, v64)  # canonical NaN
-        return jax_bitcast_f64_i64(v64)
+        # no bit-canonicalization needed: -0.0 == 0.0 under IEEE ==,
+        # values_equal handles NaN == NaN, and f64_hash_lanes collapses
+        # every NaN/zero to one hash itself
+        return v.astype(jnp.float64)
     if v.dtype == jnp.bool_:
         return v.astype(jnp.int64)
     return v.astype(jnp.int64)
 
 
-def jax_bitcast_f64_i64(x: jnp.ndarray) -> jnp.ndarray:
-    """Bit-exact f64 -> i64 via an i32-pair bitcast. A direct s64
-    bitcast-convert is unimplemented in the TPU backend's X64-rewriting
-    pass ("While rewriting computation to not contain X64 element
-    types..."); bitcasting to the next-smaller type adds a minor [2]
-    dimension of i32 lanes, which rewrites fine, and the i64 recombine is
-    ordinary (emulated) arithmetic."""
-    import jax
-    pair = jax.lax.bitcast_convert_type(x, jnp.int32)   # [..., 2]
-    lo = pair[..., 0].astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
-    hi = pair[..., 1].astype(jnp.int64)
-    return (hi << jnp.int64(32)) | lo
+def values_equal(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Group-key equality over group_values outputs: NaN == NaN (SQL
+    grouping semantics). `x != x` is False for every non-float dtype, so
+    this is a no-op for ints."""
+    return (a == b) | ((a != a) & (b != b))
+
+
+def f64_hash_lanes(v: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic u64 hash input for f64 values without bitcasting:
+    SCALE-AWARE exponent + top-32-mantissa-bit lanes extracted
+    arithmetically (log2/exp2), so entropy survives at every magnitude
+    (a fixed-point trunc/frac split would collapse everything below
+    2^-32 absolute). Values equal to ~32 significant bits collide —
+    callers use it for bucketing/partitioning only, never equality."""
+    is_nan = jnp.isnan(v)
+    is_inf = jnp.isinf(v)
+    safe = jnp.where(is_nan | is_inf, 1.0, v)
+    ae = jnp.maximum(jnp.abs(safe), 1e-300)
+    # floor(log2): ±1 ulp of log2 can misplace the boundary by one —
+    # that only shifts which 32 mantissa bits we sample, still distinct
+    e = jnp.floor(jnp.log2(ae))
+    norm = ae * jnp.exp2(-e)                       # ~[1, 2)
+    mant = (jnp.clip(norm - 1.0, 0.0, 1.0)
+            * (2.0 ** 32)).astype(jnp.uint64)
+    eb = (e.astype(jnp.int64) + 2048).astype(jnp.uint64)
+    h = eb * _GOLDEN ^ mant
+    h = jnp.where(v < 0, h ^ jnp.uint64(0xA5A5A5A5DEADBEEF), h)
+    h = jnp.where(v == 0.0, jnp.uint64(0x5E5E0000), h)   # ±0 hash equal
+    h = jnp.where(is_nan, jnp.uint64(0x7FF8000000000001), h)
+    h = jnp.where(is_inf & (v > 0), jnp.uint64(0x7FF0000000000000), h)
+    h = jnp.where(is_inf & (v < 0), jnp.uint64(0xFFF0000000000000), h)
+    return h
 
 
 def sort_perm(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
@@ -110,7 +136,7 @@ def new_group_flags(page: Page, fields: Sequence[int],
         n = col.nulls[perm]
         prev_v = jnp.roll(v, 1)
         prev_n = jnp.roll(n, 1)
-        same = ((v == prev_v) & ~n & ~prev_n) | (n & prev_n)
+        same = (values_equal(v, prev_v) & ~n & ~prev_n) | (n & prev_n)
         flags = flags | ~same
     return flags.at[0].set(True)
 
@@ -137,7 +163,11 @@ def hash_columns(cols: Sequence[Column]) -> jnp.ndarray:
     precomputed $hash channel."""
     h = jnp.zeros((cols[0].capacity,), dtype=jnp.uint64)
     for c in cols:
-        v = group_values(c).astype(jnp.uint64)
+        g = group_values(c)
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            v = f64_hash_lanes(g)     # arithmetic lanes, no bitcast
+        else:
+            v = g.astype(jnp.uint64)
         v = jnp.where(c.nulls, jnp.uint64(0x5BD1E995), v)
         h = _mix64(h ^ (v + _GOLDEN + (h << jnp.uint64(6))
                         + (h >> jnp.uint64(2))))

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
 from presto_tpu.ops import scan as pscan
-from presto_tpu.ops.keys import SortKey, _orderable_values, group_values
+from presto_tpu.ops.keys import SortKey, _orderable_values, \
+    group_values, values_equal
 from presto_tpu.types import BIGINT, DOUBLE, Type
 
 
@@ -89,7 +90,8 @@ def window_page(page: Page, partition_fields: Sequence[int],
         for i in range(count // 2):
             n = s[ops_start + 2 * i] == null_ranks[i]
             v = s[ops_start + 2 * i + 1]
-            same = ((v == jnp.roll(v, 1)) & ~n & ~jnp.roll(n, 1)) \
+            same = (values_equal(v, jnp.roll(v, 1))
+                    & ~n & ~jnp.roll(n, 1)) \
                 | (n & jnp.roll(n, 1))
             ch = ch | ~same
         return ch.at[0].set(True)
