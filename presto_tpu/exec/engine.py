@@ -141,7 +141,6 @@ class LocalEngine:
         itself is a host-side sink outside the jit fragment, fed by the
         inner query's result page."""
         from presto_tpu.expr.nodes import Literal
-        from presto_tpu.protocol.translate import parse_type
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.analyzer import AnalysisError
         from presto_tpu.sql.parser import parse_statement
@@ -160,8 +159,21 @@ class LocalEngine:
         if isinstance(stmt, A.CreateTable):
             if stmt.if_not_exists and conn.exists(stmt.name):
                 return [(0,)]
-            conn.create(stmt.name, [(c, parse_type(sig))
-                                    for c, sig in stmt.columns])
+            from presto_tpu.types import (
+                ArrayType, MapType, RowType, parse_type as parse_sql_type,
+            )
+            cols = []
+            for c, sig in stmt.columns:
+                try:
+                    t = parse_sql_type(sig)
+                except (ValueError, NotImplementedError) as e:
+                    raise AnalysisError(f"column {c!r}: {e}") from e
+                if isinstance(t, (ArrayType, MapType, RowType)):
+                    raise AnalysisError(
+                        f"column {c!r}: type {t} is not supported for "
+                        "table storage")
+                cols.append((c, t))
+            conn.create(stmt.name, cols)
             return [(0,)]
 
         if isinstance(stmt, A.CreateTableAs):
@@ -196,12 +208,23 @@ class LocalEngine:
                         vals.append(v)
                     rows.append(tuple(vals))
             if stmt.columns:
+                unknown = [c for c in stmt.columns if c not in names]
+                if unknown:
+                    raise AnalysisError(
+                        f"INSERT columns not in table: {unknown}")
+                for r in rows:
+                    if len(r) != len(stmt.columns):
+                        raise AnalysisError(
+                            f"INSERT arity {len(r)} != column list "
+                            f"{len(stmt.columns)}")
                 pos = {c: i for i, c in enumerate(stmt.columns)}
                 rows = [tuple(r[pos[c]] if c in pos else None
                               for c in names) for r in rows]
-            elif rows and len(rows[0]) != len(names):
-                raise AnalysisError(
-                    f"INSERT arity {len(rows[0])} != table {len(names)}")
+            else:
+                for r in rows:
+                    if len(r) != len(names):
+                        raise AnalysisError(
+                            f"INSERT arity {len(r)} != table {len(names)}")
             n = conn.append_rows(stmt.name, rows)
             return [(n,)]
 

@@ -697,6 +697,56 @@ class EnforceSingleRowNode(PlanNode):
     _SCHEMA = [("id", "id", None), ("source", "source", PlanNode)]
 
 
+@PlanNode.register("com.facebook.presto.sql.planner.plan.RowNumberNode")
+@dataclasses.dataclass
+class RowNumberNode(PlanNode):
+    """sql/planner/plan/RowNumberNode.java — fully-qualified @type because
+    it lives outside spi/plan (Jackson MINIMAL_CLASS is relative to the
+    spi.plan package). Seen in the reference's OffsetLimit.json capture
+    (OFFSET is planned as row_number + filter)."""
+    id: str = ""
+    source: Any = None
+    partitionBy: List[Variable] = dataclasses.field(default_factory=list)
+    rowNumberVariable: Variable = None
+    maxRowCountPerPartition: Optional[int] = None
+    partial: bool = False
+    hashVariable: Optional[Variable] = None
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("partitionBy", "partitionBy", ("list", Variable)),
+        ("rowNumberVariable", "rowNumberVariable", Variable),
+        ("maxRowCountPerPartition", "maxRowCountPerPartition",
+         ("opt", None)),
+        ("partial", "partial", None),
+        ("hashVariable", "hashVariable", ("opt", Variable)),
+    ]
+
+
+@PlanNode.register(".IndexSourceNode")
+@dataclasses.dataclass
+class IndexSourceNode(PlanNode):
+    """spi/plan/IndexSourceNode.java — parsed so the validator can reject
+    index joins with a precise message (the TPU worker has no connector
+    index lookup; mirrors VeloxPlanValidator's unsupported-node path)."""
+    id: str = ""
+    indexHandle: Any = None
+    tableHandle: Any = None
+    lookupVariables: List[Variable] = dataclasses.field(default_factory=list)
+    outputVariables: List[Variable] = dataclasses.field(default_factory=list)
+    assignments: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    currentConstraint: Any = None
+    _SCHEMA = [
+        ("id", "id", None),
+        ("indexHandle", "indexHandle", None),
+        ("tableHandle", "tableHandle", None),
+        ("lookupVariables", "lookupVariables", ("list", Variable)),
+        ("outputVariables", "outputVariables", ("list", Variable)),
+        ("assignments", "assignments", None),
+        ("currentConstraint", "currentConstraint", ("opt", None)),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # PlanFragment / TaskUpdateRequest / task metadata
 # ---------------------------------------------------------------------------

@@ -10,7 +10,6 @@ with positional InputRefs, resolved against each child's output layout.
 from __future__ import annotations
 
 import base64
-import re
 from typing import Dict, List, Sequence
 
 import numpy as np
@@ -23,30 +22,23 @@ from presto_tpu.protocol import structs as S
 from presto_tpu.types import (
     BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TIMESTAMP,
     TINYINT, VARCHAR, DecimalType, Type,
+    parse_type as _parse_type_sig,
 )
 
 
 # ------------------------------------------------------------------ types
 
-_SIMPLE_TYPES = {
-    "bigint": BIGINT, "integer": INTEGER, "smallint": SMALLINT,
-    "tinyint": TINYINT, "double": DOUBLE, "real": REAL,
-    "boolean": BOOLEAN, "date": DATE, "timestamp": TIMESTAMP,
-    "varchar": VARCHAR, "char": VARCHAR, "unknown": BIGINT,
-}
-
-
 def parse_type(sig: str) -> Type:
-    """Type-signature string -> engine Type ("varchar(25)", "decimal(12,2)"
-    ...). Reference: presto_cpp/main/types/TypeParser.cpp."""
-    sig = sig.strip().lower()
-    base = sig.split("(", 1)[0]
-    if base in _SIMPLE_TYPES:
-        return _SIMPLE_TYPES[base]
-    if base == "decimal":
-        m = re.match(r"decimal\((\d+)\s*,\s*(\d+)\)", sig)
-        return DecimalType(int(m.group(1)), int(m.group(2)))
-    raise NotImplementedError(f"type signature {sig!r}")
+    """Type-signature string -> engine Type ("varchar(25)", "decimal(12,2)",
+    "array(map(varchar, row(id bigint)))"). Reference:
+    presto_cpp/main/types/TypeParser.cpp."""
+    s = sig.strip().lower()
+    if s == "unknown":
+        return BIGINT              # bare-NULL placeholder channel
+    try:
+        return _parse_type_sig(sig)
+    except ValueError as e:
+        raise NotImplementedError(f"type signature {sig!r}") from e
 
 
 def _var_key_name(key: str) -> str:
@@ -101,7 +93,11 @@ def decode_constant(const: S.Constant) -> E.Literal:
 
     t = parse_type(const.type)
     raw = base64.b64decode(const.valueBlock)
-    blk, _off = _decode_block(memoryview(raw), 0)
+    try:
+        blk, _off = _decode_block(memoryview(raw), 0)
+    except ValueError as e:
+        raise NotImplementedError(
+            f"constant of type {const.type!r}: {e}") from e
     if blk.nulls is not None and bool(np.asarray(blk.nulls)[0]):
         return E.Literal(None, t)
     if t.is_string:
@@ -262,6 +258,8 @@ def _out_vars(node) -> List[S.Variable]:
             for k in node.windowFunctions]
     if isinstance(node, S.GroupIdNode):
         return _out_vars(node.source) + [node.groupIdVariable]
+    if isinstance(node, S.RowNumberNode):
+        return _out_vars(node.source) + [node.rowNumberVariable]
     if isinstance(node, (S.LimitNode, S.TopNNode, S.SortNode,
                          S.EnforceSingleRowNode)):
         return _out_vars(node.source)
@@ -501,9 +499,29 @@ def _node(n) -> P.PlanNode:
         src = _node(n.sources[0])
         scope = Scope(_out_vars(n.sources[0]))
         layout = n.partitioningScheme.outputLayout
-        exprs = tuple(scope.ref(v) for v in layout)
+        # inputs[i][k] names the source-i variable feeding output column k
+        # (ExchangeNode.java getInputs); output names come from the layout.
+        ins = n.inputs[0] if n.inputs else layout
+        exprs = tuple(scope.ref(v) for v in ins)
         return P.ProjectNode(tuple(v.name for v in layout),
                              tuple(e.type for e in exprs), source=src,
                              expressions=exprs)
+
+    if isinstance(n, S.RowNumberNode):
+        from presto_tpu.ops.window import WindowSpec
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        if n.maxRowCountPerPartition is not None:
+            raise NotImplementedError(
+                "RowNumberNode.maxRowCountPerPartition")
+        pf = tuple(scope.index[v.name] for v in n.partitionBy)
+        return P.WindowNode(
+            src.output_names + (n.rowNumberVariable.name,),
+            src.output_types + (BIGINT,), source=src,
+            partition_fields=pf, order_keys=(),
+            specs=(WindowSpec("row_number", None, BIGINT),))
+
+    if isinstance(n, S.RawNode):
+        raise NotImplementedError(f"plan node {n.type_key}")
 
     raise NotImplementedError(f"plan node {type(n).__name__}")

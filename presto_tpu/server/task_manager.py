@@ -26,7 +26,6 @@ from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.serde import (
     encode_serialized_page, page_to_wire_blocks,
 )
-from presto_tpu.protocol.translate import translate_fragment
 from presto_tpu.server.buffers import OutputBufferManager
 
 
@@ -225,8 +224,12 @@ class TpuTaskManager:
     def _run(self, task: Task):
         try:
             from presto_tpu.config import PROPERTIES, Session
+            from presto_tpu.protocol.validator import translate_validated
 
-            plan = translate_fragment(task.fragment)
+            # Validate + translate (VeloxPlanValidator analog): foreign
+            # connectors / unknown nodes / unsupported features fail with
+            # a precise reason, not a mid-execution traceback.
+            plan = translate_validated(task.fragment)
             # Session properties arrive on the wire as strings
             # (SessionRepresentation.systemProperties); unknown ones are
             # coordinator-side and ignored here, like the C++ worker's
@@ -243,8 +246,13 @@ class TpuTaskManager:
             self._emit_output(task, page)
             task.buffers.set_no_more_pages()
             task.set_state("FINISHED")
-        except Exception:
-            task.failures.append(traceback.format_exc())
+        except Exception as e:
+            from presto_tpu.protocol.validator import UnsupportedPlanError
+            if isinstance(e, UnsupportedPlanError):
+                # precise, coordinator-renderable reasons — no traceback
+                task.failures.extend(e.reasons)
+            else:
+                task.failures.append(traceback.format_exc())
             if task.buffers is not None:
                 task.buffers.set_no_more_pages()
             task.set_state("FAILED")

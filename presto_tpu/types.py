@@ -110,6 +110,78 @@ class DecimalType(Type):
         return f"DecimalType({self.precision},{self.scale})"
 
 
+@dataclasses.dataclass(frozen=True, repr=False)
+class ArrayType(Type):
+    """ARRAY(T): offset-encoded on device (reference:
+    presto-common/.../block/ArrayBlock.java)."""
+    element: Type = None
+
+    def __init__(self, element: Type):
+        object.__setattr__(self, "name", "array")
+        object.__setattr__(self, "element", element)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(np.int32)     # per-row offsets into element column
+
+    def __str__(self) -> str:
+        return f"array({self.element})"
+
+    def __repr__(self) -> str:
+        return f"ArrayType({self.element!r})"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MapType(Type):
+    """MAP(K, V): offsets + parallel key/value columns (reference:
+    presto-common/.../block/MapBlock.java; no hash index — lookups scan
+    the per-row slice, which vectorizes fine at TPU batch sizes)."""
+    key: Type = None
+    value: Type = None
+
+    def __init__(self, key: Type, value: Type):
+        object.__setattr__(self, "name", "map")
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+    def __str__(self) -> str:
+        return f"map({self.key}, {self.value})"
+
+    def __repr__(self) -> str:
+        return f"MapType({self.key!r}, {self.value!r})"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class RowType(Type):
+    """ROW(f1 T1, ...): struct-of-columns (reference:
+    presto-common/.../block/RowBlock.java). field_names entries may be
+    None for anonymous fields."""
+    field_names: tuple = ()
+    field_types: tuple = ()
+
+    def __init__(self, field_names, field_types):
+        object.__setattr__(self, "name", "row")
+        object.__setattr__(self, "field_names", tuple(field_names))
+        object.__setattr__(self, "field_types", tuple(field_types))
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(np.bool_)     # row itself carries only a null flag
+
+    def __str__(self) -> str:
+        fields = ", ".join(
+            (f"{n} {t}" if n else str(t))
+            for n, t in zip(self.field_names, self.field_types))
+        return f"row({fields})"
+
+    def __repr__(self) -> str:
+        return f"RowType({self.field_names!r}, {self.field_types!r})"
+
+
 BOOLEAN = Type("boolean")
 TINYINT = Type("tinyint")
 SMALLINT = Type("smallint")
@@ -147,20 +219,93 @@ _BY_NAME = {
 }
 
 
+def _split_args(inner: str):
+    """Split a parenthesized arg list on top-level commas, respecting
+    double-quoted field names: 'varchar, row("a,b" bigint)' -> two."""
+    parts, depth, start, quoted = [], 0, 0, False
+    for i, c in enumerate(inner):
+        if c == '"':
+            quoted = not quoted
+        elif quoted:
+            continue
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "," and depth == 0:
+            parts.append(inner[start:i])
+            start = i + 1
+    tail = inner[start:]
+    if tail.strip():
+        parts.append(tail)
+    return [p.strip() for p in parts]
+
+
 def parse_type(signature: str) -> Type:
     """Parse a Presto type signature, e.g. 'bigint', 'decimal(12,2)',
-    'varchar(25)'."""
-    s = signature.strip().lower()
-    if s.startswith("decimal"):
-        if "(" in s:
-            inner = s[s.index("(") + 1:s.rindex(")")]
+    'varchar(25)', 'array(map(varchar, row(id bigint, d varchar)))'.
+    Reference grammar: presto_cpp/main/types/TypeParser.cpp (nested
+    parenthesized signatures; row fields optionally named)."""
+    s = signature.strip()
+    low = s.lower()
+    base = low.split("(", 1)[0].strip()
+    if "(" in s:
+        if ")" not in s:
+            raise ValueError(
+                f"malformed type signature (unbalanced parens): "
+                f"{signature!r}")
+        inner = s[s.index("(") + 1:s.rindex(")")]
+    else:
+        inner = None
+    if base == "decimal":
+        if inner is not None:
             p, _, sc = inner.partition(",")
             return DecimalType(int(p), int(sc or 0))
         return DecimalType()
-    if "(" in s:  # varchar(25), char(1) — length is metadata only
-        s = s[:s.index("(")]
+    if base == "array":
+        if inner is None:
+            raise ValueError(f"array signature missing element: {signature!r}")
+        return ArrayType(parse_type(inner))
+    if base == "map":
+        kv = _split_args(inner or "")
+        if len(kv) != 2:
+            raise ValueError(f"map signature needs 2 args: {signature!r}")
+        return MapType(parse_type(kv[0]), parse_type(kv[1]))
+    if base == "row":
+        names, typs = [], []
+        for f in _split_args(inner or ""):
+            # 'name type' | '"quoted name" type' | bare 'type'
+            if f.startswith('"'):
+                end = f.index('"', 1)
+                names.append(f[1:end])
+                typs.append(parse_type(f[end + 1:]))
+                continue
+            head, _, rest = f.partition(" ")
+            # A leading token is a field NAME unless it is exactly a type
+            # keyword (compare the token before any '(' — 'charge' or
+            # 'row_id' must not prefix-match 'char'/'row').
+            token = head.lower().split("(", 1)[0]
+            is_type_kw = token in _BY_NAME or token in (
+                "decimal", "array", "map", "row")
+            if rest and not is_type_kw:
+                names.append(head)
+                typs.append(parse_type(rest))
+            elif rest and is_type_kw:
+                # ambiguous: a field NAMED like a type keyword
+                # ('row(date date)') vs a multi-word bare type; prefer
+                # the bare-type reading, fall back to name+type.
+                try:
+                    typs.append(parse_type(f))
+                    names.append(None)
+                except ValueError:
+                    names.append(head)
+                    typs.append(parse_type(rest))
+            else:
+                names.append(None)
+                typs.append(parse_type(f))
+        return RowType(names, typs)
     try:
-        return _BY_NAME[s]
+        return _BY_NAME[base]
     except KeyError:
         raise ValueError(f"unsupported type signature: {signature!r}") from None
 

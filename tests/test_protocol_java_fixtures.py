@@ -1,0 +1,305 @@
+"""Protocol fidelity against the reference's *captured Java* serializations.
+
+Everything else in tests/ round-trips this repo's own output; these
+fixtures were produced by the real Java coordinator and shipped with the
+reference's C++ worker as its protocol conformance data:
+
+    presto_cpp/presto_protocol/tests/data/TaskUpdateRequest.{1,2}
+        full TaskUpdateRequest captures (hive scans, base64 fragment,
+        qualified function names, $hashvalue channels, real splits)
+    presto_cpp/main/types/tests/data/*.json
+        PlanFragment captures used by PrestoToVeloxQueryPlan tests
+    presto_cpp/presto_protocol/tests/data/*.json
+        single-PlanNode captures used by protocol round-trip tests
+
+Three properties are asserted for every fixture:
+  1. lossless parse — re-serializing the parsed structs preserves every
+     field/value the Java coordinator emitted (deep subset compare);
+  2. typed resolution — the nodes this worker executes parse into typed
+     structs (not the RawNode fallback);
+  3. translate-or-reject — fragments either translate to an engine plan
+     or the validator rejects them with a precise reason
+     (VeloxPlanValidator.cpp analog), never an internal error.
+
+Skipped wholesale if the reference checkout is absent.
+"""
+
+import base64
+import json
+import os
+
+import pytest
+
+from presto_tpu.expr import nodes as E
+from presto_tpu.plan import nodes as P
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.translate import (
+    decode_constant, parse_type, translate_fragment,
+)
+from presto_tpu.protocol.validator import (
+    UnsupportedPlanError, validate_fragment,
+)
+from presto_tpu.types import ArrayType, MapType, RowType
+
+REF = "/root/reference/presto-native-execution/presto_cpp"
+PROTO_DATA = os.path.join(REF, "presto_protocol/tests/data")
+TYPES_DATA = os.path.join(REF, "main/types/tests/data")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(PROTO_DATA), reason="reference checkout not present")
+
+# PlanFragment fixtures: name -> (path, expects_valid)
+FRAGMENT_FIXTURES = {
+    "ScanAgg": (TYPES_DATA + "/ScanAgg.json", False),
+    "ScanAggBatch": (TYPES_DATA + "/ScanAggBatch.json", False),
+    "ScanAggCustomConnectorId":
+        (TYPES_DATA + "/ScanAggCustomConnectorId.json", False),
+    "FinalAgg": (TYPES_DATA + "/FinalAgg.json", True),
+    "Output": (TYPES_DATA + "/Output.json", True),
+    "OffsetLimit": (TYPES_DATA + "/OffsetLimit.json", True),
+    "PartitionedOutput": (TYPES_DATA + "/PartitionedOutput.json", False),
+    "IndexSource": (TYPES_DATA + "/IndexSource.json", False),
+    "ValuesPipeTest": (TYPES_DATA + "/ValuesPipeTest.json", True),
+    "PlanFragmentWithRemoteSource":
+        (PROTO_DATA + "/PlanFragmentWithRemoteSource.json", True),
+}
+
+NODE_FIXTURES = {
+    "ExchangeNode": S.ExchangeNode,
+    "FilterNode": S.FilterNode,
+    "OutputNode": S.OutputNode,
+    "RemoteSourceNodeAny": S.RemoteSourceNode,
+    "RemoteSourceNodeHttp": S.RemoteSourceNode,
+    "ValuesNode": S.ValuesNode,
+}
+
+
+def deep_subset(orig, enc, path=""):
+    """Every key/value the coordinator emitted must survive the
+    parse->reserialize round trip; extra fields we emit (newer protocol
+    additions, explicit nulls for absent optionals) are permitted."""
+    diffs = []
+    if isinstance(orig, dict):
+        if not isinstance(enc, dict):
+            return [f"{path}: dict became {type(enc).__name__}"]
+        for k, v in orig.items():
+            if k not in enc:
+                diffs.append(f"{path}.{k}: dropped")
+            else:
+                diffs += deep_subset(v, enc[k], f"{path}.{k}")
+    elif isinstance(orig, list):
+        if not isinstance(enc, list) or len(enc) != len(orig):
+            return [f"{path}: list changed"]
+        for i, (a, b) in enumerate(zip(orig, enc)):
+            diffs += deep_subset(a, b, f"{path}[{i}]")
+    elif orig != enc:
+        diffs.append(f"{path}: {orig!r} != {enc!r}")
+    return diffs
+
+
+def walk_types(node):
+    yield node
+    if isinstance(node, S.RawNode):
+        return
+    for py, _js, codec in type(node)._SCHEMA:
+        v = getattr(node, py)
+        if v is None:
+            continue
+        if codec is S.PlanNode:
+            yield from walk_types(v)
+        elif isinstance(codec, tuple) and len(codec) == 2 \
+                and codec[1] is S.PlanNode and isinstance(v, list):
+            for c in v:
+                yield from walk_types(c)
+
+
+# ---------------------------------------------------------------- parsing
+
+@pytest.mark.parametrize("name", sorted(FRAGMENT_FIXTURES))
+def test_fragment_fixture_lossless(name):
+    path, _ = FRAGMENT_FIXTURES[name]
+    orig = json.load(open(path))
+    frag = S.PlanFragment.from_json(orig)
+    enc = S.PlanFragment.to_json(frag)
+    diffs = deep_subset(orig, enc)
+    assert diffs == [], f"{name}: {diffs[:10]}"
+
+
+@pytest.mark.parametrize("name", sorted(NODE_FIXTURES))
+def test_node_fixture_lossless_and_typed(name):
+    path = os.path.join(PROTO_DATA, f"{name}.json")
+    orig = json.load(open(path))
+    node = S.PlanNode.from_json(orig)
+    assert isinstance(node, NODE_FIXTURES[name]), type(node).__name__
+    diffs = deep_subset(orig, S.PlanNode.to_json(node))
+    assert diffs == [], f"{name}: {diffs[:10]}"
+
+
+@pytest.mark.parametrize("which", ["1", "2"])
+def test_task_update_request_lossless(which):
+    path = os.path.join(PROTO_DATA, f"TaskUpdateRequest.{which}")
+    orig = json.load(open(path))
+    tur = S.TaskUpdateRequest.from_json(orig)
+    enc = S.TaskUpdateRequest.to_json(tur)
+    # compare the fragment decoded (base64 of semantically-equal JSON)
+    o2, e2 = dict(orig), dict(enc)
+    frag_o = json.loads(base64.b64decode(o2.pop("fragment")))
+    frag_e = json.loads(base64.b64decode(e2.pop("fragment")))
+    diffs = deep_subset(o2, e2) + deep_subset(frag_o, frag_e, ".fragment")
+    assert diffs == [], diffs[:10]
+    # real hive splits ride through Split.connectorSplit verbatim
+    assert tur.sources, "capture carries task sources"
+    sp = tur.sources[0].splits[0].split
+    assert sp.connectorId == "hive"
+    assert sp.connectorSplit["@type"] == "hive"
+
+
+def test_fixture_nodes_resolve_typed():
+    """The operator surface this worker executes parses into typed structs;
+    only genuinely foreign nodes fall back to RawNode."""
+    raw_seen = set()
+    for name, (path, _) in FRAGMENT_FIXTURES.items():
+        frag = S.PlanFragment.from_json(json.load(open(path)))
+        for n in walk_types(frag.root):
+            if isinstance(n, S.RawNode):
+                raw_seen.add(n.type_key)
+    assert raw_seen == set(), f"untyped plan nodes: {raw_seen}"
+
+
+# ----------------------------------------------------- coordinator shapes
+
+def test_qualified_function_names_resolve():
+    """presto.default.sum / $operator$hash_code forms from the capture."""
+    frag = S.PlanFragment.from_bytes(S.TaskUpdateRequest.from_json(
+        json.load(open(PROTO_DATA + "/TaskUpdateRequest.1"))).fragment)
+    root = frag.root
+    assert isinstance(root, S.AggregationNode)
+    sigs = {a.call.functionHandle["signature"]["name"]
+            for a in root.aggregations.values()}
+    assert sigs == {"presto.default.sum"}
+    plan = translate_fragment(frag)
+    assert isinstance(plan, P.AggregationNode)
+    assert {a.kind for a in plan.aggs} == {"sum"}
+
+
+def test_name_type_assignment_keys():
+    """Jackson serializes VariableReferenceExpression map keys as
+    "name<type>"; both Assignments and aggregations use them."""
+    frag = S.PlanFragment.from_bytes(S.TaskUpdateRequest.from_json(
+        json.load(open(PROTO_DATA + "/TaskUpdateRequest.1"))).fragment)
+    proj = frag.root.source
+    assert isinstance(proj, S.ProjectNode)
+    keys = list(proj.assignments.assignments)
+    assert any(k.startswith("$hashvalue_23<bigint>") for k in keys), keys
+    assert set(frag.root.aggregations) == {"sum_20<double>",
+                                           "sum_21<bigint>"}
+
+
+def test_hashvalue_channel_rides_exchange():
+    """FinalAgg: the $hashvalue channel flows RemoteSource -> Exchange
+    (via the inputs mapping) -> AggregationNode.hashVariable."""
+    frag = S.PlanFragment.from_json(json.load(open(
+        TYPES_DATA + "/FinalAgg.json")))
+    root = frag.root
+    assert isinstance(root, S.AggregationNode)
+    exch = root.source
+    assert isinstance(exch, S.ExchangeNode)
+    layout_names = [v.name for v in exch.partitioningScheme.outputLayout]
+    assert any(n.startswith("$hashvalue") for n in layout_names), \
+        layout_names
+    plan = translate_fragment(frag)    # inputs-mapped projection resolves
+    assert isinstance(plan, P.AggregationNode)
+    assert plan.step is P.Step.FINAL
+
+
+def test_nested_type_signatures_parse():
+    """ScanAgg carries array(map(varchar, row(id bigint, ...))) columns."""
+    t = parse_type("array(map(varchar, row(id bigint, description "
+                   "varchar)))")
+    assert isinstance(t, ArrayType)
+    assert isinstance(t.element, MapType)
+    row = t.element.value
+    assert isinstance(row, RowType)
+    assert row.field_names == ("id", "description")
+    frag = S.PlanFragment.from_json(json.load(open(
+        TYPES_DATA + "/ScanAgg.json")))
+    # whole fragment translates at the plan-shape level (scan resolution
+    # is connector-gated separately by the validator)
+    plan = translate_fragment(frag)
+    assert isinstance(plan, P.AggregationNode)
+
+
+def test_values_constants_decode():
+    """ValuesPipeTest rows carry base64 valueBlock constants."""
+    frag = S.PlanFragment.from_json(json.load(open(
+        TYPES_DATA + "/ValuesPipeTest.json")))
+    values = [n for n in walk_types(frag.root)
+              if isinstance(n, S.ValuesNode)]
+    assert values, "fixture contains a ValuesNode"
+    row0 = values[0].rows[0]
+    decoded = [decode_constant(c) for c in row0
+               if isinstance(c, S.Constant)]
+    assert decoded and all(isinstance(d, E.Literal) for d in decoded)
+    plan = translate_fragment(frag)
+    assert isinstance(plan, P.OutputNode)
+
+
+def test_offset_limit_row_number_translates():
+    """OFFSET is planned as RowNumberNode + filter; translates to the
+    engine's window row_number."""
+    frag = S.PlanFragment.from_json(json.load(open(
+        TYPES_DATA + "/OffsetLimit.json")))
+    rn = [n for n in walk_types(frag.root)
+          if isinstance(n, S.RowNumberNode)]
+    assert len(rn) == 1 and rn[0].rowNumberVariable.name == "row_number"
+    plan = translate_fragment(frag)
+    assert isinstance(plan, P.OutputNode)
+    kinds = {type(n).__name__ for n in _walk_engine(plan)}
+    assert "WindowNode" in kinds, kinds
+
+
+def _walk_engine(n):
+    yield n
+    for c in n.children():
+        yield from _walk_engine(c)
+
+
+# ------------------------------------------------------------- validation
+
+@pytest.mark.parametrize("name", sorted(FRAGMENT_FIXTURES))
+def test_validate_or_reject_precisely(name):
+    path, expect_valid = FRAGMENT_FIXTURES[name]
+    frag = S.PlanFragment.from_json(json.load(open(path)))
+    if expect_valid:
+        validate_fragment(frag)
+        assert translate_fragment(frag) is not None
+    else:
+        with pytest.raises(UnsupportedPlanError) as ei:
+            validate_fragment(frag)
+        reasons = " ".join(ei.value.reasons)
+        if name == "IndexSource":
+            assert "index lookup" in reasons
+        elif name == "ScanAggCustomConnectorId":
+            assert "'hive-plus'" in reasons
+        elif name == "PartitionedOutput":
+            # hive scan gate fires first; with hive allowed, the ARRAY
+            # constant is the precise reason
+            assert "'hive'" in reasons
+            with pytest.raises(UnsupportedPlanError) as ei2:
+                validate_fragment(
+                    frag, supported_connectors={"hive"})
+            assert "constant of type" in " ".join(ei2.value.reasons)
+        else:
+            assert "'hive'" in reasons
+
+
+def test_task_update_requests_reject_hive_cleanly():
+    for which in ("1", "2"):
+        tur = S.TaskUpdateRequest.from_json(json.load(open(
+            PROTO_DATA + f"/TaskUpdateRequest.{which}")))
+        frag = S.PlanFragment.from_bytes(tur.fragment)
+        with pytest.raises(UnsupportedPlanError) as ei:
+            validate_fragment(frag)
+        assert "connector 'hive'" in str(ei.value)
+        # but the plan *shape* translates: only the connector is foreign
+        assert translate_fragment(frag) is not None
