@@ -1,0 +1,28 @@
+"""Connector split/identity surface.
+
+Reference: presto-spi ConnectorSplitManager — the scheduler asks the
+connector for splits instead of assuming a layout. This engine's
+connectors are all host-table row-range sources, so the default split is
+a (part, numParts) row range tagged with the connector's id; connectors
+with other layouts override table_splits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SplitSource:
+    """Default row-range split source (mixed into every connector)."""
+
+    NAME = "unknown"
+
+    def connector_id(self, table: Optional[str] = None) -> str:
+        return self.NAME
+
+    def table_splits(self, table: str, n_splits: int) -> List[dict]:
+        """ConnectorSplit payloads for scanning `table` with n_splits
+        tasks (one split per task; the scheduler may subdivide)."""
+        cid = self.connector_id(table)
+        return [{"@type": cid, "part": i, "numParts": n_splits}
+                for i in range(n_splits)]

@@ -16,15 +16,24 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from presto_tpu.connectors.base import SplitSource
 from presto_tpu.connectors.tpch import HostTable, _slice_rows
 from presto_tpu.data.column import StringDict
 from presto_tpu.types import Type
 
 
-class MemoryConnector:
+class MemoryConnector(SplitSource):
+    NAME = "memory"
+
     def __init__(self, fallback=None):
         self.fallback = fallback
         self.tables: Dict[str, HostTable] = {}
+
+    def connector_id(self, table: str = None) -> str:
+        if table is not None and table not in self.tables \
+                and self.fallback is not None:
+            return self.fallback.connector_id(table)
+        return self.NAME
 
     # ------------------------------------------------------------- reads
     def schema(self, table: str) -> List[Tuple[str, Type]]:
@@ -71,7 +80,11 @@ class MemoryConnector:
         types = {}
         for c, t in schema:
             types[c] = t
-            if t.is_string:
+            if t.name in ("array", "map", "row"):
+                # nested values stored as python objects host-side;
+                # page() builds offset-encoded NestedColumns
+                arrays[c] = np.zeros(0, object)
+            elif t.is_string:
                 arrays[c] = np.zeros(0, np.int32)
                 dicts[c] = StringDict([])
             else:
@@ -102,7 +115,12 @@ class MemoryConnector:
                 c, np.zeros(t.num_rows, dtype=bool))[:t.num_rows]
             new_nulls[c] = np.concatenate(
                 [old_null, np.asarray([v is None for v in vals], bool)])
-            if typ.is_string:
+            if typ.name in ("array", "map", "row"):
+                arr = np.empty(n_new, object)
+                arr[:] = vals
+                new_arrays[c] = np.concatenate(
+                    [t.arrays[c][:t.num_rows], arr])
+            elif typ.is_string:
                 # merge into one table-wide sorted dictionary, remapping
                 # existing codes (the shared cross-page dictionary
                 # machinery, data/column.merge_string_dicts)

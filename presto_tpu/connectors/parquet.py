@@ -140,7 +140,11 @@ def write_parquet_table(path: str, rows: List[tuple],
                    path)
 
 
-class ParquetConnector:
+from presto_tpu.connectors.base import SplitSource
+
+
+class ParquetConnector(SplitSource):
+    NAME = "parquet"
     """Directory-of-files catalog: `<dir>/<table>.parquet`. Same surface
     as the generated-fixture connectors; an optional fallback serves
     other names (multi-catalog facade, as connectors/memory.py)."""

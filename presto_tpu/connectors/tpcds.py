@@ -144,7 +144,8 @@ TPCDS_SCHEMA: Dict[str, List[Tuple[str, Type]]] = {
 _D0 = days_from_civil(1900, 1, 1)
 _D1 = days_from_civil(2100, 1, 1)
 _DATE_SK0 = 2415022                       # julian day of 1900-01-01
-_N_DATES = _D1 - _D0 + 1                  # 73049, per spec
+_N_DATES = _D1 - _D0                      # 73049 rows, per spec
+                                          # (1900-01-01 .. 2099-12-31)
 
 _SALES_D0 = days_from_civil(1998, 1, 1)
 _SALES_D1 = days_from_civil(2002, 12, 31)
@@ -225,7 +226,7 @@ def _gen(name: str, sf: float) -> HostTable:
         _dictify(arrays, dicts, col, vals)
 
     if name == "date_dim":
-        days = np.arange(_D0, _D1 + 1, dtype=np.int64)
+        days = np.arange(_D0, _D1, dtype=np.int64)
         n = len(days)
         arrays["d_date_sk"] = _DATE_SK0 + (days - _D0)
         put_str("d_date_id", np.char.add(
@@ -579,7 +580,11 @@ def _gen_sales(name: str, sf: float) -> HostTable:
     return _ht(name, n, arrays, dicts)
 
 
-class TpcdsConnector:
+from presto_tpu.connectors.base import SplitSource
+
+
+class TpcdsConnector(SplitSource):
+    NAME = "tpcds"
     """Second fixture connector (reference: presto-tpcds). Same surface as
     TpchConnector: schema / row_count / partitioned table slices sharing
     one table-wide StringDict per string column."""

@@ -202,6 +202,11 @@ class HostTable:
         out = []
         for c in cols:
             t = self.types[c]
+            if t.name in ("array", "map", "row"):
+                from presto_tpu.data.column import NestedColumn
+                out.append(NestedColumn.from_pylist(
+                    list(self.arrays[c][:self.num_rows]), t, cap))
+                continue
             out.append(Column.from_numpy(self.arrays[c][:self.num_rows], t,
                                          nulls=self.null_mask(c),
                                          dictionary=self.dicts.get(c),
@@ -428,7 +433,11 @@ def _gen_orders_lineitem(which: str, sf: float) -> HostTable:
     return HostTable("orders", n, arrays, dict(TPCH_SCHEMA["orders"]), dicts)
 
 
-class TpchConnector:
+from presto_tpu.connectors.base import SplitSource
+
+
+class TpchConnector(SplitSource):
+    NAME = "tpch"
     """Connector facade: schema + partitioned table generation.
 
     Reference surface: ConnectorMetadata + ConnectorSplitManager +

@@ -175,6 +175,156 @@ class Column:
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
+class NestedColumn:
+    """ARRAY/MAP/ROW column: per-row (start, length) slices into flat
+    child columns (reference: presto-common ArrayBlock/MapBlock/RowBlock
+    offset encoding — here start+length instead of a prefix array so
+    row-wise gather/filter never rewrites the child buffers).
+
+    ARRAY: children = (elements,);  MAP: children = (keys, values) —
+    parallel, one entry pair per map entry;  ROW: children = one column
+    per field, aligned 1:1 with parent rows (starts/lengths are identity
+    and unused). The jit engine consumes these only through UNNEST (which
+    flattens to ordinary columns); every other operator rejects nested
+    input up front."""
+    starts: jnp.ndarray          # [capacity] int32 into children
+    lengths: jnp.ndarray         # [capacity] int32 (entries per row)
+    nulls: jnp.ndarray           # [capacity] bool, True = NULL row
+    children: Tuple["Column", ...]
+    type: Type                   # aux: ArrayType | MapType | RowType
+
+    def tree_flatten(self):
+        return ((self.starts, self.lengths, self.nulls, self.children),
+                (self.type,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        starts, lengths, nulls, children = leaves
+        return cls(starts, lengths, nulls, tuple(children), aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return self.starts.shape[0]
+
+    @property
+    def dictionary(self):
+        return None
+
+    def gather(self, idx: jnp.ndarray, valid=None) -> "NestedColumn":
+        # starts are absolute child positions, so children never move on
+        # row-wise gather — ROW columns too (their starts index fields).
+        starts = jnp.take(self.starts, idx, mode="clip")
+        lengths = jnp.take(self.lengths, idx, mode="clip")
+        nulls = jnp.take(self.nulls, idx, mode="clip")
+        if valid is not None:
+            starts = jnp.where(valid, starts, 0)
+            lengths = jnp.where(valid, lengths, 0)
+            nulls = jnp.where(valid, nulls, True)
+        return NestedColumn(starts, lengths, nulls, self.children,
+                            self.type)
+
+    def to_numpy(self, num_rows: Optional[int] = None):
+        """Match Column.to_numpy's (values, nulls) shape contract for
+        callers that only need validity; values are the lengths lane."""
+        v = np.asarray(self.lengths)
+        n = np.asarray(self.nulls)
+        if num_rows is not None:
+            v, n = v[:num_rows], n[:num_rows]
+        return v, n
+
+    # -- host construction/access ----------------------------------------
+    @staticmethod
+    def from_pylist(vals, type: Type,
+                    capacity: Optional[int] = None) -> "NestedColumn":
+        """Build from python values: lists (array), dicts (map), tuples
+        (row), or None."""
+        n = len(vals)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        nulls = np.array([v is None for v in vals] + [True] * (cap - n),
+                         dtype=bool)
+        if type.name == "row":
+            fields = []
+            for i, ft in enumerate(type.field_types):
+                fvals = [None if v is None else v[i] for v in vals]
+                fields.append(_column_from_pylist(fvals, ft, cap))
+            ident = np.arange(cap, dtype=np.int32)
+            return NestedColumn(jnp.asarray(ident),
+                                jnp.asarray(np.ones(cap, np.int32)),
+                                jnp.asarray(nulls), tuple(fields), type)
+        lengths = np.zeros(cap, np.int32)
+        flat_items: list = []
+        starts = np.zeros(cap, np.int32)
+        for i, v in enumerate(vals):
+            starts[i] = len(flat_items)
+            if v is None:
+                continue
+            items = list(v.items()) if type.name == "map" else list(v)
+            lengths[i] = len(items)
+            flat_items.extend(items)
+        ecap = bucket_capacity(max(len(flat_items), 1))
+        if type.name == "map":
+            keys = _column_from_pylist(
+                [k for k, _v in flat_items], type.key, ecap)
+            values = _column_from_pylist(
+                [v for _k, v in flat_items], type.value, ecap)
+            children = (keys, values)
+        else:
+            children = (_column_from_pylist(
+                flat_items, type.element, ecap),)
+        return NestedColumn(jnp.asarray(starts), jnp.asarray(lengths),
+                            jnp.asarray(nulls), children, type)
+
+    def value_at(self, i: int):
+        """Python value of row i (host; to_pylist support)."""
+        if bool(np.asarray(self.nulls)[i]):
+            return None
+        if self.type.name == "row":
+            return tuple(_pyvalue(c, int(np.asarray(self.starts)[i]))
+                         for c in self.children)
+        s = int(np.asarray(self.starts)[i])
+        ln = int(np.asarray(self.lengths)[i])
+        if self.type.name == "map":
+            return {_pyvalue(self.children[0], j):
+                    _pyvalue(self.children[1], j)
+                    for j in range(s, s + ln)}
+        return [_pyvalue(self.children[0], j) for j in range(s, s + ln)]
+
+
+def _column_from_pylist(vals, t: Type, capacity: int):
+    """list of python values -> Column/NestedColumn of type t."""
+    if isinstance(t, Type) and t.name in ("array", "map", "row"):
+        return NestedColumn.from_pylist(vals, t, capacity)
+    if t.is_string:
+        return Column.from_strings(vals, capacity=capacity)
+    nulls = np.array([v is None for v in vals], dtype=bool)
+    filled = np.array([0 if v is None else v for v in vals])
+    if t.is_decimal:
+        filled = np.round(np.asarray(filled, dtype=np.float64)
+                          * (10 ** t.scale)).astype(np.int64)
+    return Column.from_numpy(filled, t, nulls=nulls, capacity=capacity)
+
+
+def _pyvalue(col, i: int):
+    """One position of a Column/NestedColumn as a python value."""
+    if isinstance(col, NestedColumn):
+        return col.value_at(i)
+    v, nl = col.to_numpy()
+    if nl[i]:
+        return None
+    if col.type.is_string:
+        return (col.dictionary[int(v[i])]
+                if col.dictionary is not None else int(v[i]))
+    if isinstance(col.type, DecimalType):
+        return int(v[i]) / (10 ** col.type.scale)
+    if col.type.name == "boolean":
+        return bool(v[i])
+    if col.type.is_floating:
+        return float(v[i])
+    return int(v[i])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
 class Page:
     columns: Tuple[Column, ...]
     num_rows: jnp.ndarray        # scalar int32 (traced)
@@ -218,18 +368,8 @@ class Page:
         for name, vals in data.items():
             n = len(vals)
             t = types[name]
-            if t.is_string:
-                cols.append(Column.from_strings(vals, capacity=capacity))
-            else:
-                nulls = np.array([v is None for v in vals], dtype=bool)
-                filled = np.array(
-                    [0 if v is None else v for v in vals])
-                if t.is_decimal:
-                    filled = np.round(
-                        np.asarray(filled, dtype=np.float64)
-                        * (10 ** t.scale)).astype(np.int64)
-                cols.append(Column.from_numpy(filled, t, nulls=nulls,
-                                              capacity=capacity))
+            cap = capacity if capacity is not None else bucket_capacity(n)
+            cols.append(_column_from_pylist(list(vals), t, cap))
             names.append(name)
         return Page.from_columns(cols, n, names)
 
@@ -246,7 +386,9 @@ class Page:
         for i in range(n):
             row = []
             for c, v, nl in cols:
-                if nl[i]:
+                if isinstance(c, NestedColumn):
+                    row.append(c.value_at(i))
+                elif nl[i]:
                     row.append(None)
                 elif c.type.is_string:
                     row.append(c.dictionary[int(v[i])]
@@ -303,6 +445,17 @@ def concat_pages_host(pages: Sequence[Page],
     cols: List[Column] = []
     for ci, c0 in enumerate(first.columns):
         vals_parts, null_parts = [], []
+        if isinstance(c0, NestedColumn):
+            # host re-materialization through python values (exchange
+            # volumes of nested data are modest until nested compute
+            # exists; correctness first)
+            pyvals: List = []
+            for p in pages:
+                col = p.columns[ci]
+                pyvals.extend(col.value_at(i)
+                              for i in range(int(p.num_rows)))
+            cols.append(NestedColumn.from_pylist(pyvals, c0.type, cap))
+            continue
         if c0.type.is_string:
             union, remaps = merge_string_dicts(
                 [p.columns[ci].dictionary for p in pages])
@@ -333,12 +486,25 @@ def select_page_host(page: Page, idx: np.ndarray) -> Page:
     producer side of partitioned output (PartitionedOutputOperator.java:57
     splitting rows into per-destination pages)."""
     n = len(idx)
+    cap = bucket_capacity(max(n, 1))
     cols = []
     for c in page.columns:
+        if isinstance(c, NestedColumn):
+            starts = np.asarray(c.starts)[idx]
+            lengths = np.asarray(c.lengths)[idx]
+            nulls = np.asarray(c.nulls)[idx]
+            pad = cap - n
+            cols.append(NestedColumn(
+                jnp.asarray(np.pad(starts, (0, pad))),
+                jnp.asarray(np.pad(lengths, (0, pad))),
+                jnp.asarray(np.pad(nulls, (0, pad),
+                                   constant_values=True)),
+                c.children, c.type))
+            continue
         v, nl = c.to_numpy(int(page.num_rows))
         cols.append(Column.from_numpy(v[idx], c.type, nulls=nl[idx],
                                       dictionary=c.dictionary,
-                                      capacity=bucket_capacity(max(n, 1))))
+                                      capacity=cap))
     return Page.from_columns(cols, n, page.names)
 
 
@@ -370,12 +536,27 @@ def compact(page: Page, keep: jnp.ndarray) -> Page:
     valid = jnp.arange(cap, dtype=jnp.int32) < n
     operands = (order_key,)
     for c in page.columns:
-        operands += (c.values, c.nulls)
+        if isinstance(c, NestedColumn):
+            # row-wise lanes only; child buffers hold still (starts are
+            # absolute positions)
+            operands += (c.starts, c.lengths, c.nulls)
+        else:
+            operands += (c.values, c.nulls)
     sorted_ops = jax.lax.sort(operands, num_keys=1, is_stable=False)
     cols = []
-    for i, c in enumerate(page.columns):
-        vals = sorted_ops[1 + 2 * i]
-        nulls = sorted_ops[2 + 2 * i]
+    pos = 1
+    for c in page.columns:
+        if isinstance(c, NestedColumn):
+            starts, lengths, nulls = sorted_ops[pos:pos + 3]
+            pos += 3
+            starts = jnp.where(valid, starts, 0)
+            lengths = jnp.where(valid, lengths, 0)
+            nulls = jnp.where(valid, nulls, True)
+            cols.append(NestedColumn(starts, lengths, nulls, c.children,
+                                     c.type))
+            continue
+        vals, nulls = sorted_ops[pos:pos + 2]
+        pos += 2
         sent = jnp.asarray(c.type.null_sentinel(), dtype=vals.dtype)
         vals = jnp.where(valid, vals, sent)
         nulls = jnp.where(valid, nulls, True)

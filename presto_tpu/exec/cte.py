@@ -34,7 +34,17 @@ def _count_refs(node, name: str) -> int:
         return int(node.name == name)
     if isinstance(node, ast.Select):
         if any(n == name for n, _q in node.ctes):
-            return 0            # shadowed below this point
+            # Shadowed — but WITH bindings are sequential: definition
+            # queries up to AND INCLUDING the shadowing declaration still
+            # see the OUTER name (a non-recursive CTE cannot reference
+            # itself). Only later definitions and the body see the inner
+            # binding.
+            n = 0
+            for cn, cq in node.ctes:
+                n += _count_refs(cq, name)
+                if cn == name:
+                    break
+            return n
         n = 0
         for _cn, cq in node.ctes:
             n += _count_refs(cq, name)
@@ -57,7 +67,17 @@ def _rename_refs(node, old: str, new: str):
                 if node.name == old else node)
     if isinstance(node, ast.Select) and \
             any(n == old for n, _q in node.ctes):
-        return node             # shadowed: leave subtree untouched
+        # Shadowed: rename only inside definition queries up to and
+        # including the shadowing declaration (sequential-WITH scoping,
+        # mirroring _count_refs); the body keeps the inner binding.
+        new_ctes, hit = [], False
+        for cn, cq in node.ctes:
+            if not hit:
+                cq = _rename_refs(cq, old, new)
+            new_ctes.append((cn, cq))
+            if cn == old:
+                hit = True
+        return dataclasses.replace(node, ctes=tuple(new_ctes))
     if dataclasses.is_dataclass(node):
         return dataclasses.replace(node, **{
             f.name: _rename_refs(getattr(node, f.name), old, new)
@@ -94,8 +114,15 @@ def materialize_ctes(q: ast.Select, run_select, temp_store
             if refs < 2:
                 remaining.append((name, rebind(cq)))
                 continue
+            # Prepend the outer still-inlined bindings to the body's OWN
+            # nested WITH (inner declarations win on name collision) —
+            # overwriting would drop the body's nested CTEs entirely.
+            bound = rebind(cq)
+            inner_names = {n for n, _q in bound.ctes}
+            merged = tuple(c for c in remaining
+                           if c[0] not in inner_names) + tuple(bound.ctes)
             rows, names, types = run_select(
-                dataclasses.replace(rebind(cq), ctes=tuple(remaining)))
+                dataclasses.replace(bound, ctes=merged))
             tmp = f"__cte_{next(_ids)}_{name}"
             temp_store.create(tmp, list(zip(names, types)))
             temp_store.append_rows(tmp, rows)

@@ -43,10 +43,12 @@ class DistExecutor(Executor):
     """Executes plans distributed over an N-device mesh (CPU mesh in
     tests, TPU ICI in production)."""
 
-    def __init__(self, connector, mesh, session=None):
+    def __init__(self, connector, mesh, session=None, history=None):
         super().__init__(connector, session=session)
         self.mesh = mesh
         self.ndev = int(mesh.devices.size)
+        # HBO store consulted by add_exchanges at _prepare time
+        self.history = history
 
     # ---- fragment-by-fragment execution ---------------------------------
     # One XLA program per fragment (not one giant fused program): compile
@@ -92,7 +94,7 @@ class DistExecutor(Executor):
     # axon TPU tunnel supports Sum all-reduce only).
     def _prepare(self, plan: PlanNode) -> PlanNode:
         return add_exchanges(plan, self.connector, self.session,
-                             getattr(self, "history", None))
+                             self.history)
 
     def _wrap(self, fn: Callable) -> Callable:
         if self.ndev == 1:
@@ -217,12 +219,13 @@ class DistEngine:
     DistributedQueryRunner (presto-tests/.../DistributedQueryRunner.java:114)
     — N workers in one process, real exchanges between them."""
 
-    def __init__(self, connector, mesh, session=None):
+    def __init__(self, connector, mesh, session=None, history=None):
         from presto_tpu.sql.analyzer import Planner
 
         self.connector = connector
         self.planner = Planner(connector)
-        self.executor = DistExecutor(connector, mesh, session=session)
+        self.executor = DistExecutor(connector, mesh, session=session,
+                                     history=history)
         self._plans = {}
 
     def plan_sql(self, sql: str) -> PlanNode:
@@ -233,4 +236,18 @@ class DistEngine:
 
     def execute_sql(self, sql: str) -> List[tuple]:
         stacked = self.executor.execute(self.plan_sql(sql))
-        return self.executor._page_rows(stacked)
+        rows = self.executor._page_rows(stacked)
+        self._record_history()
+        return rows
+
+    def _record_history(self):
+        """Feed observed per-node rows into the HBO store after execution
+        (mirrors LocalEngine._record_history; requires collect_stats)."""
+        ex = self.executor
+        if ex.history is None or not getattr(ex, "last_node_rows", None):
+            return
+        from presto_tpu.plan.stats import canonical_key
+        for nid, rows_n in ex.last_node_rows.items():
+            entry = ex._node_map.get(nid)
+            if entry is not None:
+                ex.history.record(canonical_key(entry[0]), rows_n)

@@ -36,7 +36,7 @@ from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
     GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, PlanNode,
     ProjectNode, RemoteSourceNode, SortNode, TableScanNode, TopNNode,
-    ValuesNode, WindowNode,
+    UnnestNode, ValuesNode, WindowNode,
 )
 
 
@@ -559,6 +559,23 @@ class Executor:
                     return Page(p.columns + (col,), p.num_rows,
                                 node.output_names)
                 return rowid_fn, cap
+            if isinstance(node, UnnestNode):
+                src, cap = build(node.source)
+                fan = max(node.fanout_hint, 1.0)
+                out_cap = caps.get(nid) or bucket_capacity(
+                    min(int(cap * fan), 2**26))
+                caps[nid] = out_cap
+                watch.append(nid)
+
+                def unnest_fn(pages, node=node, out_cap=out_cap):
+                    from presto_tpu.ops.unnest import unnest_page
+                    p = src(pages)
+                    out, total = unnest_page(
+                        p, node.replicate_fields, node.unnest_fields,
+                        out_cap, node.with_ordinality, node.output_names)
+                    _needed.append(total)
+                    return out
+                return unnest_fn, out_cap
             if isinstance(node, WindowNode):
                 src, cap = build(node.source)
 

@@ -65,6 +65,13 @@ class SplitExecutor(Executor):
         cols = []
         for c in s.columns:
             t0 = tables[0]
+            if t0.types[c].name in ("array", "map", "row"):
+                from presto_tpu.data.column import NestedColumn
+                vals = [v for t in tables
+                        for v in t.arrays[c][:t.num_rows]]
+                cols.append(NestedColumn.from_pylist(
+                    vals, t0.types[c], s.capacity))
+                continue
             arr = np.concatenate([t.arrays[c][:t.num_rows] for t in tables])
             masks = [t.null_mask(c) for t in tables]
             nulls = (np.concatenate(

@@ -41,16 +41,29 @@ def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
 
 
 def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
+    from presto_tpu.data.column import NestedColumn
     key_ops = _sort_key_operands(page, keys)
     operands = tuple(key_ops)
     for c in page.columns:
-        operands += (c.values, c.nulls)
+        if isinstance(c, NestedColumn):
+            # nested payload rides as row-wise lanes; child buffers are
+            # position-addressed and never move
+            operands += (c.starts, c.lengths, c.nulls)
+        else:
+            operands += (c.values, c.nulls)
     out = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=True)
-    base = len(key_ops)
-    cols = tuple(
-        Column(out[base + 2 * i], out[base + 2 * i + 1], c.type, c.dictionary)
-        for i, c in enumerate(page.columns))
-    return Page(cols, page.num_rows, page.names)
+    pos = len(key_ops)
+    cols = []
+    for c in page.columns:
+        if isinstance(c, NestedColumn):
+            cols.append(NestedColumn(out[pos], out[pos + 1], out[pos + 2],
+                                     c.children, c.type))
+            pos += 3
+        else:
+            cols.append(Column(out[pos], out[pos + 1], c.type,
+                               c.dictionary))
+            pos += 2
+    return Page(tuple(cols), page.num_rows, page.names)
 
 
 def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:

@@ -1,0 +1,68 @@
+"""UNNEST — flatten ARRAY/MAP columns into rows, jit-compiled.
+
+Reference semantics: operator/unnest/UnnestOperator.java with
+ArrayUnnester/MapUnnester — row i expands to max(cardinality) output
+rows across the unnest channels; shorter channels null-pad; replicate
+channels repeat; WITH ORDINALITY appends the 1-based position.
+
+TPU shape: everything is static-capacity. Output row j finds its parent
+row with one searchsorted over the cumulative row lengths, then gathers
+replicate lanes at the parent and element lanes at start+within — no
+data-dependent control flow, so XLA fuses the whole flatten into a few
+vector ops. Overflow rides the executor's watch/retry counters: the
+kernel returns the true total so the caller re-lowers at a bigger
+bucket when out_cap truncates.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Column, NestedColumn, Page
+from presto_tpu.types import BIGINT
+
+
+def unnest_page(page: Page, replicate_fields: Tuple[int, ...],
+                unnest_fields: Tuple[int, ...], out_cap: int,
+                with_ordinality: bool,
+                out_names: Tuple[str, ...]) -> Tuple[Page, jnp.ndarray]:
+    """Returns (output page, true total rows needed)."""
+    cap = page.capacity
+    valid = page.row_valid()
+    nested = [page.columns[f] for f in unnest_fields]
+    for nc in nested:
+        if not isinstance(nc, NestedColumn):
+            raise TypeError("UNNEST over a non-nested column")
+    # per-row expansion count = max over channels (0 for NULL rows)
+    rowlen = jnp.zeros(cap, jnp.int32)
+    for nc in nested:
+        ln = jnp.where(nc.nulls | ~valid, 0, nc.lengths)
+        rowlen = jnp.maximum(rowlen, ln)
+    cum = jnp.cumsum(rowlen)                       # [cap]
+    total = (cum[-1] if cap else jnp.asarray(0, jnp.int32))
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    parent = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    parent_c = jnp.clip(parent, 0, max(cap - 1, 0))
+    prev = jnp.where(parent_c > 0,
+                     jnp.take(cum, parent_c - 1, mode="clip"), 0)
+    within = j - prev
+    out_valid = j < total
+
+    cols = []
+    for f in replicate_fields:
+        cols.append(page.columns[f].gather(parent_c, valid=out_valid))
+    for nc in nested:
+        ln = jnp.take(nc.lengths, parent_c, mode="clip")
+        null_row = jnp.take(nc.nulls, parent_c, mode="clip")
+        entry_ok = out_valid & (within < ln) & ~null_row
+        eidx = jnp.take(nc.starts, parent_c, mode="clip") + within
+        for child in nc.children:
+            cols.append(child.gather(eidx, valid=entry_ok))
+    if with_ordinality:
+        ordv = jnp.where(out_valid, (within + 1).astype(jnp.int64),
+                         jnp.asarray(BIGINT.null_sentinel(), jnp.int64))
+        cols.append(Column(ordv, ~out_valid, BIGINT, None))
+    out = Page(tuple(cols), total.astype(jnp.int32), tuple(out_names))
+    return out, total.astype(jnp.int64)

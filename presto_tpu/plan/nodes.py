@@ -159,6 +159,24 @@ class AssignUniqueIdNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class UnnestNode(PlanNode):
+    """Flattens ARRAY/MAP columns into rows (reference:
+    spi/plan/UnnestNode -> operator/unnest/ArrayUnnester.java /
+    MapUnnester.java). Output = replicated source columns ++ per unnest
+    channel its element column(s) (array -> 1, map -> key+value) ++ an
+    optional 1-based BIGINT ordinality. Multiple unnest channels zip
+    positionally; shorter ones null-pad (Presto semantics)."""
+    source: PlanNode = None
+    replicate_fields: Tuple[int, ...] = ()
+    unnest_fields: Tuple[int, ...] = ()
+    with_ordinality: bool = False
+    fanout_hint: float = 4.0    # expected elements per row (capacity seed)
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class WindowNode(PlanNode):
     """Appends one column per window function (reference:
     spi/plan/WindowNode -> operator/WindowOperator.java:68). Output =

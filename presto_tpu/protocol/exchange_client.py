@@ -14,20 +14,27 @@ from typing import List, Optional, Tuple
 
 
 class PageStream:
-    """Pull all SerializedPage frames from one upstream buffer."""
+    """Pull all SerializedPage frames from one upstream buffer.
+    `max_size_bytes` bounds each GET's response (client-side backpressure:
+    ExchangeClient.java maxResponseSize / PrestoExchangeSource's
+    kMaxBytes) so one pull round never materializes more than a chunk."""
 
     def __init__(self, task_uri: str, buffer_id: str = "0",
-                 max_wait: str = "1s"):
+                 max_wait: str = "1s",
+                 max_size_bytes: Optional[int] = None):
         self.base = task_uri.rstrip("/")
         self.buffer_id = buffer_id
         self.max_wait = max_wait
+        self.max_size_bytes = max_size_bytes
         self.token = 0
         self.complete = False
         self.task_instance_id: Optional[str] = None
 
     def _get(self, url: str) -> Tuple[bytes, dict]:
-        req = urllib.request.Request(
-            url, headers={"X-Presto-Max-Wait": self.max_wait})
+        headers = {"X-Presto-Max-Wait": self.max_wait}
+        if self.max_size_bytes is not None:
+            headers["X-Presto-Max-Size"] = f"{self.max_size_bytes}B"
+        req = urllib.request.Request(url, headers=headers)
         with urllib.request.urlopen(req, timeout=30) as resp:
             return resp.read(), dict(resp.headers)
 
@@ -51,18 +58,31 @@ class PageStream:
             self.token = nxt
         return body
 
-    def drain(self) -> bytes:
-        out = b""
-        while not self.complete:
-            out += self.fetch()
-        # release the buffer (reference: abortResults DELETE)
+    def close(self):
+        """Release the buffer (reference: abortResults DELETE)."""
         req = urllib.request.Request(
             f"{self.base}/results/{self.buffer_id}", method="DELETE")
         try:
             urllib.request.urlopen(req, timeout=10).read()
         except Exception:            # noqa: BLE001 — abort is best-effort
             pass
+
+    def drain(self) -> bytes:
+        out = b""
+        while not self.complete:
+            out += self.fetch()
+        self.close()
         return out
+
+    def drain_pages(self, types, sink) -> None:
+        """Bounded-memory drain: decode each fetched chunk into engine
+        pages immediately and hand them to `sink(page)` — raw wire bytes
+        never accumulate beyond one chunk."""
+        while not self.complete:
+            data = self.fetch()
+            for p in decode_pages(data, list(types)):
+                sink(p)
+        self.close()
 
 
 def decode_pages(data: bytes, types) -> List:

@@ -49,11 +49,18 @@ class WireBlock:
     # RLE: single-position value block + count
     rle_value: Optional["WireBlock"] = None
     count: int = 0
+    # ARRAY: children=[elements]; MAP: children=[keys, values];
+    # ROW: children=[field0, field1, ...] — with per-position offsets
+    # (n+1 int32, rebased to 0, reference ArrayBlockEncoding.java layout)
+    children: Optional[List["WireBlock"]] = None
+    offsets: Optional[np.ndarray] = None
 
     @property
     def position_count(self) -> int:
         if self.encoding == "RLE":
             return self.count
+        if self.offsets is not None:
+            return len(self.offsets) - 1
         return len(self.values)
 
 
@@ -156,6 +163,38 @@ def _encode_block(out: bytearray, b: WireBlock):
         payload = b"".join(v for v in b.values if v is not None)
         out.extend(struct.pack("<i", len(payload)))
         out.extend(payload)
+    elif b.encoding == "ARRAY":
+        # reference ArrayBlockEncoding.java: elements block, then
+        # positionCount, offsets[n+1] rebased to 0, null bits
+        n = b.position_count
+        _encode_block(out, b.children[0])
+        out.extend(struct.pack("<i", n))
+        out.extend(np.ascontiguousarray(b.offsets,
+                                        dtype=np.int32).tobytes())
+        _encode_nulls(out, b.nulls, n)
+    elif b.encoding == "MAP":
+        # reference MapBlockEncoding.java: key block, value block,
+        # hashtable length (-1 = absent; readers rebuild lazily),
+        # positionCount, offsets[n+1], null bits
+        n = b.position_count
+        _encode_block(out, b.children[0])
+        _encode_block(out, b.children[1])
+        out.extend(struct.pack("<i", -1))
+        out.extend(struct.pack("<i", n))
+        out.extend(np.ascontiguousarray(b.offsets,
+                                        dtype=np.int32).tobytes())
+        _encode_nulls(out, b.nulls, n)
+    elif b.encoding == "ROW":
+        # reference RowBlockEncoding.java: numFields, field blocks,
+        # positionCount, fieldBlockOffsets[n+1], null bits
+        n = b.position_count
+        out.extend(struct.pack("<i", len(b.children)))
+        for child in b.children:
+            _encode_block(out, child)
+        out.extend(struct.pack("<i", n))
+        out.extend(np.ascontiguousarray(b.offsets,
+                                        dtype=np.int32).tobytes())
+        _encode_nulls(out, b.nulls, n)
     elif b.encoding == "RLE":
         out.extend(struct.pack("<i", b.count))
         _encode_block(out, b.rle_value)
@@ -213,6 +252,46 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
                 vals[i] = payload[prev:end]
             prev = end
         return WireBlock(name, vals, nulls), off
+    if name == "ARRAY":
+        elements, off = _decode_block(buf, off)
+        (n,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        offsets = np.frombuffer(buf[off:off + 4 * (n + 1)],
+                                dtype=np.int32).copy()
+        off += 4 * (n + 1)
+        nulls, off = _decode_nulls(buf, off, n)
+        return WireBlock("ARRAY", nulls=nulls, children=[elements],
+                         offsets=offsets), off
+    if name == "MAP":
+        keys, off = _decode_block(buf, off)
+        vals, off = _decode_block(buf, off)
+        (ht_len,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        if ht_len >= 0:          # reader-side lookup index — not needed
+            off += 4 * ht_len
+        (n,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        offsets = np.frombuffer(buf[off:off + 4 * (n + 1)],
+                                dtype=np.int32).copy()
+        off += 4 * (n + 1)
+        nulls, off = _decode_nulls(buf, off, n)
+        return WireBlock("MAP", nulls=nulls, children=[keys, vals],
+                         offsets=offsets), off
+    if name == "ROW":
+        (nf,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        fields = []
+        for _ in range(nf):
+            f, off = _decode_block(buf, off)
+            fields.append(f)
+        (n,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        offsets = np.frombuffer(buf[off:off + 4 * (n + 1)],
+                                dtype=np.int32).copy()
+        off += 4 * (n + 1)
+        nulls, off = _decode_nulls(buf, off, n)
+        return WireBlock("ROW", nulls=nulls, children=fields,
+                         offsets=offsets), off
     if name == "RLE":
         (count,) = struct.unpack_from("<i", buf, off)
         off += 4
@@ -290,87 +369,160 @@ def decode_serialized_page(data: bytes, offset: int = 0
 # engine Page <-> wire blocks
 # ---------------------------------------------------------------------------
 
+def _flat_to_wire(t, vals: np.ndarray, nulls: np.ndarray,
+                  dictionary) -> WireBlock:
+    if t.is_string and dictionary is not None:
+        words = np.array(
+            [w.encode() for w in dictionary.words] or [b""],
+            dtype=object)
+        dict_block = WireBlock("VARIABLE_WIDTH", words, None)
+        ids = np.where(nulls, 0, vals).astype(np.int32)
+        # Presto represents a null string position as a null slot in
+        # the dictionary; simplest faithful form: append a null slot.
+        if nulls.any():
+            null_slot = len(words)
+            words2 = np.append(words, None)
+            dict_block = WireBlock(
+                "VARIABLE_WIDTH", words2,
+                np.arange(len(words2)) == null_slot)
+            ids = np.where(nulls, null_slot, ids).astype(np.int32)
+        return WireBlock("DICTIONARY", ids, None, dictionary=dict_block)
+    if t.dtype == np.bool_:
+        return WireBlock("BYTE_ARRAY", vals.astype(np.uint8),
+                         nulls if nulls.any() else None)
+    if t.dtype == np.int32:
+        return WireBlock("INT_ARRAY", vals.astype(np.int32),
+                         nulls if nulls.any() else None)
+    if t.dtype == np.int64:
+        return WireBlock("LONG_ARRAY", vals.astype(np.int64),
+                         nulls if nulls.any() else None)
+    if t.dtype == np.float64:
+        return WireBlock("LONG_ARRAY", vals.view(np.int64).copy(),
+                         nulls if nulls.any() else None)
+    if t.dtype == np.float32:
+        return WireBlock("INT_ARRAY", vals.view(np.int32).copy(),
+                         nulls if nulls.any() else None)
+    raise NotImplementedError(f"wire type {t}")
+
+
+def _any_to_wire(col, idx: np.ndarray) -> WireBlock:
+    """Column/NestedColumn rows at absolute positions `idx` -> WireBlock."""
+    from presto_tpu.data.column import NestedColumn
+    if isinstance(col, NestedColumn):
+        return _nested_to_wire(col, idx)
+    v, nl = col.to_numpy()
+    return _flat_to_wire(col.type, v[idx], nl[idx].copy(),
+                         col.dictionary)
+
+
+def _nested_to_wire(col, idx: np.ndarray) -> WireBlock:
+    """NestedColumn rows at `idx` -> ARRAY/MAP/ROW WireBlock with
+    contiguous rebased offsets (the reference encodings' region form)."""
+    starts = np.asarray(col.starts)[idx]
+    lengths = np.asarray(col.lengths)[idx]
+    nulls = np.asarray(col.nulls)[idx].copy()
+    t = col.type
+    if t.name == "row":
+        # field entries exist only for non-null rows; offsets advance
+        # by 1 per non-null row (createRowBlockInternal semantics)
+        keep = ~nulls
+        fidx = starts[keep]
+        children = [_any_to_wire(ch, fidx) for ch in col.children]
+        offsets = np.zeros(len(idx) + 1, np.int32)
+        offsets[1:] = np.cumsum(keep)
+        return WireBlock("ROW", nulls=nulls if nulls.any() else None,
+                         children=children, offsets=offsets)
+    lens = np.where(nulls, 0, lengths).astype(np.int64)
+    eidx = (np.concatenate(
+        [np.arange(s, s + ln) for s, ln in zip(starts, lens)])
+        if len(idx) else np.zeros(0, np.int64)).astype(np.int64)
+    offsets = np.zeros(len(idx) + 1, np.int32)
+    offsets[1:] = np.cumsum(lens)
+    children = [_any_to_wire(ch, eidx) for ch in col.children]
+    return WireBlock("ARRAY" if t.name == "array" else "MAP",
+                     nulls=nulls if nulls.any() else None,
+                     children=children, offsets=offsets)
+
+
 def page_to_wire_blocks(page) -> List[WireBlock]:
     """Host-side conversion of an engine Page (presto_tpu.data.column) to
     wire blocks. Strings become DICTIONARY over VARIABLE_WIDTH (the engine's
     native layout); DECIMAL<=18 travels as LONG_ARRAY (short decimal),
-    matching Presto's representation."""
+    matching Presto's representation; ARRAY/MAP/ROW nest recursively."""
+    from presto_tpu.data.column import NestedColumn
+
     n = int(page.num_rows)
     out: List[WireBlock] = []
     for c in page.columns:
+        if isinstance(c, NestedColumn):
+            out.append(_nested_to_wire(c, np.arange(n)))
+            continue
         vals, nulls = c.to_numpy(n)
-        nulls = nulls.copy()
-        t = c.type
-        if t.is_string and c.dictionary is not None:
-            words = np.array(
-                [w.encode() for w in c.dictionary.words] or [b""],
-                dtype=object)
-            dict_block = WireBlock("VARIABLE_WIDTH", words, None)
-            ids = np.where(nulls, 0, vals).astype(np.int32)
-            # Presto represents a null string position as a null slot in
-            # the dictionary; simplest faithful form: append a null slot.
-            if nulls.any():
-                null_slot = len(words)
-                words2 = np.append(words, None)
-                dict_block = WireBlock(
-                    "VARIABLE_WIDTH", words2,
-                    np.arange(len(words2)) == null_slot)
-                ids = np.where(nulls, null_slot, ids).astype(np.int32)
-            out.append(WireBlock("DICTIONARY", ids, None,
-                                 dictionary=dict_block))
-        elif t.dtype == np.bool_:
-            out.append(WireBlock("BYTE_ARRAY", vals.astype(np.uint8),
-                                 nulls if nulls.any() else None))
-        elif t.dtype == np.int32:
-            out.append(WireBlock("INT_ARRAY", vals.astype(np.int32),
-                                 nulls if nulls.any() else None))
-        elif t.dtype == np.int64:
-            out.append(WireBlock("LONG_ARRAY", vals.astype(np.int64),
-                                 nulls if nulls.any() else None))
-        elif t.dtype == np.float64:
-            out.append(WireBlock(
-                "LONG_ARRAY", vals.view(np.int64).copy(),
-                nulls if nulls.any() else None))
-        elif t.dtype == np.float32:
-            out.append(WireBlock(
-                "INT_ARRAY", vals.view(np.int32).copy(),
-                nulls if nulls.any() else None))
-        else:
-            raise NotImplementedError(f"wire type {t}")
+        out.append(_flat_to_wire(c.type, vals, nulls.copy(),
+                                 c.dictionary))
     return out
+
+
+def _wire_to_column(b: WireBlock, t, position_count: int, capacity: int):
+    """One wire block -> engine Column/NestedColumn of type t."""
+    from presto_tpu.data.column import Column, NestedColumn, StringDict, \
+        bucket_capacity
+    import jax.numpy as jnp
+
+    b = _materialize_rle(b)
+    if b.encoding in ("ARRAY", "MAP", "ROW"):
+        n = position_count
+        offs = np.asarray(b.offsets, np.int32)
+        nulls = (b.nulls if b.nulls is not None
+                 else np.zeros(n, dtype=bool))
+        starts = offs[:-1].copy()
+        lengths = np.diff(offs).astype(np.int32)
+        if b.encoding == "ROW":
+            lengths = np.where(nulls[:n], 0, 1).astype(np.int32)
+        child_types = (
+            (t.element,) if t.name == "array" else
+            (t.key, t.value) if t.name == "map" else t.field_types)
+        n_child = int(offs[-1]) if len(offs) else 0
+        ccap = bucket_capacity(max(n_child, 1))
+        children = tuple(
+            _wire_to_column(cb, ct, n_child, ccap)
+            for cb, ct in zip(b.children, child_types))
+        pad = capacity - n
+        return NestedColumn(
+            jnp.asarray(np.pad(starts, (0, pad))),
+            jnp.asarray(np.pad(lengths, (0, pad))),
+            jnp.asarray(np.pad(nulls[:n], (0, pad),
+                               constant_values=True)),
+            children, t)
+    if t.is_string:
+        words, codes, nulls = _block_to_strings(b, position_count)
+        return Column.from_numpy(codes, t, nulls=nulls,
+                                 dictionary=StringDict(words),
+                                 capacity=capacity)
+    vals = b.values
+    nulls = b.nulls if b.nulls is not None else \
+        np.zeros(position_count, dtype=bool)
+    if t.dtype == np.float64:
+        vals = vals.view(np.float64)
+    elif t.dtype == np.float32:
+        vals = vals.astype(np.int32).view(np.float32)
+    elif t.dtype == np.bool_:
+        vals = vals.astype(bool)
+    else:
+        vals = vals.astype(t.dtype)
+    vals = np.where(nulls, t.dtype.type(t.null_sentinel()), vals) \
+        if nulls.any() else vals
+    return Column.from_numpy(vals, t, nulls=nulls, capacity=capacity)
 
 
 def wire_blocks_to_page(blocks: List[WireBlock], types, position_count: int,
                         capacity: Optional[int] = None):
     """Wire blocks -> engine Page. `types` are presto_tpu SQL types."""
-    from presto_tpu.data.column import Column, Page, StringDict, \
-        bucket_capacity
+    from presto_tpu.data.column import Page, bucket_capacity
 
     cap = capacity or bucket_capacity(max(position_count, 1))
-    cols = []
-    for b, t in zip(blocks, types):
-        b = _materialize_rle(b)
-        if t.is_string:
-            words, codes, nulls = _block_to_strings(b, position_count)
-            d = StringDict(words)
-            cols.append(Column.from_numpy(codes, t, nulls=nulls,
-                                          dictionary=d, capacity=cap))
-        else:
-            vals = b.values
-            nulls = b.nulls if b.nulls is not None else \
-                np.zeros(position_count, dtype=bool)
-            if t.dtype == np.float64:
-                vals = vals.view(np.float64)
-            elif t.dtype == np.float32:
-                vals = vals.astype(np.int32).view(np.float32)
-            elif t.dtype == np.bool_:
-                vals = vals.astype(bool)
-            else:
-                vals = vals.astype(t.dtype)
-            vals = np.where(nulls, t.dtype.type(t.null_sentinel()), vals) \
-                if nulls.any() else vals
-            cols.append(Column.from_numpy(vals, t, nulls=nulls,
-                                          capacity=cap))
+    cols = [_wire_to_column(b, t, position_count, cap)
+            for b, t in zip(blocks, types)]
     return Page.from_columns(cols, position_count)
 
 

@@ -697,6 +697,29 @@ class EnforceSingleRowNode(PlanNode):
     _SCHEMA = [("id", "id", None), ("source", "source", PlanNode)]
 
 
+@PlanNode.register(".UnnestNode")
+@dataclasses.dataclass
+class UnnestNode(PlanNode):
+    """spi/plan/UnnestNode.java — unnestVariables maps each nested input
+    variable ("name<type>" key) to its flattened output variables (1 for
+    array, 2 for map)."""
+    id: str = ""
+    source: Any = None
+    replicateVariables: List[Variable] = dataclasses.field(
+        default_factory=list)
+    unnestVariables: Dict[str, List[Variable]] = dataclasses.field(
+        default_factory=dict)
+    ordinalityVariable: Optional[Variable] = None
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("replicateVariables", "replicateVariables", ("list", Variable)),
+        ("unnestVariables", "unnestVariables",
+         ("map", ("list", Variable))),
+        ("ordinalityVariable", "ordinalityVariable", ("opt", Variable)),
+    ]
+
+
 @PlanNode.register("com.facebook.presto.sql.planner.plan.RowNumberNode")
 @dataclasses.dataclass
 class RowNumberNode(PlanNode):

@@ -49,6 +49,8 @@ _FN_REV = {
 def type_sig(t: Type) -> str:
     if isinstance(t, DecimalType):
         return f"decimal({t.precision},{t.scale})"
+    if t.name in ("array", "map", "row"):
+        return str(t)          # recursive signature spelling
     return t.name
 
 
@@ -132,11 +134,18 @@ class FragmentSpec:
 
 
 class _FragmentConverter:
-    def __init__(self, names: _Names):
+    def __init__(self, names: _Names, connector=None):
         self.names = names
+        self.connector = connector
         self.scan_nodes: Dict[str, str] = {}
         self.remote_nodes: Dict[str, int] = {}
         self.scan_order: List[str] = []
+
+    def _cid(self, table: str) -> str:
+        if self.connector is not None \
+                and hasattr(self.connector, "connector_id"):
+            return self.connector.connector_id(table)
+        return "tpch"
 
     def convert(self, node: P.PlanNode
                 ) -> Tuple[S.PlanNode, List[S.Variable]]:
@@ -144,18 +153,19 @@ class _FragmentConverter:
         names = self.names
 
         if isinstance(node, P.TableScanNode):
+            cid = self._cid(node.table)
             out = [names.var(n, t) for n, t in zip(node.output_names,
                                                    node.output_types)]
             assigns = {f"{v.name}<{v.type}>":
-                       {"@type": "tpch", "columnName": col,
+                       {"@type": cid, "columnName": col,
                         "typeSignature": v.type}
                        for v, col in zip(out, node.columns)}
             self.scan_nodes[nid] = node.table
             self.scan_order.append(nid)
             return S.TableScanNode(
                 id=nid,
-                table={"connectorId": "tpch",
-                       "connectorHandle": {"@type": "tpch",
+                table={"connectorId": cid,
+                       "connectorHandle": {"@type": cid,
                                            "tableName": node.table}},
                 outputVariables=out, assignments=assigns), out
 
@@ -317,6 +327,35 @@ class _FragmentConverter:
             return S.WindowNode(id=nid, source=src, specification=spec,
                                 windowFunctions=fns), out
 
+        if isinstance(node, P.UnnestNode):
+            from presto_tpu.types import ArrayType, MapType
+            src, in_vars = self.convert(node.source)
+            repl = [in_vars[f] for f in node.replicate_fields]
+            unnest_vars: Dict[str, List[S.Variable]] = {}
+            out = list(repl)
+            oi = len(node.replicate_fields)
+            for f in node.unnest_fields:
+                nested_t = node.source.output_types[f]
+                n_out = 2 if isinstance(nested_t, MapType) else 1
+                outs = []
+                for _ in range(n_out):
+                    v = names.var(node.output_names[oi],
+                                  node.output_types[oi])
+                    outs.append(v)
+                    out.append(v)
+                    oi += 1
+                key = f"{in_vars[f].name}<{in_vars[f].type}>"
+                unnest_vars[key] = outs
+            ordv = None
+            if node.with_ordinality:
+                ordv = names.var(node.output_names[oi],
+                                 node.output_types[oi])
+                out.append(ordv)
+            return S.UnnestNode(
+                id=nid, source=src, replicateVariables=repl,
+                unnestVariables=unnest_vars,
+                ordinalityVariable=ordv), out
+
         if isinstance(node, P.SortNode):
             src, in_vars = self.convert(node.source)
             return S.SortNode(id=nid, source=src,
@@ -354,9 +393,13 @@ _PART_NAMES = {
 }
 
 
-def fragment_to_protocol(frag: EngineFragment) -> FragmentSpec:
-    """One engine fragment -> protocol fragment + scheduling metadata."""
-    conv = _FragmentConverter(_Names())
+def fragment_to_protocol(frag: EngineFragment,
+                         connector=None) -> FragmentSpec:
+    """One engine fragment -> protocol fragment + scheduling metadata.
+    `connector` resolves per-table connector ids for scan handles/splits
+    (reference: the coordinator's Metadata handing ConnectorIds to the
+    fragmenter)."""
+    conv = _FragmentConverter(_Names(), connector)
     root, out_vars = conv.convert(frag.root)
     handle = S.PartitioningHandle(connectorHandle={
         "@type": "$remote",

@@ -86,10 +86,52 @@ def _fn_name(call: S.Call) -> str:
     return short  # engine registry may know it directly (sum/min/...)
 
 
+def _wire_value(blk, i: int, t: Type):
+    """One position of a decoded WireBlock as a python value, guided by
+    the declared type (nested blocks recurse per the reference's
+    Array/Map/RowBlock position semantics)."""
+    from presto_tpu.types import ArrayType, MapType, RowType
+
+    if blk.encoding == "RLE":
+        return _wire_value(blk.rle_value, 0, t)
+    if blk.encoding == "DICTIONARY":
+        return _wire_value(blk.dictionary, int(blk.values[i]), t)
+    if blk.nulls is not None and bool(np.asarray(blk.nulls)[i]):
+        return None
+    if isinstance(t, ArrayType):
+        lo, hi = int(blk.offsets[i]), int(blk.offsets[i + 1])
+        return [_wire_value(blk.children[0], j, t.element)
+                for j in range(lo, hi)]
+    if isinstance(t, MapType):
+        lo, hi = int(blk.offsets[i]), int(blk.offsets[i + 1])
+        return {
+            _wire_value(blk.children[0], j, t.key):
+                _wire_value(blk.children[1], j, t.value)
+            for j in range(lo, hi)}
+    if isinstance(t, RowType):
+        pos = int(blk.offsets[i])
+        return tuple(_wire_value(f, pos, ft)
+                     for f, ft in zip(blk.children, t.field_types))
+    if t.is_string:
+        v = blk.values[i]
+        return None if v is None else (
+            v.decode() if isinstance(v, bytes) else str(v))
+    v = np.asarray(blk.values)[i]
+    if t.name == "boolean":
+        return bool(v)
+    if t.name == "double":
+        return float(np.int64(v).view(np.float64)
+                     if np.asarray(blk.values).dtype == np.int64 else v)
+    if t.name == "real":
+        return float(np.int32(v).view(np.float32)
+                     if np.asarray(blk.values).dtype == np.int32 else v)
+    return int(v)
+
+
 def decode_constant(const: S.Constant) -> E.Literal:
     """ConstantExpression.valueBlock (base64 single-position Block) ->
     typed Literal, via the SerializedPage block codec."""
-    from presto_tpu.protocol.serde import _block_to_strings, _decode_block
+    from presto_tpu.protocol.serde import _decode_block
 
     t = parse_type(const.type)
     raw = base64.b64decode(const.valueBlock)
@@ -98,21 +140,7 @@ def decode_constant(const: S.Constant) -> E.Literal:
     except ValueError as e:
         raise NotImplementedError(
             f"constant of type {const.type!r}: {e}") from e
-    if blk.nulls is not None and bool(np.asarray(blk.nulls)[0]):
-        return E.Literal(None, t)
-    if t.is_string:
-        words, codes, _nulls = _block_to_strings(blk, 1)
-        return E.Literal(str(words[int(codes[0])]), t)
-    v = np.asarray(blk.values)[0]
-    if t.name == "boolean":
-        return E.Literal(bool(v), t)
-    if t.is_floating:
-        if blk.encoding == "LONG_ARRAY" and t.name == "double":
-            v = np.asarray(blk.values).view(np.float64)[0]
-        elif blk.encoding == "INT_ARRAY" and t.name == "real":
-            v = np.asarray(blk.values).view(np.float32)[0]
-        return E.Literal(float(v), t)
-    return E.Literal(int(v), t)
+    return E.Literal(_wire_value(blk, 0, t), t)
 
 
 def encode_constant(value, t: Type) -> S.Constant:
@@ -260,6 +288,13 @@ def _out_vars(node) -> List[S.Variable]:
         return _out_vars(node.source) + [node.groupIdVariable]
     if isinstance(node, S.RowNumberNode):
         return _out_vars(node.source) + [node.rowNumberVariable]
+    if isinstance(node, S.UnnestNode):
+        out = list(node.replicateVariables)
+        for outs in node.unnestVariables.values():
+            out += outs
+        if node.ordinalityVariable is not None:
+            out.append(node.ordinalityVariable)
+        return out
     if isinstance(node, (S.LimitNode, S.TopNNode, S.SortNode,
                          S.EnforceSingleRowNode)):
         return _out_vars(node.source)
@@ -520,6 +555,19 @@ def _node(n) -> P.PlanNode:
             src.output_types + (BIGINT,), source=src,
             partition_fields=pf, order_keys=(),
             specs=(WindowSpec("row_number", None, BIGINT),))
+
+    if isinstance(n, S.UnnestNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        repl = tuple(scope.index[v.name] for v in n.replicateVariables)
+        channels = tuple(scope.index[_var_key_name(k)]
+                         for k in n.unnestVariables)
+        out_vars = _out_vars(n)
+        return P.UnnestNode(
+            tuple(v.name for v in out_vars),
+            tuple(parse_type(v.type) for v in out_vars),
+            source=src, replicate_fields=repl, unnest_fields=channels,
+            with_ordinality=n.ordinalityVariable is not None)
 
     if isinstance(n, S.RawNode):
         raise NotImplementedError(f"plan node {n.type_key}")

@@ -125,17 +125,44 @@ def translate_validated(frag: S.PlanFragment,
 
 
 def _check_executable_types(plan) -> None:
-    """Composite (array/map/row) channels parse and translate but have no
-    device column representation yet; reject them here with the precise
-    reason rather than tracebacking mid-execution."""
+    """Composite (array/map/row) channels are executable only on the
+    storage->UNNEST path (scan/filter pass-through into an UnnestNode);
+    anywhere else they have no device compute, so reject with the precise
+    reason rather than tracebacking mid-execution. `allowed` tracks which
+    of a node's output channels a composite value may legally occupy."""
+    from presto_tpu.expr.nodes import InputRef
+    from presto_tpu.plan.nodes import (
+        FilterNode, OutputNode, ProjectNode, TableScanNode, UnnestNode,
+    )
     from presto_tpu.types import ArrayType, MapType, RowType
 
-    def walk(n):
-        for name, t in zip(n.output_names, n.output_types):
-            if isinstance(t, (ArrayType, MapType, RowType)):
+    def walk(n, allowed):
+        for i, (name, t) in enumerate(zip(n.output_names,
+                                          n.output_types)):
+            if isinstance(t, (ArrayType, MapType, RowType)) \
+                    and i not in allowed:
                 raise UnsupportedPlanError(
-                    [f"channel {name!r}: composite type {t} is not yet "
-                     "executable on this worker"])
+                    [f"channel {name!r}: composite type {t} is only "
+                     "executable through UNNEST on this worker"])
+        if isinstance(n, UnnestNode):
+            child_allowed = set(n.unnest_fields)
+            for j, src_ch in enumerate(n.replicate_fields):
+                if j in allowed:
+                    child_allowed.add(src_ch)
+            walk(n.source, child_allowed)
+            return
+        if isinstance(n, (FilterNode, OutputNode)):
+            walk(n.source, set(allowed))
+            return
+        if isinstance(n, ProjectNode):
+            child_allowed = set()
+            for j, e in enumerate(n.expressions):
+                if j in allowed and isinstance(e, InputRef):
+                    child_allowed.add(e.field)
+            walk(n.source, child_allowed)
+            return
+        if isinstance(n, TableScanNode):
+            return
         for c in n.children():
-            walk(c)
-    walk(plan)
+            walk(c, set())
+    walk(plan, set())

@@ -14,11 +14,13 @@ import urllib.request
 
 class Announcer:
     def __init__(self, coordinator_uri: str, self_uri: str, node_id: str,
-                 environment: str = "tpu", interval_s: float = 5.0):
+                 environment: str = "tpu", interval_s: float = 5.0,
+                 connector_ids: str = "tpch,tpcds,memory,parquet"):
         self.coordinator_uri = coordinator_uri.rstrip("/")
         self.self_uri = self_uri
         self.node_id = node_id
         self.environment = environment
+        self.connector_ids = connector_ids
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -34,9 +36,9 @@ class Announcer:
                 "id": self.node_id,
                 "type": "presto",
                 "properties": {
-                    "node_version": "presto-tpu-0.2",
+                    "node_version": "presto-tpu-0.3",
                     "coordinator": "false",
-                    "connectorIds": "tpch",
+                    "connectorIds": self.connector_ids,
                     "http": self.self_uri,
                 },
             }],

@@ -131,16 +131,24 @@ class TpuCluster:
 
     def __init__(self, connector, n_workers: int = 2,
                  session_properties: Optional[Dict[str, str]] = None,
-                 resource_groups=None):
+                 resource_groups=None, history=None, discovery=None):
         from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
 
         self.connector = connector
         self.planner = Planner(connector)
+        # HBO store (plan/stats.HistoryStore) consulted by AddExchanges'
+        # broadcast-vs-repartition costing, like the engines' stores
+        # (reference: HistoryBasedPlanStatisticsCalculator.java:58)
+        self.history = history
         self.session_properties = dict(session_properties or {})
         # admission control (reference: InternalResourceGroupManager
         # gating DispatchManager.createQueryInternal)
         self.resource_groups = resource_groups or ResourceGroupManager()
+        # discovery-driven membership (reference: DiscoveryNodeManager):
+        # workers that announce to `discovery` join the schedulable set
+        # alongside the statically started ones.
+        self.discovery = discovery
         self.workers: List[TpuWorkerServer] = [
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}").start()
             for i in range(n_workers)]
@@ -153,7 +161,11 @@ class TpuCluster:
 
     @property
     def worker_uris(self) -> List[str]:
-        return [u for u in self.all_worker_uris if u not in self.dead]
+        uris = list(self.all_worker_uris)
+        if self.discovery is not None:
+            uris += [u for u in self.discovery.active_workers()
+                     if u not in uris]
+        return [u for u in uris if u not in self.dead]
 
     # ---------------------------------------------------- failure detector
     def check_workers(self) -> List[str]:
@@ -224,7 +236,7 @@ class TpuCluster:
         session = Session({k: v for k, v in
                            self.session_properties.items() if k in known})
         ex_plan = _derange(add_exchanges(_unshare(plan), self.connector,
-                                         session))
+                                         session, self.history))
         frags = create_fragments(ex_plan)
         return self._run_fragments(frags, list(plan.output_types))
 
@@ -246,8 +258,12 @@ class TpuCluster:
                     "partitioned producer shared by several consumer "
                     "fragments (CTE materialization boundary — planned)")
 
-        W = len(self.worker_uris)
-        specs = {f.fragment_id: fragment_to_protocol(f) for f in frags}
+        # snapshot membership for this query: placement must not shift if
+        # an announcement arrives mid-schedule
+        placement = list(self.worker_uris)
+        W = len(placement)
+        specs = {f.fragment_id: fragment_to_protocol(f, self.connector)
+                 for f in frags}
 
         stages: Dict[int, _Stage] = {}
 
@@ -267,6 +283,14 @@ class TpuCluster:
             nbuf = 0
             for c in cons:
                 offsets[c] = nbuf
+                if part == Partitioning.SINGLE and n_tasks(c) > 1:
+                    # One buffer would be drained destructively by N
+                    # consumer tasks, silently splitting the stream —
+                    # needs per-task buffers + broadcast like _emit_output
+                    # does for multi-buffer SINGLE.
+                    raise NotImplementedError(
+                        "SINGLE-partitioned producer feeding a "
+                        f"multi-task consumer fragment {c}")
                 nbuf += 1 if part == Partitioning.SINGLE else n_tasks(c)
             nbuf = max(nbuf, 1)
             stages[f.fragment_id] = _Stage(
@@ -282,7 +306,7 @@ class TpuCluster:
                 return
             for src in by_id[fid].remote_sources:
                 schedule(src)
-            self._start_stage(qid, fid, stages, by_id)
+            self._start_stage(qid, fid, stages, by_id, placement)
             scheduled.add(fid)
 
         try:
@@ -294,24 +318,27 @@ class TpuCluster:
 
     # ------------------------------------------------------------------
     def _start_stage(self, qid: str, fid: int, stages: Dict[int, _Stage],
-                     by_id):
+                     by_id, placement: List[str]):
         stage = stages[fid]
         spec = stage.spec
         frag_bytes = spec.fragment.to_bytes()
+        # connector-provided splits, one list per scan node (reference:
+        # ConnectorSplitManager; split t goes to task t)
+        scan_splits = {
+            node_id: (self.connector.connector_id(table),
+                      self.connector.table_splits(table, stage.n_tasks))
+            for node_id, table in spec.scan_nodes.items()}
         for t in range(stage.n_tasks):
-            w = t % len(self.worker_uris)
+            w = t % len(placement)
             task_id = f"{qid}.{fid}.0.{t}.0"
-            uri = f"{self.worker_uris[w]}/v1/task/{task_id}"
+            uri = f"{placement[w]}/v1/task/{task_id}"
             sources: List[S.TaskSource] = []
             seq = 0
-            for node_id, table in spec.scan_nodes.items():
+            for node_id, (cid, all_splits) in scan_splits.items():
                 splits = [S.ScheduledSplit(
                     sequenceId=seq, planNodeId=node_id,
-                    split=S.Split(connectorId="tpch",
-                                  connectorSplit={"@type": "tpch",
-                                                  "part": t,
-                                                  "numParts":
-                                                  stage.n_tasks}))]
+                    split=S.Split(connectorId=cid,
+                                  connectorSplit=all_splits[t]))]
                 seq += 1
                 sources.append(S.TaskSource(planNodeId=node_id,
                                             splits=splits,

@@ -1,0 +1,121 @@
+"""Coordinator-side discovery: consumes worker announcements.
+
+Reference: the embedded Airlift discovery service consumed by
+DiscoveryNodeManager (presto-main/.../metadata/DiscoveryNodeManager.java:88)
+— workers PUT /v1/announcement/{nodeId} periodically (Announcer.cpp:64 /
+server/announcer.py) and the coordinator's active worker set is everyone
+whose announcement is fresh. Expiry doubles as passive failure detection
+(HeartbeatFailureDetector's timeout role)."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+_ANNOUNCE = re.compile(r"^/v1/announcement/([^/?]+)$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):            # quiet
+        pass
+
+    def do_PUT(self):
+        m = _ANNOUNCE.match(self.path.split("?")[0])
+        if not m:
+            self.send_response(404)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError:
+            self.send_response(400)
+            self.end_headers()
+            return
+        self.server.service.record(m.group(1), body)
+        self.send_response(202)
+        self.end_headers()
+
+    def do_DELETE(self):
+        m = _ANNOUNCE.match(self.path.split("?")[0])
+        if m:
+            self.server.service.remove(m.group(1))
+        self.send_response(200 if m else 404)
+        self.end_headers()
+
+    def do_GET(self):
+        # /v1/service/presto/general — the discovery lookup surface
+        if self.path.startswith("/v1/service"):
+            svc = self.server.service
+            body = json.dumps({"services": [
+                {"id": nid, "properties": {"http": uri}}
+                for nid, (uri, _ts) in svc.snapshot().items()]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(404)
+        self.end_headers()
+
+
+class DiscoveryService:
+    """In-process announcement listener + active-node view."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 expiry_s: float = 30.0):
+        self.expiry_s = expiry_s
+        self._nodes: Dict[str, Tuple[str, float]] = {}   # id -> (uri, ts)
+        self._lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.service = self
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    # -- server lifecycle -------------------------------------------------
+    def start(self) -> "DiscoveryService":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- announcement state ----------------------------------------------
+    def record(self, node_id: str, body: dict):
+        uri: Optional[str] = None
+        for svc in body.get("services", []):
+            props = svc.get("properties", {})
+            if props.get("coordinator") == "true":
+                continue
+            uri = props.get("http") or uri
+        if uri:
+            with self._lock:
+                self._nodes[node_id] = (uri, time.time())
+
+    def remove(self, node_id: str):
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def snapshot(self) -> Dict[str, Tuple[str, float]]:
+        with self._lock:
+            return dict(self._nodes)
+
+    def active_workers(self) -> List[str]:
+        """URIs of workers whose announcement is fresh (expired entries
+        are the passive failure-detector signal)."""
+        now = time.time()
+        with self._lock:
+            stale = [nid for nid, (_u, ts) in self._nodes.items()
+                     if now - ts > self.expiry_s]
+            for nid in stale:
+                del self._nodes[nid]
+            return [uri for uri, _ts in
+                    (v for v in self._nodes.values())]

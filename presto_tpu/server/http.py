@@ -52,6 +52,19 @@ def _parse_duration(s: Optional[str], default: float) -> float:
     return v / 1000 if unit == "ms" else v * 60 if unit == "m" else v
 
 
+def _parse_size(s: Optional[str], default: int) -> int:
+    """X-Presto-Max-Size: '16MB' / '1048576B' / '512kB' -> bytes."""
+    if not s:
+        return default
+    m = re.match(r"([\d.]+)\s*(B|kB|MB|GB)?", s)
+    if not m:
+        return default
+    v = float(m.group(1))
+    unit = m.group(2) or "B"
+    return int(v * {"B": 1, "kB": 1 << 10, "MB": 1 << 20,
+                    "GB": 1 << 30}[unit])
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "presto-tpu-worker"
@@ -191,7 +204,8 @@ class _Handler(BaseHTTPRequestHandler):
         buf = task.buffers.buffer(buffer_id)
         if buf is None:
             return self._json(404, {"error": "no buffer"})
-        max_bytes = 16 << 20
+        max_bytes = _parse_size(self.headers.get("X-Presto-Max-Size"),
+                                16 << 20)
         tok = int(token)
         # Long-poll until a page (or completion) is available.
         deadline = time.time() + _parse_duration(

@@ -163,6 +163,7 @@ class TpuTaskManager:
         self.base_uri = base_uri
         self.tasks: Dict[str, Task] = {}
         self.total_bytes_out = 0      # monotonic (survives task delete)
+        self.lifetime_tasks = 0       # monotonic created-task count
         self.lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -173,6 +174,7 @@ class TpuTaskManager:
             if task is None:
                 task = Task(task_id)
                 self.tasks[task_id] = task
+                self.lifetime_tasks += 1
         # The update protocol is at-least-once and concurrent (coordinator
         # retries race the original POST): apply the whole update under
         # the task's lock, dedupe splits by sequenceId, and resolve split
@@ -240,10 +242,11 @@ class TpuTaskManager:
                      if k in known}
             ex = SplitExecutor(self.connector, session=Session(props))
             ex.set_splits(task.splits)
-            remote = self._pull_remote_inputs(task, plan)
-            ex.set_remote_pages(remote)
-            page = ex.execute(plan)
-            self._emit_output(task, page)
+            if not self._run_streaming(task, plan, ex):
+                remote = self._pull_remote_inputs(task, plan)
+                ex.set_remote_pages(remote)
+                page = ex.execute(plan)
+                self._emit_output(task, page)
             task.buffers.set_no_more_pages()
             task.set_state("FINISHED")
         except Exception as e:
@@ -257,27 +260,95 @@ class TpuTaskManager:
                 task.buffers.set_no_more_pages()
             task.set_state("FAILED")
 
-    def _pull_remote_inputs(self, task: Task, plan) -> Dict[str, Page]:
-        """Drain every upstream page stream this task's remote splits name
-        and fuse them into one engine Page per RemoteSourceNode (consumer
-        side of the pull protocol — ExchangeClient.java:255 semantics,
-        batch-materialized for the jit engine)."""
-        from presto_tpu.protocol.exchange_client import (
-            PageStream, decode_pages,
+    def _run_streaming(self, task: Task, plan, ex: SplitExecutor) -> bool:
+        """Leaf-fragment streaming: execute one driving-scan lifespan at a
+        time, emitting each batch's output into the token/ack buffers
+        while the task is RUNNING — consumers observe token advances
+        before this task finishes (reference: Driver.processFor
+        incremental page flow through ClientBuffer, adapted to the
+        batch-jit engine: the lifespan is the streaming quantum). Under a
+        memory limit, lifespans subdivide until the static footprint
+        fits, so a scan several times query_max_memory_per_node completes
+        instead of failing. Returns False when the fragment shape needs
+        single-shot execution (remote inputs / non-additive root)."""
+        from presto_tpu.exec.executor import MemoryLimitExceeded
+        from presto_tpu.exec.lifespan import _streamable
+        from presto_tpu.plan.nodes import (
+            AggregationNode, FilterNode, OutputNode, ProjectNode, Step,
         )
+
+        if _remote_source_nodes(plan):
+            return False
+        driving, driving_rows = None, -1
+        for table in task.splits:
+            rows = self.connector.table(table).num_rows
+            if rows > driving_rows:
+                driving, driving_rows = table, rows
+        if driving is None or not task.splits.get(driving):
+            return False
+        # Additive-root check: emitting per-lifespan outputs is correct
+        # iff the union of batch outputs equals the single-shot output —
+        # row-preserving pipelines, and PARTIAL aggregations (the
+        # consumer's FINAL step merges partial states).
+        node = plan
+        while isinstance(node, (OutputNode, ProjectNode, FilterNode)):
+            node = node.source
+        if isinstance(node, AggregationNode):
+            if node.step != Step.PARTIAL \
+                    or not _streamable(node.source, driving):
+                return False
+        elif not _streamable(node, driving):
+            return False
+
+        base = list(task.splits[driving])
+        sub = 1
+        first: Optional[Page] = None
+        while True:
+            lifespans = [(p * sub + i, n * sub)
+                         for (p, n) in base for i in range(sub)]
+            try:
+                ex.set_splits({**task.splits, driving: [lifespans[0]]})
+                first = ex.execute(plan)
+                break
+            except MemoryLimitExceeded:
+                # nothing emitted yet — safe to restart subdivided
+                if sub >= 256:
+                    raise
+                sub *= 2
+        self._emit_output(task, first)
+        for ls in lifespans[1:]:
+            ex.set_splits({**task.splits, driving: [ls]})
+            self._emit_output(task, ex.execute(plan))
+        return True
+
+    #: Each GET to an upstream buffer returns at most this many bytes
+    #: (client-side backpressure; reference: ExchangeClient's
+    #: maxResponseSize). Chunks decode to engine pages immediately, so
+    #: raw wire bytes never accumulate past one chunk per upstream.
+    REMOTE_CHUNK_BYTES = 4 << 20
+
+    def _pull_remote_inputs(self, task: Task, plan) -> Dict[str, Page]:
+        """Pull every upstream page stream this task's remote splits name
+        in bounded chunks and fuse them into one engine Page per
+        RemoteSourceNode (consumer side of the pull protocol —
+        ExchangeClient.java:255 semantics; the final materialization is
+        what the whole-fragment jit engine consumes)."""
+        from presto_tpu.protocol.exchange_client import PageStream
 
         out: Dict[str, Page] = {}
         for node in _remote_source_nodes(plan):
             splits = task.remote_splits.get(node.node_id, [])
-            # concurrent drains (reference: ExchangeClient's parallel
+            # concurrent pulls (reference: ExchangeClient's parallel
             # PageBufferClients) — producer latencies overlap
-            datas: List[Optional[bytes]] = [None] * len(splits)
+            per_src: List[List[Page]] = [[] for _ in splits]
             errs: List[Optional[BaseException]] = [None] * len(splits)
 
             def pull(i, location, buffer_id):
                 try:
-                    datas[i] = PageStream(
-                        location, buffer_id=buffer_id).drain()
+                    PageStream(
+                        location, buffer_id=buffer_id,
+                        max_size_bytes=self.REMOTE_CHUNK_BYTES,
+                    ).drain_pages(node.output_types, per_src[i].append)
                 except BaseException as e:   # noqa: BLE001 — re-raised
                     errs[i] = e
             threads = [threading.Thread(target=pull, args=(i, loc, b))
@@ -289,9 +360,7 @@ class TpuTaskManager:
             for e in errs:
                 if e is not None:
                     raise e
-            pages = []
-            for data in datas:
-                pages.extend(decode_pages(data, list(node.output_types)))
+            pages = [p for src in per_src for p in src]
             if not pages:
                 # no producer emitted rows: empty page of the right shape
                 from presto_tpu.data.column import Column

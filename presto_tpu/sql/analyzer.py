@@ -533,9 +533,49 @@ class Planner:
             return [Field(n, UNKNOWN, r.alias)
                     for n in self._select_output_names(r.query)]
         if isinstance(r, ast.Join):
-            return (self._shallow_rel_fields(r.left)
-                    + self._shallow_rel_fields(r.right))
+            left = self._shallow_rel_fields(r.left)
+            if isinstance(r.right, ast.UnnestRef):
+                return left + self._shallow_unnest_fields(r.right, left)
+            return left + self._shallow_rel_fields(r.right)
+        if isinstance(r, ast.UnnestRef):
+            return self._shallow_unnest_fields(r, [])
         raise AnalysisError(f"relation {r}")
+
+    def _shallow_unnest_fields(self, u: ast.UnnestRef,
+                               left_fields) -> List[Field]:
+        """Mirror _plan_unnest's output arity and default naming so
+        free-ident classification sees the same scope the planner will
+        build (a MAP channel contributes TWO outputs; defaults are
+        <col> / <col>_key / <col>_value / ordinality)."""
+        from presto_tpu.types import MapType
+        out: List[Field] = []
+        ai = 0
+        for e in u.exprs:
+            base, t = "_col", None
+            if isinstance(e, ast.Ident):
+                base = e.parts[-1]
+                for f in left_fields:
+                    if f.name == e.parts[-1] and (
+                            len(e.parts) == 1
+                            or f.qualifier == e.parts[0]):
+                        t = f.type
+                        break
+            if isinstance(t, MapType):
+                outs = [(base + "_key", t.key), (base + "_value", t.value)]
+            elif t is not None and t.name == "array":
+                outs = [(base, t.element)]
+            else:
+                outs = [(base, UNKNOWN)]
+            for dn, dt in outs:
+                name = (u.column_aliases[ai]
+                        if ai < len(u.column_aliases) else dn)
+                out.append(Field(name, dt, u.alias))
+                ai += 1
+        if u.with_ordinality:
+            name = (u.column_aliases[ai]
+                    if ai < len(u.column_aliases) else "ordinality")
+            out.append(Field(name, BIGINT, u.alias))
+        return out
 
     def _shallow_resolves(self, parts: Tuple[str, ...], fields) -> bool:
         for f in fields:
@@ -608,7 +648,89 @@ class Planner:
     def _is_equi(self, c) -> bool:
         return isinstance(c, ast.BinaryOp) and c.op == "eq"
 
+    def _plan_unnest(self, left: Optional[RelationPlan],
+                     u: ast.UnnestRef) -> RelationPlan:
+        """UNNEST lowering (reference: RelationPlanner.visitUnnest ->
+        spi/plan/UnnestNode). With a left relation the arguments are
+        lateral column references; standalone, they must be constant
+        arrays and expand to a ValuesNode at plan time."""
+        from presto_tpu.plan.nodes import UnnestNode, ValuesNode
+        from presto_tpu.types import ArrayType, MapType
+
+        if left is None:
+            # constant form: SELECT * FROM UNNEST(ARRAY[...], ...)
+            lits = [self.analyze(e, ()) for e in u.exprs]
+            if not all(isinstance(x, Literal) and isinstance(
+                    x.type, ArrayType) for x in lits):
+                raise AnalysisError(
+                    "standalone UNNEST arguments must be array constants "
+                    "(UNNEST of a table column needs CROSS JOIN UNNEST)")
+            width = max((len(x.value or []) for x in lits), default=0)
+            rows, names, types = [], [], []
+            for i, x in enumerate(lits):
+                names.append(u.column_aliases[i]
+                             if i < len(u.column_aliases) else f"_col{i}")
+                types.append(x.type.element)
+            if u.with_ordinality:
+                names.append(u.column_aliases[len(lits)]
+                             if len(u.column_aliases) > len(lits)
+                             else "ordinality")
+                types.append(BIGINT)
+            for j in range(width):
+                row = [
+                    (x.value[j] if x.value is not None
+                     and j < len(x.value) else None) for x in lits]
+                if u.with_ordinality:
+                    row.append(j + 1)
+                rows.append(tuple(row))
+            fields = tuple(Field(n, t, u.alias)
+                           for n, t in zip(names, types))
+            node = ValuesNode(tuple(names), tuple(types), tuple(rows))
+            return RelationPlan(node, fields, max(width, 1))
+
+        # lateral form: each argument is a nested-typed column of `left`
+        channels, new_fields, new_types = [], [], []
+        ai = 0
+        for e in u.exprs:
+            if not isinstance(e, ast.Ident):
+                raise AnalysisError(
+                    "UNNEST argument must be a column reference")
+            idx, f = self._resolve(e.parts, left.fields)
+            if isinstance(f.type, ArrayType):
+                outs = [(f.name, f.type.element)]
+            elif isinstance(f.type, MapType):
+                outs = [(f.name + "_key", f.type.key),
+                        (f.name + "_value", f.type.value)]
+            else:
+                raise AnalysisError(
+                    f"UNNEST over non-ARRAY/MAP column {f.name} "
+                    f"({f.type})")
+            channels.append(idx)
+            for dn, dt in outs:
+                name = (u.column_aliases[ai]
+                        if ai < len(u.column_aliases) else dn)
+                new_fields.append(Field(name, dt, u.alias))
+                new_types.append(dt)
+                ai += 1
+        if u.with_ordinality:
+            name = (u.column_aliases[ai]
+                    if ai < len(u.column_aliases) else "ordinality")
+            new_fields.append(Field(name, BIGINT, u.alias))
+            new_types.append(BIGINT)
+        out_fields = left.fields + tuple(new_fields)
+        node = UnnestNode(
+            tuple(f.name for f in out_fields),
+            tuple(f.type for f in out_fields),
+            source=left.node,
+            replicate_fields=tuple(range(len(left.fields))),
+            unnest_fields=tuple(channels),
+            with_ordinality=u.with_ordinality)
+        return RelationPlan(node, out_fields,
+                            max(left.est_rows * 4.0, 1.0))
+
     def _plan_relation(self, r: ast.Relation, q: ast.Select) -> RelationPlan:
+        if isinstance(r, ast.UnnestRef):
+            return self._plan_unnest(None, r)
         if isinstance(r, ast.TableRef):
             cte = self._lookup_cte(r.name)
             if cte is not None:
@@ -636,6 +758,14 @@ class Planner:
             return RelationPlan(sub.node, fields,
                                 max(sub.est_rows / 10.0, 1.0))
         if isinstance(r, ast.Join):
+            if isinstance(r.right, ast.UnnestRef):
+                # lateral: UNNEST args see the left relation's columns
+                if r.kind not in ("cross", "inner", "left") \
+                        or r.on is not None:
+                    raise AnalysisError(
+                        "UNNEST join supports CROSS JOIN (no ON)")
+                left = self._plan_relation(r.left, q)
+                return self._plan_unnest(left, r.right)
             left = self._plan_relation(r.left, q)
             right = self._plan_relation(r.right, q)
             if r.kind == "cross":
@@ -705,6 +835,9 @@ class Planner:
                     idents.update(_expr_idents(r.on))
                 walk_rel(r.left)
                 walk_rel(r.right)
+            if isinstance(r, ast.UnnestRef):
+                for e in r.exprs:
+                    idents.update(_expr_idents(e))
 
         walk_query(q)
         out = set()
@@ -1482,6 +1615,28 @@ class Planner:
             if e.part not in ("year", "month", "day"):
                 raise AnalysisError(f"extract({e.part}) unsupported")
             return Call(e.part, (v,), BIGINT)
+        if isinstance(e, ast.ArrayLit):
+            from presto_tpu.types import ArrayType, common_super_type
+            items = tuple(a(i) for i in e.items)
+            if not all(isinstance(x, Literal) for x in items):
+                raise AnalysisError(
+                    "ARRAY[...] elements must be constants")
+            et = UNKNOWN
+            for x in items:
+                if x.value is None:
+                    continue
+                nt = common_super_type(et, x.type)
+                if nt is None:
+                    raise AnalysisError(
+                        f"ARRAY[...] mixes {et} and {x.type}")
+                et = nt
+            vals = []
+            for x in items:
+                v = x.value
+                if v is not None and x.type.is_decimal:
+                    v = v / 10 ** x.type.scale
+                vals.append(v)
+            return Literal(vals, ArrayType(et))
         if isinstance(e, ast.ScalarSubquery):
             sub = self.plan_query(e.query)
             if len(sub.output_types) != 1:

@@ -145,10 +145,17 @@ class ScalarSubquery:
     query: "Select"
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayLit:
+    """ARRAY[e1, e2, ...] constructor — reference:
+    sql/tree/ArrayConstructor.java."""
+    items: Tuple["Expr", ...]
+
+
 Expr = Union[Ident, NumberLit, StringLit, DateLit, IntervalLit, NullLit,
              UnaryOp, BinaryOp, Between, InList, InSubquery, Exists, Like,
              IsNull, Case, Cast, Extract, FuncCall, WindowCall,
-             ScalarSubquery, Star]
+             ScalarSubquery, ArrayLit, Star]
 
 
 # ---- relations ------------------------------------------------------------
@@ -166,6 +173,17 @@ class SubqueryRef:
 
 
 @dataclasses.dataclass(frozen=True)
+class UnnestRef:
+    """UNNEST(expr, ...) [WITH ORDINALITY] [AS alias (c1, c2, ...)] —
+    reference: sql/tree/Unnest.java. In a join, the arguments may
+    reference columns of the left relation (lateral semantics)."""
+    exprs: Tuple["Expr", ...]
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+    with_ordinality: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Join:
     kind: str                     # inner | left | right | cross
     left: "Relation"
@@ -173,7 +191,7 @@ class Join:
     on: Optional[Expr] = None
 
 
-Relation = Union[TableRef, SubqueryRef, Join]
+Relation = Union[TableRef, SubqueryRef, Join, UnnestRef]
 
 
 # ---- query ----------------------------------------------------------------

@@ -20,7 +20,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
-  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=;])
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=;\[\]])
 """, re.VERBOSE)
 
 _KEYWORDS = {
@@ -416,6 +416,33 @@ class Parser:
             elif self.peek().kind == "ident":
                 alias = self.ident_text()
             return ast.SubqueryRef(q, alias)
+        if self.peek().kind == "ident" and self.peek().text == "unnest" \
+                and self.peek(1).kind == "op" \
+                and self.peek(1).text == "(":
+            self.next()
+            self.next()
+            exprs = [self.expr()]
+            while self.accept("op", ","):
+                exprs.append(self.expr())
+            self.expect("op", ")")
+            with_ord = False
+            if self.peek().kind == "keyword" and self.peek().text == "with":
+                if self.peek(1).kind == "ident" \
+                        and self.peek(1).text == "ordinality":
+                    self.next()
+                    self.next()
+                    with_ord = True
+            alias, col_aliases = None, ()
+            if self.accept_kw("as") or self.peek().kind == "ident":
+                alias = self.ident_text()
+                if self.accept("op", "("):
+                    cols = [self.ident_text()]
+                    while self.accept("op", ","):
+                        cols.append(self.ident_text())
+                    self.expect("op", ")")
+                    col_aliases = tuple(cols)
+            return ast.UnnestRef(tuple(exprs), alias, col_aliases,
+                                 with_ord)
         name = self.ident_text()
         alias = None
         if self.accept_kw("as"):
@@ -610,6 +637,18 @@ class Parser:
                 return self._maybe_over(
                     ast.FuncCall("count", (arg,), distinct=distinct))
         if t.kind in ("ident", "keyword"):
+            if t.kind == "ident" and t.text == "array" \
+                    and self.peek(1).kind == "op" \
+                    and self.peek(1).text == "[":
+                self.next()
+                self.next()
+                items = []
+                if not self.accept("op", "]"):
+                    items.append(self.expr())
+                    while self.accept("op", ","):
+                        items.append(self.expr())
+                    self.expect("op", "]")
+                return ast.ArrayLit(tuple(items))
             name = self.ident_text()
             if self.peek().kind == "op" and self.peek().text == "(":
                 self.next()

@@ -188,7 +188,8 @@ def q1_like_fragment(sf: float = 0.01) -> S.PlanFragment:
 
 
 def task_update_request(frag: S.PlanFragment, n_splits: int = 1,
-                        sf: float = 0.01) -> S.TaskUpdateRequest:
+                        sf: float = 0.01,
+                        session_properties=None) -> S.TaskUpdateRequest:
     splits = [S.ScheduledSplit(
         sequenceId=i, planNodeId="0",
         split=S.Split(connectorId="tpch",
@@ -197,8 +198,9 @@ def task_update_request(frag: S.PlanFragment, n_splits: int = 1,
                                       "scaleFactor": sf}))
         for i in range(n_splits)]
     return S.TaskUpdateRequest(
-        session=S.SessionRepresentation(queryId="q_fixture", user="test",
-                                        catalog="tpch", schema="sf"),
+        session=S.SessionRepresentation(
+            queryId="q_fixture", user="test", catalog="tpch", schema="sf",
+            systemProperties=dict(session_properties or {})),
         extraCredentials={},
         fragment=frag.to_bytes(),
         sources=[S.TaskSource(planNodeId="0", splits=splits,

@@ -1,0 +1,134 @@
+"""Connector-generic splits + discovery-driven membership (VERDICT #5).
+
+1. memory and parquet tables run through the HTTP cluster (splits come
+   from the connector, not hardcoded tpch payloads);
+2. a worker that announces itself to the coordinator's DiscoveryService
+   joins the schedulable set and receives tasks.
+"""
+
+import time
+
+import pytest
+
+from presto_tpu.connectors import MemoryConnector, TpchConnector
+from presto_tpu.exec.engine import LocalEngine
+from presto_tpu.server import TpuWorkerServer
+from presto_tpu.server.cluster import TpuCluster
+from presto_tpu.server.discovery import DiscoveryService
+from presto_tpu.types import BIGINT, VARCHAR
+
+
+@pytest.fixture(scope="module")
+def mem_connector():
+    mem = MemoryConnector(fallback=TpchConnector(0.01))
+    eng = LocalEngine(mem)
+    eng.execute_sql("CREATE TABLE kv (k varchar, v bigint)")
+    eng.execute_sql(
+        "INSERT INTO kv VALUES ('a', 1), ('b', 2), ('a', 3), ('c', 4), "
+        "('b', 5), ('a', 6)")
+    return mem
+
+
+def test_memory_table_through_cluster(mem_connector):
+    cluster = TpuCluster(mem_connector, n_workers=2)
+    try:
+        got = cluster.execute_sql(
+            "SELECT k, sum(v) AS s, count(*) AS c FROM kv "
+            "GROUP BY k ORDER BY k")
+    finally:
+        cluster.stop()
+    assert got == [("a", 10, 3), ("b", 7, 2), ("c", 4, 1)]
+
+
+def test_mixed_catalog_join_through_cluster(mem_connector):
+    """memory table joined with a fallback (tpch) table: per-table
+    connector ids ride the split/scan protocol."""
+    cluster = TpuCluster(mem_connector, n_workers=2)
+    try:
+        got = cluster.execute_sql(
+            "SELECT k, count(*) AS c FROM kv, nation "
+            "WHERE v = n_nationkey GROUP BY k ORDER BY k")
+    finally:
+        cluster.stop()
+    local = LocalEngine(mem_connector).execute_sql(
+        "SELECT k, count(*) AS c FROM kv, nation "
+        "WHERE v = n_nationkey GROUP BY k ORDER BY k")
+    assert got == local
+
+
+def test_parquet_table_through_cluster(tmp_path):
+    pytest.importorskip("pyarrow")
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from presto_tpu.connectors import ParquetConnector
+
+    pq.write_table(pa.table({
+        "g": ["x", "y", "x", "z", "y", "x"],
+        "n": [1, 2, 3, 4, 5, 6]}), tmp_path / "t1.parquet")
+    conn = ParquetConnector(str(tmp_path))
+    cluster = TpuCluster(conn, n_workers=2)
+    try:
+        got = cluster.execute_sql(
+            "SELECT g, sum(n) AS s FROM t1 GROUP BY g ORDER BY g")
+    finally:
+        cluster.stop()
+    assert got == [("x", 10), ("y", 7), ("z", 4)]
+
+
+def test_worker_joins_via_announcement():
+    conn = TpchConnector(0.01)
+    disco = DiscoveryService(expiry_s=30).start()
+    cluster = TpuCluster(conn, n_workers=1, discovery=disco)
+    extern = None
+    try:
+        assert len(cluster.worker_uris) == 1
+        # boot an EXTERNAL worker announcing to the coordinator
+        extern = TpuWorkerServer(conn, coordinator_uri=disco.uri,
+                                 node_id="external-1")
+        extern.announcer.interval_s = 0.2
+        extern.start()
+        deadline = time.time() + 10
+        while len(cluster.worker_uris) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(cluster.worker_uris) == 2, "announced worker joined"
+
+        got = cluster.execute_sql(
+            "SELECT l_returnflag, count(*) AS c FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY l_returnflag")
+        local = LocalEngine(conn).execute_sql(
+            "SELECT l_returnflag, count(*) AS c FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY l_returnflag")
+        assert got == local
+        # the announced worker actually executed tasks
+        assert extern.task_manager.total_bytes_out > 0 \
+            or len(extern.task_manager.tasks) >= 0  # tasks may be deleted
+        assert extern.task_manager.lifetime_tasks > 0
+    finally:
+        if extern is not None:
+            extern.stop()
+        cluster.stop()
+        disco.stop()
+
+
+def test_announcement_expiry_drops_worker():
+    conn = TpchConnector(0.01)
+    disco = DiscoveryService(expiry_s=0.3).start()
+    cluster = TpuCluster(conn, n_workers=1, discovery=disco)
+    extern = TpuWorkerServer(conn, coordinator_uri=disco.uri,
+                             node_id="external-2")
+    extern.announcer.interval_s = 0.1
+    extern.start()
+    try:
+        deadline = time.time() + 10
+        while len(cluster.worker_uris) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(cluster.worker_uris) == 2
+        extern.announcer.stop()          # heartbeats cease
+        deadline = time.time() + 10
+        while len(cluster.worker_uris) > 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(cluster.worker_uris) == 1, "stale announcement expired"
+    finally:
+        extern.stop()
+        cluster.stop()
+        disco.stop()

@@ -1,0 +1,271 @@
+"""Nested types (ARRAY/MAP/ROW) + UNNEST (round-3 VERDICT #4).
+
+- wire goldens: the reference's captured Java ARRAY constants decode and
+  re-encode byte-identically; engine round-trips cover MAP/ROW.
+- SQL: UNNEST queries green vs a pre-flattened sqlite oracle
+  (sqlite has no arrays, so the oracle table IS the flattened form —
+  the VERDICT's suggested fixture strategy).
+- protocol: UnnestNode round-trips structs -> engine -> structs.
+"""
+
+import base64
+import json
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import MemoryConnector
+from presto_tpu.data.column import NestedColumn, Page
+from presto_tpu.exec.engine import LocalEngine
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.serde import (
+    _decode_block, _encode_block, decode_serialized_page,
+    encode_serialized_page, page_to_wire_blocks, wire_blocks_to_page,
+)
+from presto_tpu.protocol.translate import translate_fragment
+from presto_tpu.types import (
+    BIGINT, VARCHAR, ArrayType, MapType, RowType, parse_type,
+)
+
+REF_FIXTURE = ("/root/reference/presto-native-execution/presto_cpp/"
+               "main/types/tests/data/PartitionedOutput.json")
+
+
+# ------------------------------------------------------------ wire layer
+
+@pytest.mark.skipif(not os.path.exists(REF_FIXTURE),
+                    reason="reference checkout not present")
+def test_java_array_constants_golden():
+    """Real Java-emitted ARRAY blocks decode and re-encode to the exact
+    same bytes (ArrayBlockEncoding.java layout)."""
+    d = json.load(open(REF_FIXTURE))
+    found = []
+
+    def consts(n):
+        if isinstance(n, dict):
+            if n.get("@type") == "constant" and \
+                    n.get("type", "").startswith("array("):
+                found.append(n)
+            for v in n.values():
+                consts(v)
+        elif isinstance(n, list):
+            for v in n:
+                consts(v)
+    consts(d)
+    assert found, "fixture contains array constants"
+    for c in found:
+        raw = base64.b64decode(c["valueBlock"])
+        blk, _ = _decode_block(memoryview(raw), 0)
+        assert blk.encoding == "ARRAY"
+        out = bytearray()
+        _encode_block(out, blk)
+        assert bytes(out) == raw
+
+
+def test_nested_page_wire_roundtrip():
+    page = Page.from_pydict(
+        {"id": [1, 2, 3],
+         "arr": [[1, 2], None, []],
+         "m": [{"a": 1}, {"b": 2, "c": 3}, None],
+         "r": [(1, "x"), None, (3, "z")]},
+        {"id": BIGINT, "arr": ArrayType(BIGINT),
+         "m": MapType(VARCHAR, BIGINT),
+         "r": RowType(("f1", "f2"), (BIGINT, VARCHAR))})
+    blocks = page_to_wire_blocks(page)
+    frame = encode_serialized_page(blocks, int(page.num_rows))
+    blocks2, n, _off = decode_serialized_page(frame)
+    types = [BIGINT, ArrayType(BIGINT), MapType(VARCHAR, BIGINT),
+             RowType(("f1", "f2"), (BIGINT, VARCHAR))]
+    page2 = wire_blocks_to_page(blocks2, types, n)
+    assert page2.to_pylist() == page.to_pylist()
+
+
+def test_nested_wire_after_filter():
+    """Non-contiguous (filtered) nested columns re-encode as contiguous
+    regions — the region-rebasing the reference encodings perform."""
+    import jax.numpy as jnp
+    from presto_tpu.data.column import compact
+    page = Page.from_pydict(
+        {"id": [1, 2, 3, 4], "arr": [[1], [2, 2], [3], [4, 4, 4]]},
+        {"id": BIGINT, "arr": ArrayType(BIGINT)})
+    keep = jnp.asarray(
+        np.array([True, False, True, True]
+                 + [False] * (page.capacity - 4)))
+    filtered = compact(page, keep)
+    blocks = page_to_wire_blocks(filtered)
+    frame = encode_serialized_page(blocks, int(filtered.num_rows))
+    blocks2, n, _ = decode_serialized_page(frame)
+    page2 = wire_blocks_to_page(blocks2, [BIGINT, ArrayType(BIGINT)], n)
+    assert page2.to_pylist() == [(1, [1]), (3, [3]), (4, [4, 4, 4])]
+
+
+# ---------------------------------------------------------- sql vs oracle
+
+@pytest.fixture(scope="module")
+def docs_engine():
+    mem = MemoryConnector()
+    mem.create("docs", [("id", BIGINT), ("tags", ArrayType(VARCHAR)),
+                        ("scores", MapType(VARCHAR, BIGINT))])
+    mem.append_rows("docs", [
+        (1, ["red", "blue"], {"a": 1}),
+        (2, None, {"b": 2, "c": 3}),
+        (3, [], None),
+        (4, ["green", "red"], {}),
+        (5, ["red"], {"a": 9, "d": 4}),
+    ])
+    return LocalEngine(mem)
+
+
+@pytest.fixture(scope="module")
+def oracle_db():
+    """sqlite with the PRE-FLATTENED forms as oracle tables."""
+    db = sqlite3.connect(":memory:")
+    db.execute("CREATE TABLE doc_tags (id INTEGER, ord INTEGER,"
+               " tag TEXT)")
+    db.execute("CREATE TABLE doc_scores (id INTEGER, k TEXT, v INTEGER)")
+    flat_tags = [(1, 1, "red"), (1, 2, "blue"), (4, 1, "green"),
+                 (4, 2, "red"), (5, 1, "red")]
+    flat_scores = [(1, "a", 1), (2, "b", 2), (2, "c", 3), (5, "a", 9),
+                   (5, "d", 4)]
+    db.executemany("INSERT INTO doc_tags VALUES (?,?,?)", flat_tags)
+    db.executemany("INSERT INTO doc_scores VALUES (?,?,?)", flat_scores)
+    return db
+
+
+def test_unnest_array_vs_oracle(docs_engine, oracle_db):
+    got = docs_engine.execute_sql(
+        "SELECT id, tag FROM docs CROSS JOIN UNNEST(tags) AS t(tag) "
+        "ORDER BY id, tag")
+    exp = oracle_db.execute(
+        "SELECT id, tag FROM doc_tags ORDER BY id, tag").fetchall()
+    assert [tuple(r) for r in got] == [tuple(r) for r in exp]
+
+
+def test_unnest_with_ordinality_vs_oracle(docs_engine, oracle_db):
+    got = docs_engine.execute_sql(
+        "SELECT id, tag, ord FROM docs CROSS JOIN "
+        "UNNEST(tags) WITH ORDINALITY AS t(tag, ord) ORDER BY id, ord")
+    exp = oracle_db.execute(
+        "SELECT id, tag, ord FROM doc_tags ORDER BY id, ord").fetchall()
+    assert [tuple(r) for r in got] == [tuple(r) for r in exp]
+
+
+def test_unnest_map_vs_oracle(docs_engine, oracle_db):
+    got = docs_engine.execute_sql(
+        "SELECT id, k, v FROM docs CROSS JOIN "
+        "UNNEST(scores) AS s(k, v) ORDER BY id, k")
+    exp = oracle_db.execute(
+        "SELECT id, k, v FROM doc_scores ORDER BY id, k").fetchall()
+    assert [tuple(r) for r in got] == [tuple(r) for r in exp]
+
+
+def test_unnest_agg_join_vs_oracle(docs_engine, oracle_db):
+    got = docs_engine.execute_sql(
+        "SELECT tag, count(*) AS c, sum(id) AS s FROM docs "
+        "CROSS JOIN UNNEST(tags) AS t(tag) "
+        "GROUP BY tag ORDER BY c DESC, tag")
+    exp = oracle_db.execute(
+        "SELECT tag, count(*) AS c, sum(id) AS s FROM doc_tags "
+        "GROUP BY tag ORDER BY c DESC, tag").fetchall()
+    assert [tuple(r) for r in got] == [tuple(r) for r in exp]
+
+
+def test_unnest_where_filter(docs_engine, oracle_db):
+    got = docs_engine.execute_sql(
+        "SELECT id, tag FROM docs CROSS JOIN UNNEST(tags) AS t(tag) "
+        "WHERE tag = 'red' AND id > 1 ORDER BY id")
+    exp = oracle_db.execute(
+        "SELECT id, tag FROM doc_tags WHERE tag = 'red' AND id > 1 "
+        "ORDER BY id").fetchall()
+    assert [tuple(r) for r in got] == [tuple(r) for r in exp]
+
+
+def test_standalone_unnest_constant(docs_engine):
+    got = docs_engine.execute_sql(
+        "SELECT x FROM UNNEST(ARRAY[3, 1, 2]) AS t(x) ORDER BY x")
+    assert got == [(1,), (2,), (3,)]
+
+
+def test_select_nested_columns_verbatim(docs_engine):
+    got = docs_engine.execute_sql(
+        "SELECT id, tags, scores FROM docs ORDER BY id")
+    assert got[0] == (1, ["red", "blue"], {"a": 1})
+    assert got[1][1] is None
+    assert got[2] == (3, [], None)
+
+
+# ------------------------------------------------------------- protocol
+
+def test_unnest_node_protocol_roundtrip():
+    """structs UnnestNode -> engine plan; engine UnnestNode ->
+    protocol (to_protocol) -> structs -> engine again."""
+    scan = S.TableScanNode(
+        id="0",
+        table={"connectorId": "memory",
+               "connectorHandle": {"@type": "memory",
+                                   "tableName": "docs"}},
+        outputVariables=[S.Variable("id", "bigint"),
+                         S.Variable("tags", "array(varchar)")],
+        assignments={"id<bigint>": {"columnName": "id"},
+                     "tags<array(varchar)>": {"columnName": "tags"}})
+    un = S.UnnestNode(
+        id="1", source=scan,
+        replicateVariables=[S.Variable("id", "bigint")],
+        unnestVariables={"tags<array(varchar)>":
+                         [S.Variable("tag", "varchar")]},
+        ordinalityVariable=S.Variable("ord", "bigint"))
+    # lossless struct round-trip
+    j = S.PlanNode.to_json(un)
+    un2 = S.PlanNode.from_json(j)
+    assert S.PlanNode.to_json(un2) == j
+    # translate to the engine plan
+    from presto_tpu.plan import nodes as P
+    frag = S.PlanFragment(
+        id="0", root=un, variables=[],
+        partitioning=S.PartitioningHandle(
+            connectorHandle={"@type": "$remote",
+                             "partitioning": "SOURCE_DISTRIBUTED"}),
+        partitioningScheme=S.PartitioningScheme(
+            partitioning=S.PartitioningScheme_Partitioning(
+                handle=S.PartitioningHandle(
+                    connectorHandle={"@type": "$remote",
+                                     "partitioning": "SINGLE"}),
+                arguments=[]),
+            outputLayout=[]),
+        stageExecutionDescriptor=S.StageExecutionDescriptor())
+    plan = translate_fragment(frag)
+    assert isinstance(plan, P.UnnestNode)
+    assert plan.with_ordinality
+    assert plan.replicate_fields == (0,)
+    assert plan.unnest_fields == (1,)
+    assert plan.output_names == ("id", "tag", "ord")
+    assert isinstance(plan.output_types[1], type(VARCHAR))
+
+
+def test_validator_allows_unnest_rejects_bare_composite():
+    from presto_tpu.plan.nodes import (
+        OutputNode, TableScanNode, UnnestNode,
+    )
+    from presto_tpu.protocol.validator import (
+        UnsupportedPlanError, _check_executable_types,
+    )
+    at = ArrayType(BIGINT)
+    scan = TableScanNode(("id", "arr"), (BIGINT, at),
+                         table="t", columns=("id", "arr"))
+    un = UnnestNode(("id", "e"), (BIGINT, BIGINT), source=scan,
+                    replicate_fields=(0,), unnest_fields=(1,))
+    _check_executable_types(OutputNode(("id", "e"), (BIGINT, BIGINT),
+                                       source=un))
+    with pytest.raises(UnsupportedPlanError):
+        _check_executable_types(
+            OutputNode(("id", "arr"), (BIGINT, at), source=scan))
+
+
+def test_parse_type_nested_signatures():
+    t = parse_type("map(varchar, array(row(id bigint, name varchar)))")
+    assert isinstance(t, MapType)
+    assert isinstance(t.value, ArrayType)
+    assert isinstance(t.value.element, RowType)
+    assert t.value.element.field_names == ("id", "name")
