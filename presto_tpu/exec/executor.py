@@ -36,7 +36,7 @@ from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
     GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, PlanNode,
     ProjectNode, RemoteSourceNode, SortNode, TableScanNode, TopNNode,
-    UnnestNode, ValuesNode, WindowNode,
+    MarkDistinctNode, UnionAllNode, UnnestNode, ValuesNode, WindowNode,
 )
 
 
@@ -559,6 +559,57 @@ class Executor:
                     return Page(p.columns + (col,), p.num_rows,
                                 node.output_names)
                 return rowid_fn, cap
+            if isinstance(node, MarkDistinctNode):
+                src, cap = build(node.source)
+
+                def mark_fn(pages, node=node):
+                    from presto_tpu.ops.mark_distinct import mark_distinct
+                    p = src(pages)
+                    out = mark_distinct(p, node.key_fields,
+                                        node.output_names[-1])
+                    return Page(out.columns, out.num_rows,
+                                node.output_names)
+                return mark_fn, cap
+            if isinstance(node, UnionAllNode):
+                built = [build(s) for s in node.sources]
+                out_cap = sum(c for _f, c in built)
+
+                def union_fn(pages, node=node, built=built,
+                             out_cap=out_cap):
+                    from presto_tpu.data.column import merge_string_dicts
+                    ps = [f(pages) for f, _c in built]
+                    cols = []
+                    for ci, t in enumerate(node.output_types):
+                        branch = [p.columns[ci] for p in ps]
+                        dicts = [c.dictionary for c in branch]
+                        d0 = dicts[0]
+                        if t.is_string and any(d is not d0
+                                               for d in dicts):
+                            # per-source dictionaries differ: merge at
+                            # trace time (dicts are static aux), remap
+                            # codes with constant tables
+                            union_d, remaps = merge_string_dicts(dicts)
+                            vals = jnp.concatenate([
+                                (jnp.take(jnp.asarray(r), c.values,
+                                          mode="clip") if len(r)
+                                 else c.values)
+                                for c, r in zip(branch, remaps)])
+                            d0 = union_d
+                        else:
+                            vals = jnp.concatenate(
+                                [c.values for c in branch])
+                        nulls = jnp.concatenate(
+                            [c.nulls for c in branch])
+                        cols.append(Column(vals, nulls, t, d0))
+                    # each source's valid rows sit at its own capacity
+                    # offset; declare everything in-range, then compact
+                    # squeezes the survivors dense and sets num_rows
+                    keep = jnp.concatenate([p.row_valid() for p in ps])
+                    out = Page(tuple(cols),
+                               jnp.asarray(out_cap, jnp.int32),
+                               node.output_names)
+                    return compact(out, keep)
+                return union_fn, out_cap
             if isinstance(node, UnnestNode):
                 src, cap = build(node.source)
                 fan = max(node.fanout_hint, 1.0)

@@ -214,6 +214,114 @@ def _concat_pages(pages: List) -> Page:
     return Page.from_columns(cols, total, pages[0].names)
 
 
+class BatchedRunner:
+    """Prepared lifespan-batched execution: plan analysis, partial-plan
+    construction and the SplitExecutor (with its compiled-program memo)
+    are built ONCE; run() executes all lifespans and the final merge.
+    Repeat run() calls reuse the jitted programs — the shape the bench
+    needs for warm timing, and the worker for repeated tasks."""
+
+    def __init__(self, connector, plan: PlanNode, num_batches: int,
+                 memory_limit_bytes: Optional[int] = None, session=None):
+        from presto_tpu.plan.fragment import (
+            _UNSPLITTABLE, _partial_agg_layout,
+        )
+
+        self.connector = connector
+        self.num_batches = num_batches
+        resolver = SplitExecutor(connector)
+        plan = resolver._resolve_subqueries(plan)
+        self.plan = plan
+        chain = _root_chain(plan)
+        driving = _driving_scan(connector, plan)
+        self.batchable = not (
+            chain is None or driving is None or num_batches <= 1
+            or not _streamable(chain[1].source, driving)
+            # sketch aggregates have no column-shaped partial state —
+            # same rule as the fragmenter's reshard-instead-of-split
+            or any(a.kind in _UNSPLITTABLE for a in chain[1].aggs))
+        self.ex = SplitExecutor(connector, session=session)
+        self.ex.memory_limit_bytes = memory_limit_bytes
+        self.driving = driving
+        if not self.batchable:
+            return
+        self.above, self.agg = chain
+        partial_specs, final_specs, pnames, ptypes = \
+            _partial_agg_layout(self.agg)
+        self.final_specs = final_specs
+        self.partial_plan = AggregationNode(
+            pnames, ptypes, source=self.agg.source,
+            group_fields=self.agg.group_fields, aggs=tuple(partial_specs),
+            step=Step.PARTIAL, group_count_hint=self.agg.group_count_hint)
+        self.dyn = None
+        if self.ex.session["dynamic_filtering_enabled"]:
+            self.dyn = _dynamic_filter(connector, self.ex,
+                                       self.agg.source, driving)
+        self.spill = bool(self.ex.session["spill_enabled"])
+
+    def run(self, stats: Optional[dict] = None) -> Page:
+        if not self.batchable:
+            return self.ex.execute(self.plan)
+        connector, ex = self.connector, self.ex
+        driving, num_batches = self.driving, self.num_batches
+        skipped = 0
+        partials: List[Page] = []
+        for b in range(num_batches):
+            if self.dyn is not None:
+                col, lo, hi, empty = self.dyn
+                t = connector.table(driving, part=b,
+                                    num_parts=num_batches)
+                if t.num_rows:
+                    sv = t.arrays[col][:t.num_rows]
+                    if empty or sv.min() > hi or sv.max() < lo:
+                        skipped += 1
+                        continue
+            ex.set_splits({driving: [(b, num_batches)]})
+            p = ex.execute(self.partial_plan)
+            if self.spill:
+                p = _spill_to_host(p)
+            partials.append(p)
+        if stats is not None:
+            stats.update(batches=num_batches, skipped=skipped)
+        if not partials:
+            # every lifespan pruned: run one anyway — pruned means its
+            # join cannot match, so it yields the correct zero-state
+            # partial (global aggregates still emit their count=0 row)
+            ex.set_splits({driving: [(0, num_batches)]})
+            partials.append(ex.execute(self.partial_plan))
+
+        merged = _concat_pages(partials)
+        k = len(self.agg.group_fields)
+        out_cap = bucket_capacity(max(int(merged.num_rows), 256))
+        page, _groups = grouped_aggregate(merged, tuple(range(k)),
+                                          tuple(self.final_specs),
+                                          out_cap)
+        page = Page(page.columns, page.num_rows, self.agg.output_names)
+
+        # Interpret the small chain above the aggregation.
+        from presto_tpu.data.column import compact
+        from presto_tpu.expr.compile import compile_expr
+
+        for node in reversed(self.above):
+            if isinstance(node, SortNode):
+                page = sort_page(page, node.keys)
+            elif isinstance(node, TopNNode):
+                page = top_n(page, node.keys, node.count)
+            elif isinstance(node, LimitNode):
+                page = limit_page(page, node.count)
+            elif isinstance(node, ProjectNode):
+                cols = tuple(compile_expr(e)(page)
+                             for e in node.expressions)
+                page = Page(cols, page.num_rows, node.output_names)
+            elif isinstance(node, FilterNode):         # HAVING
+                c = compile_expr(node.predicate)(page)
+                page = compact(page, ~c.nulls & c.values.astype(bool))
+            else:  # OutputNode
+                page = Page(page.columns, page.num_rows,
+                            node.output_names)
+        return page
+
+
 def execute_batched(connector, plan: PlanNode, num_batches: int,
                     memory_limit_bytes: Optional[int] = None,
                     session=None,
@@ -222,91 +330,8 @@ def execute_batched(connector, plan: PlanNode, num_batches: int,
     lifespans. Falls back to single-shot execution when the plan shape
     does not support batching (no root aggregation). `stats` (if given)
     records {"batches", "skipped"} — dynamic-filter effectiveness."""
-    from presto_tpu.plan.fragment import _partial_agg_layout
-
-    # Resolve scalar subqueries ONCE over the full tables (a per-batch
-    # resolution would compute them over split slices).
-    resolver = SplitExecutor(connector)
-    plan = resolver._resolve_subqueries(plan)
-
-    from presto_tpu.plan.fragment import _UNSPLITTABLE
-
-    chain = _root_chain(plan)
-    driving = _driving_scan(connector, plan)
-    if (chain is None or driving is None or num_batches <= 1
-            or not _streamable(chain[1].source, driving)
-            # sketch aggregates have no column-shaped partial state —
-            # same rule as the fragmenter's reshard-instead-of-split
-            or any(a.kind in _UNSPLITTABLE for a in chain[1].aggs)):
-        ex = SplitExecutor(connector, session=session)
-        ex.memory_limit_bytes = memory_limit_bytes
-        return ex.execute(plan)
-
-    above, agg = chain
-    partial_specs, final_specs, pnames, ptypes = _partial_agg_layout(agg)
-    partial_plan = AggregationNode(
-        pnames, ptypes, source=agg.source,
-        group_fields=agg.group_fields, aggs=tuple(partial_specs),
-        step=Step.PARTIAL, group_count_hint=agg.group_count_hint)
-
-    ex = SplitExecutor(connector, session=session)
-    ex.memory_limit_bytes = memory_limit_bytes
-    dyn = None
-    if ex.session["dynamic_filtering_enabled"]:
-        dyn = _dynamic_filter(connector, ex, agg.source, driving)
-    spill = bool(ex.session["spill_enabled"])
-    skipped = 0
-    partials: List[Page] = []
-    for b in range(num_batches):
-        if dyn is not None:
-            col, lo, hi, empty = dyn
-            t = connector.table(driving, part=b, num_parts=num_batches)
-            if t.num_rows:
-                sv = t.arrays[col][:t.num_rows]
-                if empty or sv.min() > hi or sv.max() < lo:
-                    skipped += 1
-                    continue
-        ex.set_splits({driving: [(b, num_batches)]})
-        p = ex.execute(partial_plan)
-        if spill:
-            p = _spill_to_host(p)
-        partials.append(p)
-    if stats is not None:
-        stats.update(batches=num_batches, skipped=skipped)
-    if not partials:
-        # every lifespan pruned: run one anyway — pruned means its join
-        # cannot match, so it yields the correct zero-state partial
-        # (global aggregates still emit their count=0 row)
-        ex.set_splits({driving: [(0, num_batches)]})
-        partials.append(ex.execute(partial_plan))
-
-    merged = _concat_pages(partials)
-    k = len(agg.group_fields)
-    out_cap = bucket_capacity(max(int(merged.num_rows), 256))
-    page, _groups = grouped_aggregate(merged, tuple(range(k)),
-                                      tuple(final_specs), out_cap)
-    page = Page(page.columns, page.num_rows, agg.output_names)
-
-    # Interpret the small chain above the aggregation.
-    from presto_tpu.data.column import compact
-    from presto_tpu.expr.compile import compile_expr
-
-    for node in reversed(above):
-        if isinstance(node, SortNode):
-            page = sort_page(page, node.keys)
-        elif isinstance(node, TopNNode):
-            page = top_n(page, node.keys, node.count)
-        elif isinstance(node, LimitNode):
-            page = limit_page(page, node.count)
-        elif isinstance(node, ProjectNode):
-            cols = tuple(compile_expr(e)(page) for e in node.expressions)
-            page = Page(cols, page.num_rows, node.output_names)
-        elif isinstance(node, FilterNode):         # HAVING
-            c = compile_expr(node.predicate)(page)
-            page = compact(page, ~c.nulls & c.values.astype(bool))
-        else:  # OutputNode
-            page = Page(page.columns, page.num_rows, node.output_names)
-    return page
+    return BatchedRunner(connector, plan, num_batches,
+                         memory_limit_bytes, session).run(stats)
 
 
 def execute_bounded(connector, plan: PlanNode,

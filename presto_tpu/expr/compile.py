@@ -71,12 +71,16 @@ def _merge_dicts(a: StringDict, b: StringDict):
 
 
 def align_string_columns(x: Column, y: Column):
-    """Recode two VARCHAR columns onto one shared sorted dictionary."""
+    """Recode two VARCHAR columns onto one shared sorted dictionary.
+    An empty-dictionary side (all-NULL literal column) keeps zero codes —
+    nothing to remap."""
     if x.dictionary is y.dictionary:
         return x, y
     md, ma, mb = _merge_dicts(x.dictionary, y.dictionary)
-    xv = jnp.take(ma, jnp.clip(x.values, 0, len(x.dictionary) - 1))
-    yv = jnp.take(mb, jnp.clip(y.values, 0, len(y.dictionary) - 1))
+    xv = (jnp.take(ma, jnp.clip(x.values, 0, len(x.dictionary) - 1))
+          if len(x.dictionary) else jnp.zeros_like(x.values))
+    yv = (jnp.take(mb, jnp.clip(y.values, 0, len(y.dictionary) - 1))
+          if len(y.dictionary) else jnp.zeros_like(y.values))
     return (Column(xv, x.nulls, x.type, md),
             Column(yv, y.nulls, y.type, md))
 

@@ -1,0 +1,58 @@
+"""Mark-distinct: flag the first occurrence of each key combination.
+
+Reference: operator/MarkDistinctOperator.java + MarkDistinctHash — used
+to plan MIXED plain/DISTINCT aggregates (count(x), count(DISTINCT x) in
+one SELECT): the distinct aggregate becomes a plain aggregate masked by
+the marker (MultipleDistinctAggregationToMarkDistinct rule).
+
+TPU shape: one multi-operand lax.sort by the key lanes carrying every
+page column as payload (the compact()/sort_page idiom — no random
+gathers), then marker[i] = keys[i] != keys[i-1]. Row order changes,
+which is immaterial to the aggregation consuming the marker."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.data.column import Column, Page
+from presto_tpu.ops.keys import group_values, values_equal
+from presto_tpu.types import BOOLEAN
+
+
+def mark_distinct(page: Page, key_fields: Sequence[int],
+                  marker_name: str = "_distinct") -> Page:
+    """Page -> same rows (reordered) + trailing BOOLEAN marker column,
+    True on the first row of each (key...) combination. Padding rows are
+    ordered last and never marked. NULL keys form their own group (SQL
+    DISTINCT treats NULLs as equal)."""
+    cap = page.capacity
+    pad_last = (~page.row_valid()).astype(jnp.int8)
+    key_ops = [pad_last]
+    for f in key_fields:
+        c = page.columns[f]
+        key_ops.append(c.nulls.astype(jnp.int8))
+        key_ops.append(group_values(c))
+    operands = tuple(key_ops)
+    for c in page.columns:
+        operands += (c.values, c.nulls)
+    out = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=False)
+
+    # first-occurrence detection over the sorted key lanes
+    first = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for ki in range(1, len(key_ops)):
+        lane = out[ki]
+        prev = jnp.concatenate([lane[:1], lane[:-1]])
+        first = first | ~values_equal(lane, prev)
+    first = first & (out[0] == 0)          # padding rows unmarked
+
+    pos = len(key_ops)
+    cols = []
+    for c in page.columns:
+        cols.append(Column(out[pos], out[pos + 1], c.type, c.dictionary))
+        pos += 2
+    marker = Column(first, jnp.zeros(cap, dtype=bool), BOOLEAN, None)
+    return Page(tuple(cols) + (marker,), page.num_rows,
+                page.names + (marker_name,))

@@ -288,6 +288,42 @@ def add_exchanges(plan: PlanNode, connector=None, session=None,
             src, prop = visit(node.source)
             return dataclasses.replace(node, source=src), prop
 
+        from presto_tpu.plan.nodes import (
+            MarkDistinctNode, UnionAllNode, UnnestNode,
+        )
+        if isinstance(node, UnionAllNode):
+            # Gather every branch to a single stream and concatenate
+            # there (reference UnionNode is arbitrary-distributed; the
+            # gather form is the correct first cut — a distributed union
+            # would need multi-source exchange fragments).
+            srcs = []
+            for s in node.sources:
+                ssrc, sprop = visit(s)
+                if sprop[0] != Partitioning.SINGLE:
+                    ssrc = exchange(ssrc, Partitioning.SINGLE)
+                srcs.append(ssrc)
+            return (dataclasses.replace(node, sources=tuple(srcs)),
+                    (Partitioning.SINGLE, ()))
+        if isinstance(node, MarkDistinctNode):
+            # every row of one key combination must be device-local,
+            # like grouping
+            src, prop = visit(node.source)
+            kf = tuple(node.key_fields)
+            if not hash_satisfied(prop, kf, subset_ok=True):
+                src = exchange(src, Partitioning.HASH, kf)
+                prop = (Partitioning.HASH, kf)
+            return dataclasses.replace(node, source=src), prop
+        if isinstance(node, UnnestNode):
+            # row-local flatten: any distribution works; the output keeps
+            # the source's partitioning property only when the unnest
+            # preserves the partition keys (conservative: demote to
+            # SOURCE so consumers reshuffle as needed)
+            src, prop = visit(node.source)
+            out_prop = (Partitioning.SOURCE, ())
+            if prop[0] == Partitioning.SINGLE:
+                out_prop = prop
+            return dataclasses.replace(node, source=src), out_prop
+
         raise NotImplementedError(f"add_exchanges: {type(node).__name__}")
 
     out, _prop = visit(plan)
@@ -341,6 +377,9 @@ def create_fragments(plan: PlanNode) -> List[PlanFragment]:
         if isinstance(node, JoinNode):
             repl["probe"] = cut(node.probe, sources)
             repl["build"] = cut(node.build, sources)
+        elif "sources" in names:       # UnionAllNode: N-ary
+            repl["sources"] = tuple(cut(s, sources)
+                                    for s in node.sources)
         elif "source" in names:
             repl["source"] = cut(node.source, sources)
         return dataclasses.replace(node, **repl)

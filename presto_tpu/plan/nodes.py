@@ -159,6 +159,31 @@ class AssignUniqueIdNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class MarkDistinctNode(PlanNode):
+    """Appends a BOOLEAN first-occurrence marker per (key...) combination
+    (reference: spi/plan/MarkDistinctNode -> MarkDistinctOperator.java);
+    rows may be reordered. Plans mixed plain/DISTINCT aggregations: the
+    distinct aggregate consumes the marker as its mask."""
+    source: PlanNode = None
+    key_fields: Tuple[int, ...] = ()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionAllNode(PlanNode):
+    """Bag concatenation of N same-schema sources (reference:
+    spi/plan/UnionNode — distinct UNION/INTERSECT/EXCEPT are planned as
+    UnionAll + aggregation above, mirroring the reference's
+    SetOperationNodeTranslator rewrite)."""
+    sources: Tuple[PlanNode, ...] = ()
+
+    def children(self):
+        return self.sources
+
+
+@dataclasses.dataclass(frozen=True)
 class UnnestNode(PlanNode):
     """Flattens ARRAY/MAP columns into rows (reference:
     spi/plan/UnnestNode -> operator/unnest/ArrayUnnester.java /

@@ -697,6 +697,45 @@ class EnforceSingleRowNode(PlanNode):
     _SCHEMA = [("id", "id", None), ("source", "source", PlanNode)]
 
 
+@PlanNode.register(".UnionNode")
+@dataclasses.dataclass
+class UnionNode(PlanNode):
+    """spi/plan/UnionNode.java (SetOperationNode shape): outputToInputs
+    maps each output variable ("name<type>" key) to the per-source input
+    variables, in source order."""
+    id: str = ""
+    sources: List[Any] = dataclasses.field(default_factory=list)
+    outputVariables: List[Variable] = dataclasses.field(
+        default_factory=list)
+    outputToInputs: Dict[str, List[Variable]] = dataclasses.field(
+        default_factory=dict)
+    _SCHEMA = [
+        ("id", "id", None),
+        ("sources", "sources", ("list", PlanNode)),
+        ("outputVariables", "outputVariables", ("list", Variable)),
+        ("outputToInputs", "outputToInputs", ("map", ("list", Variable))),
+    ]
+
+
+@PlanNode.register(".MarkDistinctNode")
+@dataclasses.dataclass
+class MarkDistinctNode(PlanNode):
+    """spi/plan/MarkDistinctNode.java."""
+    id: str = ""
+    source: Any = None
+    markerVariable: Variable = None
+    distinctVariables: List[Variable] = dataclasses.field(
+        default_factory=list)
+    hashVariable: Optional[Variable] = None
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("markerVariable", "markerVariable", Variable),
+        ("distinctVariables", "distinctVariables", ("list", Variable)),
+        ("hashVariable", "hashVariable", ("opt", Variable)),
+    ]
+
+
 @PlanNode.register(".UnnestNode")
 @dataclasses.dataclass
 class UnnestNode(PlanNode):

@@ -327,6 +327,32 @@ class _FragmentConverter:
             return S.WindowNode(id=nid, source=src, specification=spec,
                                 windowFunctions=fns), out
 
+        if isinstance(node, P.UnionAllNode):
+            psrcs, out_to_in = [], {}
+            out = [names.var(n_, t) for n_, t in zip(node.output_names,
+                                                     node.output_types)]
+            per_src_vars = []
+            for s in node.sources:
+                ssrc, svars = self.convert(s)
+                psrcs.append(ssrc)
+                per_src_vars.append(svars)
+            for ci, ov in enumerate(out):
+                out_to_in[f"{ov.name}<{ov.type}>"] = [
+                    sv[ci] for sv in per_src_vars]
+            return S.UnionNode(id=nid, sources=psrcs,
+                               outputVariables=out,
+                               outputToInputs=out_to_in), out
+
+        if isinstance(node, P.MarkDistinctNode):
+            src, in_vars = self.convert(node.source)
+            marker = names.var(node.output_names[-1],
+                               node.output_types[-1])
+            return S.MarkDistinctNode(
+                id=nid, source=src, markerVariable=marker,
+                distinctVariables=[in_vars[f]
+                                   for f in node.key_fields]), \
+                in_vars + [marker]
+
         if isinstance(node, P.UnnestNode):
             from presto_tpu.types import ArrayType, MapType
             src, in_vars = self.convert(node.source)

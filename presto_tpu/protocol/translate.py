@@ -295,6 +295,10 @@ def _out_vars(node) -> List[S.Variable]:
         if node.ordinalityVariable is not None:
             out.append(node.ordinalityVariable)
         return out
+    if isinstance(node, S.UnionNode):
+        return node.outputVariables
+    if isinstance(node, S.MarkDistinctNode):
+        return _out_vars(node.source) + [node.markerVariable]
     if isinstance(node, (S.LimitNode, S.TopNNode, S.SortNode,
                          S.EnforceSingleRowNode)):
         return _out_vars(node.source)
@@ -568,6 +572,40 @@ def _node(n) -> P.PlanNode:
             tuple(parse_type(v.type) for v in out_vars),
             source=src, replicate_fields=repl, unnest_fields=channels,
             with_ordinality=n.ordinalityVariable is not None)
+
+    if isinstance(n, S.UnionNode):
+        srcs = []
+        for si, s in enumerate(n.sources):
+            child = _node(s)
+            scope = Scope(_out_vars(s))
+            # outputToInputs names source si's column for each output
+            exprs, names, types = [], [], []
+            for ov in n.outputVariables:
+                key = f"{ov.name}<{ov.type}>"
+                ins = n.outputToInputs.get(key) or n.outputToInputs.get(
+                    ov.name)
+                if ins is None or si >= len(ins):
+                    raise NotImplementedError(
+                        f"UnionNode outputToInputs missing {ov.name}")
+                exprs.append(scope.ref(ins[si]))
+                names.append(ov.name)
+                types.append(parse_type(ov.type))
+            srcs.append(P.ProjectNode(tuple(names), tuple(types),
+                                      source=child,
+                                      expressions=tuple(exprs)))
+        return P.UnionAllNode(
+            tuple(v.name for v in n.outputVariables),
+            tuple(parse_type(v.type) for v in n.outputVariables),
+            sources=tuple(srcs))
+
+    if isinstance(n, S.MarkDistinctNode):
+        src = _node(n.source)
+        scope = Scope(_out_vars(n.source))
+        return P.MarkDistinctNode(
+            src.output_names + (n.markerVariable.name,),
+            src.output_types + (BOOLEAN,), source=src,
+            key_fields=tuple(scope.index[v.name]
+                             for v in n.distinctVariables))
 
     if isinstance(n, S.RawNode):
         raise NotImplementedError(f"plan node {n.type_key}")

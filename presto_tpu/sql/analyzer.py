@@ -134,6 +134,59 @@ def _or_all(disjs: Sequence[ast.Expr]) -> ast.Expr:
     return out
 
 
+_STDDEV_FUNCS = {"stddev_samp", "stddev", "var_samp", "variance"}
+
+
+def _rewrite_stddev(x):
+    """stddev_samp(x) -> case when count(x) > 1 then
+    sqrt((sum(x*x) - sum(x)*sum(x)/count(x)) / (count(x) - 1)) end —
+    a pure AST rewrite so the sum/count machinery (incl. partial/final
+    splitting) computes it (reference: the decomposable-aggregate
+    rewrites in operator/aggregation/VarianceAggregation semantics)."""
+    if isinstance(x, ast.FuncCall) and x.name in _STDDEV_FUNCS and x.args:
+        if x.distinct:
+            raise AnalysisError(
+                f"{x.name}(DISTINCT ...) is not supported")
+        a = _rewrite_stddev(x.args[0])
+        sum_sq = ast.Cast(ast.FuncCall("sum", (ast.BinaryOp("*", a, a),)),
+                          "double")
+        s = ast.Cast(ast.FuncCall("sum", (a,)), "double")
+        cnt = ast.FuncCall("count", (a,))
+        var = ast.BinaryOp(
+            "/",
+            ast.BinaryOp("-", sum_sq,
+                         ast.BinaryOp("/", ast.BinaryOp("*", s, s), cnt)),
+            ast.BinaryOp("-", cnt, ast.NumberLit("1")))
+        out = (ast.FuncCall("sqrt", (var,))
+               if x.name in ("stddev_samp", "stddev") else var)
+        return ast.Case(None,
+                        ((ast.BinaryOp("gt", cnt, ast.NumberLit("1")),
+                          out),), None)
+    if isinstance(x, ast.Select):
+        return x                       # nested scopes rewrite themselves
+    if dataclasses.is_dataclass(x):
+        changes = {}
+        for f in dataclasses.fields(x):
+            v = getattr(x, f.name)
+            nv = _rewrite_stddev(v)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(x, **changes) if changes else x
+    if isinstance(x, tuple):
+        return tuple(_rewrite_stddev(i) for i in x)
+    return x
+
+
+def _rewrite_stddev_query(q: ast.Select) -> ast.Select:
+    items = tuple(_rewrite_stddev(it) for it in q.items)
+    having = _rewrite_stddev(q.having) if q.having is not None else None
+    order = tuple(_rewrite_stddev(o) for o in q.order_by)
+    if items == q.items and having is q.having and order == q.order_by:
+        return q
+    return dataclasses.replace(q, items=items, having=having,
+                               order_by=order)
+
+
 def _expr_idents(e) -> Set[Tuple[str, ...]]:
     out: Set[Tuple[str, ...]] = set()
 
@@ -273,6 +326,9 @@ class Planner:
                     dataclasses.replace(q, ctes=()))
             finally:
                 self._cte_stack.pop()
+        if q.set_ops:
+            return self._plan_set_ops(q)
+        q = _rewrite_stddev_query(q)
         where_conjuncts = _normalize_conjuncts(_conjuncts(q.where))
 
         if q.relations:
@@ -300,6 +356,134 @@ class Planner:
         rp = self._plan_order_limit(q, rp)
         return rp
 
+    def _plan_set_ops(self, q: ast.Select) -> RelationPlan:
+        """UNION / INTERSECT / EXCEPT (reference: sql/tree set operations
+        -> spi/plan/UnionNode; the distinct forms rewrite through
+        aggregation like SetOperationNodeTranslator). Lowerings:
+          UNION ALL        -> UnionAllNode
+          UNION            -> UnionAll + DISTINCT aggregation
+          INTERSECT        -> distinct(L) ++ distinct(R), group by all
+                              columns, keep groups seen on both sides
+          EXCEPT           -> same, keep groups seen only on the left
+        The aggregation route gives SQL set-op NULL semantics for free
+        (grouping treats NULLs as equal — IS NOT DISTINCT FROM)."""
+        from presto_tpu.plan.nodes import UnionAllNode
+        from presto_tpu.types import common_super_type
+
+        head = dataclasses.replace(q, set_ops=(), order_by=(),
+                                   limit=None)
+        current = self._plan_select(head)
+        for op, distinct, rhs in q.set_ops:
+            right = self._plan_select(rhs)
+            if len(right.fields) != len(current.fields):
+                raise AnalysisError(
+                    f"set operation column counts differ: "
+                    f"{len(current.fields)} vs {len(right.fields)}")
+            # unify column types (coercion casts on either side)
+            types = []
+            for lf, rf in zip(current.fields, right.fields):
+                t = common_super_type(lf.type, rf.type)
+                if t is None:
+                    raise AnalysisError(
+                        f"set operation type mismatch: {lf.type} vs "
+                        f"{rf.type} for column {lf.name!r}")
+                types.append(t)
+            current = self._coerce_columns(current, types)
+            right = self._coerce_columns(right, types)
+            if not distinct and op != "union":
+                raise AnalysisError(f"{op.upper()} ALL is not supported")
+            names = tuple(f.name for f in current.fields)
+            if op == "union":
+                node = UnionAllNode(names, tuple(types),
+                                    sources=(current.node, right.node))
+                est = current.est_rows + right.est_rows
+                current = RelationPlan(
+                    node, tuple(Field(n, t) for n, t in
+                                zip(names, types)), est)
+                if distinct:
+                    current = self._distinct_plan(current)
+            else:
+                current = self._intersect_except(
+                    current, right, keep_both=(op == "intersect"))
+        # trailing ORDER BY / LIMIT over the combined result
+        tail = dataclasses.replace(
+            q, set_ops=(), relations=(), where=None, group_by=(),
+            having=None, distinct=False,
+            items=tuple(ast.SelectItem(ast.Ident((f.name,)))
+                        for f in current.fields))
+        return self._plan_order_limit(tail, current)
+
+    def _coerce_columns(self, rp: RelationPlan,
+                        types: List[Type]) -> RelationPlan:
+        if all(f.type == t for f, t in zip(rp.fields, types)):
+            return rp
+        exprs = []
+        for i, (f, t) in enumerate(zip(rp.fields, types)):
+            ref = InputRef(i, f.type)
+            exprs.append(ref if f.type == t else Call("cast", (ref,), t))
+        names = tuple(f.name for f in rp.fields)
+        node = ProjectNode(names, tuple(types), rp.node, tuple(exprs))
+        return RelationPlan(
+            node, tuple(Field(f.name, t, f.qualifier)
+                        for f, t in zip(rp.fields, types)), rp.est_rows)
+
+    def _distinct_plan(self, rp: RelationPlan) -> RelationPlan:
+        node = AggregationNode(
+            tuple(f.name for f in rp.fields),
+            tuple(f.type for f in rp.fields), rp.node,
+            tuple(range(len(rp.fields))), (), Step.SINGLE)
+        return RelationPlan(node, rp.fields, max(rp.est_rows / 2, 1.0))
+
+    def _intersect_except(self, left: RelationPlan, right: RelationPlan,
+                          keep_both: bool) -> RelationPlan:
+        """distinct(L) ++ distinct(R) tagged with a side flag, grouped by
+        every column; INTERSECT keeps groups present on both sides,
+        EXCEPT keeps groups only on the left. NULL-safe by construction
+        (group keys compare nulls equal)."""
+        from presto_tpu.ops.aggregate import AggSpec
+        from presto_tpu.plan.nodes import UnionAllNode
+
+        left = self._distinct_plan(left)
+        right = self._distinct_plan(right)
+        k = len(left.fields)
+
+        def tag(rp: RelationPlan, flag: int) -> PlanNode:
+            names = tuple(f.name for f in rp.fields) + ("_side",)
+            types = tuple(f.type for f in rp.fields) + (BIGINT,)
+            exprs = tuple(InputRef(i, f.type)
+                          for i, f in enumerate(rp.fields)) \
+                + (Literal(flag, BIGINT),)
+            return ProjectNode(names, types, rp.node, exprs)
+
+        names = tuple(f.name for f in left.fields)
+        types = tuple(f.type for f in left.fields)
+        union = UnionAllNode(names + ("_side",), types + (BIGINT,),
+                             sources=(tag(left, 0), tag(right, 1)))
+        agg = AggregationNode(
+            names + ("_minside", "_maxside"),
+            types + (BIGINT, BIGINT), union,
+            tuple(range(k)),
+            (AggSpec("min", k, BIGINT), AggSpec("max", k, BIGINT)),
+            Step.SINGLE)
+        if keep_both:       # INTERSECT: seen with flag 0 AND flag 1
+            pred = SpecialForm(
+                Form.AND,
+                (Call("eq", (InputRef(k, BIGINT), Literal(0, BIGINT)),
+                      BOOLEAN),
+                 Call("eq", (InputRef(k + 1, BIGINT),
+                             Literal(1, BIGINT)), BOOLEAN)),
+                BOOLEAN)
+        else:               # EXCEPT: only ever seen with flag 0
+            pred = Call("eq", (InputRef(k + 1, BIGINT),
+                               Literal(0, BIGINT)), BOOLEAN)
+        filt = FilterNode(agg.output_names, agg.output_types, agg, pred)
+        proj = ProjectNode(names, types, filt,
+                           tuple(InputRef(i, t)
+                                 for i, t in enumerate(types)))
+        est = (min(left.est_rows, right.est_rows) if keep_both
+               else left.est_rows)
+        return RelationPlan(proj, left.fields, max(est, 1.0))
+
     def _plan_from(self, relations: List[ast.Relation],
                    conjuncts: List[ast.Expr], q: ast.Select) -> RelationPlan:
         # classify conjuncts: single-relation -> pushdown filter;
@@ -320,6 +504,7 @@ class Planner:
         pushed: Dict[int, List[ast.Expr]] = {i: [] for i in range(len(plans))}
         join_conds: List[Tuple[Set[int], ast.Expr]] = []
         semijoins: List[ast.Expr] = []
+        or_exists: List[List[Tuple[ast.Select, bool]]] = []
         corr_scalars: List[Tuple[str, ast.Expr, ast.Select, bool]] = []
         for c in conjuncts:
             # NOT EXISTS / NOT IN arrive as UnaryOp(not, ...).
@@ -329,6 +514,10 @@ class Planner:
                                         negated=not c.operand.negated)
             if isinstance(c, (ast.InSubquery, ast.Exists)):
                 semijoins.append(c)
+                continue
+            terms = self._exists_disjunction(c)
+            if terms is not None:
+                or_exists.append(terms)
                 continue
             cs = self._match_correlated_scalar(c)
             if cs is not None:
@@ -386,7 +575,92 @@ class Planner:
                                                     sub_q, flipped)
         for sq in semijoins:
             current = self._apply_semijoin(current, sq)
+        for terms in or_exists:
+            current = self._apply_or_exists(current, terms)
         return current
+
+    def _exists_disjunction(self, c: ast.Expr) -> Optional[List[tuple]]:
+        """An OR containing [NOT] EXISTS / IN-subquery disjuncts ->
+        [("exists", subq, neg) | ("in", value, subq) | ("plain", expr),
+        ...]; None when no subquery term is present (plain predicate)."""
+        ds = _disjuncts(c)
+        if len(ds) < 2:
+            return None
+        out: List[tuple] = []
+        has_subquery = False
+        for d in ds:
+            neg = False
+            if isinstance(d, ast.UnaryOp) and d.op == "not" \
+                    and isinstance(d.operand, (ast.Exists,
+                                               ast.InSubquery)):
+                neg, d = True, d.operand
+            if isinstance(d, ast.Exists):
+                has_subquery = True
+                out.append(("exists", d.query, neg ^ d.negated))
+            elif isinstance(d, ast.InSubquery):
+                if neg or d.negated:
+                    # NOT IN inside OR needs three-valued NULL handling
+                    # the flag form doesn't carry
+                    return None
+                has_subquery = True
+                out.append(("in", d.value, d.query))
+            else:
+                out.append(("plain", d))
+        return out if has_subquery else None
+
+    def _apply_or_exists(self, rp: RelationPlan,
+                         terms: List[tuple]) -> RelationPlan:
+        """(EXISTS(a) OR x IN (b) OR plain ...) — each subquery term
+        becomes a flag-emitting mark join; one filter ORs flags and plain
+        predicates; flags are projected away (reference: the planner's
+        semiJoinOutput form for existence predicates in disjunctions)."""
+        base_arity = len(rp.fields)
+        flag_of: Dict[int, int] = {}      # term index -> flag channel
+        nflags = 0
+        for ti, term in enumerate(terms):
+            if term[0] == "exists":
+                rp = self._apply_exists(rp, term[1], False,
+                                        flag_name=f"_orex{nflags}")
+            elif term[0] == "in":
+                sub = self._plan_select(term[2])
+                if len(sub.fields) != 1:
+                    raise AnalysisError(
+                        "IN subquery must return one column")
+                v = self.analyze(term[1], rp.fields)
+                vf = self._as_input_field(v, rp)
+                node = JoinNode(
+                    tuple(f.name for f in rp.fields)
+                    + (f"_orex{nflags}",),
+                    tuple(f.type for f in rp.fields) + (BOOLEAN,),
+                    rp.node, sub.node, JoinType.SEMI, (vf,), (0,),
+                    None, emit_flag=True)
+                rp = RelationPlan(
+                    node,
+                    rp.fields + (Field(f"_orex{nflags}", BOOLEAN),),
+                    rp.est_rows)
+            else:
+                continue
+            flag_of[ti] = base_arity + nflags
+            nflags += 1
+        pred: Optional[RowExpression] = None
+        for ti, term in enumerate(terms):
+            if ti in flag_of:
+                e = InputRef(flag_of[ti], BOOLEAN)
+                if term[0] == "exists" and term[2]:
+                    e = Call("not", (e,), BOOLEAN)
+            else:
+                e = self.analyze(term[1], rp.fields)
+            pred = e if pred is None else \
+                SpecialForm(Form.OR, (pred, e), BOOLEAN)
+        filt = FilterNode(tuple(f.name for f in rp.fields),
+                          tuple(f.type for f in rp.fields),
+                          rp.node, pred)
+        base = rp.fields[:base_arity]
+        proj = ProjectNode(tuple(f.name for f in base),
+                           tuple(f.type for f in base), filt,
+                           tuple(InputRef(i, f.type)
+                                 for i, f in enumerate(base)))
+        return RelationPlan(proj, base, max(rp.est_rows * 0.5, 1.0))
 
     def _match_correlated_scalar(self, c: ast.Expr):
         """cmp(value, correlated scalar subquery) in either orientation ->
@@ -418,7 +692,7 @@ class Planner:
                 "unsupported")
         kept: List[ast.Expr] = []
         corr: List[Tuple[ast.Expr, ast.Ident]] = []  # (outer, inner)
-        for cc in _conjuncts(sub_q.where):
+        for cc in _normalize_conjuncts(_conjuncts(sub_q.where)):
             free = [p for p in _expr_idents(cc)
                     if not self._shallow_resolves(p, inner_shallow)]
             if not free:
@@ -632,8 +906,12 @@ class Planner:
                 walk(o.expr)
             for r in q.relations:
                 walk(r)
-            return {p for p in idents
+            free = {p for p in idents
                     if not self._shallow_resolves(p, fields)}
+            # set-op branches are full query terms with their own scopes
+            for _op, _d, term in q.set_ops:
+                free |= self._free_idents(term)
+            return free
         finally:
             if q.ctes:
                 self._cte_stack.pop()
@@ -862,8 +1140,19 @@ class Planner:
     def _join(self, probe: RelationPlan, build: RelationPlan,
               conds: List[ast.Expr], outer: bool = False,
               preserve_order: bool = True) -> RelationPlan:
-        fields = probe.fields + build.fields
+        out_fields = probe.fields + build.fields
         pk, bk, residual = [], [], []
+        p_extra: List[RowExpression] = []
+        b_extra: List[RowExpression] = []
+
+        def chan(e: RowExpression, rp: RelationPlan, extra) -> int:
+            # computed equi keys (q59's week_seq - 52) get projected as
+            # trailing key columns on their side
+            if isinstance(e, InputRef):
+                return e.field
+            extra.append(e)
+            return len(rp.fields) + len(extra) - 1
+
         for c in conds:
             if self._is_equi(c):
                 l, r = c.left, c.right
@@ -879,15 +1168,29 @@ class Planner:
                 else:
                     residual.append(c)
                     continue
-                pi = self._as_input_field(pe, probe)
-                bi = self._as_input_field(be, build)
-                pk.append(pi)
-                bk.append(bi)
+                pk.append(chan(pe, probe, p_extra))
+                bk.append(chan(be, build, b_extra))
             else:
                 residual.append(c)
-        probe, pk = self._maybe_project_keys(probe, pk)
-        build, bk = self._maybe_project_keys(build, bk)
-        fields = probe.fields + build.fields
+
+        def append_keys(rp: RelationPlan, extra) -> RelationPlan:
+            if not extra:
+                return rp
+            names = tuple(f.name for f in rp.fields) + tuple(
+                f"_jk{i}" for i in range(len(extra)))
+            types = tuple(f.type for f in rp.fields) + tuple(
+                e.type for e in extra)
+            exprs = tuple(InputRef(i, f.type)
+                          for i, f in enumerate(rp.fields)) + tuple(extra)
+            node = ProjectNode(names, types, rp.node, exprs)
+            extra_fields = tuple(
+                Field(f"_jk{i}", e.type) for i, e in enumerate(extra))
+            return RelationPlan(node, rp.fields + extra_fields,
+                                rp.est_rows)
+
+        probe2 = append_keys(probe, p_extra)
+        build2 = append_keys(build, b_extra)
+        fields = probe2.fields + build2.fields
 
         jt = {False: JoinType.INNER, "left": JoinType.LEFT,
               True: JoinType.LEFT, "full": JoinType.FULL}[outer]
@@ -900,22 +1203,30 @@ class Planner:
         est = probe.est_rows if pk else probe.est_rows * build.est_rows
         node = JoinNode(tuple(f.name for f in fields),
                         tuple(f.type for f in fields),
-                        probe.node, build.node, jt, tuple(pk), tuple(bk),
+                        probe2.node, build2.node, jt, tuple(pk), tuple(bk),
                         res_expr,
                         fanout_hint=1.0 if pk else build.est_rows)
-        return RelationPlan(node, fields, max(est, 1.0))
+        rp_out = RelationPlan(node, fields, max(est, 1.0))
+        if p_extra or b_extra:
+            # project the internal _jk columns away (SELECT * must not
+            # see them); output layout = probe fields ++ build fields
+            idx = (list(range(len(probe.fields)))
+                   + [len(probe2.fields) + i
+                      for i in range(len(build.fields))])
+            proj = ProjectNode(
+                tuple(f.name for f in out_fields),
+                tuple(f.type for f in out_fields), node,
+                tuple(InputRef(i, fields[i].type) for i in idx))
+            rp_out = RelationPlan(proj, out_fields, max(est, 1.0))
+        return rp_out
 
     def _as_input_field(self, e: RowExpression, rp: RelationPlan) -> int:
-        """Join keys must be plain columns on device; project computed keys
-        into the relation first (simplification: only direct InputRefs are
-        zero-cost)."""
+        """Join keys must be plain columns on device (semi-join/flag
+        paths; _join projects computed keys itself)."""
         if isinstance(e, InputRef):
             return e.field
         raise AnalysisError(
             f"computed join keys not yet supported: {e}")
-
-    def _maybe_project_keys(self, rp, keys):
-        return rp, keys
 
     def _apply_semijoin(self, rp: RelationPlan, c) -> RelationPlan:
         if isinstance(c, ast.Exists):
@@ -936,12 +1247,18 @@ class Planner:
         return RelationPlan(node, fields, max(rp.est_rows * 0.5, 1.0))
 
     def _apply_exists(self, rp: RelationPlan, sub_q: ast.Select,
-                      negated: bool) -> RelationPlan:
+                      negated: bool,
+                      flag_name: Optional[str] = None) -> RelationPlan:
         """Decorrelate [NOT] EXISTS. Equality correlations become semi /
         anti-exists join keys; other correlated conditions force the
         mark-join form (row ids + inner join + residual filter + semi on
         row id). Reference: TransformCorrelatedExistsToJoin rules,
-        AssignUniqueIdNode-based mark joins."""
+        AssignUniqueIdNode-based mark joins.
+
+        With `flag_name`, every probe row survives and a trailing BOOLEAN
+        match-flag column is appended instead of filtering (the
+        semiJoinOutput form — how EXISTS inside OR disjunctions plans);
+        `negated` is then the caller's concern."""
         inner_shallow = self._shallow_fields(list(sub_q.relations))
         if sub_q.group_by or sub_q.having:
             raise AnalysisError(
@@ -949,7 +1266,7 @@ class Planner:
         kept: List[ast.Expr] = []
         corr_eq: List[Tuple[ast.Expr, ast.Ident]] = []   # (outer, inner)
         corr_res: List[ast.Expr] = []
-        for cc in _conjuncts(sub_q.where):
+        for cc in _normalize_conjuncts(_conjuncts(sub_q.where)):
             free = [p for p in _expr_idents(cc)
                     if not self._shallow_resolves(p, inner_shallow)]
             if not free:
@@ -992,6 +1309,15 @@ class Planner:
             pk = [self._as_input_field(self.analyze(o, fields), rp)
                   for o, _i in corr_eq]
             bk = [key_pos[i.parts] for _o, i in corr_eq]
+            if flag_name is not None:
+                node = JoinNode(
+                    tuple(f.name for f in fields) + (flag_name,),
+                    tuple(f.type for f in fields) + (BOOLEAN,),
+                    rp.node, sub_rp.node, JoinType.SEMI, tuple(pk),
+                    tuple(bk), None, emit_flag=True)
+                return RelationPlan(
+                    node, fields + (Field(flag_name, BOOLEAN),),
+                    rp.est_rows)
             jt = JoinType.ANTI_EXISTS if negated else JoinType.SEMI
             node = JoinNode(tuple(f.name for f in fields),
                             tuple(f.type for f in fields),
@@ -1031,6 +1357,20 @@ class Planner:
         rowid_idx = len(fields)
         match_ids = ProjectNode(("_rowid",), (rowid_t,), matches,
                                 (InputRef(rowid_idx, rowid_t),))
+        if flag_name is not None:
+            marked = JoinNode(
+                tuple(f.name for f in tagged_fields) + (flag_name,),
+                tuple(f.type for f in tagged_fields) + (BOOLEAN,),
+                tagged, match_ids, JoinType.SEMI, (rowid_idx,), (0,),
+                None, emit_flag=True)
+            proj = ProjectNode(
+                tuple(f.name for f in fields) + (flag_name,),
+                tuple(f.type for f in fields) + (BOOLEAN,), marked,
+                tuple(InputRef(i, f.type)
+                      for i, f in enumerate(fields))
+                + (InputRef(len(tagged_fields), BOOLEAN),))
+            return RelationPlan(
+                proj, fields + (Field(flag_name, BOOLEAN),), rp.est_rows)
         jt = JoinType.ANTI_EXISTS if negated else JoinType.SEMI
         marked = JoinNode(tuple(f.name for f in tagged_fields),
                           tuple(f.type for f in tagged_fields),
@@ -1073,8 +1413,21 @@ class Planner:
 
     def _plan_aggregation(self, q: ast.Select, rp: RelationPlan
                           ) -> RelationPlan:
+        mark_distinct_mode = False
         if self._has_distinct_aggs(q):
-            q, rp = self._rewrite_distinct_aggs(q, rp)
+            try:
+                # all-DISTINCT single-argument form: dedupe-then-aggregate
+                # (SingleDistinctAggregationToGroupBy)
+                q, rp = self._rewrite_distinct_aggs(q, rp)
+            except AnalysisError:
+                # mixed plain/DISTINCT or multiple arguments: plan with
+                # first-occurrence markers
+                # (MultipleDistinctAggregationToMarkDistinct)
+                mark_distinct_mode = True
+                if q.grouping_sets is not None:
+                    raise AnalysisError(
+                        "DISTINCT aggregates with GROUPING SETS "
+                        "unsupported")
         fields = rp.fields
         # 1. group keys (support ordinals)
         key_exprs: List[RowExpression] = []
@@ -1165,6 +1518,10 @@ class Planner:
                     out_t = arg.type if kind != "sum" or \
                         not arg.type.is_integer else BIGINT
                 spec = AggSpec(kind, f, out_t, param=param)
+                if call.distinct and mark_distinct_mode:
+                    # placeholder mask; resolved to a marker channel once
+                    # the pre-projection layout is final
+                    spec = dataclasses.replace(spec, mask_field=-1 - f)
             agg_to_output[call] = len(key_exprs) + len(agg_specs)
             agg_specs.append(spec)
             agg_types.append(spec.output_type)
@@ -1177,6 +1534,30 @@ class Planner:
                           tuple(e.type for e in pre_exprs), rp.node,
                           tuple(pre_exprs))
         k = len(key_exprs)
+        if mark_distinct_mode:
+            # one MarkDistinctNode per distinct argument channel; each
+            # appends a marker the masked aggregate consumes (reference:
+            # MarkDistinctOperator under mixed aggregations)
+            from presto_tpu.plan.nodes import MarkDistinctNode
+            distinct_channels: List[int] = []
+            for s in agg_specs:
+                if s.mask_field is not None and s.mask_field < 0:
+                    ch = -1 - s.mask_field
+                    if ch not in distinct_channels:
+                        distinct_channels.append(ch)
+            marker_of: Dict[int, int] = {}
+            node_md = pre
+            for i, ch in enumerate(distinct_channels):
+                marker_of[ch] = len(pre_exprs) + i
+                node_md = MarkDistinctNode(
+                    node_md.output_names + (f"_dm{i}",),
+                    node_md.output_types + (BOOLEAN,), source=node_md,
+                    key_fields=tuple(range(k)) + (ch,))
+            agg_specs = [
+                (dataclasses.replace(s, mask_field=marker_of[-1 - s.mask_field])
+                 if s.mask_field is not None and s.mask_field < 0 else s)
+                for s in agg_specs]
+            pre = node_md
         gsets = q.grouping_sets
         if gsets is not None:
             # GROUPING SETS: expand rows per set (GroupIdNode), then group
@@ -1494,15 +1875,38 @@ class Planner:
         node = rp.node
         if q.order_by:
             keys = []
+            extra: List[RowExpression] = []
             for o in q.order_by:
-                idx = self._resolve_order_expr(o.expr, q, rp)
+                r = self._resolve_order_expr(o.expr, q, rp)
+                if isinstance(r, int):
+                    idx = r
+                else:
+                    # computed sort key (ORDER BY case when ... end):
+                    # append it as a temporary column, sort, drop it
+                    idx = len(rp.fields) + len(extra)
+                    extra.append(r)
                 keys.append(SortKey(idx, o.ascending, o.nulls_first))
+            if extra:
+                names = node.output_names + tuple(
+                    f"_ok{i}" for i in range(len(extra)))
+                types = node.output_types + tuple(
+                    e.type for e in extra)
+                node = ProjectNode(
+                    names, types, node,
+                    tuple(InputRef(i, t) for i, t in
+                          enumerate(node.output_types)) + tuple(extra))
             if q.limit is not None:
                 node = TopNNode(node.output_names, node.output_types, node,
                                 tuple(keys), q.limit)
             else:
                 node = SortNode(node.output_names, node.output_types, node,
                                 tuple(keys))
+            if extra:
+                k = len(rp.fields)
+                node = ProjectNode(
+                    node.output_names[:k], node.output_types[:k], node,
+                    tuple(InputRef(i, t) for i, t in
+                          enumerate(node.output_types[:k])))
         elif q.limit is not None:
             node = LimitNode(node.output_names, node.output_types, node,
                              q.limit)
@@ -1513,22 +1917,64 @@ class Planner:
         # ordinal
         if isinstance(e, ast.NumberLit) and "." not in e.text:
             return int(e.text) - 1
-        # alias match
+        # alias match (single-part, or qualifier.name)
         if isinstance(e, ast.Ident) and len(e.parts) == 1:
             for i, f in enumerate(rp.fields):
                 if f.name == e.parts[0]:
                     return i
-        # expression match against select items
+        if isinstance(e, ast.Ident) and len(e.parts) == 2:
+            for i, f in enumerate(rp.fields):
+                if f.qualifier == e.parts[0] and f.name == e.parts[1]:
+                    return i
+            # output columns of a subquery lose their inner qualifier:
+            # fall back to the bare name when it is unambiguous
+            hits = [i for i, f in enumerate(rp.fields)
+                    if f.name == e.parts[1]]
+            if len(hits) == 1:
+                return hits[0]
+        # expression match against select items (aliases substitute in —
+        # ORDER BY case when lochierarchy = 0 then ... end)
         if self._order_scope is not None:
             rewriter, out_exprs, _names = self._order_scope
-            try:
-                re_ = rewriter.rewrite(e)
-            except AnalysisError:
-                re_ = None
-            if re_ is not None:
-                for i, oe in enumerate(out_exprs):
-                    if oe == re_:
-                        return i
+            alias_map = {}
+            for it in q.items:
+                if it.alias is not None and not isinstance(it.expr,
+                                                           ast.Star):
+                    alias_map[it.alias] = it.expr
+
+            def subst(x):
+                if isinstance(x, ast.Ident) and len(x.parts) == 1 \
+                        and x.parts[0] in alias_map:
+                    return alias_map[x.parts[0]]
+                if isinstance(x, ast.Select):
+                    return x
+                if dataclasses.is_dataclass(x):
+                    ch = {}
+                    for fl in dataclasses.fields(x):
+                        v = getattr(x, fl.name)
+                        nv = subst(v)
+                        if nv is not v:
+                            ch[fl.name] = nv
+                    return dataclasses.replace(x, **ch) if ch else x
+                if isinstance(x, tuple):
+                    return tuple(subst(i) for i in x)
+                return x
+
+            for cand in (e, subst(e)):
+                try:
+                    re_ = rewriter.rewrite(cand)
+                except AnalysisError:
+                    re_ = None
+                if re_ is not None:
+                    for i, oe in enumerate(out_exprs):
+                        if oe == re_:
+                            return i
+        # computed sort key over the OUTPUT columns (ORDER BY
+        # case when lochierarchy = 0 then i_category end)
+        try:
+            return self.analyze(e, rp.fields)
+        except AnalysisError:
+            pass
         raise AnalysisError(f"ORDER BY expression not in select list: {e}")
 
     # ======================================================== expressions

@@ -226,6 +226,11 @@ class Select:
     # distinct key list); None = plain GROUP BY (one implicit set).
     # Reference: sql/tree/GroupingSets.java + spi/plan GroupIdNode.
     grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
+    # Set operations chained onto this term (reference: sql/tree/Union/
+    # Intersect/Except): ((op, distinct, right_term), ...) applied left to
+    # right; order_by/limit on this Select then apply to the combined
+    # result (trailing ORDER BY binds to the whole set expression).
+    set_ops: Tuple[Tuple[str, bool, "Select"], ...] = ()
 
 
 # --------------------------------------------------------------------- DDL/DML

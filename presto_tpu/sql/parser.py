@@ -29,8 +29,8 @@ _KEYWORDS = {
     "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
     "date", "interval", "join", "inner", "left", "right", "outer", "cross",
     "on", "asc", "desc", "nulls", "first", "last", "distinct", "all",
-    "union", "year", "month", "day", "substring", "for", "count", "with",
-    "over", "partition", "full",
+    "union", "intersect", "except", "year", "month", "day", "substring",
+    "for", "count", "with", "over", "partition", "full",
 }
 
 
@@ -254,8 +254,61 @@ class Parser:
                 self.expect("op", ")")
                 if not self.accept("op", ","):
                     break
-        q = self._select_body()
+        q = self._set_op_expr()
         return dataclasses.replace(q, ctes=tuple(ctes)) if ctes else q
+
+    def _set_op_distinct(self) -> bool:
+        if self.accept_kw("all"):
+            return False
+        self.accept_kw("distinct")
+        return True
+
+    @staticmethod
+    def _attach_set_ops(q, set_ops):
+        """Chain terms, lifting the trailing ORDER BY / LIMIT off the
+        LAST term onto the whole set expression (SQL binds them to the
+        combined result; parenthesized terms keep their own)."""
+        if not set_ops:
+            return q
+        op, d, last = set_ops[-1]
+        order_by, limit = last.order_by, last.limit
+        set_ops[-1] = (op, d, dataclasses.replace(
+            last, order_by=(), limit=None))
+        return dataclasses.replace(
+            q, set_ops=q.set_ops + tuple(set_ops),
+            order_by=q.order_by or order_by,
+            limit=q.limit if q.limit is not None else limit)
+
+    def _intersect_chain(self) -> ast.Select:
+        # INTERSECT binds tighter than UNION/EXCEPT (SQL standard)
+        q = self._query_term()
+        set_ops = []
+        while self.peek().kind == "keyword" and \
+                self.peek().text == "intersect":
+            self.next()
+            set_ops.append(("intersect", self._set_op_distinct(),
+                            self._query_term()))
+        return self._attach_set_ops(q, set_ops)
+
+    def _set_op_expr(self) -> ast.Select:
+        q = self._intersect_chain()
+        set_ops = []
+        while self.peek().kind == "keyword" and \
+                self.peek().text in ("union", "except"):
+            op = self.next().text
+            set_ops.append((op, self._set_op_distinct(),
+                            self._intersect_chain()))
+        return self._attach_set_ops(q, set_ops)
+
+    def _query_term(self) -> ast.Select:
+        if self.peek().kind == "op" and self.peek().text == "(" and \
+                self.peek(1).kind == "keyword" and \
+                self.peek(1).text in ("select", "with"):
+            self.next()
+            q = self.query()
+            self.expect("op", ")")
+            return q
+        return self._select_body()
 
     def _select_body(self) -> ast.Select:
         self.expect_kw("select")

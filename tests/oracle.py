@@ -14,9 +14,14 @@ def table_df(conn, name: str) -> pd.DataFrame:
         arr = t.arrays[col][:t.num_rows]
         if col in t.dicts:
             words = np.asarray(t.dicts[col].words, dtype=object)
-            parts[col] = pd.Series(words[arr])
+            s = pd.Series(words[arr])
         else:
-            parts[col] = pd.Series(arr)
+            s = pd.Series(arr)
+        mask = t.null_mask(col)
+        if mask is not None and mask.any():
+            s = s.astype(object)
+            s[np.asarray(mask, dtype=bool)] = None
+        parts[col] = s
     return pd.DataFrame(parts)
 
 

@@ -10,14 +10,19 @@ from presto_tpu.connectors import TpcdsConnector
 from presto_tpu.exec import LocalEngine
 from tests.oracle import table_df
 from tests.test_tpch_full import _iso, to_sqlite
-from tests.tpcds_queries import Q22_SQLITE, Q27_SQLITE, QUERIES
+from tests.tpcds_queries import (
+    Q22_SQLITE, Q27_SQLITE, QUERIES, SQLITE_OVERRIDES,
+)
 
 SF = 0.002
 
 _TABLES = ["date_dim", "time_dim", "item", "store", "warehouse",
            "promotion", "customer", "customer_address",
            "customer_demographics", "household_demographics",
-           "store_sales", "catalog_sales", "web_sales", "inventory"]
+           "store_sales", "catalog_sales", "web_sales", "inventory",
+           "store_returns", "catalog_returns", "web_returns",
+           "reason", "ship_mode", "income_band", "web_page",
+           "web_site", "call_center", "catalog_page"]
 
 
 @pytest.fixture(scope="module")
@@ -60,7 +65,8 @@ def run_case(qnum, engine, oracle):
     types = engine.plan_sql(sql).output_types
     got = [tuple(_iso(v) if t.name == "date" and v is not None else v
                  for v, t in zip(row, types)) for row in got]
-    exp_sql = {22: Q22_SQLITE, 27: Q27_SQLITE}.get(qnum) or to_sqlite(sql)
+    exp_sql = ({22: Q22_SQLITE, 27: Q27_SQLITE, **SQLITE_OVERRIDES}
+               .get(qnum) or to_sqlite(sql))
     exp = oracle.execute(exp_sql).fetchall()
 
     key = lambda r: tuple((v is None, v) for v in r)   # noqa: E731

@@ -54,6 +54,11 @@ def to_sqlite(sql: str) -> str:
                      {"year": "Y", "month": "m", "day": "d"}[m.group(1)],
                      m.group(2)), sql)
     sql = re.sub(r"\bsubstring\s*\(", "substr(", sql)
+    # sqlite has no stddev: same decomposable-sums formula the engine uses
+    sql = re.sub(
+        r"stddev_samp\s*\(\s*([a-z0-9_.]+)\s*\)",
+        r"(case when count(\1) > 1 then sqrt((1.0*sum(\1*\1) - "
+        r"1.0*sum(\1)*sum(\1)/count(\1)) / (count(\1) - 1)) end)", sql)
 
     # Fold constant decimal arithmetic exactly (Presto types 0.06 + 0.01 as
     # DECIMAL = 0.07; sqlite's binary floats would exclude boundary rows).

@@ -362,7 +362,1106 @@ where ss_item_sk = i_item_sk
 group by i_item_id, i_item_desc, i_category, i_class, i_current_price
 order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 """,
+    13: """
+select avg(ss_quantity), avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+from store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00
+        and hd_dep_count = 1)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'W'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00
+        and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'NY')
+        and ss_net_profit between 100 and 200)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR', 'CA', 'KY')
+        and ss_net_profit between 150 and 300)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA', 'TX', 'MI')
+        and ss_net_profit between 50 and 250))
+""",
+    19: """
+select i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+  and ss_store_sk = s_store_sk
+group by i_brand, i_brand_id, i_manufact_id, i_manufact
+order by ext_price desc, i_brand, i_brand_id, i_manufact_id,
+         i_manufact
+limit 100
+""",
+    21: """
+select * from (
+  select w_warehouse_name, i_item_id,
+         sum(case when d_date < date '2000-03-11'
+             then inv_quantity_on_hand else 0 end) inv_before,
+         sum(case when d_date >= date '2000-03-11'
+             then inv_quantity_on_hand else 0 end) inv_after
+  from inventory, warehouse, item, date_dim
+  where i_current_price between 0.99 and 1.49
+    and i_item_sk = inv_item_sk
+    and inv_warehouse_sk = w_warehouse_sk
+    and inv_date_sk = d_date_sk
+    and d_date between date '2000-03-11' - interval '30' day
+                   and date '2000-03-11' + interval '30' day
+  group by w_warehouse_name, i_item_id) x
+where (case when inv_before > 0
+       then cast(inv_after as double) / inv_before else null end)
+      between 2.00 / 3.00 and 3.00 / 2.00
+order by w_warehouse_name, i_item_id
+limit 100
+""",
+    33: """
+with ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5 and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5 and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+ws as (
+  select i_manufact_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5 and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_manufact_id
+order by total_sales, i_manufact_id
+limit 100
+""",
+    38: """
+select count(*) from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    and store_sales.ss_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1211
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1211
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1211
+) hot_cust
+limit 100
+""",
+    44: """
+select asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from (select * from (
+        select item_sk, rank() over (order by rank_col) rnk
+        from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+              from store_sales
+              where ss_store_sk = 4
+              group by ss_item_sk
+              having avg(ss_net_profit) > 0.9 * (
+                select avg(ss_net_profit) rank_col
+                from store_sales
+                where ss_store_sk = 4 and ss_addr_sk is null
+                group by ss_store_sk)) v1) v11
+      where rnk < 11) asceding,
+     (select * from (
+        select item_sk, rank() over (order by rank_col desc) rnk
+        from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col
+              from store_sales
+              where ss_store_sk = 4
+              group by ss_item_sk
+              having avg(ss_net_profit) > 0.9 * (
+                select avg(ss_net_profit) rank_col
+                from store_sales
+                where ss_store_sk = 4 and ss_addr_sk is null
+                group by ss_store_sk)) v2) v21
+      where rnk < 11) descending,
+     item i1, item i2
+where asceding.rnk = descending.rnk
+  and i1.i_item_sk = asceding.item_sk
+  and i2.i_item_sk = descending.item_sk
+order by asceding.rnk
+limit 100
+""",
+    45: """
+select ca_zip, ca_city, sum(ws_sales_price)
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                '86475', '85392', '85460', '80348',
+                                '81792')
+    or i_item_id in (select i_item_id from item
+                     where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19,
+                                         23, 29)))
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+""",
+    56: """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched', 'burnished'))
+    and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2 and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched', 'burnished'))
+    and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2 and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched', 'burnished'))
+    and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2 and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_item_id
+order by total_sales, i_item_id
+limit 100
+""",
+    60: """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category in ('Music'))
+    and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9 and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category in ('Music'))
+    and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9 and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category in ('Music'))
+    and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9 and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+""",
+    87: """
+select count(*) from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    and store_sales.ss_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1211
+  except
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1211
+  except
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1211
+) cool_cust
+""",
+    46: """
+select c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_dow in (6, 0)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Fairview', 'Midway', 'Oak Grove',
+                             'Five Points', 'Centerville')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city,
+         ss_ticket_number
+limit 100
+""",
+    65: """
+select s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from store, item,
+     (select ss_store_sk, avg(revenue) ave
+      from (select ss_store_sk, ss_item_sk,
+                   sum(ss_sales_price) revenue
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk
+              and d_month_seq between 1176 and 1187
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 1176 and 1187
+      group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk
+  and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc
+limit 100
+""",
+    68: """
+select c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Midway', 'Centerville', 'Greenfield')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+""",
+    69: """
+select cd_gender, cd_marital_status, cd_education_status,
+       count(*) cnt1, cd_purchase_estimate, count(*) cnt2,
+       cd_credit_rating, count(*) cnt3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KY', 'GA', 'NM')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2001 and d_moy between 4 and 6)
+  and not exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+  and not exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+limit 100
+""",
+    71: """
+select i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+from item,
+     (select ws_ext_sales_price ext_price,
+             ws_sold_date_sk sold_date_sk, ws_item_sk sold_item_sk,
+             ws_sold_time_sk time_sk
+      from web_sales, date_dim
+      where d_date_sk = ws_sold_date_sk and d_moy = 11
+        and d_year = 1999
+      union all
+      select cs_ext_sales_price, cs_sold_date_sk, cs_item_sk,
+             cs_sold_time_sk
+      from catalog_sales, date_dim
+      where d_date_sk = cs_sold_date_sk and d_moy = 11
+        and d_year = 1999
+      union all
+      select ss_ext_sales_price, ss_sold_date_sk, ss_item_sk,
+             ss_sold_time_sk
+      from store_sales, date_dim
+      where d_date_sk = ss_sold_date_sk and d_moy = 11
+        and d_year = 1999) tmp,
+     time_dim
+where sold_item_sk = i_item_sk and i_manager_id = 1
+  and time_sk = t_time_sk
+  and (t_meal_time = 'breakfast' or t_meal_time = 'dinner')
+group by i_brand, i_brand_id, t_hour, t_minute
+order by ext_price desc, i_brand_id
+""",
+    76: """
+select channel, col_name, d_year, d_qoy, i_category,
+       count(*) sales_cnt, sum(ext_sales_price) sales_amt
+from (select 'store' channel, 'ss_store_sk' col_name, d_year, d_qoy,
+             i_category, ss_ext_sales_price ext_sales_price
+      from store_sales, item, date_dim
+      where ss_store_sk is null and ss_sold_date_sk = d_date_sk
+        and ss_item_sk = i_item_sk
+      union all
+      select 'web', 'ws_ship_customer_sk', d_year, d_qoy,
+             i_category, ws_ext_sales_price
+      from web_sales, item, date_dim
+      where ws_ship_customer_sk is null
+        and ws_sold_date_sk = d_date_sk and ws_item_sk = i_item_sk
+      union all
+      select 'catalog', 'cs_ship_addr_sk', d_year, d_qoy,
+             i_category, cs_ext_sales_price
+      from catalog_sales, item, date_dim
+      where cs_ship_addr_sk is null
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk) foo
+group by channel, col_name, d_year, d_qoy, i_category
+order by channel, col_name, d_year, d_qoy, i_category
+limit 100
+""",
+    82: """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 62 and 92
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2000-05-25'
+                 and date '2000-05-25' + interval '60' day
+  and i_manufact_id in (129, 270, 821, 423, 5, 8, 14, 17)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+""",
+    89: """
+select * from (
+  select i_category, i_class, i_brand, s_store_name, s_company_name,
+         d_moy, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_category,
+             i_brand, s_store_name, s_company_name) avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk and d_year in (1999)
+    and ((i_category in ('Books', 'Electronics', 'Sports')
+          and i_class in ('Books class 1', 'Electronics class 4',
+                          'Sports class 7'))
+      or (i_category in ('Men', 'Jewelry', 'Women')
+          and i_class in ('Men class 2', 'Jewelry class 5',
+                          'Women class 3')))
+  group by i_category, i_class, i_brand, s_store_name,
+           s_company_name, d_moy) tmp1
+where (case when avg_monthly_sales <> 0
+       then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+       else null end) > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name
+limit 100
+""",
+    92: """
+select sum(ws_ext_discount_amt) excess_discount_amount
+from web_sales, item, date_dim
+where i_manufact_id = 5
+  and i_item_sk = ws_item_sk
+  and d_date between date '2000-01-27'
+                 and date '2000-01-27' + interval '90' day
+  and d_date_sk = ws_sold_date_sk
+  and ws_ext_discount_amt > (
+    select 1.3 * avg(ws_ext_discount_amt)
+    from web_sales, date_dim
+    where ws_item_sk = i_item_sk
+      and d_date between date '2000-01-27'
+                     and date '2000-01-27' + interval '90' day
+      and d_date_sk = ws_sold_date_sk)
+order by sum(ws_ext_discount_amt)
+limit 100
+""",
+    97: """
+with ssci as (
+  select ss_customer_sk customer_sk, ss_item_sk item_sk
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by ss_customer_sk, ss_item_sk),
+csci as (
+  select cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is null then 1 else 0 end)
+         store_only,
+       sum(case when ssci.customer_sk is null
+                 and csci.customer_sk is not null then 1 else 0 end)
+         catalog_only,
+       sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is not null then 1 else 0 end)
+         store_and_catalog
+from ssci full join csci
+  on ssci.customer_sk = csci.customer_sk
+ and ssci.item_sk = csci.item_sk
+limit 100
+""",
+    28: """
+select * from
+ (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+         count(distinct ss_list_price) b1_cntd
+  from store_sales
+  where ss_quantity between 0 and 5
+    and (ss_list_price between 8 and 18
+      or ss_coupon_amt between 459 and 1459
+      or ss_wholesale_cost between 57 and 77)) b1,
+ (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+         count(distinct ss_list_price) b2_cntd
+  from store_sales
+  where ss_quantity between 6 and 10
+    and (ss_list_price between 90 and 100
+      or ss_coupon_amt between 2323 and 3323
+      or ss_wholesale_cost between 31 and 51)) b2,
+ (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+         count(distinct ss_list_price) b3_cntd
+  from store_sales
+  where ss_quantity between 11 and 15
+    and (ss_list_price between 142 and 152
+      or ss_coupon_amt between 12214 and 13214
+      or ss_wholesale_cost between 79 and 99)) b3,
+ (select avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+         count(distinct ss_list_price) b4_cntd
+  from store_sales
+  where ss_quantity between 16 and 20
+    and (ss_list_price between 135 and 145
+      or ss_coupon_amt between 6071 and 7071
+      or ss_wholesale_cost between 38 and 58)) b4,
+ (select avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+         count(distinct ss_list_price) b5_cntd
+  from store_sales
+  where ss_quantity between 21 and 25
+    and (ss_list_price between 122 and 132
+      or ss_coupon_amt between 836 and 1836
+      or ss_wholesale_cost between 17 and 37)) b5,
+ (select avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+         count(distinct ss_list_price) b6_cntd
+  from store_sales
+  where ss_quantity between 26 and 30
+    and (ss_list_price between 154 and 164
+      or ss_coupon_amt between 7326 and 8326
+      or ss_wholesale_cost between 7 and 27)) b6
+limit 100
+""",
+    32: """
+select sum(cs_ext_discount_amt) excess_discount_amount
+from catalog_sales, item, date_dim
+where i_manufact_id = 8
+  and i_item_sk = cs_item_sk
+  and d_date between date '2000-01-27'
+                 and date '2000-01-27' + interval '90' day
+  and d_date_sk = cs_sold_date_sk
+  and cs_ext_discount_amt > (
+    select 1.3 * avg(cs_ext_discount_amt)
+    from catalog_sales, date_dim
+    where cs_item_sk = i_item_sk
+      and d_date between date '2000-01-27'
+                     and date '2000-01-27' + interval '90' day
+      and d_date_sk = cs_sold_date_sk)
+limit 100
+""",
+    41: """
+select distinct i_product_name
+from item i1
+where i_manufact_id between 5 and 45
+  and (select count(*) item_cnt
+       from item
+       where (i_manufact = i1.i_manufact
+              and ((i_category = 'Women'
+                    and (i_color = 'powder' or i_color = 'navy')
+                    and (i_units = 'Ounce' or i_units = 'Oz')
+                    and (i_size = 'medium' or i_size = 'extra large'))
+                or (i_category = 'Women'
+                    and (i_color = 'beige' or i_color = 'slate')
+                    and (i_units = 'Bunch' or i_units = 'Ton')
+                    and (i_size = 'N/A' or i_size = 'small'))
+                or (i_category = 'Men'
+                    and (i_color = 'frosted' or i_color = 'dodger')
+                    and (i_units = 'N/A' or i_units = 'Dozen')
+                    and (i_size = 'petite' or i_size = 'large'))
+                or (i_category = 'Men'
+                    and (i_color = 'chiffon' or i_color = 'rose')
+                    and (i_units = 'Box' or i_units = 'Pound')
+                    and (i_size = 'medium' or i_size = 'extra large'))))
+          or (i_manufact = i1.i_manufact
+              and ((i_category = 'Women'
+                    and (i_color = 'misty' or i_color = 'smoke')
+                    and (i_units = 'Pallet' or i_units = 'Gross')
+                    and (i_size = 'medium' or i_size = 'extra large'))
+                or (i_category = 'Women'
+                    and (i_color = 'peach' or i_color = 'saddle')
+                    and (i_units = 'Cup' or i_units = 'Dram')
+                    and (i_size = 'N/A' or i_size = 'small'))
+                or (i_category = 'Men'
+                    and (i_color = 'aquamarine' or i_color = 'salmon')
+                    and (i_units = 'Each' or i_units = 'Tbl')
+                    and (i_size = 'petite' or i_size = 'large'))
+                or (i_category = 'Men'
+                    and (i_color = 'metallic' or i_color = 'powder')
+                    and (i_units = 'Lb' or i_units = 'Bundle')
+                    and (i_size = 'medium' or i_size = 'extra large'))))
+      ) > 0
+order by i_product_name
+limit 100
+""",
+    53: """
+select * from (
+  select i_manufact_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manufact_id)
+           avg_quarterly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205, 1206,
+                        1207, 1208, 1209, 1210, 1211)
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('Books class 1', 'Children class 2',
+                          'Electronics class 3', 'Books class 4'))
+      or (i_category in ('Women', 'Music', 'Men')
+          and i_class in ('Women class 1', 'Music class 2',
+                          'Men class 3', 'Women class 4')))
+  group by i_manufact_id, d_qoy) tmp1
+where (case when avg_quarterly_sales > 0
+       then abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+       else null end) > 0.1
+order by avg_quarterly_sales, sum_sales, i_manufact_id
+limit 100
+""",
+    63: """
+select * from (
+  select i_manager_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manager_id)
+           avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205, 1206,
+                        1207, 1208, 1209, 1210, 1211)
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('Books class 1', 'Children class 2',
+                          'Electronics class 3', 'Books class 4'))
+      or (i_category in ('Women', 'Music', 'Men')
+          and i_class in ('Women class 1', 'Music class 2',
+                          'Men class 3', 'Women class 4')))
+  group by i_manager_id, d_moy) tmp1
+where (case when avg_monthly_sales > 0
+       then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+       else null end) > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+""",
+    10: """
+select cd_gender, cd_marital_status, cd_education_status,
+       count(*) cnt1, cd_purchase_estimate, count(*) cnt2,
+       cd_credit_rating, count(*) cnt3, cd_dep_count, count(*) cnt4,
+       cd_dep_employed_count, count(*) cnt5, cd_dep_college_count,
+       count(*) cnt6
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county in ('Ziebach County', 'Walker County',
+                    'Daviess County', 'Barrow County',
+                    'Fairfield County')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_moy between 1 and 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_moy between 1 and 4)
+    or exists (select * from catalog_sales, date_dim
+               where c.c_customer_sk = cs_ship_customer_sk
+                 and cs_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_moy between 1 and 4))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+""",
+    31: """
+with ss as (
+  select ca_county, d_qoy, d_year,
+         sum(ss_ext_sales_price) store_sales
+  from store_sales, date_dim, customer_address
+  where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year),
+ws as (
+  select ca_county, d_qoy, d_year, sum(ws_ext_sales_price) web_sales
+  from web_sales, date_dim, customer_address
+  where ws_sold_date_sk = d_date_sk and ws_bill_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year)
+select ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase,
+       ws3.web_sales / ws2.web_sales web_q2_q3_increase,
+       ss3.store_sales / ss2.store_sales store_q2_q3_increase
+from ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+where ss1.d_qoy = 1 and ss1.d_year = 2000
+  and ss1.ca_county = ss2.ca_county
+  and ss2.d_qoy = 2 and ss2.d_year = 2000
+  and ss2.ca_county = ss3.ca_county
+  and ss3.d_qoy = 3 and ss3.d_year = 2000
+  and ss1.ca_county = ws1.ca_county
+  and ws1.d_qoy = 1 and ws1.d_year = 2000
+  and ws1.ca_county = ws2.ca_county
+  and ws2.d_qoy = 2 and ws2.d_year = 2000
+  and ws1.ca_county = ws3.ca_county
+  and ws3.d_qoy = 3 and ws3.d_year = 2000
+  and (case when ws1.web_sales > 0
+       then ws2.web_sales / ws1.web_sales else null end)
+    > (case when ss1.store_sales > 0
+       then ss2.store_sales / ss1.store_sales else null end)
+  and (case when ws2.web_sales > 0
+       then ws3.web_sales / ws2.web_sales else null end)
+    > (case when ss2.store_sales > 0
+       then ss3.store_sales / ss2.store_sales else null end)
+order by ss1.ca_county
+""",
+    35: """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) cnt1, min(cd_dep_count), max(cd_dep_count),
+       avg(cd_dep_count), cd_dep_employed_count, count(*) cnt2,
+       min(cd_dep_employed_count), max(cd_dep_employed_count),
+       avg(cd_dep_employed_count), cd_dep_college_count, count(*) cnt3,
+       min(cd_dep_college_count), max(cd_dep_college_count),
+       avg(cd_dep_college_count)
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_qoy < 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_qoy < 4)
+    or exists (select * from catalog_sales, date_dim
+               where c.c_customer_sk = cs_ship_customer_sk
+                 and cs_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+""",
+    59: """
+with wss as (
+  select d_week_seq, ss_store_sk,
+         sum(case when d_day_name = 'Sunday'
+             then ss_sales_price else null end) sun_sales,
+         sum(case when d_day_name = 'Monday'
+             then ss_sales_price else null end) mon_sales,
+         sum(case when d_day_name = 'Tuesday'
+             then ss_sales_price else null end) tue_sales,
+         sum(case when d_day_name = 'Wednesday'
+             then ss_sales_price else null end) wed_sales,
+         sum(case when d_day_name = 'Thursday'
+             then ss_sales_price else null end) thu_sales,
+         sum(case when d_day_name = 'Friday'
+             then ss_sales_price else null end) fri_sales,
+         sum(case when d_day_name = 'Saturday'
+             then ss_sales_price else null end) sat_sales
+  from store_sales, date_dim
+  where d_date_sk = ss_sold_date_sk
+  group by d_week_seq, ss_store_sk)
+select s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2, mon_sales1 / mon_sales2,
+       tue_sales1 / tue_sales2, wed_sales1 / wed_sales2,
+       thu_sales1 / thu_sales2, fri_sales1 / fri_sales2,
+       sat_sales1 / sat_sales2
+from (select s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             s_store_id s_store_id1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+        and d_month_seq between 1200 and 1211) y,
+     (select s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             s_store_id s_store_id2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      from wss, store, date_dim d
+      where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+        and d_month_seq between 1212 and 1223) x
+where s_store_id1 = s_store_id2
+  and d_week_seq1 = d_week_seq2 - 52
+order by s_store_name1, s_store_id1, d_week_seq1
+limit 100
+""",
+    74: """
+with year_total as (
+  select c_customer_id customer_id,
+         c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         d_year year_n, sum(ss_net_paid) year_total, 's' sale_type
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk and d_year in (2001, 2002)
+  group by c_customer_id, c_first_name, c_last_name, d_year
+  union all
+  select c_customer_id, c_first_name, c_last_name,
+         d_year, sum(ws_net_paid), 'w'
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk and d_year in (2001, 2002)
+  group by c_customer_id, c_first_name, c_last_name, d_year)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 's'
+  and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's'
+  and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.year_n = 2001
+  and t_s_secyear.year_n = 2002
+  and t_w_firstyear.year_n = 2001
+  and t_w_secyear.year_n = 2002
+  and t_s_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and (case when t_w_firstyear.year_total > 0
+       then t_w_secyear.year_total / t_w_firstyear.year_total
+       else null end)
+    > (case when t_s_firstyear.year_total > 0
+       then t_s_secyear.year_total / t_s_firstyear.year_total
+       else null end)
+order by 1, 2, 3
+limit 100
+""",
+    1: """
+with customer_total_return as (
+  select sr_customer_sk ctr_customer_sk, sr_store_sk ctr_store_sk,
+         sum(sr_return_amt) ctr_total_return
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk and d_year = 2000
+  group by sr_customer_sk, sr_store_sk)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return > (
+        select avg(ctr_total_return) * 1.2
+        from customer_total_return ctr2
+        where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk
+  and s_state = 'TN'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+""",
+    16: """
+select count(distinct cs_order_number) order_count,
+       sum(cs_ext_ship_cost) total_shipping_cost,
+       sum(cs_net_profit) total_net_profit
+from catalog_sales cs1, date_dim, customer_address, call_center
+where d_date between date '2002-02-01'
+                 and date '2002-02-01' + interval '60' day
+  and cs1.cs_ship_date_sk = d_date_sk
+  and cs1.cs_ship_addr_sk = ca_address_sk
+  and ca_state = 'GA'
+  and cs1.cs_call_center_sk = cc_call_center_sk
+  and cc_county in ('Ziebach County', 'Walker County',
+                    'Daviess County', 'Barrow County',
+                    'Fairfield County')
+  and exists (select * from catalog_sales cs2
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  and not exists (select * from catalog_returns cr1
+                  where cs1.cs_order_number = cr1.cr_order_number)
+order by count(distinct cs_order_number)
+limit 100
+""",
+    17: """
+select i_item_id, i_item_desc, s_state,
+       count(ss_quantity) store_sales_quantitycount,
+       avg(ss_quantity) store_sales_quantityave,
+       stddev_samp(ss_quantity) store_sales_quantitystdev,
+       stddev_samp(ss_quantity) / avg(ss_quantity)
+         store_sales_quantitycov,
+       count(sr_return_quantity) store_returns_quantitycount,
+       avg(sr_return_quantity) store_returns_quantityave,
+       stddev_samp(sr_return_quantity) store_returns_quantitystdev,
+       stddev_samp(sr_return_quantity) / avg(sr_return_quantity)
+         store_returns_quantitycov,
+       count(cs_quantity) catalog_sales_quantitycount,
+       avg(cs_quantity) catalog_sales_quantityave,
+       stddev_samp(cs_quantity) catalog_sales_quantitystdev,
+       stddev_samp(cs_quantity) / avg(cs_quantity)
+         catalog_sales_quantitycov
+from store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+where d1.d_quarter_name = '2001Q1'
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_quarter_name in ('2001Q1', '2001Q2', '2001Q3')
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_quarter_name in ('2001Q1', '2001Q2', '2001Q3')
+group by i_item_id, i_item_desc, s_state
+order by i_item_id, i_item_desc, s_state
+limit 100
+""",
+    25: """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) store_sales_profit,
+       sum(sr_net_loss) store_returns_loss,
+       sum(cs_net_profit) catalog_sales_profit
+from store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10 and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10 and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+""",
+    30: """
+with customer_total_return as (
+  select wr_returning_customer_sk ctr_customer_sk,
+         ca_state ctr_state, sum(wr_return_amt) ctr_total_return
+  from web_returns, date_dim, customer_address
+  where wr_returned_date_sk = d_date_sk and d_year = 2002
+    and wr_returning_addr_sk = ca_address_sk
+  group by wr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month,
+       c_birth_year, c_birth_country, c_login, c_email_address,
+       c_last_review_date_sk, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return > (
+        select avg(ctr_total_return) * 1.2
+        from customer_total_return ctr2
+        where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'GA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_day, c_birth_month,
+         c_birth_year, c_birth_country, c_login, c_email_address,
+         c_last_review_date_sk, ctr_total_return
+limit 100
+""",
+    62: """
+select substr(w_warehouse_name, 1, 20) wname, sm_type, web_name,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30
+           then 1 else 0 end) d30,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+             and ws_ship_date_sk - ws_sold_date_sk <= 60
+           then 1 else 0 end) d60,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+             and ws_ship_date_sk - ws_sold_date_sk <= 90
+           then 1 else 0 end) d90,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 90
+             and ws_ship_date_sk - ws_sold_date_sk <= 120
+           then 1 else 0 end) d120,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 120
+           then 1 else 0 end) dmore
+from web_sales, warehouse, ship_mode, web_site, date_dim
+where d_month_seq between 1200 and 1211
+  and ws_ship_date_sk = d_date_sk
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by substr(w_warehouse_name, 1, 20), sm_type, web_name
+order by substr(w_warehouse_name, 1, 20), sm_type, web_name
+limit 100
+""",
+    90: """
+select cast(amc as double) / cast(pmc as double) am_pm_ratio
+from (select count(*) amc
+      from web_sales, household_demographics, time_dim, web_page
+      where ws_sold_time_sk = time_dim.t_time_sk
+        and ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        and ws_web_page_sk = web_page.wp_web_page_sk
+        and time_dim.t_hour between 8 and 9
+        and household_demographics.hd_dep_count = 6
+        and web_page.wp_char_count between 5000 and 5200) at_t,
+     (select count(*) pmc
+      from web_sales, household_demographics, time_dim, web_page
+      where ws_sold_time_sk = time_dim.t_time_sk
+        and ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        and ws_web_page_sk = web_page.wp_web_page_sk
+        and time_dim.t_hour between 19 and 20
+        and household_demographics.hd_dep_count = 6
+        and web_page.wp_char_count between 5000 and 5200) pt
+order by am_pm_ratio
+limit 100
+""",
+    93: """
+select ss_customer_sk, sum(act_sales) sumsales
+from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity)
+                       * ss_sales_price
+                  else ss_quantity * ss_sales_price end act_sales
+      from store_sales left join store_returns
+        on sr_item_sk = ss_item_sk
+       and sr_ticket_number = ss_ticket_number,
+           reason
+      where sr_reason_sk = r_reason_sk
+        and r_reason_desc = 'reason 28') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+""",
+    94: """
+select count(distinct ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+from web_sales ws1, date_dim, customer_address, web_site
+where d_date between date '1999-02-01'
+                 and date '1999-02-01' + interval '60' day
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk
+  and ca_state = 'IL'
+  and ws1.ws_web_site_sk = web_site_sk
+  and web_company_name = 'pri'
+  and exists (select * from web_sales ws2
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  and not exists (select * from web_returns wr1
+                  where ws1.ws_order_number = wr1.wr_order_number)
+order by count(distinct ws_order_number)
+limit 100
+""",
+    99: """
+select substr(w_warehouse_name, 1, 20) wname, sm_type, cc_name,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30
+           then 1 else 0 end) d30,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+             and cs_ship_date_sk - cs_sold_date_sk <= 60
+           then 1 else 0 end) d60,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+             and cs_ship_date_sk - cs_sold_date_sk <= 90
+           then 1 else 0 end) d90,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 90
+             and cs_ship_date_sk - cs_sold_date_sk <= 120
+           then 1 else 0 end) d120,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 120
+           then 1 else 0 end) dmore
+from catalog_sales, warehouse, ship_mode, call_center, date_dim
+where d_month_seq between 1200 and 1211
+  and cs_ship_date_sk = d_date_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by substr(w_warehouse_name, 1, 20), sm_type, cc_name
+order by substr(w_warehouse_name, 1, 20), sm_type, cc_name
+limit 100
+""",
 }
+
+def _rollup_union(keys, aggs, body):
+    """sqlite has no ROLLUP: spell it as a UNION ALL of the grouping
+    levels. keys/aggs are select-list fragments; each level nulls out the
+    rolled-up tail keys and reports _loch = #nulled keys (GROUPING sum)."""
+    branches = []
+    for lvl in range(len(keys), -1, -1):
+        sel = (list(keys[:lvl])
+               + [f"null as {k}" for k in keys[lvl:]]
+               + [f"{len(keys) - lvl} as _loch"] + list(aggs))
+        gb = f" group by {', '.join(keys[:lvl])}" if lvl else ""
+        branches.append(f"select {', '.join(sel)} {body}{gb}")
+    return " union all ".join(branches)
+
 
 # q27's ROLLUP spelled as explicit union-all sets for the sqlite oracle
 _Q27_BODY = """
@@ -425,3 +1524,196 @@ from inventory, date_dim, item
 where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
   and d_month_seq between 1176 and 1187
 """
+
+
+# ---- round-3 ROLLUP queries + hand sqlite oracles (no ROLLUP/GROUPING
+# in sqlite; rank() windows exist there, so only the grouping levels are
+# spelled as unions) --------------------------------------------------------
+
+QUERIES[18] = """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cast(cs_quantity as double)) agg1,
+       avg(cast(cs_list_price as double)) agg2,
+       avg(cast(cs_coupon_amt as double)) agg3,
+       avg(cast(cs_sales_price as double)) agg4,
+       avg(cast(cs_net_profit as double)) agg5,
+       avg(cast(c_birth_year as double)) agg6,
+       avg(cast(cd1.cd_dep_count as double)) agg7
+from catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address,
+     date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd1.cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd1.cd_gender = 'F' and cd1.cd_education_status = 'Unknown'
+  and c_current_cdemo_sk = cd2.cd_demo_sk
+  and c_current_addr_sk = ca_address_sk
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+  and ca_state in ('MI', 'IN', 'CA', 'OK', 'NY', 'VA')
+group by rollup (i_item_id, ca_country, ca_state, ca_county)
+order by ca_country, ca_state, ca_county, i_item_id
+limit 100
+"""
+
+_Q18_BODY = """
+from catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address,
+     date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd1.cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd1.cd_gender = 'F' and cd1.cd_education_status = 'Unknown'
+  and c_current_cdemo_sk = cd2.cd_demo_sk
+  and c_current_addr_sk = ca_address_sk
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+  and ca_state in ('MI', 'IN', 'CA', 'OK', 'NY', 'VA')
+"""
+_Q18_AGGS = ["avg(1.0*cs_quantity) agg1", "avg(1.0*cs_list_price) agg2",
+             "avg(1.0*cs_coupon_amt) agg3", "avg(1.0*cs_sales_price) agg4",
+             "avg(1.0*cs_net_profit) agg5", "avg(1.0*c_birth_year) agg6",
+             "avg(1.0*cd1.cd_dep_count) agg7"]
+Q18_SQLITE = (
+    "select i_item_id, ca_country, ca_state, ca_county, agg1, agg2, "
+    "agg3, agg4, agg5, agg6, agg7 from ("
+    + _rollup_union(["i_item_id", "ca_country", "ca_state", "ca_county"],
+                    _Q18_AGGS, _Q18_BODY)
+    + ") order by ca_country nulls last, ca_state nulls last, "
+      "ca_county nulls last, i_item_id nulls last limit 100")
+
+QUERIES[36] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() over (partition by grouping(i_category)
+                      + grouping(i_class),
+                    case when grouping(i_class) = 0
+                         then i_category end
+                    order by sum(ss_net_profit)
+                             / sum(ss_ext_sales_price))
+         rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state in ('TN', 'TX', 'CA', 'NY', 'OH', 'GA', 'FL', 'IL')
+group by rollup (i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+
+_Q36_BODY = """
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state in ('TN', 'TX', 'CA', 'NY', 'OH', 'GA', 'FL', 'IL')
+"""
+Q36_SQLITE = (
+    "select gross_margin, i_category, i_class, _loch lochierarchy, "
+    "rank() over (partition by _loch, case when _loch = 0 then "
+    "i_category end order by gross_margin) rank_within_parent from ("
+    + _rollup_union(
+        ["i_category", "i_class"],
+        ["sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin"],
+        _Q36_BODY)
+    + ") order by lochierarchy desc, case when lochierarchy = 0 then "
+      "i_category end nulls last, rank_within_parent limit 100")
+
+QUERIES[70] = """
+select sum(ss_net_profit) total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) lochierarchy,
+       rank() over (partition by grouping(s_state)
+                      + grouping(s_county),
+                    case when grouping(s_county) = 0
+                         then s_state end
+                    order by sum(ss_net_profit) desc)
+         rank_within_parent
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_state in (select s_state
+                  from (select s_state s_state,
+                               rank() over (partition by s_state
+                                            order by sum(ss_net_profit)
+                                            desc) ranking
+                        from store_sales, store, date_dim
+                        where d_month_seq between 1200 and 1211
+                          and d_date_sk = ss_sold_date_sk
+                          and s_store_sk = ss_store_sk
+                        group by s_state) tmp1
+                  where ranking <= 5)
+group by rollup (s_state, s_county)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent, s_state, s_county
+limit 100
+"""
+
+_Q70_SUB = """
+(select s_state from (
+   select s_state s_state, rank() over (partition by s_state
+     order by sum(ss_net_profit) desc) ranking
+   from store_sales, store, date_dim
+   where d_month_seq between 1200 and 1211
+     and d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+   group by s_state) where ranking <= 5)
+"""
+_Q70_BODY = f"""
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_state in {_Q70_SUB}
+"""
+Q70_SQLITE = (
+    "select total_sum, s_state, s_county, _loch lochierarchy, "
+    "rank() over (partition by _loch, case when _loch = 0 then s_state "
+    "end order by total_sum desc) rank_within_parent from ("
+    + _rollup_union(["s_state", "s_county"],
+                    ["sum(ss_net_profit) total_sum"], _Q70_BODY)
+    + ") order by lochierarchy desc, case when lochierarchy = 0 then "
+      "s_state end nulls last, rank_within_parent, "
+      "s_state nulls last, s_county nulls last limit 100")
+
+QUERIES[86] = """
+select sum(ws_net_paid) total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() over (partition by grouping(i_category)
+                      + grouping(i_class),
+                    case when grouping(i_class) = 0
+                         then i_category end
+                    order by sum(ws_net_paid) desc) rank_within_parent
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ws_sold_date_sk and i_item_sk = ws_item_sk
+group by rollup (i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+
+_Q86_BODY = """
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1200 and 1211
+  and d1.d_date_sk = ws_sold_date_sk and i_item_sk = ws_item_sk
+"""
+Q86_SQLITE = (
+    "select total_sum, i_category, i_class, _loch lochierarchy, "
+    "rank() over (partition by _loch, case when _loch = 0 then "
+    "i_category end order by total_sum desc) rank_within_parent from ("
+    + _rollup_union(["i_category", "i_class"],
+                    ["sum(ws_net_paid) total_sum"], _Q86_BODY)
+    + ") order by lochierarchy desc, case when lochierarchy = 0 then "
+      "i_category end nulls last, rank_within_parent limit 100")
+
+#: qnum -> hand sqlite oracle (ROLLUP/GROUPING spelled as unions)
+SQLITE_OVERRIDES = {18: Q18_SQLITE, 36: Q36_SQLITE,
+                    70: Q70_SQLITE, 86: Q86_SQLITE}
