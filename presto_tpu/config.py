@@ -79,6 +79,10 @@ PROPERTIES = [
              "match the build side (reference: "
              "enable_dynamic_filtering / DynamicFilterSourceOperator)",
              _parse_bool, True),
+    Property("exchange_compression_codec",
+             "Compress exchange pages: none | zlib (reference: "
+             "exchange_compression_codec, PagesSerdeFactory + "
+             "CompressionCodec.java:16)", str.strip, "none"),
 ]
 
 _BY_NAME = {p.name: p for p in PROPERTIES}

@@ -26,8 +26,12 @@ class MemoryConnector(SplitSource):
     NAME = "memory"
 
     def __init__(self, fallback=None):
+        import threading
         self.fallback = fallback
         self.tables: Dict[str, HostTable] = {}
+        # concurrent TableWriter tasks append in parallel (reference:
+        # MemoryPagesStore synchronization)
+        self._write_lock = threading.Lock()
 
     def connector_id(self, table: str = None) -> str:
         if table is not None and table not in self.tables \
@@ -100,6 +104,10 @@ class MemoryConnector(SplitSource):
         """Append python rows (strings decoded, decimals as python
         floats — the engine's to_pylist() shape). Reference role:
         ConnectorPageSink.appendPage (MemoryPagesStore.add)."""
+        with self._write_lock:
+            return self._append_rows_locked(name, rows)
+
+    def _append_rows_locked(self, name: str, rows: List[tuple]) -> int:
         t = self.tables[name]
         cols = t.column_names()
         n_new = len(rows)

@@ -175,6 +175,103 @@ class Column:
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
+class Decimal128Column:
+    """DECIMAL(p>18) aggregate results: exact value = (hi << 32) + lo,
+    recombined with python big ints on the host. `hi` accumulates the
+    signed high limbs (x >> 32) and `lo` the unsigned low limbs
+    (x & 0xFFFFFFFF) — both plain int64 segment sums, so a 6e8-row SF100
+    sum that would overflow a scaled int64 stays exact (reference:
+    presto-common/.../type/UnscaledDecimal128Arithmetic.java, re-expressed
+    as limb lanes because the TPU X64 pass lowers no 128-bit ops).
+    With `count` set the logical value is the AVERAGE: exact_sum / count
+    rounded HALF_UP to the type's scale (Presto avg(decimal))."""
+    hi: jnp.ndarray              # [capacity] int64 (signed high limbs)
+    lo: jnp.ndarray              # [capacity] int64 (unsigned low limbs)
+    nulls: jnp.ndarray           # [capacity] bool
+    type: Type                   # aux: DecimalType(p>18, s)
+    count: Optional[jnp.ndarray] = None   # avg denominator
+
+    def tree_flatten(self):
+        if self.count is None:
+            return (self.hi, self.lo, self.nulls), (self.type, False)
+        return ((self.hi, self.lo, self.nulls, self.count),
+                (self.type, True))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        t, has_count = aux
+        if has_count:
+            hi, lo, nulls, count = leaves
+            return cls(hi, lo, nulls, t, count)
+        hi, lo, nulls = leaves
+        return cls(hi, lo, nulls, t, None)
+
+    @property
+    def capacity(self) -> int:
+        return self.hi.shape[0]
+
+    @property
+    def dictionary(self):
+        return None
+
+    # -- generic row-lane protocol (compact/sort payload) -----------------
+    def row_lanes(self):
+        lanes = [self.hi, self.lo, self.nulls]
+        if self.count is not None:
+            lanes.append(self.count)
+        return lanes
+
+    def from_lanes(self, lanes):
+        if self.count is not None:
+            return Decimal128Column(lanes[0], lanes[1], lanes[2],
+                                    self.type, lanes[3])
+        return Decimal128Column(lanes[0], lanes[1], lanes[2], self.type)
+
+    def gather(self, idx: jnp.ndarray, valid=None) -> "Decimal128Column":
+        lanes = [jnp.take(x, idx, mode="clip") for x in self.row_lanes()]
+        if valid is not None:
+            lanes[0] = jnp.where(valid, lanes[0], 0)
+            lanes[1] = jnp.where(valid, lanes[1], 0)
+            lanes[2] = jnp.where(valid, lanes[2], True)
+        return self.from_lanes(lanes)
+
+    def to_numpy(self, num_rows: Optional[int] = None):
+        """(approximate float values, nulls) — ordering/debug only; exact
+        values come from value_at."""
+        v = (np.asarray(self.hi, dtype=np.float64) * float(1 << 32)
+             + np.asarray(self.lo, dtype=np.float64))
+        n = np.asarray(self.nulls)
+        if num_rows is not None:
+            v, n = v[:num_rows], n[:num_rows]
+        return v, n
+
+    def value_at(self, i: int):
+        """Exact python value of row i (scaled down per the type)."""
+        if bool(np.asarray(self.nulls)[i]):
+            return None
+        unscaled = ((int(np.asarray(self.hi)[i]) << 32)
+                    + int(np.asarray(self.lo)[i]))
+        scale = self.type.scale
+        if self.count is not None:
+            n = int(np.asarray(self.count)[i])
+            if n == 0:
+                return None
+            # avg = sum/n rounded HALF_UP at the result scale
+            num = unscaled
+            sign = -1 if (num < 0) != (n < 0) else 1
+            num, n = abs(num), abs(n)
+            q, r = divmod(num, n)
+            if 2 * r >= n:
+                q += 1
+            unscaled = sign * q
+        if scale == 0:
+            return unscaled
+        from decimal import Decimal
+        return Decimal(unscaled).scaleb(-scale)   # exact, not float
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
 class NestedColumn:
     """ARRAY/MAP/ROW column: per-row (start, length) slices into flat
     child columns (reference: presto-common ArrayBlock/MapBlock/RowBlock
@@ -386,7 +483,7 @@ class Page:
         for i in range(n):
             row = []
             for c, v, nl in cols:
-                if isinstance(c, NestedColumn):
+                if isinstance(c, (NestedColumn, Decimal128Column)):
                     row.append(c.value_at(i))
                 elif nl[i]:
                     row.append(None)
@@ -445,6 +542,23 @@ def concat_pages_host(pages: Sequence[Page],
     cols: List[Column] = []
     for ci, c0 in enumerate(first.columns):
         vals_parts, null_parts = [], []
+        if isinstance(c0, Decimal128Column):
+            lanes_parts = [[] for _ in c0.row_lanes()]
+            for p in pages:
+                c = p.columns[ci]
+                n_p = int(p.num_rows)
+                for li, lane in enumerate(c.row_lanes()):
+                    lanes_parts[li].append(np.asarray(lane)[:n_p])
+            lanes = []
+            for li, parts in enumerate(lanes_parts):
+                a = np.concatenate(parts) if parts else \
+                    np.zeros(0, np.int64)
+                pad = cap - len(a)
+                fill = True if li == 2 else 0
+                lanes.append(jnp.asarray(
+                    np.pad(a, (0, pad), constant_values=fill)))
+            cols.append(c0.from_lanes(lanes))
+            continue
         if isinstance(c0, NestedColumn):
             # host re-materialization through python values (exchange
             # volumes of nested data are modest until nested compute
@@ -489,6 +603,16 @@ def select_page_host(page: Page, idx: np.ndarray) -> Page:
     cap = bucket_capacity(max(n, 1))
     cols = []
     for c in page.columns:
+        if isinstance(c, Decimal128Column):
+            pad = cap - n
+            lanes = []
+            for li, lane in enumerate(c.row_lanes()):
+                a = np.asarray(lane)[idx]
+                fill = True if li == 2 else 0
+                lanes.append(jnp.asarray(
+                    np.pad(a, (0, pad), constant_values=fill)))
+            cols.append(c.from_lanes(lanes))
+            continue
         if isinstance(c, NestedColumn):
             starts = np.asarray(c.starts)[idx]
             lengths = np.asarray(c.lengths)[idx]
@@ -540,6 +664,8 @@ def compact(page: Page, keep: jnp.ndarray) -> Page:
             # row-wise lanes only; child buffers hold still (starts are
             # absolute positions)
             operands += (c.starts, c.lengths, c.nulls)
+        elif isinstance(c, Decimal128Column):
+            operands += tuple(c.row_lanes())
         else:
             operands += (c.values, c.nulls)
     sorted_ops = jax.lax.sort(operands, num_keys=1, is_stable=False)
@@ -554,6 +680,15 @@ def compact(page: Page, keep: jnp.ndarray) -> Page:
             nulls = jnp.where(valid, nulls, True)
             cols.append(NestedColumn(starts, lengths, nulls, c.children,
                                      c.type))
+            continue
+        if isinstance(c, Decimal128Column):
+            k = len(c.row_lanes())
+            lanes = list(sorted_ops[pos:pos + k])
+            pos += k
+            lanes[0] = jnp.where(valid, lanes[0], 0)
+            lanes[1] = jnp.where(valid, lanes[1], 0)
+            lanes[2] = jnp.where(valid, lanes[2], True)
+            cols.append(c.from_lanes(lanes))
             continue
         vals, nulls = sorted_ops[pos:pos + 2]
         pos += 2

@@ -36,7 +36,8 @@ from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
     GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, PlanNode,
     ProjectNode, RemoteSourceNode, SortNode, TableScanNode, TopNNode,
-    MarkDistinctNode, UnionAllNode, UnnestNode, ValuesNode, WindowNode,
+    MarkDistinctNode, TableWriterNode, UnionAllNode, UnnestNode,
+    ValuesNode, WindowNode,
 )
 
 
@@ -106,7 +107,21 @@ class Executor:
     def execute(self, plan: PlanNode) -> Page:
         plan = self._resolve_subqueries(plan)
         plan = self._prepare(plan)
+        if isinstance(plan, TableWriterNode):
+            return self._execute_writer(plan)
         return self._execute_tree(plan)
+
+    def _execute_writer(self, node: TableWriterNode) -> Page:
+        """Writer root: run the source pipeline on device, then sink the
+        rows host-side (ConnectorPageSink role) and emit the count row
+        (TableWriterOperator's output contract)."""
+        page = self._execute_tree(node.source)
+        rows = self._page_rows(page)
+        n = self.connector.append_rows(node.table, rows)
+        out_col = Column.from_numpy(
+            __import__("numpy").array([n], dtype="int64"),
+            node.output_types[0])
+        return Page.from_columns([out_col], 1, node.output_names)
 
     def _execute_tree(self, plan: PlanNode) -> Page:
         # Learned capacities persist per plan: overflow retries and
@@ -559,6 +574,21 @@ class Executor:
                     return Page(p.columns + (col,), p.num_rows,
                                 node.output_names)
                 return rowid_fn, cap
+            if isinstance(node, TableWriterNode):
+                src, cap = build(node.source)
+
+                def writer_fn(pages, node=node):
+                    # the jit pipeline produces the page; the sink write
+                    # is a HOST side-effect (ConnectorPageSink role) —
+                    # legal here because jit tracing happens once and the
+                    # actual write runs per execution via io_callback-free
+                    # host interpretation: the executor runs this whole
+                    # closure eagerly when the plan root is a writer (see
+                    # execute()); inside jit it is rejected below.
+                    raise NotImplementedError(
+                        "TableWriterNode inside a jit fragment — the "
+                        "engine executes writer roots host-side")
+                return writer_fn, cap
             if isinstance(node, MarkDistinctNode):
                 src, cap = build(node.source)
 

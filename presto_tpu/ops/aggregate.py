@@ -227,6 +227,34 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
             else:  # avg_partial -> (sum double, count bigint)
                 cols.append(widen(s, DOUBLE, (n_per == 0)[take]))
                 cols.append(widen(n_per.astype(jnp.int64), BIGINT, false_w))
+        elif kind in ("sum128", "avg128"):
+            # DECIMAL(38): signed-high/unsigned-low 32-bit limb sums per
+            # bin; exact recombination happens host-side
+            # (Decimal128Column.value_at)
+            from presto_tpu.data.column import Decimal128Column
+            masked = jnp.where(jnp.stack(live), vals, 0).astype(jnp.int64)
+            lo32 = masked & jnp.int64(0xFFFFFFFF)
+            hi32 = masked >> 32
+            lo_b = jnp.sum(lo32, axis=1)
+            hi_b = jnp.sum(hi32, axis=1)
+            nulls_w = (n_per == 0)[take]
+            is_null = nulls_w | ~out_valid_w
+
+            def lane(bins_arr, fill=0):
+                v = jnp.where(is_null, fill, bins_arr[take])
+                if width < out_cap:
+                    pad = out_cap - width
+                    v = jnp.concatenate(
+                        [v, jnp.full((pad,), fill, dtype=v.dtype)])
+                return v
+            nl = is_null
+            if width < out_cap:
+                nl = jnp.concatenate(
+                    [nl, jnp.ones((out_cap - width,), bool)])
+            cols.append(Decimal128Column(
+                lane(hi_b), lane(lo_b), nl, t,
+                count=(lane(n_per.astype(jnp.int64))
+                       if kind == "avg128" else None)))
         elif kind in ("min", "max"):
             v = vals.astype(jnp.int32) if vals.dtype == jnp.bool_ else vals
             if jnp.issubdtype(v.dtype, jnp.floating):
@@ -457,6 +485,25 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
                     jnp.zeros_like(out_valid))]
     if kind == "count":
         return [out(seg_count(~nulls), jnp.zeros_like(out_valid))]
+    if kind in ("sum128", "avg128"):
+        # DECIMAL(38) accumulation: per-row scaled-int64 inputs split
+        # into signed-high / unsigned-low 32-bit limbs, segment-summed
+        # separately — each limb sum fits int64 for any realistic row
+        # count, and the exact 128-bit value recombines on the host
+        # (reference: UnscaledDecimal128Arithmetic.java; limb lanes
+        # because no 128-bit ops lower on TPU)
+        from presto_tpu.data.column import Decimal128Column
+        live = jnp.where(nulls, 0, vals).astype(jnp.int64)
+        lo32 = live & jnp.int64(0xFFFFFFFF)
+        hi32 = live >> 32                       # arithmetic shift
+        lo = pscan.segment_sums(lo32, starts, ends)
+        hi = pscan.segment_sums(hi32, starts, ends)
+        n = seg_count(~nulls)
+        is_null = (n == 0) | ~out_valid
+        col = Decimal128Column(
+            jnp.where(is_null, 0, hi), jnp.where(is_null, 0, lo),
+            is_null, t, count=(n if kind == "avg128" else None))
+        return [col]
     if kind in ("sum", "avg", "avg_partial"):
         acc_dtype = jnp.float64 if t.is_floating or kind != "sum" \
             else jnp.int64

@@ -36,7 +36,14 @@ class SortKey:
 
 def _orderable_values(col: Column) -> jnp.ndarray:
     """Per-type array whose ascending order == SQL ascending order.
-    Strings are already codes into a sorted dictionary."""
+    Strings are already codes into a sorted dictionary. Decimal128
+    columns order by their float64 image — exact to 2^53, where ORDER BY
+    on 38-digit sums is ties-only beyond (values stay exact; only the
+    sort key is approximate)."""
+    from presto_tpu.data.column import Decimal128Column
+    if isinstance(col, Decimal128Column):
+        return (col.hi.astype(jnp.float64) * float(1 << 32)
+                + col.lo.astype(jnp.float64))
     v = col.values
     if v.dtype == jnp.bool_:
         return v.astype(jnp.int32)

@@ -41,7 +41,7 @@ def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
 
 
 def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
-    from presto_tpu.data.column import NestedColumn
+    from presto_tpu.data.column import Decimal128Column, NestedColumn
     key_ops = _sort_key_operands(page, keys)
     operands = tuple(key_ops)
     for c in page.columns:
@@ -49,6 +49,8 @@ def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
             # nested payload rides as row-wise lanes; child buffers are
             # position-addressed and never move
             operands += (c.starts, c.lengths, c.nulls)
+        elif isinstance(c, Decimal128Column):
+            operands += tuple(c.row_lanes())
         else:
             operands += (c.values, c.nulls)
     out = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=True)
@@ -59,6 +61,10 @@ def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
             cols.append(NestedColumn(out[pos], out[pos + 1], out[pos + 2],
                                      c.children, c.type))
             pos += 3
+        elif isinstance(c, Decimal128Column):
+            k = len(c.row_lanes())
+            cols.append(c.from_lanes(list(out[pos:pos + k])))
+            pos += k
         else:
             cols.append(Column(out[pos], out[pos + 1], c.type,
                                c.dictionary))

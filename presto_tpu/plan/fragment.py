@@ -35,7 +35,10 @@ from presto_tpu.types import BIGINT, DOUBLE
 
 # Aggregates whose state has no fixed-width column form (sketches/runs):
 # distributed by resharding rows, not by splitting into partial+final.
-_UNSPLITTABLE = {"approx_distinct", "approx_percentile"}
+_UNSPLITTABLE = {"approx_distinct", "approx_percentile",
+                 # DECIMAL(38) limb-lane accumulators: the partial state
+                 # is a Decimal128Column (no wire/final-merge path yet)
+                 "sum128", "avg128"}
 
 
 def _partial_agg_layout(node: AggregationNode):
@@ -289,8 +292,13 @@ def add_exchanges(plan: PlanNode, connector=None, session=None,
             return dataclasses.replace(node, source=src), prop
 
         from presto_tpu.plan.nodes import (
-            MarkDistinctNode, UnionAllNode, UnnestNode,
+            MarkDistinctNode, TableWriterNode, UnionAllNode, UnnestNode,
         )
+        if isinstance(node, TableWriterNode):
+            # write where the rows are; per-task count rows gather above
+            src, _prop = visit(node.source)
+            return (dataclasses.replace(node, source=src),
+                    (Partitioning.SOURCE, ()))
         if isinstance(node, UnionAllNode):
             # Gather every branch to a single stream and concatenate
             # there (reference UnionNode is arbitrary-distributed; the

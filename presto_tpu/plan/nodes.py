@@ -159,6 +159,23 @@ class AssignUniqueIdNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class TableWriterNode(PlanNode):
+    """Writes its source rows to `table` via the connector page sink and
+    emits ONE row with the written count (reference: spi/plan/
+    TableWriterNode -> operator/TableWriterOperator.java). The write is a
+    host side-effect executed after the jit source pipeline; output =
+    ("rows", BIGINT). The TableFinish role (summing per-task counts and
+    committing) is a plain sum aggregation above the gathered counts
+    (TableFinishOperator.java)."""
+    source: PlanNode = None
+    table: str = ""
+    column_names: Tuple[str, ...] = ()
+
+    def children(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
 class MarkDistinctNode(PlanNode):
     """Appends a BOOLEAN first-occurrence marker per (key...) combination
     (reference: spi/plan/MarkDistinctNode -> MarkDistinctOperator.java);

@@ -325,7 +325,8 @@ def _checksum(payload: bytes, markers: int, position_count: int,
 
 
 def encode_serialized_page(blocks: List[WireBlock],
-                           checksummed: bool = True) -> bytes:
+                           checksummed: bool = True,
+                           compression: Optional[str] = None) -> bytes:
     if not blocks:
         raise ValueError("page needs at least one block")
     position_count = blocks[0].position_count
@@ -335,9 +336,20 @@ def encode_serialized_page(blocks: List[WireBlock],
         _encode_block(payload, b)
     payload = bytes(payload)
     markers = CHECKSUMMED if checksummed else 0
+    uncompressed = len(payload)
+    if compression == "zlib" and uncompressed > 256:
+        comp = zlib.compress(payload, 6)
+        if len(comp) < uncompressed:   # keep raw when incompressible
+            payload = comp
+            markers |= COMPRESSED
+    elif compression not in (None, "none", "zlib"):
+        raise ValueError(f"unsupported exchange compression "
+                         f"{compression!r}")
+    # checksum covers the payload AS TRANSMITTED
+    # (PagesSerdeUtil.computeSerializedPageChecksum)
     checksum = _checksum(payload, markers, position_count,
-                         len(payload)) if checksummed else 0
-    header = struct.pack("<ibiiq", position_count, markers, len(payload),
+                         uncompressed) if checksummed else 0
+    header = struct.pack("<ibiiq", position_count, markers, uncompressed,
                          len(payload), checksum)
     return header + payload
 
@@ -349,12 +361,18 @@ def decode_serialized_page(data: bytes, offset: int = 0
         struct.unpack_from("<ibiiq", data, offset)
     off = offset + 21
     payload = bytes(data[off:off + size])
-    if markers & COMPRESSED or markers & ENCRYPTED:
-        raise NotImplementedError("compressed/encrypted pages")
+    if markers & ENCRYPTED:
+        raise NotImplementedError("encrypted pages")
     if markers & CHECKSUMMED:
         want = _checksum(payload, markers, position_count, uncompressed)
         if want != checksum:
             raise ValueError(f"page checksum mismatch: {want} != {checksum}")
+    if markers & COMPRESSED:
+        payload = zlib.decompress(payload)
+        if len(payload) != uncompressed:
+            raise ValueError(
+                f"decompressed size {len(payload)} != declared "
+                f"{uncompressed}")
     buf = memoryview(payload)
     (nblocks,) = struct.unpack_from("<i", buf, 0)
     p = 4
@@ -451,11 +469,31 @@ def page_to_wire_blocks(page) -> List[WireBlock]:
     matching Presto's representation; ARRAY/MAP/ROW nest recursively."""
     from presto_tpu.data.column import NestedColumn
 
+    from presto_tpu.data.column import Decimal128Column
+
     n = int(page.num_rows)
     out: List[WireBlock] = []
     for c in page.columns:
         if isinstance(c, NestedColumn):
             out.append(_nested_to_wire(c, np.arange(n)))
+            continue
+        if isinstance(c, Decimal128Column):
+            # exact recombination -> INT128_ARRAY (low64, high64) lanes;
+            # avg forms pre-divide host-side so the wire carries the
+            # final value (long-decimal wire layout, Decimals.java)
+            lanes = np.zeros((n, 2), dtype=np.int64)
+            nulls = np.asarray(c.nulls)[:n].copy()
+            scale = c.type.scale
+            for i in range(n):
+                if nulls[i]:
+                    continue
+                v = c.value_at(i)
+                unscaled = int(v.scaleb(scale)) if scale else int(v)
+                lanes[i, 0] = (unscaled & ((1 << 64) - 1)) - (
+                    1 << 64 if unscaled & (1 << 63) else 0)
+                lanes[i, 1] = unscaled >> 64
+            out.append(WireBlock("INT128_ARRAY", lanes,
+                                 nulls if nulls.any() else None))
             continue
         vals, nulls = c.to_numpy(n)
         out.append(_flat_to_wire(c.type, vals, nulls.copy(),
@@ -494,6 +532,25 @@ def _wire_to_column(b: WireBlock, t, position_count: int, capacity: int):
             jnp.asarray(np.pad(nulls[:n], (0, pad),
                                constant_values=True)),
             children, t)
+    if b.encoding == "INT128_ARRAY" and getattr(t, "uses_int128", False):
+        import jax.numpy as jnp2
+        from presto_tpu.data.column import Decimal128Column
+        n = position_count
+        nulls = (b.nulls if b.nulls is not None
+                 else np.zeros(n, dtype=bool))
+        hi = np.zeros(capacity, np.int64)
+        lo = np.zeros(capacity, np.int64)
+        nl = np.ones(capacity, bool)
+        for i in range(n):
+            nl[i] = bool(nulls[i])
+            if nl[i]:
+                continue
+            low = int(b.values[i, 0]) & ((1 << 64) - 1)
+            unscaled = (int(b.values[i, 1]) << 64) | low
+            hi[i] = unscaled >> 32
+            lo[i] = unscaled & 0xFFFFFFFF
+        return Decimal128Column(jnp2.asarray(hi), jnp2.asarray(lo),
+                                jnp2.asarray(nl), t)
     if t.is_string:
         words, codes, nulls = _block_to_strings(b, position_count)
         return Column.from_numpy(codes, t, nulls=nulls,

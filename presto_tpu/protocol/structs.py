@@ -697,6 +697,49 @@ class EnforceSingleRowNode(PlanNode):
     _SCHEMA = [("id", "id", None), ("source", "source", PlanNode)]
 
 
+@PlanNode.register(".TableWriterNode")
+@dataclasses.dataclass
+class TableWriterNode(PlanNode):
+    """spi/plan/TableWriterNode.java (the fields this worker consumes;
+    target/statistics extensions ride raw)."""
+    id: str = ""
+    source: Any = None
+    target: Any = None
+    rowCountVariable: Variable = None
+    fragmentVariable: Optional[Variable] = None
+    tableCommitContextVariable: Optional[Variable] = None
+    columns: List[Variable] = dataclasses.field(default_factory=list)
+    columnNames: List[str] = dataclasses.field(default_factory=list)
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("target", "target", ("opt", None)),
+        ("rowCountVariable", "rowCountVariable", Variable),
+        ("fragmentVariable", "fragmentVariable", ("opt", Variable)),
+        ("tableCommitContextVariable", "tableCommitContextVariable",
+         ("opt", Variable)),
+        ("columns", "columns", ("list", Variable)),
+        ("columnNames", "columnNames", None),
+    ]
+
+
+@PlanNode.register(".TableFinishNode")
+@dataclasses.dataclass
+class TableFinishNode(PlanNode):
+    """spi/plan/TableFinishNode.java — commits and emits the summed row
+    count."""
+    id: str = ""
+    source: Any = None
+    target: Any = None
+    rowCountVariable: Variable = None
+    _SCHEMA = [
+        ("id", "id", None),
+        ("source", "source", PlanNode),
+        ("target", "target", ("opt", None)),
+        ("rowCountVariable", "rowCountVariable", Variable),
+    ]
+
+
 @PlanNode.register(".UnionNode")
 @dataclasses.dataclass
 class UnionNode(PlanNode):

@@ -327,6 +327,22 @@ class _FragmentConverter:
             return S.WindowNode(id=nid, source=src, specification=spec,
                                 windowFunctions=fns), out
 
+        if isinstance(node, P.TableWriterNode):
+            src, in_vars = self.convert(node.source)
+            rc = names.var(node.output_names[0], node.output_types[0])
+            cid = self._cid(node.table)
+            return S.TableWriterNode(
+                id=nid, source=src,
+                target={"@type": "CreateHandle",
+                        "handle": {"connectorId": cid,
+                                   "connectorHandle": {
+                                       "@type": cid,
+                                       "tableName": node.table}},
+                        "schemaTableName": {"schema": "default",
+                                            "table": node.table}},
+                rowCountVariable=rc, columns=list(in_vars),
+                columnNames=list(node.column_names)), [rc]
+
         if isinstance(node, P.UnionAllNode):
             psrcs, out_to_in = [], {}
             out = [names.var(n_, t) for n_, t in zip(node.output_names,

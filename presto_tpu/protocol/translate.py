@@ -218,7 +218,10 @@ def translate_expr(x, scope: Scope) -> E.RowExpression:
 # ------------------------------------------------------------- plan nodes
 
 _AGG_KINDS = {"sum", "count", "min", "max", "avg", "bool_or", "bool_and",
-              "avg_partial", "approx_distinct", "approx_percentile"}
+              "avg_partial", "approx_distinct", "approx_percentile",
+              # DECIMAL(38) limb-lane accumulators (engine extension,
+              # like avg_final — the wire carries the qualified name)
+              "sum128", "avg128"}
 
 _JOIN_TYPES = {"INNER": P.JoinType.INNER, "LEFT": P.JoinType.LEFT,
                "FULL": P.JoinType.FULL}
@@ -299,6 +302,10 @@ def _out_vars(node) -> List[S.Variable]:
         return node.outputVariables
     if isinstance(node, S.MarkDistinctNode):
         return _out_vars(node.source) + [node.markerVariable]
+    if isinstance(node, S.TableWriterNode):
+        return [node.rowCountVariable]
+    if isinstance(node, S.TableFinishNode):
+        return [node.rowCountVariable]
     if isinstance(node, (S.LimitNode, S.TopNNode, S.SortNode,
                          S.EnforceSingleRowNode)):
         return _out_vars(node.source)
@@ -606,6 +613,32 @@ def _node(n) -> P.PlanNode:
             src.output_types + (BOOLEAN,), source=src,
             key_fields=tuple(scope.index[v.name]
                              for v in n.distinctVariables))
+
+    if isinstance(n, S.TableWriterNode):
+        src = _node(n.source)
+        h = (n.target or {})
+        table = (h.get("handle", {}).get("connectorHandle", {})
+                 .get("tableName")) if isinstance(h, dict) else None
+        table = table or (h.get("tableName") if isinstance(h, dict)
+                          else None) or ""
+        if not table:
+            raise NotImplementedError(
+                "TableWriterNode without a resolvable table target")
+        return P.TableWriterNode(
+            (n.rowCountVariable.name,),
+            (parse_type(n.rowCountVariable.type),), source=src,
+            table=table, column_names=tuple(n.columnNames))
+
+    if isinstance(n, S.TableFinishNode):
+        # commit + summed count == a SINGLE sum aggregation over the
+        # gathered per-task counts (TableFinishOperator's arithmetic)
+        src = _node(n.source)
+        return P.AggregationNode(
+            (n.rowCountVariable.name,),
+            (parse_type(n.rowCountVariable.type),), source=src,
+            group_fields=(),
+            aggs=(AggSpec("sum", 0, parse_type(n.rowCountVariable.type)),),
+            step=P.Step.SINGLE)
 
     if isinstance(n, S.RawNode):
         raise NotImplementedError(f"plan node {n.type_key}")

@@ -207,8 +207,113 @@ class TpuCluster:
                 user=self.session_properties.get("user", ""),
                 source=self.session_properties.get("source", ""))
             with group.acquire(timeout_s=600):
-                box[0] = self._execute_plan(self.plan_sql(sql))
+                head = (sql.lstrip().split(None, 1)[0].lower()
+                        if sql.strip() else "")
+                if head in ("create", "insert", "drop"):
+                    box[0] = self._execute_write(sql)
+                else:
+                    box[0] = self._execute_plan(self.plan_sql(sql))
         return box[0]
+
+    def _execute_write(self, sql: str) -> List[tuple]:
+        """Distributed CTAS / INSERT ... SELECT: the coordinator runs the
+        metadata DDL (CreateTableTask role), then schedules TableWriter
+        fragments on the workers — each writes its partition of rows and
+        reports a count; the coordinator sums them (TableFinish role).
+        Literal-VALUES inserts and bare DDL run coordinator-side."""
+        from presto_tpu.plan.nodes import TableWriterNode
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.analyzer import AnalysisError
+        from presto_tpu.sql.parser import parse_statement
+        from presto_tpu.types import BIGINT
+
+        stmt = parse_statement(sql)
+        conn = self.connector
+        if not hasattr(conn, "create"):
+            raise AnalysisError("connector is not writable")
+        query = getattr(stmt, "query", None)
+        if query is None:
+            # bare DDL / literal VALUES: coordinator-local metadata ops
+            from presto_tpu.exec.engine import LocalEngine
+            return LocalEngine(conn).execute_sql(sql)
+
+        plan = self.planner.plan_query(query)
+        if isinstance(stmt, A.CreateTableAs):
+            if stmt.if_not_exists and conn.exists(stmt.name):
+                return [(0,)]
+            conn.create(stmt.name, list(zip(plan.output_names,
+                                            plan.output_types)))
+        elif not conn.exists(stmt.name):
+            raise AnalysisError(f"unknown table {stmt.name}")
+        if getattr(stmt, "columns", None):
+            # INSERT (col list): map SELECT outputs to the declared
+            # columns, NULL-fill the rest — same semantics as
+            # LocalEngine's literal path (engine.py INSERT handling)
+            from presto_tpu.expr.nodes import InputRef, Literal
+            from presto_tpu.plan.nodes import ProjectNode
+            from presto_tpu.types import UNKNOWN
+            schema = conn.schema(stmt.name)
+            names = [c for c, _t in schema]
+            unknown = [c for c in stmt.columns if c not in names]
+            if unknown:
+                raise AnalysisError(
+                    f"INSERT columns not in table: {unknown}")
+            if len(stmt.columns) != len(plan.output_types):
+                raise AnalysisError(
+                    f"INSERT arity {len(plan.output_types)} != column "
+                    f"list {len(stmt.columns)}")
+            pos = {c: i for i, c in enumerate(stmt.columns)}
+            exprs, types = [], []
+            for c, t in schema:
+                if c in pos:
+                    i = pos[c]
+                    exprs.append(InputRef(i, plan.output_types[i]))
+                    types.append(plan.output_types[i])
+                else:
+                    exprs.append(Literal(None, UNKNOWN))
+                    types.append(t)
+            plan = ProjectNode(tuple(names), tuple(types), plan,
+                               tuple(exprs))
+        writer = TableWriterNode(("rows",), (BIGINT,), source=plan,
+                                 table=stmt.name,
+                                 column_names=plan.output_names)
+        try:
+            counts = self._execute_plan(writer)
+        except Exception:
+            if isinstance(stmt, A.CreateTableAs):
+                conn.drop(stmt.name, if_exists=True)   # no partial CTAS
+            raise
+        return [(sum(int(r[0]) for r in counts if r[0] is not None),)]
+
+    def explain_analyze_sql(self, sql: str) -> str:
+        """Execute, then render per-fragment / per-operator row counts
+        from the workers' TaskInfo stats trees (the coordinator's
+        EXPLAIN ANALYZE surface over the wire). Stats capture adds one
+        TaskInfo GET per task, so it is gated to this entry point."""
+        self._capture_stats = True
+        try:
+            rows = self.execute_sql(sql)
+        finally:
+            self._capture_stats = False
+        by_frag: Dict[int, Dict[str, list]] = {}
+        for fid, info in getattr(self, "last_task_infos", []):
+            stats = info.get("stats") or {}
+            for pipe in stats.get("pipelines", []):
+                for op in pipe.get("operatorSummaries", []):
+                    key = (op.get("planNodeId"), op.get("operatorType"))
+                    agg = by_frag.setdefault(fid, {}).setdefault(
+                        key, [0, 0])
+                    agg[0] += int(op.get("outputPositions", 0))
+                    agg[1] += 1
+        lines = [f"EXPLAIN ANALYZE ({len(rows)} result rows)"]
+        for fid in sorted(by_frag):
+            lines.append(f"Fragment {fid}:")
+            for (nid, op_type), (total, ntasks) in sorted(
+                    by_frag[fid].items()):
+                lines.append(
+                    f"  {op_type} [node {nid}]: {total} rows "
+                    f"across {ntasks} task(s)")
+        return "\n".join(lines)
 
     def _execute_plan(self, plan: PlanNode, _retried: bool = False
                       ) -> List[tuple]:
@@ -312,9 +417,26 @@ class TpuCluster:
         try:
             schedule(0)
             self._await_all(stages)
+            if getattr(self, "_capture_stats", False):
+                self._capture_task_infos(stages)
             return self._collect_root(stages[0], out_types)
         finally:
             self._cleanup(stages)
+
+    def _capture_task_infos(self, stages: Dict[int, _Stage]):
+        """Fetch every task's TaskInfo (stats tree included) before
+        cleanup deletes the tasks — the coordinator's QueryStats
+        aggregation source (reference: per-task OperatorStats rolled up
+        by SqlStageExecution)."""
+        infos = []
+        for fid, stage in stages.items():
+            for uri in stage.task_uris:
+                try:
+                    with urllib.request.urlopen(uri, timeout=10) as resp:
+                        infos.append((fid, json.loads(resp.read())))
+                except Exception:    # noqa: BLE001 — stats best-effort
+                    pass
+        self.last_task_infos = infos
 
     # ------------------------------------------------------------------
     def _start_stage(self, qid: str, fid: int, stages: Dict[int, _Stage],

@@ -123,6 +123,15 @@ class Task:
         self.update_lock = threading.Lock()
         self.state_change = threading.Condition()
         self.bytes_out = 0
+        # execution stats (TaskStats/OperatorStats roles)
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self.cpu_nanos = 0
+        self.memory_bytes = 0
+        self.raw_input_positions = 0
+        self.output_positions = 0
+        self.operator_stats: List[dict] = []   # per-plan-node summaries
+        self.total_splits = 0
 
     def set_state(self, state: str):
         with self.state_change:
@@ -132,6 +141,7 @@ class Task:
 
     # ---- protocol views -------------------------------------------------
     def status(self, base_uri: str = "") -> S.TaskStatus:
+        running = 1 if self.state == "RUNNING" else 0
         return S.TaskStatus(
             taskInstanceIdLeastSignificantBits=(
                 self.instance_id.int & ((1 << 64) - 1)),
@@ -139,17 +149,87 @@ class Task:
             version=self.version,
             state=self.state,
             self_uri=f"{base_uri}/v1/task/{self.task_id}",
+            queuedPartitionedDrivers=(
+                1 if self.state == "PLANNED" else 0),
+            runningPartitionedDrivers=running,
+            runningPartitionedSplitsWeight=running,
             physicalWrittenDataSizeInBytes=self.bytes_out,
+            memoryReservationInBytes=self.memory_bytes,
+            peakNodeTotalMemoryReservationInBytes=self.memory_bytes,
+            totalCpuTimeInNanos=self.cpu_nanos,
             taskAgeInMillis=int((time.time() - self.created) * 1000),
             failures=[{"message": m, "type": "PRESTO_TPU"}
                       for m in self.failures],
         )
+
+    def stats_tree(self) -> dict:
+        """TaskStats JSON (shape-compatible subset of the reference's
+        presto_cpp/main/tests/data/TaskInfo.json stats; the pipeline's
+        operatorSummaries carry per-plan-node rows)."""
+        now = time.time()
+        end = self.end_time or now
+        start = self.start_time or self.created
+        done = self.state in ("FINISHED", "FAILED", "ABORTED",
+                              "CANCELED")
+        return {
+            "createTimeInMillis": int(self.created * 1000),
+            "firstStartTimeInMillis": int(start * 1000),
+            "lastStartTimeInMillis": int(start * 1000),
+            "lastEndTimeInMillis": int(end * 1000),
+            "endTimeInMillis": int(end * 1000) if done else 0,
+            "elapsedTimeInNanos": int((end - self.created) * 1e9),
+            "queuedTimeInNanos": int((start - self.created) * 1e9),
+            "totalDrivers": 1,
+            "queuedDrivers": 1 if self.state == "PLANNED" else 0,
+            "runningDrivers": 1 if self.state == "RUNNING" else 0,
+            "completedDrivers": 1 if done else 0,
+            "blockedDrivers": 0,
+            "blockedReasons": [],
+            "fullyBlocked": False,
+            "totalSplits": self.total_splits,
+            "queuedSplits": 0,
+            "runningSplits": 0,
+            "completedSplits": self.total_splits if done else 0,
+            "cumulativeUserMemory": float(self.memory_bytes),
+            "cumulativeTotalMemory": float(self.memory_bytes),
+            "userMemoryReservationInBytes": self.memory_bytes,
+            "systemMemoryReservationInBytes": 0,
+            "revocableMemoryReservationInBytes": 0,
+            "peakUserMemoryInBytes": self.memory_bytes,
+            "peakTotalMemoryInBytes": self.memory_bytes,
+            "peakNodeTotalMemoryInBytes": self.memory_bytes,
+            "totalScheduledTimeInNanos": self.cpu_nanos,
+            "totalCpuTimeInNanos": self.cpu_nanos,
+            "totalBlockedTimeInNanos": 0,
+            "totalAllocationInBytes": self.memory_bytes,
+            "rawInputDataSizeInBytes": 0,
+            "rawInputPositions": self.raw_input_positions,
+            "processedInputDataSizeInBytes": 0,
+            "processedInputPositions": self.raw_input_positions,
+            "outputDataSizeInBytes": self.bytes_out,
+            "outputPositions": self.output_positions,
+            "physicalWrittenDataSizeInBytes": self.bytes_out,
+            "fullGcCount": 0,
+            "fullGcTimeInMillis": 0,
+            "runtimeStats": {},
+            "pipelines": ([{
+                "pipelineId": 0,
+                "firstStartTimeInMillis": int(start * 1000),
+                "lastStartTimeInMillis": int(start * 1000),
+                "lastEndTimeInMillis": int(end * 1000),
+                "inputPipeline": True,
+                "outputPipeline": True,
+                "totalDrivers": 1,
+                "operatorSummaries": self.operator_stats,
+            }] if self.operator_stats else []),
+        }
 
     def info(self, base_uri: str = "") -> S.TaskInfo:
         return S.TaskInfo(
             taskId=self.task_id, taskStatus=self.status(base_uri),
             lastHeartbeatInMillis=int(time.time() * 1000),
             noMoreSplits=sorted(self.splits) if self.no_more_splits else [],
+            stats=self.stats_tree(),
             needsPlan=self.fragment is None, nodeId="tpu-worker-0")
 
 
@@ -240,13 +320,23 @@ class TpuTaskManager:
             props = {k: v for k, v in
                      (task.session_properties or {}).items()
                      if k in known}
+            # per-operator row counters feed the TaskInfo stats tree the
+            # coordinator renders (OperatorStats role) — on by default
+            props.setdefault("collect_stats", "true")
             ex = SplitExecutor(self.connector, session=Session(props))
             ex.set_splits(task.splits)
+            task.total_splits = sum(len(v) for v in task.splits.values())
+            task.start_time = time.time()
             if not self._run_streaming(task, plan, ex):
                 remote = self._pull_remote_inputs(task, plan)
                 ex.set_remote_pages(remote)
                 page = ex.execute(plan)
+                task.output_positions = int(page.num_rows)
+                self._collect_stats(task, ex)
                 self._emit_output(task, page)
+            task.end_time = time.time()
+            task.cpu_nanos = int(
+                (task.end_time - task.start_time) * 1e9)
             task.buffers.set_no_more_pages()
             task.set_state("FINISHED")
         except Exception as e:
@@ -315,11 +405,45 @@ class TpuTaskManager:
                 if sub >= 256:
                     raise
                 sub *= 2
+        task.output_positions += int(first.num_rows)
         self._emit_output(task, first)
         for ls in lifespans[1:]:
             ex.set_splits({**task.splits, driving: [ls]})
-            self._emit_output(task, ex.execute(plan))
+            out = ex.execute(plan)
+            task.output_positions += int(out.num_rows)
+            self._emit_output(task, out)
+        self._collect_stats(task, ex)
         return True
+
+    def _collect_stats(self, task: Task, ex: SplitExecutor) -> None:
+        """Executor per-node row counters -> OperatorStats summaries
+        (reference: PrestoTask.cpp converting velox stats to protocol
+        OperatorStats; planNodeId/operatorType/outputPositions are the
+        fields the coordinator's UI and EXPLAIN ANALYZE consume)."""
+        from presto_tpu.plan.nodes import TableScanNode
+        task.memory_bytes = int(
+            getattr(ex, "last_memory_estimate", 0) or 0)
+        rows = getattr(ex, "last_node_rows", None) or {}
+        node_map = getattr(ex, "_node_map", {}) or {}
+        summaries = []
+        raw_in = 0
+        for op_id, (nid, out_rows) in enumerate(sorted(rows.items())):
+            entry = node_map.get(nid)
+            node = entry[0] if entry else None
+            op_type = type(node).__name__ if node is not None else "?"
+            if isinstance(node, TableScanNode):
+                raw_in += int(out_rows)
+            summaries.append({
+                "pipelineId": 0,
+                "operatorId": op_id,
+                "planNodeId": str(nid),
+                "operatorType": op_type.replace("Node", "Operator"),
+                "totalDrivers": 1,
+                "outputPositions": int(out_rows),
+                "outputDataSizeInBytes": 0,
+            })
+        task.raw_input_positions = raw_in
+        task.operator_stats = summaries
 
     #: Each GET to an upstream buffer returns at most this many bytes
     #: (client-side backpressure; reference: ExchangeClient's
@@ -380,6 +504,10 @@ class TpuTaskManager:
         fragment's PartitioningScheme (producer side of the exchange:
         PartitionedOutputOperator.java:57 hash split,
         BroadcastOutputBuffer replication, TaskOutputOperator single)."""
+        codec = (task.session_properties or {}).get(
+            "exchange_compression_codec")
+        if codec in (None, "", "none"):
+            codec = None
         scheme = task.fragment.partitioningScheme
         handle = ((scheme.partitioning.handle.connectorHandle or {})
                   if scheme and scheme.partitioning else {})
@@ -400,7 +528,7 @@ class TpuTaskManager:
             # BROADCAST — and SINGLE gathers shared by several consumers:
             # every buffer receives the full output (each consumer task
             # owns one buffer; token/ack state is per-buffer).
-            frame = self._serialize(page)
+            frame = self._serialize(page, codec)
             for b in buffer_ids:
                 emit(b, frame)
             return
@@ -410,7 +538,7 @@ class TpuTaskManager:
             n = int(page.num_rows)
             for b_idx, b in enumerate(buffer_ids):
                 idx = np.arange(b_idx, n, nbuf)
-                emit(b, self._serialize(select_page_host(page, idx)))
+                emit(b, self._serialize(select_page_host(page, idx), codec))
             return
         if kind != "FIXED_HASH_DISTRIBUTION" and nbuf > 1:
             raise NotImplementedError(
@@ -422,14 +550,15 @@ class TpuTaskManager:
             pid = _hash_partition_ids(page, channels, nbuf)
             for b_idx, b in enumerate(buffer_ids):
                 idx = np.nonzero(pid == b_idx)[0]
-                emit(b, self._serialize(select_page_host(page, idx)))
+                emit(b, self._serialize(select_page_host(page, idx), codec))
             return
         # SINGLE (and the 1-buffer degenerate of every other scheme)
-        emit(buffer_ids[0], self._serialize(page))
+        emit(buffer_ids[0], self._serialize(page, codec))
 
-    def _serialize(self, page: Page) -> bytes:
+    def _serialize(self, page: Page, codec=None) -> bytes:
         blocks = page_to_wire_blocks(page)
-        return encode_serialized_page(blocks, int(page.num_rows))
+        return encode_serialized_page(blocks, checksummed=True,
+                                      compression=codec)
 
     # ------------------------------------------------------------------
     def get(self, task_id: str) -> Optional[Task]:

@@ -1487,16 +1487,25 @@ class Planner:
                 spec = AggSpec("count_star", None, BIGINT)
             else:
                 arg = self.analyze(call.args[0], fields)
-                if call.name == "avg" and isinstance(arg.type, DecimalType):
-                    # avg accumulates in double; descale the scaled int64
-                    arg = Call("cast", (arg,), DOUBLE)
                 if arg not in arg_pos:
                     arg_pos[arg] = len(pre_exprs)
                     pre_exprs.append(arg)
                 f = arg_pos[arg]
                 kind = call.name
                 param = None
-                if kind in ("count", "approx_distinct"):
+                if kind == "avg" and isinstance(arg.type, DecimalType):
+                    # Presto: avg(DECIMAL(p,s)) -> DECIMAL(s) kept exact
+                    # (HALF_UP) via hi/lo limb sums + host division
+                    kind = "avg128"
+                    out_t = DecimalType(38, arg.type.scale)
+                elif kind == "sum" and isinstance(arg.type, DecimalType) \
+                        and arg.type.uses_int128:
+                    # long-decimal sums: exact 128-bit limb accumulation
+                    # (UnscaledDecimal128Arithmetic role); short decimals
+                    # keep the splittable scaled-int64 fast path
+                    kind = "sum128"
+                    out_t = DecimalType(38, arg.type.scale)
+                elif kind in ("count", "approx_distinct"):
                     out_t = BIGINT
                 elif kind == "avg":
                     out_t = DOUBLE

@@ -91,13 +91,20 @@ class DecimalType(Type):
     scale: int = 0
 
     def __init__(self, precision: int = 18, scale: int = 0):
-        if precision > 18:
-            raise NotImplementedError(
-                f"DECIMAL({precision},{scale}): precision > 18 (int128) not "
-                "yet supported on the int64 fast path")
+        if precision > 38:
+            raise ValueError(
+                f"DECIMAL({precision},{scale}): precision > 38")
         object.__setattr__(self, "name", "decimal")
         object.__setattr__(self, "precision", precision)
         object.__setattr__(self, "scale", scale)
+
+    @property
+    def uses_int128(self) -> bool:
+        """p > 18 exceeds the scaled-int64 fast path; values live in
+        hi/lo int64 limb lanes (reference: presto-common Decimals.java
+        short/long decimal split at 18 digits,
+        UnscaledDecimal128Arithmetic.java)."""
+        return self.precision > 18
 
     @property
     def dtype(self) -> np.dtype:

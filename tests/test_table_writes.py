@@ -1,0 +1,99 @@
+"""Protocol-level table writes (round-3 VERDICT #7): CTAS / INSERT run
+through the HTTP cluster as TableWriter fragments — each worker writes
+its partition and reports a count; the coordinator sums (TableFinish
+role). Reference: spi/plan/TableWriterNode -> TableWriterOperator.java,
+TableFinishOperator.java."""
+
+import pytest
+
+from presto_tpu.connectors import MemoryConnector, TpchConnector
+from presto_tpu.exec.engine import LocalEngine
+from presto_tpu.protocol import structs as S
+from presto_tpu.protocol.translate import translate_fragment
+from presto_tpu.plan import nodes as P
+
+
+@pytest.fixture()
+def cluster():
+    from presto_tpu.server.cluster import TpuCluster
+    mem = MemoryConnector(fallback=TpchConnector(0.01))
+    c = TpuCluster(mem, n_workers=2)
+    yield c, mem
+    c.stop()
+
+
+def test_ctas_through_two_workers(cluster):
+    c, mem = cluster
+    n = c.execute_sql(
+        "CREATE TABLE nc AS SELECT n_nationkey, n_name, n_regionkey "
+        "FROM nation WHERE n_regionkey < 3")
+    local = LocalEngine(mem).execute_sql(
+        "SELECT count(*), sum(n_nationkey) FROM nation "
+        "WHERE n_regionkey < 3")
+    assert n[0][0] == local[0][0]
+    back = c.execute_sql("SELECT count(*), sum(n_nationkey) FROM nc")
+    assert back == local
+    # both workers actually executed writer tasks
+    assert all(w.task_manager.lifetime_tasks > 0 for w in c.workers)
+
+
+def test_insert_select_through_cluster(cluster):
+    c, mem = cluster
+    c.execute_sql("CREATE TABLE t2 AS SELECT n_nationkey AS k FROM "
+                  "nation WHERE n_regionkey = 0")
+    n = c.execute_sql("INSERT INTO t2 SELECT n_nationkey FROM nation "
+                      "WHERE n_regionkey = 1")
+    exp = LocalEngine(mem).execute_sql(
+        "SELECT count(*) FROM nation WHERE n_regionkey <= 1")
+    assert c.execute_sql("SELECT count(*) FROM t2") == exp
+    assert n[0][0] > 0
+
+
+def test_failed_ctas_leaves_no_table(cluster):
+    c, mem = cluster
+    with pytest.raises(Exception):
+        c.execute_sql("CREATE TABLE bad AS SELECT no_such_col FROM nation")
+    assert not mem.exists("bad")
+
+
+def test_writer_node_protocol_roundtrip():
+    scan = S.TableScanNode(
+        id="0",
+        table={"connectorId": "tpch",
+               "connectorHandle": {"@type": "tpch",
+                                   "tableName": "nation"}},
+        outputVariables=[S.Variable("n_nationkey", "bigint")],
+        assignments={"n_nationkey<bigint>":
+                     {"columnName": "n_nationkey"}})
+    writer = S.TableWriterNode(
+        id="1", source=scan,
+        target={"@type": "CreateHandle",
+                "handle": {"connectorId": "memory",
+                           "connectorHandle": {"@type": "memory",
+                                               "tableName": "dst"}}},
+        rowCountVariable=S.Variable("rows", "bigint"),
+        columns=[S.Variable("n_nationkey", "bigint")],
+        columnNames=["k"])
+    j = S.PlanNode.to_json(writer)
+    w2 = S.PlanNode.from_json(j)
+    assert S.PlanNode.to_json(w2) == j
+    finish = S.TableFinishNode(
+        id="2", source=writer,
+        rowCountVariable=S.Variable("rows", "bigint"))
+    frag = S.PlanFragment(
+        id="0", root=finish, variables=[],
+        partitioning=S.PartitioningHandle(
+            connectorHandle={"@type": "$remote",
+                             "partitioning": "SOURCE_DISTRIBUTED"}),
+        partitioningScheme=S.PartitioningScheme(
+            partitioning=S.PartitioningScheme_Partitioning(
+                handle=S.PartitioningHandle(
+                    connectorHandle={"@type": "$remote",
+                                     "partitioning": "SINGLE"}),
+                arguments=[]),
+            outputLayout=[]),
+        stageExecutionDescriptor=S.StageExecutionDescriptor())
+    plan = translate_fragment(frag)
+    assert isinstance(plan, P.AggregationNode)      # TableFinish = sum
+    assert isinstance(plan.source, P.TableWriterNode)
+    assert plan.source.table == "dst"

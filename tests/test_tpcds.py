@@ -69,7 +69,11 @@ def run_case(qnum, engine, oracle):
                .get(qnum) or to_sqlite(sql))
     exp = oracle.execute(exp_sql).fetchall()
 
-    key = lambda r: tuple((v is None, v) for v in r)   # noqa: E731
+    # floats sort ROUNDED so epsilon differences (summation order) can't
+    # mis-pair otherwise-identical rows between the two engines
+    key = lambda r: tuple(                            # noqa: E731
+        (v is None, round(v, 3) if isinstance(v, float) else v)
+        for v in r)
     got_s, exp_s = sorted(got, key=key), sorted(exp, key=key)
     assert len(got_s) == len(exp_s), \
         f"Q{qnum}: {len(got_s)} rows != {len(exp_s)}\n" \
