@@ -134,7 +134,9 @@ TPCDS_SCHEMA: Dict[str, List[Tuple[str, Type]]] = {
         ("cs_order_number", BIGINT), ("cs_quantity", INTEGER),
         ("cs_wholesale_cost", DOUBLE), ("cs_list_price", DOUBLE),
         ("cs_sales_price", DOUBLE), ("cs_ext_discount_amt", DOUBLE),
-        ("cs_ext_sales_price", DOUBLE), ("cs_ext_ship_cost", DOUBLE),
+        ("cs_ext_sales_price", DOUBLE),
+        ("cs_ext_wholesale_cost", DOUBLE),
+        ("cs_ext_list_price", DOUBLE), ("cs_ext_ship_cost", DOUBLE),
         ("cs_coupon_amt", DOUBLE), ("cs_net_paid", DOUBLE),
         ("cs_net_profit", DOUBLE),
     ],
@@ -150,7 +152,9 @@ TPCDS_SCHEMA: Dict[str, List[Tuple[str, Type]]] = {
         ("ws_order_number", BIGINT), ("ws_quantity", INTEGER),
         ("ws_wholesale_cost", DOUBLE), ("ws_list_price", DOUBLE),
         ("ws_sales_price", DOUBLE), ("ws_ext_discount_amt", DOUBLE),
-        ("ws_ext_sales_price", DOUBLE), ("ws_ext_ship_cost", DOUBLE),
+        ("ws_ext_sales_price", DOUBLE), ("ws_ext_list_price", DOUBLE),
+        ("ws_ext_wholesale_cost", DOUBLE),
+        ("ws_ext_ship_cost", DOUBLE),
         ("ws_net_paid", DOUBLE), ("ws_net_profit", DOUBLE),
     ],
     "inventory": [
@@ -955,6 +959,8 @@ def _gen_sales(name: str, sf: float) -> HostTable:
         put("sales_price", sales_price)
         put("ext_discount_amt", ext_discount)
         put("ext_sales_price", ext_sales)
+        put("ext_wholesale_cost", ext_whole)
+        put("ext_list_price", ext_list)
         put("ext_ship_cost", np.round(ext_list * 0.1, 2))
         put("coupon_amt", coupon)
         put("net_paid", net_paid)
@@ -990,6 +996,8 @@ def _gen_sales(name: str, sf: float) -> HostTable:
         put("sales_price", sales_price)
         put("ext_discount_amt", ext_discount)
         put("ext_sales_price", ext_sales)
+        put("ext_list_price", ext_list)
+        put("ext_wholesale_cost", ext_whole)
         put("ext_ship_cost", np.round(ext_list * 0.1, 2))
         put("net_paid", net_paid)
         put("net_profit", net_profit)

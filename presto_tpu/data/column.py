@@ -245,15 +245,27 @@ class Decimal128Column:
             v, n = v[:num_rows], n[:num_rows]
         return v, n
 
+    def _host(self):
+        """One host transfer per lane, memoized (value_at is called per
+        row by to_pylist / wire encode loops)."""
+        cached = getattr(self, "_host_cache", None)
+        if cached is None:
+            cached = (np.asarray(self.hi), np.asarray(self.lo),
+                      np.asarray(self.nulls),
+                      None if self.count is None
+                      else np.asarray(self.count))
+            object.__setattr__(self, "_host_cache", cached)
+        return cached
+
     def value_at(self, i: int):
         """Exact python value of row i (scaled down per the type)."""
-        if bool(np.asarray(self.nulls)[i]):
+        hi, lo, nulls, count = self._host()
+        if bool(nulls[i]):
             return None
-        unscaled = ((int(np.asarray(self.hi)[i]) << 32)
-                    + int(np.asarray(self.lo)[i]))
+        unscaled = (int(hi[i]) << 32) + int(lo[i])
         scale = self.type.scale
         if self.count is not None:
-            n = int(np.asarray(self.count)[i])
+            n = int(count[i])
             if n == 0:
                 return None
             # avg = sum/n rounded HALF_UP at the result scale

@@ -114,9 +114,28 @@ class Executor:
     def _execute_writer(self, node: TableWriterNode) -> Page:
         """Writer root: run the source pipeline on device, then sink the
         rows host-side (ConnectorPageSink role) and emit the count row
-        (TableWriterOperator's output contract)."""
+        (TableWriterOperator's output contract). `column_names` maps the
+        source outputs onto the target schema (missing columns
+        NULL-fill), so a coordinator plan whose writer column order
+        differs from the table layout still writes correctly."""
         page = self._execute_tree(node.source)
         rows = self._page_rows(page)
+        schema = self.connector.schema(node.table)
+        names = [c for c, _t in schema]
+        cols = list(node.column_names) or list(page.names)
+        if rows and len(rows[0]) != len(cols):
+            raise ValueError(
+                f"writer arity {len(rows[0])} != declared columns "
+                f"{len(cols)}")
+        if cols != names:
+            unknown = [c for c in cols if c not in names]
+            if unknown:
+                raise ValueError(
+                    f"writer columns not in table {node.table!r}: "
+                    f"{unknown}")
+            pos = {c: i for i, c in enumerate(cols)}
+            rows = [tuple(r[pos[c]] if c in pos else None
+                          for c in names) for r in rows]
         n = self.connector.append_rows(node.table, rows)
         out_col = Column.from_numpy(
             __import__("numpy").array([n], dtype="int64"),

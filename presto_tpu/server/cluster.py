@@ -196,7 +196,8 @@ class TpuCluster:
             self._plans[sql] = self.planner.plan_query(parse_sql(sql))
         return self._plans[sql]
 
-    def execute_sql(self, sql: str) -> List[tuple]:
+    def execute_sql(self, sql: str,
+                    _capture: bool = False) -> List[tuple]:
         from presto_tpu.utils.tracing import query_lifecycle
 
         with self._lock:
@@ -212,7 +213,8 @@ class TpuCluster:
                 if head in ("create", "insert", "drop"):
                     box[0] = self._execute_write(sql)
                 else:
-                    box[0] = self._execute_plan(self.plan_sql(sql))
+                    box[0] = self._execute_plan(self.plan_sql(sql),
+                                                capture=_capture)
         return box[0]
 
     def _execute_write(self, sql: str) -> List[tuple]:
@@ -274,11 +276,24 @@ class TpuCluster:
                     types.append(t)
             plan = ProjectNode(tuple(names), tuple(types), plan,
                                tuple(exprs))
+        schema = conn.schema(stmt.name)
+        if not getattr(stmt, "columns", None) \
+                and len(plan.output_types) != len(schema):
+            raise AnalysisError(
+                f"INSERT arity {len(plan.output_types)} != table "
+                f"{len(schema)}")
+        # positional semantics: the i-th SELECT output feeds the i-th
+        # table column (the column-list case pre-projected to schema
+        # order above)
         writer = TableWriterNode(("rows",), (BIGINT,), source=plan,
                                  table=stmt.name,
-                                 column_names=plan.output_names)
+                                 column_names=tuple(
+                                     c for c, _t in schema))
         try:
-            counts = self._execute_plan(writer)
+            # NON-idempotent: never auto-retried (a partial write on a
+            # surviving worker would duplicate rows; reference: streaming
+            # INSERT failures fail the query)
+            counts = self._execute_plan_once(writer)
         except Exception:
             if isinstance(stmt, A.CreateTableAs):
                 conn.drop(stmt.name, if_exists=True)   # no partial CTAS
@@ -290,11 +305,7 @@ class TpuCluster:
         from the workers' TaskInfo stats trees (the coordinator's
         EXPLAIN ANALYZE surface over the wire). Stats capture adds one
         TaskInfo GET per task, so it is gated to this entry point."""
-        self._capture_stats = True
-        try:
-            rows = self.execute_sql(sql)
-        finally:
-            self._capture_stats = False
+        rows = self.execute_sql(sql, _capture=True)
         by_frag: Dict[int, Dict[str, list]] = {}
         for fid, info in getattr(self, "last_task_infos", []):
             stats = info.get("stats") or {}
@@ -315,21 +326,23 @@ class TpuCluster:
                     f"across {ntasks} task(s)")
         return "\n".join(lines)
 
-    def _execute_plan(self, plan: PlanNode, _retried: bool = False
-                      ) -> List[tuple]:
+    def _execute_plan(self, plan: PlanNode, _retried: bool = False,
+                      capture: bool = False) -> List[tuple]:
         """Streaming-mode recovery (reference: a worker failure fails the
         query; the dispatcher retries on the surviving nodes once the
         failure detector excludes the dead worker)."""
         try:
-            return self._execute_plan_once(plan)
+            return self._execute_plan_once(plan, capture=capture)
         except (ClusterQueryError, OSError):
             before = set(self.worker_uris)
             alive = set(self.check_workers())
             if _retried or alive == before or not alive:
                 raise
-            return self._execute_plan(plan, _retried=True)
+            return self._execute_plan(plan, _retried=True,
+                                      capture=capture)
 
-    def _execute_plan_once(self, plan: PlanNode) -> List[tuple]:
+    def _execute_plan_once(self, plan: PlanNode,
+                           capture: bool = False) -> List[tuple]:
         # Uncorrelated scalar subqueries execute through the cluster
         # itself (recursively), not a local engine: distributed partial/
         # final aggregation orders float summation differently, and a
@@ -343,10 +356,12 @@ class TpuCluster:
         ex_plan = _derange(add_exchanges(_unshare(plan), self.connector,
                                          session, self.history))
         frags = create_fragments(ex_plan)
-        return self._run_fragments(frags, list(plan.output_types))
+        return self._run_fragments(frags, list(plan.output_types),
+                                   capture=capture)
 
     # ------------------------------------------------------------------
-    def _run_fragments(self, frags, out_types) -> List[tuple]:
+    def _run_fragments(self, frags, out_types,
+                       capture: bool = False) -> List[tuple]:
         with self._lock:
             self._query_counter += 1
             qid = f"q{self._query_counter}_{int(time.time())}"
@@ -417,7 +432,7 @@ class TpuCluster:
         try:
             schedule(0)
             self._await_all(stages)
-            if getattr(self, "_capture_stats", False):
+            if capture:
                 self._capture_task_infos(stages)
             return self._collect_root(stages[0], out_types)
         finally:
