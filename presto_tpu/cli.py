@@ -1,0 +1,103 @@
+"""presto-tpu CLI — interactive shell over the statement REST protocol.
+
+Reference role: presto-cli (presto-cli/.../Console.java:67) on the
+client protocol (StatementClientV1). Usage:
+
+    python -m presto_tpu.cli --server http://127.0.0.1:8080
+    python -m presto_tpu.cli --execute "select 1" --server ...
+    python -m presto_tpu.cli --local tpch:0.01   # embedded engine
+
+`--local connector:scale` skips the server and runs an in-process
+LocalEngine (the LocalQueryRunner convenience)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _render(columns, rows) -> str:
+    if columns is None:
+        columns = [{"name": f"_col{i}"}
+                   for i in range(len(rows[0]) if rows else 0)]
+    names = [c["name"] for c in columns]
+    cells = [[("NULL" if v is None else str(v)) for v in r] for r in rows]
+    widths = [max([len(n)] + [len(r[i]) for r in cells])
+              for i, n in enumerate(names)]
+    sep = "-+-".join("-" * w for w in widths)
+    out = [" | ".join(n.ljust(w) for n, w in zip(names, widths)), sep]
+    for r in cells:
+        out.append(" | ".join(v.ljust(w) for v, w in zip(r, widths)))
+    out.append(f"({len(rows)} row{'s' if len(rows) != 1 else ''})")
+    return "\n".join(out)
+
+
+def _local_engine(spec: str):
+    from presto_tpu.connectors import (
+        MemoryConnector, TpcdsConnector, TpchConnector,
+    )
+    from presto_tpu.exec.engine import LocalEngine
+    name, _, scale = spec.partition(":")
+    sf = float(scale or "0.01")
+    conn = {"tpch": TpchConnector, "tpcds": TpcdsConnector}.get(name)
+    if conn is None:
+        raise SystemExit(f"unknown local connector {name!r}")
+    return LocalEngine(MemoryConnector(fallback=conn(sf)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="presto-tpu")
+    ap.add_argument("--server", help="coordinator URI "
+                    "(http://host:port with /v1/statement)")
+    ap.add_argument("--local", help="embedded engine: connector[:scale]")
+    ap.add_argument("--execute", "-e", help="run one statement and exit")
+    args = ap.parse_args(argv)
+    if not args.server and not args.local:
+        ap.error("one of --server or --local is required")
+
+    if args.local:
+        engine = _local_engine(args.local)
+
+        def run(sql):
+            rows = engine.execute_sql(sql)
+            try:
+                names = engine.plan_sql(sql).output_names
+                cols = [{"name": n} for n in names]
+            except Exception:   # noqa: BLE001 — DDL
+                cols = None
+            return cols, rows
+    else:
+        from presto_tpu.server.statement import run_statement
+
+        def run(sql):
+            return run_statement(args.server, sql)
+
+    if args.execute:
+        cols, rows = run(args.execute)
+        print(_render(cols, rows))
+        return 0
+
+    print("presto-tpu> interactive shell; end statements with ';', "
+          "quit/exit to leave")
+    buf = []
+    while True:
+        try:
+            line = input("presto-tpu> " if not buf else "        ...> ")
+        except EOFError:
+            break
+        if not buf and line.strip().lower() in ("quit", "exit"):
+            break
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            sql = "\n".join(buf).rstrip().rstrip(";")
+            buf = []
+            try:
+                cols, rows = run(sql)
+                print(_render(cols, rows))
+            except Exception as e:   # noqa: BLE001 — REPL keeps going
+                print(f"error: {e}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,8 +152,9 @@ TPCDS_SCHEMA: Dict[str, List[Tuple[str, Type]]] = {
         ("ws_order_number", BIGINT), ("ws_quantity", INTEGER),
         ("ws_wholesale_cost", DOUBLE), ("ws_list_price", DOUBLE),
         ("ws_sales_price", DOUBLE), ("ws_ext_discount_amt", DOUBLE),
-        ("ws_ext_sales_price", DOUBLE), ("ws_ext_list_price", DOUBLE),
+        ("ws_ext_sales_price", DOUBLE),
         ("ws_ext_wholesale_cost", DOUBLE),
+        ("ws_ext_list_price", DOUBLE),
         ("ws_ext_ship_cost", DOUBLE),
         ("ws_net_paid", DOUBLE), ("ws_net_profit", DOUBLE),
     ],

@@ -523,11 +523,24 @@ class TpuCluster:
 
     def _await_all(self, stages: Dict[int, _Stage],
                    timeout_s: float = 1800):
+        """Long-poll every task CONCURRENTLY (reference: one
+        ContinuousTaskStatusFetcher per task) — a straggler in one stage
+        no longer hides a failure in another, and N tasks cost one
+        round-trip time per sweep instead of N."""
         deadline = time.time() + timeout_s
-        for stage in stages.values():
-            for uri in stage.task_uris:
-                state = "PLANNED"
+        uris = [u for st in stages.values() for u in st.task_uris]
+        results: Dict[str, Optional[dict]] = {}
+        errs: Dict[str, BaseException] = {}
+        wake = threading.Event()          # first failure OR all done
+        remaining = [len(uris)]
+        lock = threading.Lock()
+
+        def watch(uri: str):
+            state = "PLANNED"
+            try:
                 while state in ("PLANNED", "RUNNING"):
+                    if wake.is_set() and errs:
+                        return            # another task already failed
                     if time.time() > deadline:
                         raise ClusterQueryError(f"timeout on {uri}")
                     req = urllib.request.Request(
@@ -537,11 +550,34 @@ class TpuCluster:
                     with urllib.request.urlopen(req, timeout=30) as resp:
                         st = json.loads(resp.read())
                     state = st["state"]
+                results[uri] = st
                 if state != "FINISHED":
                     msgs = [f.get("message", "") for f in
                             st.get("failures", [])]
                     raise ClusterQueryError(
                         f"task {uri} {state}: " + "\n".join(msgs))
+            except BaseException as e:    # noqa: BLE001 — re-raised below
+                errs[uri] = e
+                wake.set()                # fail fast
+            finally:
+                with lock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        wake.set()
+
+        threads = [threading.Thread(target=watch, args=(u,), daemon=True)
+                   for u in uris]
+        for t in threads:
+            t.start()
+        # wake on the FIRST failure (fail-fast) or when every watcher
+        # finished; stragglers are daemons and die with their long-poll
+        wake.wait(max(0.0, deadline - time.time()) + 60)
+        for uri, e in errs.items():
+            raise e if isinstance(e, (ClusterQueryError, OSError)) \
+                else ClusterQueryError(f"task {uri}: {e}")
+        for uri in uris:
+            if results.get(uri) is None:
+                raise ClusterQueryError(f"no status from {uri}")
 
     def _collect_root(self, root: _Stage, out_types) -> List[tuple]:
         rows: List[tuple] = []

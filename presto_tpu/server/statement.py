@@ -1,0 +1,237 @@
+"""Client statement protocol: POST /v1/statement + nextUri polling.
+
+Reference: QueuedStatementResource / ExecutingStatementResource
+(presto-main/.../server/protocol/QueuedStatementResource.java:213,
+ExecutingStatementResource.java) and the client contract in
+presto-client/.../StatementClientV1.java:365 — a client POSTs SQL,
+receives a QueryResults JSON with a `nextUri`, and polls it until
+`nextUri` disappears; `columns` + `data` batches carry the rows, and
+`stats.state` tracks QUEUED -> RUNNING -> FINISHED/FAILED.
+
+This is the L0 surface over TpuCluster: queries run on a background
+thread (the dispatcher role), results buffer per query, and each GET
+serves one data batch."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+_EXECUTING = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
+_QUEUED = re.compile(r"^/v1/statement/queued/([^/]+)/(\d+)$")
+_CANCEL = re.compile(r"^/v1/statement/executing/([^/]+)$")
+
+_BATCH_ROWS = 4096
+
+
+def _type_name(t) -> str:
+    return str(t)
+
+
+class _Query:
+    def __init__(self, qid: str, sql: str):
+        self.qid = qid
+        self.sql = sql
+        self.state = "QUEUED"
+        self.error: Optional[str] = None
+        self.columns: Optional[List[dict]] = None
+        self.rows: List[tuple] = []
+        self.done = threading.Event()
+        self.cancelled = False
+
+    def run(self, engine):
+        self.state = "RUNNING"
+        try:
+            rows = engine.execute_sql(self.sql)
+            names = ()
+            types = ()
+            try:
+                plan = engine.plan_sql(self.sql)
+                names, types = plan.output_names, plan.output_types
+            except Exception:   # noqa: BLE001 — DDL has no plan
+                pass
+            if not names:
+                names = tuple(f"_col{i}"
+                              for i in range(len(rows[0]) if rows else 1))
+                types = ()
+            self.columns = [
+                {"name": n,
+                 "type": _type_name(types[i]) if i < len(types)
+                 else "unknown"}
+                for i, n in enumerate(names)]
+            self.rows = [
+                [None if v is None else
+                 (float(v) if type(v).__name__ == "Decimal" else v)
+                 for v in r] for r in rows]
+            self.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 — rendered to the client
+            self.error = f"{type(e).__name__}: {e}"[:500]
+            self.state = "FAILED"
+        finally:
+            if self.cancelled:
+                # the engine call itself is not interruptible; report
+                # the cancellation honestly instead of a silent FINISH
+                self.state = "FAILED"
+                self.error = "Query was canceled by the user"
+                self.rows = []
+            self.done.set()
+
+    def results_json(self, base: str, token: int) -> dict:
+        out = {
+            "id": self.qid,
+            "infoUri": f"{base}/v1/query/{self.qid}",
+            "stats": {"state": self.state, "queued": self.state == "QUEUED",
+                      "scheduled": self.state != "QUEUED"},
+        }
+        if self.state == "FAILED":
+            out["error"] = {"message": self.error,
+                            "errorName": "GENERIC_INTERNAL_ERROR",
+                            "errorType": "INTERNAL_ERROR"}
+            return out
+        if self.state != "FINISHED":
+            out["nextUri"] = \
+                f"{base}/v1/statement/executing/{self.qid}/{token}"
+            return out
+        # FINISHED: serve data batches; nextUri until drained
+        if self.columns is not None:
+            out["columns"] = self.columns
+        lo = token * _BATCH_ROWS
+        hi = lo + _BATCH_ROWS
+        batch = self.rows[lo:hi]
+        if batch:
+            out["data"] = batch
+        if hi < len(self.rows):
+            out["nextUri"] = \
+                f"{base}/v1/statement/executing/{self.qid}/{token + 1}"
+        else:
+            # final batch served: release the buffered result (queries
+            # stay listed for /v1/query info, rows do not accumulate)
+            self.rows = []
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code: int, obj: dict):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path.split("?")[0] != "/v1/statement":
+            return self._json(404, {"error": "no route"})
+        length = int(self.headers.get("Content-Length", 0))
+        sql = self.rfile.read(length).decode()
+        q = self.server.coordinator.submit(sql)
+        return self._json(200, q.results_json(self.server.base, 0))
+
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        m = _EXECUTING.match(path) or _QUEUED.match(path)
+        if m:
+            q = self.server.coordinator.queries.get(m.group(1))
+            if q is None:
+                return self._json(404, {"error": "no query"})
+            # long-poll briefly while the query runs
+            q.done.wait(timeout=1.0)
+            return self._json(200, q.results_json(self.server.base,
+                                                  int(m.group(2))))
+        if path.startswith("/v1/query/"):
+            q = self.server.coordinator.queries.get(path.rsplit("/", 1)[-1])
+            if q is None:
+                return self._json(404, {"error": "no query"})
+            return self._json(200, {"queryId": q.qid, "state": q.state,
+                                    "query": q.sql,
+                                    "error": q.error})
+        return self._json(404, {"error": f"no route {path}"})
+
+    def do_DELETE(self):
+        m = _CANCEL.match(self.path.split("?")[0])
+        if m:
+            q = self.server.coordinator.queries.get(m.group(1))
+            if q is not None:
+                q.cancelled = True
+            self.send_response(204)      # no body with 204
+            self.end_headers()
+            return
+        return self._json(404, {"error": "no route"})
+
+
+class StatementServer:
+    """The coordinator's client-facing HTTP surface over any engine with
+    execute_sql/plan_sql (TpuCluster or LocalEngine)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self.queries: Dict[str, _Query] = {}
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.coordinator = self
+        self.port = self.httpd.server_address[1]
+        self.base = f"http://{host}:{self.port}"
+        self.httpd.base = self.base
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    #: completed queries kept for /v1/query info (QueryTracker role)
+    MAX_TRACKED = 200
+
+    def submit(self, sql: str) -> _Query:
+        qid = f"{uuid.uuid4().hex[:16]}"
+        q = _Query(qid, sql)
+        self.queries[qid] = q
+        if len(self.queries) > self.MAX_TRACKED:
+            # FIFO-evict finished queries (dict preserves insertion order)
+            for old_id in list(self.queries):
+                if len(self.queries) <= self.MAX_TRACKED:
+                    break
+                if self.queries[old_id].done.is_set():
+                    del self.queries[old_id]
+        threading.Thread(target=q.run, args=(self.engine,),
+                         daemon=True).start()
+        return q
+
+    def start(self) -> "StatementServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def run_statement(base_uri: str, sql: str, timeout_s: float = 600):
+    """Client side of the protocol (StatementClientV1.advance loop):
+    POST, then follow nextUri until it disappears; returns
+    (columns, rows). Raises on FAILED."""
+    import time
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{base_uri}/v1/statement", data=sql.encode(), method="POST",
+        headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        payload = json.loads(resp.read())
+    columns, rows = None, []
+    deadline = time.time() + timeout_s
+    while True:
+        if "error" in payload:
+            raise RuntimeError(payload["error"]["message"])
+        if payload.get("columns"):
+            columns = payload["columns"]
+        rows.extend(payload.get("data", []))
+        nxt = payload.get("nextUri")
+        if not nxt:
+            return columns, rows
+        if time.time() > deadline:
+            raise TimeoutError(f"query {payload.get('id')} timed out")
+        with urllib.request.urlopen(nxt, timeout=30) as resp:
+            payload = json.loads(resp.read())

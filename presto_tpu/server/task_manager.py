@@ -50,6 +50,30 @@ def _scan_tables(frag: S.PlanFragment) -> Dict[str, str]:
     return out
 
 
+def _fragment_has_remote_sources(frag: S.PlanFragment) -> bool:
+    """Does the protocol fragment contain any RemoteSourceNode (it then
+    needs remote splits before starting)?"""
+    found = [False]
+
+    def walk(n):
+        if isinstance(n, S.RemoteSourceNode):
+            found[0] = True
+        if isinstance(n, S.RawNode):
+            return
+        for py, _js, codec in type(n)._SCHEMA:
+            v = getattr(n, py)
+            if v is None:
+                continue
+            if codec is S.PlanNode:
+                walk(v)
+            elif isinstance(codec, tuple) and len(codec) == 2 \
+                    and codec[1] is S.PlanNode and isinstance(v, list):
+                for c in v:
+                    walk(c)
+    walk(frag.root)
+    return found[0]
+
+
 def _remote_source_nodes(plan) -> List[RemoteSourceNode]:
     """Engine-plan walk: every RemoteSourceNode (pull inputs)."""
     out: List[RemoteSourceNode] = []
@@ -292,8 +316,20 @@ class TpuTaskManager:
                             (int(cs.get("part", 0)),
                              int(cs.get("numParts", 1))))
                 task.pending_splits = []
-            start = (task.fragment is not None and task.no_more_splits
+            # A fragment with NO source nodes (pure VALUES / SELECT
+            # without FROM) never receives a TaskSource, so no
+            # noMoreSplits signal arrives — it is startable as soon as
+            # the fragment and output buffers exist (the reference's
+            # SqlTaskExecution treats a task with zero pending splits
+            # per lifecycle the same way).
+            sourceless = (task.fragment is not None
+                          and not task.scan_tables
+                          and not _fragment_has_remote_sources(
+                              task.fragment))
+            start = (task.fragment is not None
+                     and (task.no_more_splits or sourceless)
                      and not task.pending_splits
+                     and task.buffers is not None
                      and task.state == "PLANNED")
             if start:
                 task.set_state("RUNNING")
