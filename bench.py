@@ -216,11 +216,12 @@ def _main_orchestrator(sf, qids) -> None:
         return
 
     timeout_s = float(os.environ.get("BENCH_QUERY_TIMEOUT", "2400"))
-    # join-heavy programs are known to OOM this environment's remote
-    # compile service (SIGKILL/EOF after ~10-40 min) — cap their attempts
-    # so the report doesn't stall on them; override via env to retry.
+    # Lifespan-batched join queries compile ~8 smaller programs through
+    # the remote service; a measured cold q3 takes ~23 min and tunnel
+    # contention can stretch it — give the same budget as whole-plan
+    # queries (the device probe already guards true wedges).
     join_timeout_s = float(os.environ.get("BENCH_JOIN_QUERY_TIMEOUT",
-                                          "900"))
+                                          "2400"))
     detail = {}
     for qid in qids:
         env = dict(os.environ, BENCH_CHILD="1", BENCH_QUERIES=str(qid))
