@@ -107,6 +107,46 @@ class MemoryConnector(SplitSource):
         with self._write_lock:
             return self._append_rows_locked(name, rows)
 
+    def move_table_rows(self, src: str, dst: str) -> int:
+        """Move every row of `src` into `dst` (identical schemas) by raw
+        array concatenation — no python-value round trip, so DECIMAL
+        limbs and dictionary codes stay exact. The staged-INSERT commit
+        step (reference: TableFinishOperator making sink writes visible
+        atomically). Drops `src`. Returns rows moved."""
+        from presto_tpu.data.column import merge_string_dicts
+        with self._write_lock:
+            s, t = self.tables[src], self.tables[dst]
+            n_new = s.num_rows
+            if n_new:
+                new_arrays: Dict[str, np.ndarray] = {}
+                new_dicts: Dict[str, StringDict] = dict(t.dicts)
+                new_nulls: Dict[str, np.ndarray] = {}
+                for c in t.column_names():
+                    typ = t.types[c]
+                    old_null = (t.nulls or {}).get(
+                        c, np.zeros(t.num_rows, dtype=bool))[:t.num_rows]
+                    src_null = (s.nulls or {}).get(
+                        c, np.zeros(n_new, dtype=bool))[:n_new]
+                    new_nulls[c] = np.concatenate([old_null, src_null])
+                    sa = s.arrays[c][:n_new]
+                    if typ.is_string:
+                        union, (remap_old, remap_new) = merge_string_dicts(
+                            [t.dicts[c], s.dicts[c]])
+                        old_codes = t.arrays[c][:t.num_rows]
+                        new_arrays[c] = np.concatenate([
+                            remap_old[old_codes] if len(remap_old)
+                            else old_codes,
+                            remap_new[sa] if len(remap_new) else sa])
+                        new_dicts[c] = union
+                    else:
+                        new_arrays[c] = np.concatenate(
+                            [t.arrays[c][:t.num_rows], sa])
+                self.tables[dst] = HostTable(
+                    dst, t.num_rows + n_new, new_arrays, t.types,
+                    new_dicts, new_nulls)
+            self.tables.pop(src, None)
+            return n_new
+
     def _append_rows_locked(self, name: str, rows: List[tuple]) -> int:
         t = self.tables[name]
         cols = t.column_names()
@@ -146,8 +186,11 @@ class MemoryConnector(SplitSource):
             else:
                 filled = [0 if v is None else v for v in vals]
                 if typ.is_decimal:
-                    arr = np.round(np.asarray(filled, np.float64)
-                                   * 10 ** typ.scale).astype(np.int64)
+                    # exact unscale, one shared rounding rule
+                    from presto_tpu.data.column import unscale_decimal
+                    arr = np.asarray(
+                        [unscale_decimal(v, typ.scale) for v in filled],
+                        np.int64)
                 else:
                     arr = np.asarray(filled, dtype=typ.dtype)
                 new_arrays[c] = np.concatenate(

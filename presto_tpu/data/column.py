@@ -27,6 +27,7 @@ downstream operator.
 from __future__ import annotations
 
 import dataclasses
+import decimal as _decimal
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -34,6 +35,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.types import Type, DecimalType, VARCHAR
+
+
+def scale_down_decimal(unscaled: int, scale: int) -> _decimal.Decimal:
+    """Unscaled int -> exact python Decimal at `scale`. THE conversion
+    for every decimal read path (never a float64 image; the reference
+    client protocol carries decimals as exact strings)."""
+    return _decimal.Decimal(unscaled).scaleb(-scale)
+
+
+def unscale_decimal(v, scale: int) -> int:
+    """Python value -> exact unscaled int at `scale`, HALF_UP (the
+    reference's decimal rounding, UnscaledDecimal128Arithmetic). One
+    shared definition so every write path rounds identically; floats go
+    through Decimal(str(v)) — their shortest decimal reading — never a
+    binary-scaled round()."""
+    if not isinstance(v, _decimal.Decimal):
+        v = _decimal.Decimal(str(v))
+    return int(v.scaleb(scale).to_integral_value(
+        rounding=_decimal.ROUND_HALF_UP))
 
 
 # Capacity buckets: pages are padded up to the next bucket so XLA compiles a
@@ -406,10 +426,13 @@ def _column_from_pylist(vals, t: Type, capacity: int):
     if t.is_string:
         return Column.from_strings(vals, capacity=capacity)
     nulls = np.array([v is None for v in vals], dtype=bool)
-    filled = np.array([0 if v is None else v for v in vals])
     if t.is_decimal:
-        filled = np.round(np.asarray(filled, dtype=np.float64)
-                          * (10 ** t.scale)).astype(np.int64)
+        # exact unscaling: Decimal values never round-trip through
+        # float64 (38-digit literals keep every digit)
+        filled = np.array([0 if v is None else unscale_decimal(v, t.scale)
+                           for v in vals], dtype=np.int64)
+    else:
+        filled = np.array([0 if v is None else v for v in vals])
     return Column.from_numpy(filled, t, nulls=nulls, capacity=capacity)
 
 
@@ -424,7 +447,7 @@ def _pyvalue(col, i: int):
         return (col.dictionary[int(v[i])]
                 if col.dictionary is not None else int(v[i]))
     if isinstance(col.type, DecimalType):
-        return int(v[i]) / (10 ** col.type.scale)
+        return scale_down_decimal(int(v[i]), col.type.scale)
     if col.type.name == "boolean":
         return bool(v[i])
     if col.type.is_floating:
@@ -503,7 +526,8 @@ class Page:
                     row.append(c.dictionary[int(v[i])]
                                if c.dictionary is not None else int(v[i]))
                 elif isinstance(c.type, DecimalType):
-                    row.append(int(v[i]) / (10 ** c.type.scale))
+                    row.append(scale_down_decimal(int(v[i]),
+                                                  c.type.scale))
                 elif c.type.name == "boolean":
                     row.append(bool(v[i]))
                 elif c.type.is_floating:

@@ -204,7 +204,12 @@ class LocalEngine:
                                 "INSERT VALUES must be literals")
                         v = lit.value
                         if v is not None and lit.type.is_decimal:
-                            v = v / 10 ** lit.type.scale
+                            # exact: append_rows re-unscales via
+                            # unscale_decimal, so no float64 round trip
+                            from presto_tpu.data.column import \
+                                scale_down_decimal
+                            v = scale_down_decimal(int(v),
+                                                   lit.type.scale)
                         vals.append(v)
                     rows.append(tuple(vals))
             if stmt.columns:

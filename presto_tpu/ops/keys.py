@@ -37,17 +37,34 @@ class SortKey:
 def _orderable_values(col: Column) -> jnp.ndarray:
     """Per-type array whose ascending order == SQL ascending order.
     Strings are already codes into a sorted dictionary. Decimal128
-    columns order by their float64 image — exact to 2^53, where ORDER BY
-    on 38-digit sums is ties-only beyond (values stay exact; only the
-    sort key is approximate)."""
+    columns order by their float64 image — exact to 2^53; ORDER BY
+    uses `_orderable_lanes` instead for exact 128-bit ordering."""
     from presto_tpu.data.column import Decimal128Column
     if isinstance(col, Decimal128Column):
-        return (col.hi.astype(jnp.float64) * float(1 << 32)
-                + col.lo.astype(jnp.float64))
+        img = (col.hi.astype(jnp.float64) * float(1 << 32)
+               + col.lo.astype(jnp.float64))
+        if col.count is not None:
+            img = img / jnp.maximum(col.count, 1).astype(jnp.float64)
+        return img
     v = col.values
     if v.dtype == jnp.bool_:
         return v.astype(jnp.int32)
     return v
+
+
+def _orderable_lanes(col: Column):
+    """Sort-key lanes, most-significant first; lexicographic comparison
+    of the lanes == SQL ascending order. Decimal128 SUMS sort exactly:
+    normalize the limb sums (lo accumulates unsigned 32-bit limbs, so
+    carry its overflow into hi), then (hi, lo) lexicographic IS value
+    order because lo lands in [0, 2^32). Averages (count set) keep the
+    float64 image of sum/count — a ratio has no per-row sort key that is
+    exact without division."""
+    from presto_tpu.data.column import Decimal128Column
+    if isinstance(col, Decimal128Column) and col.count is None:
+        carry = col.lo >> jnp.int64(32)      # lo >= 0: limb sums
+        return [col.hi + carry, col.lo & jnp.int64(0xFFFFFFFF)]
+    return [_orderable_values(col)]
 
 
 def group_values(col: Column) -> jnp.ndarray:
@@ -112,15 +129,17 @@ def sort_perm(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
     perm = jnp.arange(cap, dtype=jnp.int32)
     for k in reversed(list(keys)):
         col = page.columns[k.field]
-        v = _orderable_values(col)[perm]
-        if not k.ascending:
-            # Descending: sort on rank under reversed order. Negate where
-            # safe; for unsigned-ish codes negation is fine in int64.
-            v = -v.astype(jnp.int64) if v.dtype != jnp.float64 \
-                and v.dtype != jnp.float32 else -v
-        # Null placement: stable two-pass — first values, then null bucket.
-        s = jnp.argsort(v, stable=True)
-        perm = perm[s]
+        # Multi-lane keys (Decimal128): least-significant lane first,
+        # each pass a stable argsort, composing to lexicographic order.
+        for lane in reversed(_orderable_lanes(col)):
+            v = lane[perm]
+            if not k.ascending:
+                # Descending: sort on rank under reversed order. Negate
+                # where safe; codes/limbs negate fine in int64.
+                v = -v.astype(jnp.int64) if v.dtype != jnp.float64 \
+                    and v.dtype != jnp.float32 else -v
+            perm = perm[jnp.argsort(v, stable=True)]
+        # Null placement: stable two-pass — values first, then null bucket.
         n = col.nulls[perm]
         null_key = jnp.where(n, 0, 1) if k.nulls_sort_first else \
             n.astype(jnp.int32)

@@ -17,12 +17,13 @@ import jax
 import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
-from presto_tpu.ops.keys import SortKey, _orderable_values
+from presto_tpu.ops.keys import SortKey, _orderable_lanes
 
 
 def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
     """Lexicographic key operands for lax.sort: padding rows last, then
-    per-SortKey (null rank, order-transformed value)."""
+    per-SortKey (null rank, order-transformed value lanes — Decimal128
+    sums contribute two exact limb lanes, ops/keys._orderable_lanes)."""
     cap = page.capacity
     ops: List = [
         (jnp.arange(cap, dtype=jnp.int32) >= page.num_rows).astype(jnp.int8)]
@@ -32,11 +33,11 @@ def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
                               jnp.int8(0 if k.nulls_sort_first else 1),
                               jnp.int8(1 if k.nulls_sort_first else 0))
         ops.append(null_rank)
-        v = _orderable_values(col)
-        if not k.ascending:
-            v = -v.astype(jnp.int64) if not jnp.issubdtype(
-                v.dtype, jnp.floating) else -v
-        ops.append(v)
+        for v in _orderable_lanes(col):
+            if not k.ascending:
+                v = -v.astype(jnp.int64) if not jnp.issubdtype(
+                    v.dtype, jnp.floating) else -v
+            ops.append(v)
     return ops
 
 

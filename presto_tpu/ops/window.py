@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
 from presto_tpu.ops import scan as pscan
-from presto_tpu.ops.keys import SortKey, _orderable_values, \
+from presto_tpu.ops.keys import SortKey, _orderable_lanes, \
     group_values, values_equal
 from presto_tpu.types import BIGINT, DOUBLE, Type
 
@@ -59,17 +59,20 @@ def window_page(page: Page, partition_fields: Sequence[int],
         n_part_ops += 2
     n_order_ops = 0
     null_rank_of_null = []   # per order key: the rank value NULL rows get
+    order_lane_counts = []   # per order key: value lanes (Decimal128 = 2)
     for k in order_keys:
         c = page.columns[k.field]
         nr = jnp.int8(0 if k.nulls_sort_first else 1)
         null_rank_of_null.append(int(0 if k.nulls_sort_first else 1))
         key_ops.append(jnp.where(c.nulls, nr, jnp.int8(1) - nr))
-        v = _orderable_values(c)
-        if not k.ascending:
-            v = -v.astype(jnp.int64) if not jnp.issubdtype(
-                v.dtype, jnp.floating) else -v
-        key_ops.append(v)
-        n_order_ops += 2
+        lanes = _orderable_lanes(c)
+        order_lane_counts.append(len(lanes))
+        for v in lanes:
+            if not k.ascending:
+                v = -v.astype(jnp.int64) if not jnp.issubdtype(
+                    v.dtype, jnp.floating) else -v
+            key_ops.append(v)
+        n_order_ops += 1 + len(lanes)
 
     arg_fields = sorted({s.field for s in specs if s.field is not None})
     operands = tuple(key_ops) + (idx, valid)
@@ -85,21 +88,25 @@ def window_page(page: Page, partition_fields: Sequence[int],
     # ---- partition / peer boundaries from adjacent key compares.
     # The rank operand encodes nulls as `null_rank` (0 when nulls sort
     # first, else 1) — decode before comparing.
-    def changed(ops_start: int, count: int, null_ranks) -> jnp.ndarray:
+    def changed(ops_start: int, lane_counts, null_ranks) -> jnp.ndarray:
         ch = jnp.zeros((cap,), bool).at[0].set(True)
-        for i in range(count // 2):
-            n = s[ops_start + 2 * i] == null_ranks[i]
-            v = s[ops_start + 2 * i + 1]
-            same = (values_equal(v, jnp.roll(v, 1))
-                    & ~n & ~jnp.roll(n, 1)) \
-                | (n & jnp.roll(n, 1))
+        pos = ops_start
+        for nlanes, nrank in zip(lane_counts, null_ranks):
+            n = s[pos] == nrank
+            same_v = jnp.ones((cap,), bool)
+            for j in range(nlanes):
+                v = s[pos + 1 + j]
+                same_v = same_v & values_equal(v, jnp.roll(v, 1))
+            same = (same_v & ~n & ~jnp.roll(n, 1)) | (n & jnp.roll(n, 1))
             ch = ch | ~same
+            pos += 1 + nlanes
         return ch.at[0].set(True)
 
-    part_start = changed(1, n_part_ops, [1] * len(partition_fields)) \
+    part_start = changed(1, [1] * len(partition_fields),
+                         [1] * len(partition_fields)) \
         if n_part_ops else jnp.zeros((cap,), bool).at[0].set(True)
     peer_start = part_start | (
-        changed(1 + n_part_ops, n_order_ops, null_rank_of_null)
+        changed(1 + n_part_ops, order_lane_counts, null_rank_of_null)
         if n_order_ops else jnp.zeros((cap,), bool))
     has_order = bool(order_keys)
 

@@ -285,8 +285,20 @@ class TpuCluster:
         # positional semantics: the i-th SELECT output feeds the i-th
         # table column (the column-list case pre-projected to schema
         # order above)
+        # Atomic commit (reference: TableFinishOperator + ConnectorPageSink
+        # commit — writes become visible only when the whole query
+        # succeeds). CTAS targets are freshly created, so drop-on-failure
+        # already gives atomicity; INSERT into an existing table stages
+        # the task writes into a temp table and moves them into the
+        # target only after every fragment finished.
+        is_insert = not isinstance(stmt, A.CreateTableAs)
+        target = stmt.name
+        if is_insert:
+            import uuid
+            target = f"stage_{uuid.uuid4().hex[:12]}_{stmt.name}"
+            conn.create(target, list(schema))
         writer = TableWriterNode(("rows",), (BIGINT,), source=plan,
-                                 table=stmt.name,
+                                 table=target,
                                  column_names=tuple(
                                      c for c, _t in schema))
         try:
@@ -295,9 +307,26 @@ class TpuCluster:
             # INSERT failures fail the query)
             counts = self._execute_plan_once(writer)
         except Exception:
-            if isinstance(stmt, A.CreateTableAs):
+            if is_insert:
+                conn.drop(target, if_exists=True)      # discard the stage
+            else:
                 conn.drop(stmt.name, if_exists=True)   # no partial CTAS
             raise
+        if is_insert:
+            # commit: one locked raw-array move (exact decimals, no
+            # python-value round trip); any connector without the fast
+            # path takes the page route. The stage is always dropped.
+            try:
+                if hasattr(conn, "move_table_rows"):
+                    conn.move_table_rows(target, stmt.name)
+                else:
+                    t = conn.table(target)
+                    cap = max(int(t.num_rows), 1)
+                    page = t.page(columns=[c for c, _t in schema],
+                                  capacity=cap)
+                    conn.append_rows(stmt.name, page.to_pylist())
+            finally:
+                conn.drop(target, if_exists=True)
         return [(sum(int(r[0]) for r in counts if r[0] is not None),)]
 
     def explain_analyze_sql(self, sql: str) -> str:

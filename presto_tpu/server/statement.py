@@ -63,10 +63,18 @@ class _Query:
                  "type": _type_name(types[i]) if i < len(types)
                  else "unknown"}
                 for i, n in enumerate(names)]
+            # decimals travel as exact strings (the reference client
+            # protocol's decimal encoding, presto-client QueryResults).
+            # Keyed on the DECLARED column type, not the python value
+            # shape, so scale-0 decimals (which materialize as ints)
+            # encode identically to scaled ones.
+            dec_cols = {i for i, t in enumerate(types)
+                        if getattr(t, "is_decimal", False)}
             self.rows = [
                 [None if v is None else
-                 (float(v) if type(v).__name__ == "Decimal" else v)
-                 for v in r] for r in rows]
+                 (str(v) if i in dec_cols
+                  or type(v).__name__ == "Decimal" else v)
+                 for i, v in enumerate(r)] for r in rows]
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 — rendered to the client
             self.error = f"{type(e).__name__}: {e}"[:500]

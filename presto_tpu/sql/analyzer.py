@@ -2022,6 +2022,21 @@ class Planner:
                 return Literal(unscaled, DecimalType(prec, scale))
             v = int(e.text)
             return Literal(v, BIGINT)
+        if isinstance(e, ast.DecimalLit):
+            # always DECIMAL-typed, whatever the text shape ('10',
+            # '1e2', '3.14'); bad text is an analysis error
+            from decimal import Decimal as _D
+            try:
+                d = _D(e.text)
+                if not d.is_finite():
+                    raise ValueError
+            except Exception:
+                raise AnalysisError(
+                    f"invalid DECIMAL literal {e.text!r}")
+            scale = max(0, -d.as_tuple().exponent)
+            unscaled = int(d.scaleb(scale))
+            prec = max(len(str(abs(unscaled))), scale + 1)
+            return Literal(unscaled, DecimalType(prec, scale))
         if isinstance(e, ast.StringLit):
             return Literal(e.value, VARCHAR)
         if isinstance(e, ast.DateLit):
@@ -2089,7 +2104,9 @@ class Planner:
             for x in items:
                 v = x.value
                 if v is not None and x.type.is_decimal:
-                    v = v / 10 ** x.type.scale
+                    # exact: keep Decimal, never a binary-float image
+                    from presto_tpu.data.column import scale_down_decimal
+                    v = scale_down_decimal(int(v), x.type.scale)
                 vals.append(v)
             return Literal(vals, ArrayType(et))
         if isinstance(e, ast.ScalarSubquery):

@@ -152,10 +152,17 @@ class ArrayLit:
     items: Tuple["Expr", ...]
 
 
-Expr = Union[Ident, NumberLit, StringLit, DateLit, IntervalLit, NullLit,
-             UnaryOp, BinaryOp, Between, InList, InSubquery, Exists, Like,
-             IsNull, Case, Cast, Extract, FuncCall, WindowCall,
-             ScalarSubquery, ArrayLit, Star]
+@dataclasses.dataclass(frozen=True)
+class DecimalLit:
+    """DECIMAL 'text' — always DECIMAL-typed, even without a point
+    (reference: SqlBase.g4 DECIMAL_VALUE)."""
+    text: str
+
+
+Expr = Union[Ident, NumberLit, DecimalLit, StringLit, DateLit, IntervalLit,
+             NullLit, UnaryOp, BinaryOp, Between, InList, InSubquery,
+             Exists, Like, IsNull, Case, Cast, Extract, FuncCall,
+             WindowCall, ScalarSubquery, ArrayLit, Star]
 
 
 # ---- relations ------------------------------------------------------------

@@ -264,51 +264,67 @@ class Parser:
         return True
 
     @staticmethod
-    def _attach_set_ops(q, set_ops):
-        """Chain terms, lifting the trailing ORDER BY / LIMIT off the
-        LAST term onto the whole set expression (SQL binds them to the
-        combined result; parenthesized terms keep their own)."""
+    def _attach_set_ops(q, q_paren, set_ops):
+        """Chain terms onto `q`. The trailing ORDER BY / LIMIT of an
+        UNPARENTHESIZED last term binds to the whole set expression (the
+        reference's queryNoWith vs queryTerm distinction,
+        presto-parser SqlBase.g4 queryNoWith); a parenthesized term keeps
+        its own ORDER BY/LIMIT scoped inside (planned per-term by
+        _plan_select). A parenthesized HEAD with its own ORDER BY/LIMIT
+        (or WITH scope) is wrapped in SELECT * FROM (head) so those
+        clauses cannot be promoted to the combined result."""
         if not set_ops:
             return q
-        op, d, last = set_ops[-1]
-        order_by, limit = last.order_by, last.limit
-        set_ops[-1] = (op, d, dataclasses.replace(
-            last, order_by=(), limit=None))
+        op, d, last, last_paren = set_ops[-1]
+        order_by: tuple = ()
+        limit = None
+        if not last_paren:
+            order_by, limit = last.order_by, last.limit
+            set_ops[-1] = (op, d, dataclasses.replace(
+                last, order_by=(), limit=None), last_paren)
+        if q_paren and (q.order_by or q.limit is not None or q.ctes):
+            q = ast.Select(
+                items=(ast.SelectItem(ast.Star()),),
+                relations=(ast.SubqueryRef(q),))
         return dataclasses.replace(
-            q, set_ops=q.set_ops + tuple(set_ops),
+            q, set_ops=q.set_ops + tuple(
+                (o, dd, t) for o, dd, t, _p in set_ops),
             order_by=q.order_by or order_by,
             limit=q.limit if q.limit is not None else limit)
 
-    def _intersect_chain(self) -> ast.Select:
+    def _intersect_chain(self):
         # INTERSECT binds tighter than UNION/EXCEPT (SQL standard)
-        q = self._query_term()
+        q, paren = self._query_term()
         set_ops = []
         while self.peek().kind == "keyword" and \
                 self.peek().text == "intersect":
             self.next()
-            set_ops.append(("intersect", self._set_op_distinct(),
-                            self._query_term()))
-        return self._attach_set_ops(q, set_ops)
+            d = self._set_op_distinct()
+            set_ops.append(("intersect", d) + self._query_term())
+        if not set_ops:
+            return q, paren
+        return self._attach_set_ops(q, paren, set_ops), False
 
     def _set_op_expr(self) -> ast.Select:
-        q = self._intersect_chain()
+        q, paren = self._intersect_chain()
         set_ops = []
         while self.peek().kind == "keyword" and \
                 self.peek().text in ("union", "except"):
             op = self.next().text
-            set_ops.append((op, self._set_op_distinct(),
-                            self._intersect_chain()))
-        return self._attach_set_ops(q, set_ops)
+            d = self._set_op_distinct()
+            set_ops.append((op, d) + self._intersect_chain())
+        return self._attach_set_ops(q, paren, set_ops)
 
-    def _query_term(self) -> ast.Select:
+    def _query_term(self):
+        """Returns (query, parenthesized)."""
         if self.peek().kind == "op" and self.peek().text == "(" and \
                 self.peek(1).kind == "keyword" and \
                 self.peek(1).text in ("select", "with"):
             self.next()
             q = self.query()
             self.expect("op", ")")
-            return q
-        return self._select_body()
+            return q, True
+        return self._select_body(), False
 
     def _select_body(self) -> ast.Select:
         self.expect_kw("select")
@@ -623,6 +639,13 @@ class Parser:
         if t.kind == "string":
             self.next()
             return ast.StringLit(t.text)
+        if t.kind == "ident" and t.text.lower() == "decimal" \
+                and self.peek(1).kind == "string":
+            # DECIMAL '123.45' — exact, always DECIMAL-typed literal
+            # (reference: SqlBase.g4 DECIMAL_VALUE)
+            self.next()
+            s = self.expect("string")
+            return ast.DecimalLit(s.text)
         if t.kind == "op" and t.text == "(":
             self.next()
             if self.peek().kind == "keyword" and self.peek().text == "select":

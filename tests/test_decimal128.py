@@ -120,3 +120,39 @@ def test_negative_values_exact():
     exp = sum(Decimal(str(v)) for v in vals)
     assert Decimal(str(got[0][0])) == exp
     assert got[0][1] == len(vals)
+
+
+def test_order_by_decimal128_sum_is_exact():
+    """ORDER BY on 128-bit decimal sums must compare the full value, not
+    a float64 image: two sums that differ only below 2^53 must order
+    correctly (ADVICE r3: ops/keys sorted by the float image)."""
+    mem = MemoryConnector()
+    mem.create("dx", [("g", DecimalType(3, 0)),
+                      ("v", DecimalType(38, 0))])
+    # group 1 sums to 10^17 + 1, group 2 to 10^17 + 2: identical float64
+    # images (ulp at 1e17 is 16), distinguishable only in exact limbs.
+    base = 10 ** 17
+    mem.append_rows("dx", [
+        (1, float(base)), (1, 1.0),
+        (2, float(base)), (2, 2.0),
+        (3, float(base)), (3, 0.0),
+    ])
+    eng = LocalEngine(mem)
+    rows = eng.execute_sql(
+        "select g, sum(v) as s from dx group by g order by s desc")
+    assert [int(r[0]) for r in rows] == [2, 1, 3]
+    assert [int(r[1]) for r in rows] == [base + 2, base + 1, base]
+    rows = eng.execute_sql(
+        "select g, sum(v) as s from dx group by g order by s asc")
+    assert [int(r[0]) for r in rows] == [3, 1, 2]
+
+
+def test_insert_values_decimal_literal_exact():
+    """INSERT ... VALUES with a DECIMAL literal beyond 2^53 keeps every
+    digit (no float64 round trip on the literal write path)."""
+    mem = MemoryConnector()
+    mem.create("dv", [("v", DecimalType(38, 2))])
+    eng = LocalEngine(mem)
+    eng.execute_sql("INSERT INTO dv VALUES (DECIMAL '12345678901234567.89')")
+    rows = eng.execute_sql("SELECT v FROM dv")
+    assert rows == [(Decimal("12345678901234567.89"),)]

@@ -66,3 +66,47 @@ def test_like_escape(engine):
     rows = engine.execute_sql(
         "select count(*) from region where r_name like '!%' escape '!'")
     assert rows == [(0,)]
+
+
+# ---- round-4 ADVICE regressions -------------------------------------------
+
+@pytest.fixture(scope="module")
+def mem_engine():
+    from presto_tpu.connectors import MemoryConnector
+    from presto_tpu.types import BIGINT
+    c = MemoryConnector()
+    c.create("so_t", [("a", BIGINT)])
+    c.append_rows("so_t", [(1,), (2,), (3,)])
+    c.create("so_u", [("a", BIGINT)])
+    c.append_rows("so_u", [(9,), (8,), (7,)])
+    return LocalEngine(c)
+
+
+def test_parenthesized_setop_term_keeps_order_limit(mem_engine):
+    # per-branch LIMIT stays inside the parentheses (SqlBase.g4
+    # queryTerm scoping): 3 + 1 rows, not LIMIT 1 over the union
+    rows = mem_engine.execute_sql(
+        "SELECT a FROM so_t UNION ALL "
+        "(SELECT a FROM so_u ORDER BY a LIMIT 1)")
+    assert sorted(rows) == [(1,), (2,), (3,), (7,)]
+
+
+def test_parenthesized_first_setop_term_keeps_order_limit(mem_engine):
+    rows = mem_engine.execute_sql(
+        "(SELECT a FROM so_t ORDER BY a DESC LIMIT 1) "
+        "UNION ALL SELECT a FROM so_u")
+    assert sorted(rows) == [(3,), (7,), (8,), (9,)]
+
+
+def test_trailing_order_limit_binds_to_whole_union(mem_engine):
+    rows = mem_engine.execute_sql(
+        "SELECT a FROM so_t UNION ALL SELECT a FROM so_u "
+        "ORDER BY a LIMIT 2")
+    assert rows == [(1,), (2,)]
+
+
+def test_parenthesized_intersect_branches(mem_engine):
+    rows = mem_engine.execute_sql(
+        "(SELECT a FROM so_t ORDER BY a LIMIT 2) INTERSECT "
+        "(SELECT a FROM so_t ORDER BY a DESC LIMIT 2)")
+    assert rows == [(2,)]

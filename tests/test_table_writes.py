@@ -97,3 +97,31 @@ def test_writer_node_protocol_roundtrip():
     assert isinstance(plan, P.AggregationNode)      # TableFinish = sum
     assert isinstance(plan.source, P.TableWriterNode)
     assert plan.source.table == "dst"
+
+
+def test_failed_insert_leaves_table_unchanged(cluster):
+    """A failed INSERT must change nothing: task writes go to a staging
+    table and commit only after the whole query succeeds (reference:
+    TableFinishOperator commit semantics)."""
+    c, mem = cluster
+    c.execute_sql("CREATE TABLE t3 AS SELECT n_nationkey AS k FROM "
+                  "nation WHERE n_regionkey = 0")
+    before = c.execute_sql("SELECT count(*) FROM t3")
+
+    real = c._execute_plan_once
+
+    def partial_then_fail(plan, capture=False):
+        # simulate tasks that wrote part of their rows before a failure
+        stage = plan.table
+        assert stage != "t3", "INSERT must write to a staging table"
+        mem.append_rows(stage, [(999,)])
+        raise RuntimeError("injected worker failure")
+
+    c._execute_plan_once = partial_then_fail
+    try:
+        with pytest.raises(RuntimeError):
+            c.execute_sql("INSERT INTO t3 SELECT n_nationkey FROM nation")
+    finally:
+        c._execute_plan_once = real
+    assert c.execute_sql("SELECT count(*) FROM t3") == before
+    assert not [t for t in mem.tables if t.startswith("stage_")]
