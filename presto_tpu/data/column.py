@@ -672,16 +672,27 @@ def select_page_host(page: Page, idx: np.ndarray) -> Page:
 # Core page transforms (shared by operators)
 # ---------------------------------------------------------------------------
 
+def gather_page(page: Page, idx: jnp.ndarray,
+                valid: Optional[jnp.ndarray] = None,
+                num_rows=None, names: Optional[tuple] = None) -> Page:
+    """Row-wise gather of every column (rows where `valid` is False
+    become padding/null). THE payload-movement primitive: operators sort
+    only key lanes (ops/keys.lex_perm) and move data with this."""
+    cols = tuple(c.gather(idx, valid) for c in page.columns)
+    return Page(cols,
+                page.num_rows if num_rows is None else num_rows,
+                page.names if names is None else names)
+
+
 def compact(page: Page, keep: jnp.ndarray) -> Page:
     """Stable-partition rows where `keep` is True to the front; the result's
     num_rows is the survivor count. This is the engine's filter primitive.
 
-    Implemented as ONE multi-operand lax.sort that carries every column as
-    a payload of the order key. On TPU this matters enormously: a random
-    index gather is a serialized scatter/gather loop (~25 ns/row measured
-    on v5e — 0.4 s for a 16M-row column), while the sorting network moves
-    all payload lanes together (~9× faster for a 7-column page; the gap
-    widens with column count). Never argsort-then-gather on TPU.
+    Implemented as ONE 2-operand argsort on the order key + per-column
+    gathers: on this stack gathers compile in under a second and run at
+    memory bandwidth, while a lax.sort carrying every column as a payload
+    operand multiplies compile cost with column count (wide variadic
+    sorts are what OOM the remote compile service on join plans).
 
     Reference semantics: PageProcessor's filter
     (presto-main-base/.../operator/project/PageProcessor.java:56), re-expressed
@@ -694,48 +705,6 @@ def compact(page: Page, keep: jnp.ndarray) -> Page:
                  + jnp.arange(cap, dtype=jnp.int32))
     n = jnp.sum(keep).astype(jnp.int32)
     valid = jnp.arange(cap, dtype=jnp.int32) < n
-    operands = (order_key,)
-    for c in page.columns:
-        if isinstance(c, NestedColumn):
-            # row-wise lanes only; child buffers hold still (starts are
-            # absolute positions)
-            operands += (c.starts, c.lengths, c.nulls)
-        elif isinstance(c, Decimal128Column):
-            operands += tuple(c.row_lanes())
-        else:
-            operands += (c.values, c.nulls)
-    sorted_ops = jax.lax.sort(operands, num_keys=1, is_stable=False)
-    cols = []
-    pos = 1
-    for c in page.columns:
-        if isinstance(c, NestedColumn):
-            starts, lengths, nulls = sorted_ops[pos:pos + 3]
-            pos += 3
-            starts = jnp.where(valid, starts, 0)
-            lengths = jnp.where(valid, lengths, 0)
-            nulls = jnp.where(valid, nulls, True)
-            cols.append(NestedColumn(starts, lengths, nulls, c.children,
-                                     c.type))
-            continue
-        if isinstance(c, Decimal128Column):
-            k = len(c.row_lanes())
-            lanes = list(sorted_ops[pos:pos + k])
-            pos += k
-            lanes[0] = jnp.where(valid, lanes[0], 0)
-            lanes[1] = jnp.where(valid, lanes[1], 0)
-            lanes[2] = jnp.where(valid, lanes[2], True)
-            cols.append(c.from_lanes(lanes))
-            continue
-        vals, nulls = sorted_ops[pos:pos + 2]
-        pos += 2
-        sent = jnp.asarray(c.type.null_sentinel(), dtype=vals.dtype)
-        vals = jnp.where(valid, vals, sent)
-        nulls = jnp.where(valid, nulls, True)
-        cols.append(Column(vals, nulls, c.type, c.dictionary))
+    perm = jnp.argsort(order_key)        # distinct keys: stability free
+    cols = [c.gather(perm, valid) for c in page.columns]
     return Page(tuple(cols), n, page.names)
-
-
-def gather_page(page: Page, idx: jnp.ndarray, valid: jnp.ndarray,
-                num_rows) -> Page:
-    cols = tuple(c.gather(idx, valid) for c in page.columns)
-    return Page(cols, jnp.asarray(num_rows, dtype=jnp.int32), page.names)

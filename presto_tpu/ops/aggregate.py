@@ -384,46 +384,44 @@ def grouped_aggregate(page: Page, group_fields: Sequence[int],
 def _sorted_grouped_aggregate(page: Page, group_fields: Sequence[int],
                               aggs: Sequence[AggSpec], out_cap: int,
                               valid: jnp.ndarray):
-    """General (large-domain) grouping: ONE multi-operand lax.sort that
-    carries every page column as payload (never argsort+gather — random
-    gathers serialize on TPU), then contiguous-segment reductions via
-    blocked cumsum (ops/scan.py; scatter-adds also serialize on TPU).
+    """General (large-domain) grouping: sort a PERMUTATION by the group
+    key lanes (composed 2-operand argsorts, ops/keys.lex_perm), gather
+    the page by it, then contiguous-segment reductions via blocked
+    cumsum (ops/scan.py; scatter-adds serialize on TPU). Wide variadic
+    sorts carrying every column as payload are banned — their compile
+    cost explodes with operand count on this stack.
 
     Reference role: HashAggregationOperator over MultiChannelGroupByHash —
     re-expressed as sort + segment reduce because a probe-loop hash table
-    has no efficient TPU form, but a bitonic sort network does."""
-    import jax
-
+    has no efficient TPU form, but a sort network does."""
+    from presto_tpu.data.column import gather_page
     from presto_tpu.ops import scan as pscan
-    from presto_tpu.ops.keys import group_values, values_equal
+    from presto_tpu.ops.keys import group_values, lex_perm, values_equal
 
     cap = page.capacity
 
-    # Sort keys: invalid rows last, then per group field (nulls last,
-    # group-canonical value).
-    key_ops = [(~valid).astype(jnp.int8)]
-    for f in group_fields:
+    # Sort lanes: invalid rows last, then per group field (nulls last,
+    # group-canonical value). The invalid rank folds into the FIRST
+    # field's null rank (invalid > null > value) to save one pass.
+    inv_rank = (~valid).astype(jnp.int8)
+    lanes = []
+    for i, f in enumerate(group_fields):
         c = page.columns[f]
-        key_ops.append(c.nulls.astype(jnp.int8))
-        key_ops.append(group_values(c))
-    operands = tuple(key_ops) + (valid,)
-    for c in page.columns:
-        operands += (c.values, c.nulls)
-    sorted_ops = jax.lax.sort(operands, num_keys=len(key_ops),
-                              is_stable=False)
-    nk = len(key_ops)
-    gvalid = sorted_ops[nk]
-    sp_cols = tuple(
-        Column(sorted_ops[nk + 1 + 2 * i], sorted_ops[nk + 2 + 2 * i],
-               c.type, c.dictionary)
-        for i, c in enumerate(page.columns))
-    sp = Page(sp_cols, page.num_rows, page.names)
+        nrank = c.nulls.astype(jnp.int8)
+        lanes.append(inv_rank * 2 + nrank if i == 0 else nrank)
+        lanes.append(group_values(c))
+    if not group_fields:
+        lanes.append(inv_rank)
+    perm = lex_perm(lanes)
+    gvalid = valid[perm]
+    sp = gather_page(page, perm)
 
-    # New-group flags from adjacent compare on the sorted key operands.
+    # New-group flags from adjacent compare on the sorted key lanes.
     flags = jnp.zeros((cap,), dtype=bool).at[0].set(True)
-    for i in range(len(group_fields)):
-        n = sorted_ops[1 + 2 * i].astype(bool)
-        v = sorted_ops[2 + 2 * i]
+    for f in group_fields:
+        c = sp.columns[f]
+        n = c.nulls
+        v = group_values(c)
         prev_n = jnp.roll(n, 1)
         prev_v = jnp.roll(v, 1)
         # values_equal: NaN group keys compare equal (SQL grouping)

@@ -74,8 +74,6 @@ def merge_join(probe: Page, build: Page,
     (presto-main-base/.../operator/MergeOperator.java) fused with the
     LookupJoin contract (LookupJoinOperator.java:52).
     """
-    import jax
-
     from presto_tpu.ops.scan import fill_forward
 
     pcap, bcap = probe.capacity, build.capacity
@@ -95,58 +93,55 @@ def merge_join(probe: Page, build: Page,
     def cat(b, p):
         return jnp.concatenate([b, p])
 
-    # Sort keys: dead rows last, then per key column (null rank, value),
-    # then build-before-probe.
-    key_ops = [cat((~build.row_valid()).astype(jnp.int8),
-                   (~p_live).astype(jnp.int8))]
-    for pc, bc in zip(pcols, bcols):
-        key_ops.append(cat(bc.nulls, pc.nulls).astype(jnp.int8))
-        key_ops.append(cat(group_values(bc), group_values(pc)))
+    # Sort PERMUTATION via ops/keys.lex_perm (composed 2-operand stable
+    # argsorts): per key column (nulls, values), then build-before-probe
+    # tag least significant. NO wide variadic sort — on this stack
+    # lax.sort compile cost explodes with operand count (a ~20-operand
+    # sort at SF1 shapes never finishes compiling), while argsort +
+    # gather compiles in seconds and gathers run at memory bandwidth.
+    # Dead rows need no sort lane: propagation only flows from `present`
+    # build rows, and matches mask on the gathered null/live flags.
+    from presto_tpu.ops.keys import lex_perm
     tag = cat(jnp.zeros((bcap,), jnp.int8), jnp.ones((pcap,), jnp.int8))
-    key_ops.append(tag)
+    lanes = []
+    for pc, bc in zip(pcols, bcols):
+        lanes.append(cat(bc.nulls, pc.nulls))
+        lanes.append(cat(group_values(bc), group_values(pc)))
+    lanes.append(tag)
+    perm = lex_perm(lanes)
 
     present = cat(b_present, jnp.zeros((pcap,), bool))
-    src_pos = cat(jnp.arange(bcap, dtype=jnp.int32),
-                  jnp.arange(pcap, dtype=jnp.int32))
-    operands = tuple(key_ops) + (present, src_pos)
-    carry_build = join_type in ("inner", "left", "full")
-    if carry_build:
-        for c in build.columns:
-            operands += (cat(c.values, jnp.zeros((pcap,), c.values.dtype)),
-                         cat(c.nulls, jnp.ones((pcap,), bool)))
-    s = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=False)
-    nk = len(key_ops)
-    s_tag = s[nk - 1]
-    s_present = s[nk]
-    s_src = s[nk + 1]
-    is_probe = s_tag.astype(bool)
+    s_present = present[perm]
+    # Propagate (build source index + 1) forward: one scan yields both
+    # the candidate build row and the seen flag for every sorted slot.
+    src1 = cat(jnp.arange(1, bcap + 1, dtype=jnp.int32),
+               jnp.zeros((pcap,), jnp.int32))
+    ff = fill_forward(jnp.where(s_present, src1[perm], 0), s_present)
 
     # Duplicate live build keys: adjacent present build rows, equal keys.
     prev_present = jnp.roll(s_present, 1).at[0].set(False)
     same_key = jnp.ones((cap,), bool)
-    for i in range(len(probe_fields)):
-        kv = s[2 + 2 * i]
-        kn = s[1 + 2 * i].astype(bool)
+    s_kv = []     # sorted key lanes (value, null) per key — reused below
+    for pc, bc in zip(pcols, bcols):
+        kv = cat(group_values(bc), group_values(pc))[perm]
+        kn = cat(bc.nulls, pc.nulls)[perm]
+        s_kv.append((kv, kn))
         same_key = same_key & values_equal(kv, jnp.roll(kv, 1)) & ~kn \
             & ~jnp.roll(kn, 1)
     dup_count = jnp.sum(s_present & prev_present & same_key
                         ).astype(jnp.int64)
 
-    # Propagate build key + payload to following slots.
-    seen = fill_forward(s_present.astype(jnp.int8), s_present) > 0
-    match = is_probe & seen
-    for i in range(len(probe_fields)):
-        kv = s[2 + 2 * i]
-        kn = s[1 + 2 * i].astype(bool)
-        ffv = fill_forward(kv, s_present)
-        match = match & values_equal(ffv, kv) & ~kn
-    ff_payload = []
-    if carry_build:
-        for j in range(len(build.columns)):
-            vals = s[nk + 2 + 2 * j]
-            nulls = s[nk + 3 + 2 * j]
-            ff_payload.append((fill_forward(vals, s_present),
-                               fill_forward(nulls, s_present)))
+    # Restore probe order by inverting the permutation: probe row j sits
+    # at sorted slot inv[bcap + j].
+    inv = jnp.argsort(perm)
+    q = inv[bcap:]                               # [pcap]
+    ffq = ff[q]
+    bidx = jnp.maximum(ffq - 1, 0)               # candidate build row
+    match_p = (ffq > 0) & p_live & ~p_null
+    for pc, bc in zip(pcols, bcols):
+        bv = group_values(bc)[bidx]
+        bn = bc.nulls[bidx]
+        match_p = match_p & values_equal(group_values(pc), bv) & ~bn
 
     # FULL outer also needs per-BUILD-row matched flags: a present build
     # row is matched iff its key run contains a live non-null-key probe
@@ -155,41 +150,29 @@ def merge_join(probe: Page, build: Page,
     b_matched = None
     if join_type == "full":
         from presto_tpu.ops.scan import cumsum as bl_cumsum
+        from presto_tpu.ops.scan import fill_backward
 
+        is_probe = tag[perm].astype(bool)
         any_key_null = jnp.zeros((cap,), bool)
-        for i in range(len(probe_fields)):
-            any_key_null = any_key_null | s[1 + 2 * i].astype(bool)
         run_start = jnp.zeros((cap,), bool).at[0].set(True)
-        for i in range(len(probe_fields)):
-            kv = s[2 + 2 * i]
-            kn = s[1 + 2 * i].astype(bool)
+        for kv, kn in s_kv:
+            any_key_null = any_key_null | kn
             same = (values_equal(kv, jnp.roll(kv, 1))
                     & ~kn & ~jnp.roll(kn, 1)) \
                 | (kn & jnp.roll(kn, 1))
             run_start = run_start | ~same
         run_start = run_start.at[0].set(True)
-        s_live = s[0] == 0                 # dead-last rank, sorted
+        s_live = cat(build.row_valid(), p_live)[perm]
         probe_contrib = (is_probe & s_live & ~any_key_null
                          ).astype(jnp.int32)
         cs_p = bl_cumsum(probe_contrib)
-        from presto_tpu.ops.scan import fill_backward, fill_forward as ff
-        before_run = ff(jnp.where(run_start, cs_p - probe_contrib, 0),
-                        run_start)
+        before_run = fill_forward(
+            jnp.where(run_start, cs_p - probe_contrib, 0), run_start)
         run_end = jnp.roll(run_start, -1).at[-1].set(True)
         at_run_end = fill_backward(jnp.where(run_end, cs_p, 0), run_end)
         probes_in_run = at_run_end - before_run
         b_matched_cat = s_present & (probes_in_run > 0)
-        back_ops_b = ((1 - s_tag).astype(jnp.int8), s_src, b_matched_cat)
-        bb = jax.lax.sort(back_ops_b, num_keys=2, is_stable=False)
-        b_matched = bb[2][pcap:]           # build rows, original order
-
-    # Restore probe order; carry only per-probe results.
-    back_keys = ((1 - s_tag).astype(jnp.int8), s_src)
-    back_ops = back_keys + (match,)
-    for fv, fn in ff_payload:
-        back_ops += (fv, fn)
-    b2 = jax.lax.sort(back_ops, num_keys=2, is_stable=False)
-    match_p = b2[2][:pcap]
+        b_matched = b_matched_cat[inv[:bcap]]    # build original order
 
     if join_type in ("semi", "anti", "anti_exists"):
         if join_type == "semi":
@@ -203,15 +186,11 @@ def merge_join(probe: Page, build: Page,
         out = Page(probe.columns + (col,), probe.num_rows, ())
         return out, dup_count, None
 
-    build_valid = match_p
+    # Build payload lands by direct gather in probe order — nothing is
+    # carried through the sorts at all.
     out_cols = list(probe.columns)
-    for j, c in enumerate(build.columns):
-        fv = b2[3 + 2 * j][:pcap]
-        fn = b2[4 + 2 * j][:pcap]
-        sent = jnp.asarray(c.type.null_sentinel(), dtype=fv.dtype)
-        vals = jnp.where(build_valid, fv, sent)
-        nulls = jnp.where(build_valid, fn, True)
-        out_cols.append(Column(vals, nulls, c.type, c.dictionary))
+    for c in build.columns:
+        out_cols.append(c.gather(bidx, match_p))
 
     if join_type == "left":
         return Page(tuple(out_cols), probe.num_rows, ()), dup_count, \

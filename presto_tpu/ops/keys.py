@@ -121,6 +121,24 @@ def f64_hash_lanes(v: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def lex_perm(lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Permutation sorting rows lexicographically by `lanes` (most
+    significant first, each ascending), via composed STABLE argsorts —
+    2-operand sorts only. On this stack a wide variadic lax.sort's
+    compile cost explodes with operand count (20 operands at SF1 shapes
+    never finishes compiling through the remote compile service), while
+    argsort + gather compiles in seconds per lane and gathers run at
+    memory bandwidth; every operator therefore sorts via this helper and
+    gathers its payload by the permutation."""
+    perm = None
+    for lane in reversed(list(lanes)):
+        if perm is None:
+            perm = jnp.argsort(lane, stable=True)
+        else:
+            perm = perm[jnp.argsort(lane[perm], stable=True)]
+    return perm
+
+
 def sort_perm(page: Page, keys: Sequence[SortKey]) -> jnp.ndarray:
     """Permutation that stably sorts valid rows by `keys` with SQL null
     ordering; padding rows always sort last. Implemented as composed stable

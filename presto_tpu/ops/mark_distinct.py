@@ -28,6 +28,9 @@ def mark_distinct(page: Page, key_fields: Sequence[int],
     True on the first row of each (key...) combination. Padding rows are
     ordered last and never marked. NULL keys form their own group (SQL
     DISTINCT treats NULLs as equal)."""
+    from presto_tpu.data.column import gather_page
+    from presto_tpu.ops.keys import lex_perm
+
     cap = page.capacity
     pad_last = (~page.row_valid()).astype(jnp.int8)
     key_ops = [pad_last]
@@ -35,24 +38,19 @@ def mark_distinct(page: Page, key_fields: Sequence[int],
         c = page.columns[f]
         key_ops.append(c.nulls.astype(jnp.int8))
         key_ops.append(group_values(c))
-    operands = tuple(key_ops)
-    for c in page.columns:
-        operands += (c.values, c.nulls)
-    out = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=False)
+    # permutation over key lanes only; payload moves by gather (wide
+    # variadic sorts explode compile cost on this stack)
+    perm = lex_perm(key_ops)
+    s_lanes = [lane[perm] for lane in key_ops]
 
     # first-occurrence detection over the sorted key lanes
     first = jnp.zeros(cap, dtype=bool).at[0].set(True)
-    for ki in range(1, len(key_ops)):
-        lane = out[ki]
+    for lane in s_lanes[1:]:
         prev = jnp.concatenate([lane[:1], lane[:-1]])
         first = first | ~values_equal(lane, prev)
-    first = first & (out[0] == 0)          # padding rows unmarked
+    first = first & (s_lanes[0] == 0)      # padding rows unmarked
 
-    pos = len(key_ops)
-    cols = []
-    for c in page.columns:
-        cols.append(Column(out[pos], out[pos + 1], c.type, c.dictionary))
-        pos += 2
+    out = gather_page(page, perm)
     marker = Column(first, jnp.zeros(cap, dtype=bool), BOOLEAN, None)
-    return Page(tuple(cols) + (marker,), page.num_rows,
+    return Page(out.columns + (marker,), page.num_rows,
                 page.names + (marker_name,))

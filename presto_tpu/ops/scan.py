@@ -74,6 +74,40 @@ def fill_forward(vals: jnp.ndarray, present: jnp.ndarray,
     return out.reshape(-1)[:n]
 
 
+def seg_scan(vals: jnp.ndarray, seg_start: jnp.ndarray, binop,
+             ident) -> jnp.ndarray:
+    """Inclusive segmented scan: out[i] = binop-fold of vals over
+    [start_of_segment(i), i], where True in `seg_start` begins a new
+    segment. Blocked like cumsum/fill_forward (intra-block associative
+    scan + block-total scan + combine). `ident` is binop's identity
+    (used for padding and pre-first-segment slots). The running min/max
+    window-frame primitive."""
+    import jax
+
+    n0 = vals.shape[0]
+    blocks = max(1, (n0 + _LANE - 1) // _LANE)
+    pad = blocks * _LANE - n0
+    if pad:
+        vals = jnp.concatenate(
+            [vals, jnp.full((pad,), ident, vals.dtype)])
+        seg_start = jnp.concatenate(
+            [seg_start, jnp.zeros((pad,), bool)])
+    x2 = vals.reshape(blocks, _LANE)
+    f2 = seg_start.reshape(blocks, _LANE)
+
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, binop(av, bv)), af | bf
+
+    wv, wf = jax.lax.associative_scan(op, (x2, f2), axis=1)
+    pv, pf = jax.lax.associative_scan(op, (wv[:, -1], wf[:, -1]), axis=0)
+    # exclusive block prefix
+    pv = jnp.concatenate([jnp.full((1,), ident, vals.dtype), pv[:-1]])
+    out = jnp.where(wf, wv, binop(pv[:, None], wv))
+    return out.reshape(-1)[:n0]
+
+
 def fill_backward(vals: jnp.ndarray, present: jnp.ndarray, init=None):
     """Per-slot next `present` value at or after the slot (reversed
     fill_forward; flips lower to strided slices, not gathers)."""

@@ -42,35 +42,13 @@ def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
 
 
 def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
-    from presto_tpu.data.column import Decimal128Column, NestedColumn
-    key_ops = _sort_key_operands(page, keys)
-    operands = tuple(key_ops)
-    for c in page.columns:
-        if isinstance(c, NestedColumn):
-            # nested payload rides as row-wise lanes; child buffers are
-            # position-addressed and never move
-            operands += (c.starts, c.lengths, c.nulls)
-        elif isinstance(c, Decimal128Column):
-            operands += tuple(c.row_lanes())
-        else:
-            operands += (c.values, c.nulls)
-    out = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=True)
-    pos = len(key_ops)
-    cols = []
-    for c in page.columns:
-        if isinstance(c, NestedColumn):
-            cols.append(NestedColumn(out[pos], out[pos + 1], out[pos + 2],
-                                     c.children, c.type))
-            pos += 3
-        elif isinstance(c, Decimal128Column):
-            k = len(c.row_lanes())
-            cols.append(c.from_lanes(list(out[pos:pos + k])))
-            pos += k
-        else:
-            cols.append(Column(out[pos], out[pos + 1], c.type,
-                               c.dictionary))
-            pos += 2
-    return Page(tuple(cols), page.num_rows, page.names)
+    """Sort via ops/keys.lex_perm (composed 2-operand argsorts over the
+    key lanes) + one gather per column — never a wide variadic lax.sort
+    (compile cost explodes with operand count on this stack)."""
+    from presto_tpu.data.column import gather_page
+    from presto_tpu.ops.keys import lex_perm
+    perm = lex_perm(_sort_key_operands(page, keys))
+    return gather_page(page, perm)
 
 
 def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:

@@ -1,44 +1,126 @@
-"""Window functions — sort-carry + blocked scans, no gathers.
+"""Window functions — permutation sort + blocked scans + gathers.
 
 Reference role: WindowOperator (presto-main-base/.../operator/
-WindowOperator.java:68 over PagesIndex sort + per-frame evaluation).
-TPU-first redesign: ONE multi-operand lax.sort by (partition keys, order
-keys) carrying every column plus the original row index; partition/peer
-boundaries come from adjacent compares; ranks and running aggregates are
-blocked fill-forward/backward scans (ops/scan.py); a second sort restores
-the original row order carrying only the computed window columns.
+WindowOperator.java:68 over PagesIndex sort + per-frame evaluation;
+frames/offsets: presto-main-base/.../operator/window/*.java). TPU-first
+redesign: a sort PERMUTATION over (partition keys, order keys) via
+composed 2-operand argsorts (ops/keys.lex_perm — wide variadic sorts
+explode compile cost on this stack); partition/peer boundaries from
+adjacent compares; ranks, running aggregates and frames are blocked
+scans (ops/scan.py) plus index-arithmetic gathers; the inverse
+permutation restores original row order.
 
-Supported: row_number, rank, dense_rank, and sum/count/avg/min/max over
-the partition — cumulative (peer-aware RANGE UNBOUNDED PRECEDING ..
-CURRENT ROW, the SQL default when ORDER BY is present) or whole-partition
-(no ORDER BY).
+Supported: row_number, rank, dense_rank, ntile, lag/lead (offset +
+default), first_value/last_value/nth_value, and sum/count/avg/min/max
+with frames:
+  - default  : RANGE UNBOUNDED PRECEDING..CURRENT ROW (peer-aware) with
+               ORDER BY, whole partition without (SQL default)
+  - ROWS     : any BETWEEN of UNBOUNDED/N PRECEDING/CURRENT/N FOLLOWING
+               (min/max: one side must be unbounded — a both-bounded
+               sliding min has no prefix-scan form; cleanly rejected)
+  - RANGE    : UNBOUNDED/CURRENT bounds (value-offset RANGE rejected)
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
 from presto_tpu.ops import scan as pscan
 from presto_tpu.ops.keys import SortKey, _orderable_lanes, \
     group_values, values_equal
-from presto_tpu.types import BIGINT, DOUBLE, Type
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    """Window frame (reference: spi/plan/WindowNode.Frame). Bound types:
+    unbounded_preceding | preceding | current | following |
+    unbounded_following; N for the bounded types sits in start_n/end_n
+    (constant — SQL frame offsets are literals in every TPC query)."""
+    mode: str = "range"                   # "range" | "rows"
+    start_type: str = "unbounded_preceding"
+    start_n: Optional[int] = None
+    end_type: str = "current"
+    end_n: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
 class WindowSpec:
-    """One window function: kind in {row_number, rank, dense_rank, sum,
-    count, count_star, avg, min, max}. `field` is the argument column."""
+    """One window function: kind in {row_number, rank, dense_rank, ntile,
+    lag, lead, first_value, last_value, nth_value, sum, count,
+    count_star, avg, min, max}. `field` is the argument column; `param`
+    is the lag/lead offset, ntile bucket count or nth_value position;
+    `default` the lag/lead default literal (python value)."""
     kind: str
     field: Optional[int]
     output_type: Type
+    param: Optional[int] = None
+    default: object = None
+    frame: Optional[Frame] = None
 
+
+_OFFSET_KINDS = {"lag", "lead"}
+_VALUE_KINDS = {"first_value", "last_value", "nth_value"}
+_AGG_KINDS = {"sum", "count", "count_star", "avg", "min", "max"}
 
 _fill_backward = pscan.fill_backward
+
+
+def _frame_bounds(frame: Optional[Frame], has_order: bool, idx,
+                  part_start_idx, part_end_idx, peer_start_idx,
+                  peer_end_idx):
+    """Per-row inclusive [lo, hi] frame bounds in sorted coordinates.
+    Returns (lo, hi, start_unbounded, end_unbounded) — the unbounded
+    flags let min/max pick a scan direction."""
+    if frame is None:
+        frame = Frame()                      # SQL default frame
+        if not has_order:
+            frame = Frame(end_type="unbounded_following")
+    if frame.mode == "rows":
+        st, en = frame.start_type, frame.end_type
+        if st == "unbounded_preceding":
+            lo = part_start_idx
+        elif st == "preceding":
+            lo = idx - int(frame.start_n)
+        elif st == "current":
+            lo = idx
+        elif st == "following":
+            lo = idx + int(frame.start_n)
+        else:
+            raise NotImplementedError(f"frame start {st}")
+        if en == "unbounded_following":
+            hi = part_end_idx
+        elif en == "following":
+            hi = idx + int(frame.end_n)
+        elif en == "current":
+            hi = idx
+        elif en == "preceding":
+            hi = idx - int(frame.end_n)
+        else:
+            raise NotImplementedError(f"frame end {en}")
+        lo = jnp.maximum(lo, part_start_idx)
+        hi = jnp.minimum(hi, part_end_idx)
+        return (lo, hi, st == "unbounded_preceding",
+                en == "unbounded_following")
+    # RANGE: UNBOUNDED/CURRENT bounds only (peer-aware)
+    st, en = frame.start_type, frame.end_type
+    if st == "unbounded_preceding":
+        lo = part_start_idx
+    elif st == "current":
+        lo = peer_start_idx
+    else:
+        raise NotImplementedError(f"RANGE frame start {st}")
+    if en == "unbounded_following":
+        hi = part_end_idx
+    elif en == "current":
+        hi = peer_end_idx
+    else:
+        raise NotImplementedError(f"RANGE frame end {en}")
+    return lo, hi, st == "unbounded_preceding", en == "unbounded_following"
 
 
 def window_page(page: Page, partition_fields: Sequence[int],
@@ -49,17 +131,15 @@ def window_page(page: Page, partition_fields: Sequence[int],
     valid = page.row_valid()
     idx = jnp.arange(cap, dtype=jnp.int32)
 
-    # ---- sort by (valid, partition keys, order keys), carrying inputs
+    # ---- sort lanes: (valid, partition keys, order keys)
     key_ops = [(~valid).astype(jnp.int8)]
-    n_part_ops = 0
     for f in partition_fields:
         c = page.columns[f]
         key_ops.append(c.nulls.astype(jnp.int8))
         key_ops.append(group_values(c))
-        n_part_ops += 2
-    n_order_ops = 0
     null_rank_of_null = []   # per order key: the rank value NULL rows get
     order_lane_counts = []   # per order key: value lanes (Decimal128 = 2)
+    order_ops_start = 1 + 2 * len(partition_fields)
     for k in order_keys:
         c = page.columns[k.field]
         nr = jnp.int8(0 if k.nulls_sort_first else 1)
@@ -72,18 +152,18 @@ def window_page(page: Page, partition_fields: Sequence[int],
                 v = -v.astype(jnp.int64) if not jnp.issubdtype(
                     v.dtype, jnp.floating) else -v
             key_ops.append(v)
-        n_order_ops += 1 + len(lanes)
 
     arg_fields = sorted({s.field for s in specs if s.field is not None})
-    operands = tuple(key_ops) + (idx, valid)
-    for f in arg_fields:
-        operands += (page.columns[f].values, page.columns[f].nulls)
-    s = jax.lax.sort(operands, num_keys=len(key_ops), is_stable=True)
-    nk = len(key_ops)
-    s_idx = s[nk]
-    s_valid = s[nk + 1]
-    s_args = {f: (s[nk + 2 + 2 * i], s[nk + 3 + 2 * i])
-              for i, f in enumerate(arg_fields)}
+    # permutation over the key lanes only (ops/keys.lex_perm); arg lanes
+    # move by gather — wide variadic sorts explode compile cost
+    from presto_tpu.ops.keys import lex_perm
+    perm = lex_perm(key_ops)
+    s = [lane[perm] for lane in key_ops]
+    s_idx = idx[perm]
+    s_valid = valid[perm]
+    s_args = {f: (jnp.take(page.columns[f].values, perm, mode="clip"),
+                  jnp.take(page.columns[f].nulls, perm, mode="clip"))
+              for f in arg_fields}
 
     # ---- partition / peer boundaries from adjacent key compares.
     # The rank operand encodes nulls as `null_rank` (0 when nulls sort
@@ -104,19 +184,27 @@ def window_page(page: Page, partition_fields: Sequence[int],
 
     part_start = changed(1, [1] * len(partition_fields),
                          [1] * len(partition_fields)) \
-        if n_part_ops else jnp.zeros((cap,), bool).at[0].set(True)
+        if partition_fields else jnp.zeros((cap,), bool).at[0].set(True)
+    # a validity change is always a partition boundary: invalid rows sort
+    # last (the most-significant lane) and must never sit inside a valid
+    # partition's frame (first/last_value gather at frame edges).
+    part_start = part_start | (~s_valid & jnp.roll(s_valid, 1))
     peer_start = part_start | (
-        changed(1 + n_part_ops, order_lane_counts, null_rank_of_null)
-        if n_order_ops else jnp.zeros((cap,), bool))
+        changed(order_ops_start, order_lane_counts, null_rank_of_null)
+        if order_keys else jnp.zeros((cap,), bool))
     has_order = bool(order_keys)
 
     part_start_idx = pscan.fill_forward(
         jnp.where(part_start, idx, 0), part_start)
     peer_start_idx = pscan.fill_forward(
         jnp.where(peer_start, idx, 0), peer_start)
-    # last row of my peer group / partition (for running + totals)
     peer_end = jnp.roll(peer_start, -1).at[-1].set(True)
     part_end = jnp.roll(part_start, -1).at[-1].set(True)
+    part_end_idx = _fill_backward(jnp.where(part_end, idx, 0), part_end)
+    peer_end_idx = _fill_backward(jnp.where(peer_end, idx, 0), peer_end)
+
+    def clipi(a):
+        return jnp.clip(a, 0, cap - 1)
 
     out_cols = []
     for spec in specs:
@@ -134,88 +222,130 @@ def window_page(page: Page, partition_fields: Sequence[int],
                 jnp.where(part_start, cs_peer, 0), part_start)
             w = (cs_peer - at_part + 1).astype(jnp.int64)
             wn = jnp.zeros((cap,), bool)
-        elif kind in ("sum", "count", "count_star", "avg"):
+        elif kind == "ntile":
+            # SQL remainder rule: the first (psize mod buckets) buckets
+            # get one extra row (NOT an even spread)
+            buckets = jnp.int64(int(spec.param))
+            psize = (part_end_idx - part_start_idx + 1).astype(jnp.int64)
+            rn = (idx - part_start_idx).astype(jnp.int64)
+            base = psize // buckets
+            rem = psize - base * buckets
+            big = rem * (base + 1)          # rows in the larger buckets
+            w = jnp.where(
+                rn < big,
+                rn // jnp.maximum(base + 1, 1) + 1,
+                rem + (rn - big) // jnp.maximum(base, 1) + 1)
+            wn = jnp.zeros((cap,), bool)
+        elif kind in _OFFSET_KINDS:
+            vals, nulls = s_args[spec.field]
+            k = int(spec.param if spec.param is not None else 1)
+            j = idx - k if kind == "lag" else idx + k
+            inb = (j >= part_start_idx) & (j <= part_end_idx)
+            jc = clipi(j)
+            w = jnp.take(vals, jc, mode="clip")
+            wn = jnp.take(nulls, jc, mode="clip") | ~inb
+            if spec.default is not None:
+                dv = jnp.asarray(spec.default, dtype=vals.dtype)
+                w = jnp.where(inb, w, dv)
+                wn = jnp.where(inb, wn, False)
+        elif kind in _VALUE_KINDS:
+            vals, nulls = s_args[spec.field]
+            lo, hi, _su, _eu = _frame_bounds(
+                spec.frame, has_order, idx, part_start_idx, part_end_idx,
+                peer_start_idx, peer_end_idx)
+            if kind == "first_value":
+                pos = lo
+            elif kind == "last_value":
+                pos = hi
+            else:
+                pos = lo + int(spec.param) - 1
+            empty = (lo > hi) | (pos < lo) | (pos > hi)
+            pc = clipi(pos)
+            w = jnp.take(vals, pc, mode="clip")
+            wn = jnp.take(nulls, pc, mode="clip") | empty
+        elif kind in _AGG_KINDS:
             if spec.field is not None:
                 vals, nulls = s_args[spec.field]
                 live = s_valid & ~nulls
             else:
                 vals = jnp.ones((cap,), jnp.int64)
                 live = s_valid
-            acc = jnp.float64 if (t.is_floating or kind == "avg") \
-                else jnp.int64
-            contrib = jnp.where(live, vals, 0).astype(acc)
-            cs = pscan.cumsum(contrib)
+            lo, hi, start_unb, end_unb = _frame_bounds(
+                spec.frame, has_order, idx, part_start_idx, part_end_idx,
+                peer_start_idx, peer_end_idx)
+            empty = lo > hi
+            loc, hic = clipi(lo), clipi(hi)
+            # live count over the frame: prefix-count + two gathers
             cnt = pscan.cumsum(live.astype(jnp.int64))
-            before_part = pscan.fill_forward(
-                jnp.where(part_start, cs - contrib, 0), part_start)
-            cnt_before = pscan.fill_forward(
-                jnp.where(part_start, cnt - live.astype(jnp.int64), 0),
-                part_start)
-            if has_order:   # cumulative to the end of my peer group
-                upto = _fill_backward(jnp.where(peer_end, cs, 0), peer_end)
-                n_upto = _fill_backward(jnp.where(peer_end, cnt, 0),
-                                        peer_end)
-            else:           # whole partition
-                upto = _fill_backward(jnp.where(part_end, cs, 0), part_end)
-                n_upto = _fill_backward(jnp.where(part_end, cnt, 0),
-                                        part_end)
-            total = upto - before_part
-            n = n_upto - cnt_before
-            if kind in ("count", "count_star"):
-                w, wn = n, jnp.zeros((cap,), bool)
-            elif kind == "sum":
-                w, wn = total, n == 0
-            else:  # avg — DECIMAL args are unscaled ints: descale
-                w = total / jnp.maximum(n, 1)
-                if spec.field is not None:
-                    arg_t = page.columns[spec.field].type
-                    if arg_t.is_decimal:
-                        w = w / (10 ** arg_t.scale)
+            c_hi = jnp.take(cnt, hic, mode="clip")
+            c_lom1 = jnp.where(lo > 0,
+                               jnp.take(cnt, clipi(lo - 1), mode="clip"),
+                               0)
+            n = jnp.where(empty, 0, c_hi - c_lom1)
+            if kind in ("sum", "count", "count_star", "avg"):
+                acc = jnp.float64 if (t.is_floating or kind == "avg") \
+                    else jnp.int64
+                contrib = jnp.where(live, vals, 0).astype(acc)
+                cs = pscan.cumsum(contrib)
+                s_hi = jnp.take(cs, hic, mode="clip")
+                s_lom1 = jnp.where(
+                    lo > 0, jnp.take(cs, clipi(lo - 1), mode="clip"),
+                    jnp.zeros((), acc))
+                total = jnp.where(empty, jnp.zeros((), acc),
+                                  s_hi - s_lom1)
+                if kind in ("count", "count_star"):
+                    w, wn = n, jnp.zeros((cap,), bool)
+                elif kind == "sum":
+                    w, wn = total, n == 0
+                else:  # avg — DECIMAL args are unscaled ints: descale
+                    w = total / jnp.maximum(n, 1)
+                    if spec.field is not None:
+                        arg_t = page.columns[spec.field].type
+                        if arg_t.is_decimal:
+                            w = w / (10 ** arg_t.scale)
+                    wn = n == 0
+            else:  # min / max over a frame with one unbounded side
+                v = vals
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    ident = jnp.inf if kind == "min" else -jnp.inf
+                else:
+                    if v.dtype == jnp.bool_:
+                        v = v.astype(jnp.int32)
+                    info = jnp.iinfo(v.dtype)
+                    ident = info.max if kind == "min" else info.min
+                masked = jnp.where(live, v, ident)
+                binop = jnp.minimum if kind == "min" else jnp.maximum
+                if start_unb:
+                    # running extreme from partition start, read at hi
+                    run = pscan.seg_scan(masked, part_start, binop, ident)
+                    w = jnp.take(run, hic, mode="clip")
+                elif end_unb:
+                    # reversed running extreme, read at lo
+                    rrun = pscan.seg_scan(
+                        jnp.flip(masked), jnp.flip(part_end), binop,
+                        ident)
+                    run = jnp.flip(rrun)
+                    w = jnp.take(run, loc, mode="clip")
+                else:
+                    raise NotImplementedError(
+                        f"{kind} over a frame bounded on both sides "
+                        "(no prefix-scan form; use an unbounded side)")
                 wn = n == 0
-        elif kind in ("min", "max"):
-            if has_order:
-                raise NotImplementedError(
-                    f"running {kind} window (frame with ORDER BY)")
-            vals, nulls = s_args[spec.field]
-            live = s_valid & ~nulls
-            v = vals
-            if jnp.issubdtype(v.dtype, jnp.floating):
-                ident = jnp.inf if kind == "min" else -jnp.inf
-            else:
-                info = jnp.iinfo(v.dtype) if v.dtype != jnp.bool_ else None
-                v = v.astype(jnp.int32) if info is None else v
-                info = jnp.iinfo(v.dtype)
-                ident = info.max if kind == "min" else info.min
-            masked = jnp.where(live, v, ident)
-            # extra sort keyed (partition run id via part_start cumsum,
-            # value) puts the winner at each partition start
-            pid = pscan.cumsum(part_start.astype(jnp.int32))
-            sort_v = masked if kind == "min" else (
-                -masked if jnp.issubdtype(masked.dtype, jnp.floating)
-                else -masked.astype(jnp.int64))
-            s2 = jax.lax.sort((pid, sort_v, masked, live.astype(jnp.int8)),
-                              num_keys=2, is_stable=False)
-            win = pscan.fill_forward(
-                jnp.where(part_start, s2[2], 0), part_start)
-            any_live = pscan.fill_forward(
-                jnp.where(part_start, s2[3], 0), part_start) > 0
-            w, wn = win, ~any_live
+                w = jnp.where(wn, ident, w)
         else:
             raise NotImplementedError(f"window function {kind}")
         out_cols.append((w, wn | ~s_valid))
 
-    # ---- restore original row order, carrying only the window outputs
-    back = ((1 - s_valid.astype(jnp.int8)), s_idx)
-    for w, wn in out_cols:
-        back += (w, wn)
-    b = jax.lax.sort(back, num_keys=2, is_stable=False)
+    # ---- restore original row order via the inverse permutation (one
+    # argsort), gathering only the window outputs
+    inv = jnp.argsort(s_idx)
     cols = list(page.columns)
     for i, spec in enumerate(specs):
-        w = b[2 + 2 * i]
-        wn = b[3 + 2 * i]
+        w = out_cols[i][0][inv]
+        wn = out_cols[i][1][inv]
         t = spec.output_type
-        # min/max over strings operate on dictionary codes (code order ==
-        # lexicographic); the output column must keep the dictionary.
+        # value-kind outputs over strings are dictionary codes (code
+        # order == lexicographic); the output keeps the dictionary.
         dictionary = (page.columns[spec.field].dictionary
                       if spec.field is not None and t.is_string else None)
         sent = jnp.asarray(t.null_sentinel(), dtype=t.dtype)

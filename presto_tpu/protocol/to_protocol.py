@@ -101,6 +101,16 @@ def expr_to_protocol(e: E.RowExpression, in_vars: List[S.Variable]):
         f"to_protocol expression {type(e).__name__}")
 
 
+# spi/plan WindowNode.Frame BoundType names
+_FRAME_BOUND = {
+    "unbounded_preceding": "UNBOUNDED_PRECEDING",
+    "preceding": "PRECEDING",
+    "current": "CURRENT_ROW",
+    "following": "FOLLOWING",
+    "unbounded_following": "UNBOUNDED_FOLLOWING",
+}
+
+
 def _agg_call(kind: str, args: List[S.Variable], ret: str) -> S.Call:
     arg_sigs = [a.type for a in args]
     c = S.Call(displayName=kind, returnType=ret, arguments=list(args),
@@ -320,9 +330,31 @@ class _FragmentConverter:
                 else:
                     args = ([in_vars[w.field]]
                             if w.field is not None else [])
+                    # lag/lead offset + default and nth_value position
+                    # travel as ConstantExpressions (the reference's
+                    # FunctionCall argument shape)
+                    from presto_tpu.types import BIGINT as _BI
+                    if w.param is not None and w.kind != "ntile":
+                        args = args + [encode_constant(w.param, _BI)]
+                    if w.kind == "ntile":
+                        args = [encode_constant(w.param, _BI)]
+                    if w.default is not None:
+                        args = args + [encode_constant(w.default, t)]
                     call = _agg_call(w.kind, args, type_sig(t))
+                frame = None
+                if w.frame is not None:
+                    fr = w.frame
+                    frame = {
+                        "type": fr.mode.upper(),
+                        "startType": _FRAME_BOUND[fr.start_type],
+                        "endType": _FRAME_BOUND[fr.end_type],
+                    }
+                    if fr.start_n is not None:
+                        frame["startValue"] = int(fr.start_n)
+                    if fr.end_n is not None:
+                        frame["endValue"] = int(fr.end_n)
                 fns[f"{v.name}<{v.type}>"] = S.WindowFunction(
-                    functionCall=call)
+                    functionCall=call, frame=frame)
                 out.append(v)
             return S.WindowNode(id=nid, source=src, specification=spec,
                                 windowFunctions=fns), out

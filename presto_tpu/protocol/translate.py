@@ -128,6 +128,28 @@ def _wire_value(blk, i: int, t: Type):
     return int(v)
 
 
+_FRAME_BOUND_BACK = {
+    "UNBOUNDED_PRECEDING": "unbounded_preceding",
+    "PRECEDING": "preceding",
+    "CURRENT_ROW": "current",
+    "FOLLOWING": "following",
+    "UNBOUNDED_FOLLOWING": "unbounded_following",
+}
+
+
+def _parse_frame(frame):
+    """WindowNode.Frame JSON -> ops.window.Frame (None = default)."""
+    if not frame:
+        return None
+    from presto_tpu.ops.window import Frame
+    return Frame(
+        mode=str(frame.get("type", "RANGE")).lower(),
+        start_type=_FRAME_BOUND_BACK[frame["startType"]],
+        start_n=frame.get("startValue"),
+        end_type=_FRAME_BOUND_BACK[frame["endType"]],
+        end_n=frame.get("endValue"))
+
+
 def decode_constant(const: S.Constant) -> E.Literal:
     """ConstantExpression.valueBlock (base64 single-position Block) ->
     typed Literal, via the SerializedPage block codec."""
@@ -523,13 +545,33 @@ def _node(n) -> P.PlanNode:
                 kind = "count_star"
             out_t = parse_type(wf.functionCall.returnType)
             field = None
-            if wf.functionCall.arguments:
-                a0 = wf.functionCall.arguments[0]
-                if not isinstance(a0, S.Variable):
+            param = None
+            default = None
+            args = list(wf.functionCall.arguments)
+            if args and isinstance(args[0], S.Variable):
+                field = scope.index[args[0].name]
+                args = args[1:]
+            # trailing ConstantExpressions: lag/lead offset [+ default],
+            # nth_value position, ntile bucket count
+            consts = []
+            for a in args:
+                if isinstance(a, S.Constant):
+                    consts.append(decode_constant(a).value)
+                else:
                     raise NotImplementedError(
                         "window function over non-variable input")
-                field = scope.index[a0.name]
-            specs.append(WindowSpec(kind, field, out_t))
+            if kind in ("lag", "lead"):
+                param = int(consts[0]) if consts else 1
+                if len(consts) > 1:
+                    default = consts[1]
+            elif kind in ("nth_value", "ntile") and consts:
+                param = int(consts[0])
+            elif consts:
+                raise NotImplementedError(
+                    f"constant arguments on window {kind}")
+            frame = _parse_frame(wf.frame)
+            specs.append(WindowSpec(kind, field, out_t, param=param,
+                                    default=default, frame=frame))
             names.append(_var_key_name(key))
             types.append(out_t)
         return P.WindowNode(

@@ -264,6 +264,8 @@ def _replace_window_calls(e, mapping: Dict[ast.WindowCall, str]):
 
 _WINDOW_RANKING = {"row_number", "rank", "dense_rank"}
 _WINDOW_AGGS = {"sum", "count", "avg", "min", "max"}
+_WINDOW_OFFSET = {"lag", "lead"}
+_WINDOW_VALUE = {"first_value", "last_value", "nth_value"}
 
 
 def _null_preserving_item(e) -> bool:
@@ -1785,6 +1787,32 @@ class Planner:
         return f"_col{i}"
 
     # ========================================================= order/limit
+    def _window_frame(self, f):
+        """Parser frame tuple -> ops.window.Frame, with the engine's
+        supported-surface validation (reference: WindowFrame analysis in
+        sql/analyzer/StatementAnalyzer; RANGE with value offsets is
+        rejected there too pre-3.x)."""
+        if f is None:
+            return None
+        from presto_tpu.ops.window import Frame
+        mode, st, sn, en, enn = f
+        if mode == "range" and (
+                st not in ("unbounded_preceding", "current")
+                or en not in ("current", "unbounded_following")):
+            raise AnalysisError(
+                "RANGE frames support only UNBOUNDED PRECEDING/"
+                "FOLLOWING and CURRENT ROW bounds")
+        rank = {"unbounded_preceding": 0, "preceding": 1, "current": 2,
+                "following": 3, "unbounded_following": 4}
+        if st not in rank or en not in rank:
+            raise AnalysisError(f"bad window frame bound {st}/{en}")
+        if rank[st] > rank[en]:
+            raise AnalysisError(
+                f"window frame start {st} cannot follow end {en}")
+        if st == "unbounded_following" or en == "unbounded_preceding":
+            raise AnalysisError("invalid window frame bound")
+        return Frame(mode, st, sn, en, enn)
+
     def _plan_window(self, wcalls: List[ast.WindowCall], rp: RelationPlan,
                      analyze_fn=None) -> Tuple[RelationPlan, List[str]]:
         """Plan the window functions over `rp`: a pre-projection computes
@@ -1825,12 +1853,80 @@ class Planner:
                                    o.nulls_first) for o in wc.order_by)
             kind = fn.name
             field = None
+            param = None
+            default = None
+            frame = self._window_frame(wc.frame)
+
+            def lit_arg(a, what):
+                neg = False
+                if isinstance(a, ast.UnaryOp) and a.op == "-":
+                    a, neg = a.operand, True
+                e = self.analyze(a, tuple(ext_fields)) \
+                    if analyze_fn is None else analyze_fn(a)
+                from presto_tpu.expr.nodes import Literal as _L
+                if not isinstance(e, _L):
+                    raise AnalysisError(f"{kind}() {what} must be a "
+                                        f"literal")
+                if neg and e.value is not None:
+                    e = dataclasses.replace(e, value=-e.value)
+                return e
+
             if kind == "count" and (fn.is_star or not fn.args):
                 kind, out_t = "count_star", BIGINT
             elif kind in _WINDOW_RANKING:
                 if not orders:
                     raise AnalysisError(f"{kind}() requires ORDER BY")
                 out_t = BIGINT
+            elif kind == "ntile":
+                if not orders:
+                    raise AnalysisError("ntile() requires ORDER BY")
+                param = int(lit_arg(fn.args[0], "bucket count").value)
+                if param <= 0:
+                    raise AnalysisError("ntile() buckets must be > 0")
+                out_t = BIGINT
+            elif kind in _WINDOW_OFFSET:
+                if not orders:
+                    raise AnalysisError(f"{kind}() requires ORDER BY")
+                field = channel(fn.args[0])
+                out_t = ext_fields[field].type
+                param = 1
+                if len(fn.args) >= 2:
+                    param = int(lit_arg(fn.args[1], "offset").value)
+                    if param < 0:
+                        raise AnalysisError(f"{kind}() offset must be "
+                                            f">= 0")
+                if len(fn.args) >= 3:
+                    d = lit_arg(fn.args[2], "default")
+                    default = d.value
+                    if default is not None and out_t.is_string:
+                        # defaults over dictionary columns need a code;
+                        # reject rather than mis-encode
+                        raise AnalysisError(
+                            f"{kind}() varchar default not supported")
+                    if default is not None:
+                        # store the default in the ARG COLUMN's value
+                        # representation: unscaled int for decimal
+                        # columns (exact rescale), plain value otherwise
+                        import decimal as _dec
+                        from presto_tpu.data.column import \
+                            scale_down_decimal, unscale_decimal
+                        dv = (scale_down_decimal(int(default),
+                                                 d.type.scale)
+                              if d.type.is_decimal
+                              else default)
+                        if out_t.is_decimal:
+                            default = unscale_decimal(
+                                _dec.Decimal(str(dv)), out_t.scale)
+                        elif d.type.is_decimal:
+                            default = float(dv)
+            elif kind in _WINDOW_VALUE:
+                field = channel(fn.args[0])
+                out_t = ext_fields[field].type
+                if kind == "nth_value":
+                    param = int(lit_arg(fn.args[1], "position").value)
+                    if param <= 0:
+                        raise AnalysisError(
+                            "nth_value() position must be > 0")
             elif kind in _WINDOW_AGGS:
                 field = channel(fn.args[0])
                 arg_t = ext_fields[field].type
@@ -1847,7 +1943,8 @@ class Planner:
             else:
                 raise AnalysisError(f"unsupported window function {kind}")
             resolved.append(((parts, orders),
-                             WindowSpec(kind, field, out_t)))
+                             WindowSpec(kind, field, out_t, param=param,
+                                        default=default, frame=frame)))
 
         node = rp.node
         if extended:

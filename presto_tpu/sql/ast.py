@@ -133,11 +133,14 @@ class FuncCall:
 
 @dataclasses.dataclass(frozen=True)
 class WindowCall:
-    """fn(args) OVER (PARTITION BY ... ORDER BY ...) — reference:
-    sql/tree/FunctionCall with a Window."""
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame]) —
+    reference: sql/tree/FunctionCall with a Window. `frame` is
+    (mode, start_type, start_n, end_type, end_n) or None (SQL default
+    frame)."""
     func: "FuncCall"
     partition_by: Tuple["Expr", ...] = ()
     order_by: Tuple["OrderItem", ...] = ()
+    frame: Optional[tuple] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -745,7 +745,7 @@ class Parser:
         raise SyntaxError(f"unexpected token {t.text!r} at {t.pos}")
 
     def _maybe_over(self, fc: ast.FuncCall) -> ast.Expr:
-        """fn(...) [OVER (PARTITION BY ... ORDER BY ...)]."""
+        """fn(...) [OVER (PARTITION BY ... ORDER BY ... [frame])]."""
         if not self.accept_kw("over"):
             return fc
         self.expect("op", "(")
@@ -761,8 +761,44 @@ class Parser:
             order.append(self.order_item())
             while self.accept("op", ","):
                 order.append(self.order_item())
+        frame = None
+        nxt = self.peek()
+        if nxt.kind == "ident" and nxt.text.lower() in ("rows", "range"):
+            frame = self._frame_clause(self.next().text.lower())
         self.expect("op", ")")
-        return ast.WindowCall(fc, tuple(partition), tuple(order))
+        return ast.WindowCall(fc, tuple(partition), tuple(order), frame)
+
+    def _frame_clause(self, mode: str) -> tuple:
+        """ROWS|RANGE [BETWEEN b AND b | b] — reference: SqlBase.g4
+        windowFrame. Returns (mode, start_type, start_n, end_type,
+        end_n); the single-bound form ends at CURRENT ROW."""
+        def bound():
+            t = self.peek()
+            if t.kind == "ident" and t.text.lower() == "unbounded":
+                self.next()
+                d = self.ident_text().lower()
+                if d not in ("preceding", "following"):
+                    raise SyntaxError(f"UNBOUNDED {d!r}")
+                return (f"unbounded_{d}", None)
+            if t.kind == "ident" and t.text.lower() == "current":
+                self.next()
+                if self.ident_text().lower() != "row":
+                    raise SyntaxError("expected CURRENT ROW")
+                return ("current", None)
+            n = self.expect("number")
+            d = self.ident_text().lower()
+            if d not in ("preceding", "following"):
+                raise SyntaxError(f"frame bound {d!r}")
+            return (d, int(n.text))
+
+        if self.accept_kw("between"):
+            st, sn = bound()
+            self.expect_kw("and")
+            en, enn = bound()
+        else:
+            st, sn = bound()
+            en, enn = "current", None
+        return (mode, st, sn, en, enn)
 
     def case_expr(self) -> ast.Expr:
         self.expect_kw("case")

@@ -51,8 +51,11 @@ def test_scan_matches_source(engine, catalog):
 
 
 def test_nulls_decimals_strings(engine):
+    from decimal import Decimal
+    # decimals materialize as exact python Decimals (never floats)
     assert engine.execute_sql("select k, v, s from typed order by k") == \
-        [(1, 1.23, None), (2, None, "x"), (3, -4.56, "y")]
+        [(1, Decimal("1.23"), None), (2, None, "x"),
+         (3, Decimal("-4.56"), "y")]
     # null-aware aggregation over the file
     assert engine.execute_sql(
         "select count(v), count(*) from typed") == [(2, 3)]

@@ -147,3 +147,67 @@ def test_distributed_windows_and_full_outer():
         "on a.n_name = b.n_name order by 1, 2",
     ):
         assert dist.execute_sql(q) == local.execute_sql(q), q
+
+
+# ------------------------------------------- round-4: offsets + frames
+
+FRAME_QUERIES = [
+    # lag / lead with offsets and defaults
+    "select n_nationkey, lag(n_name) over "
+    "(partition by n_regionkey order by n_nationkey) from nation",
+    "select n_nationkey, lead(n_nationkey, 2, -1) over "
+    "(partition by n_regionkey order by n_nationkey) from nation",
+    "select n_nationkey, lag(n_nationkey, 3) over "
+    "(order by n_nationkey desc) from nation",
+    # first/last/nth over default and explicit frames
+    "select n_nationkey, first_value(n_name) over "
+    "(partition by n_regionkey order by n_nationkey) from nation",
+    "select n_nationkey, last_value(n_nationkey) over "
+    "(partition by n_regionkey order by n_nationkey "
+    "rows between unbounded preceding and unbounded following) "
+    "from nation",
+    "select n_nationkey, nth_value(n_name, 2) over "
+    "(partition by n_regionkey order by n_nationkey "
+    "rows between unbounded preceding and unbounded following) "
+    "from nation",
+    # ntile
+    "select n_nationkey, ntile(3) over (order by n_nationkey) "
+    "from nation",
+    "select n_nationkey, ntile(4) over "
+    "(partition by n_regionkey order by n_nationkey) from nation",
+    # ROWS frames over aggregates (sliding windows)
+    "select n_nationkey, sum(n_nationkey) over "
+    "(partition by n_regionkey order by n_nationkey "
+    "rows between 1 preceding and current row) from nation",
+    "select n_nationkey, avg(n_nationkey) over "
+    "(order by n_nationkey rows between 2 preceding and 2 following) "
+    "from nation",
+    "select n_nationkey, count(n_comment) over "
+    "(order by n_nationkey rows between current row and "
+    "3 following) from nation",
+    "select n_nationkey, sum(n_nationkey) over "
+    "(order by n_nationkey rows 2 preceding) from nation",
+    # min/max: running (ORDER BY implies default frame) + suffix frames
+    "select n_nationkey, max(n_name) over "
+    "(partition by n_regionkey order by n_nationkey) from nation",
+    "select n_nationkey, min(n_nationkey) over "
+    "(order by n_nationkey rows between current row and "
+    "unbounded following) from nation",
+    # supplier-scale (bigger partitions, s_acctbal float keys)
+    "select s_suppkey, lag(s_acctbal) over "
+    "(partition by s_nationkey order by s_suppkey), "
+    "sum(s_acctbal) over (partition by s_nationkey order by s_suppkey "
+    "rows between 3 preceding and 1 preceding) from supplier",
+]
+
+
+@pytest.mark.parametrize("sql", FRAME_QUERIES)
+def test_window_frames_vs_sqlite(engine, oracle, sql):  # noqa: F811
+    check(engine, oracle, sql)
+
+
+def test_bounded_minmax_frame_rejected(engine):
+    with pytest.raises(Exception, match="bounded|NotImplemented"):
+        engine.execute_sql(
+            "select min(n_nationkey) over (order by n_nationkey "
+            "rows between 2 preceding and 2 following) from nation")
