@@ -51,6 +51,13 @@ PROPERTIES = [
     Property("merge_join_enabled",
              "Use the sort-merge join fast path for unique build keys",
              _parse_bool, True),
+    Property("execution_mode",
+             "Plan lowering granularity: 'auto' splits join/window/"
+             "union-bearing plans into per-operator fusion islands "
+             "(bounded XLA program size — the remote TPU compile "
+             "service OOMs on fused whole-plan join programs), 'fused' "
+             "always lowers one whole-plan program, 'island' always "
+             "splits", str, "auto"),
     Property("direct_agg_max_bins",
              "Max mixed-radix bins for the scatter-free small-domain "
              "aggregation path", int, 64),

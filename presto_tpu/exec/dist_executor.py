@@ -43,6 +43,11 @@ class DistExecutor(Executor):
     """Executes plans distributed over an N-device mesh (CPU mesh in
     tests, TPU ICI in production)."""
 
+    # the whole distributed plan lowers into ONE shard_map program
+    # (exchanges are ICI collectives inside it) — island splitting does
+    # not apply here
+    _force_fused = True
+
     def __init__(self, connector, mesh, session=None, history=None):
         super().__init__(connector, session=session)
         self.mesh = mesh

@@ -72,21 +72,32 @@ def _streamable(below_agg: PlanNode, driving: str) -> bool:
     aggregation, a join build/filtering side, a window or a sort would
     make per-batch partials non-additive — batching would silently
     corrupt results, so those shapes fall back to single-shot."""
-    from presto_tpu.plan.nodes import JoinNode, JoinType
+    return _streamable_from(
+        below_agg,
+        lambda n: isinstance(n, TableScanNode) and n.table == driving)
 
-    def scans_driving(n) -> bool:
-        if isinstance(n, TableScanNode):
-            return n.table == driving
-        return any(c is not None and scans_driving(c)
+
+def _streamable_from(below_agg: PlanNode, is_driving) -> bool:
+    """Generalized additivity check: `is_driving(node)` marks the
+    streamed input (a table scan lifespan, or a RemoteSourceNode whose
+    pages arrive in chunks — server/task_manager's non-leaf streaming)."""
+    from presto_tpu.plan.nodes import JoinNode, JoinType, RemoteSourceNode
+
+    def has_driving(n) -> bool:
+        if is_driving(n):
+            return True
+        return any(c is not None and has_driving(c)
                    for c in n.children())
 
     def ok(n) -> bool:
-        if isinstance(n, TableScanNode):
+        if is_driving(n):
+            return True
+        if isinstance(n, (TableScanNode, RemoteSourceNode)):
             return True
         if isinstance(n, (FilterNode, ProjectNode)):
             return ok(n.source)
         if isinstance(n, JoinNode):
-            if scans_driving(n.build):
+            if has_driving(n.build):
                 return False
             if n.join_type not in (JoinType.INNER, JoinType.LEFT,
                                    JoinType.SEMI, JoinType.ANTI,
@@ -94,8 +105,8 @@ def _streamable(below_agg: PlanNode, driving: str) -> bool:
                 return False
             return ok(n.probe)
         # Any other node (nested aggregation, window, sort, unique-id)
-        # between the driving scan and the root agg is non-streamable.
-        return not scans_driving(n)
+        # between the driving input and the root agg is non-streamable.
+        return not has_driving(n)
 
     return ok(below_agg)
 

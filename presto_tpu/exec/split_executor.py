@@ -56,6 +56,8 @@ class SplitExecutor(Executor):
     def _fetch(self, s) -> Page:
         if isinstance(s, RemotePageSpec):
             return self.remote_pages[s.node_id]
+        if not hasattr(s, "table"):       # island PageInputSpec
+            return super()._fetch(s)
         parts = self.splits.get(s.table)
         if parts is None:
             return super()._fetch(s)

@@ -302,7 +302,8 @@ def hash_join(probe: Page, build: Page,
                            jnp.maximum(counts, jnp.where(
                                probe.row_valid(), 1, 0)), counts)
         # rows with no candidates still emit one (null-extended) pair
-    cum = jnp.cumsum(counts)
+    from presto_tpu.ops.scan import cumsum as blocked_cumsum
+    cum = blocked_cumsum(counts)     # jnp.cumsum at 8M is pathological
     total = cum[-1] if pcap > 0 else jnp.int64(0)
 
     j = jnp.arange(out_capacity, dtype=jnp.int64)

@@ -43,47 +43,75 @@ def cumsum(x: jnp.ndarray) -> jnp.ndarray:
     return out.reshape(-1)[:n]
 
 
+def _cummax_1d_doubling(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive running max of a SMALL 1-D array via Hillis-Steele
+    doubling (log n shifted-max steps — plain elementwise ops, never
+    lax.associative_scan, whose custom-op lowering takes tens of
+    minutes to compile through the remote TPU compile service)."""
+    n = x.shape[0]
+    lo = jnp.full((1,), jnp.iinfo(x.dtype).min, x.dtype)
+    d = 1
+    while d < n:
+        pad = jnp.broadcast_to(lo, (d,))
+        x = jnp.maximum(x, jnp.concatenate([pad, x[:-d]]))
+        d *= 2
+    return x
+
+
+def blocked_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive running max, blocked: doubling scan over the [B, LANE]
+    trailing axis + tiny block-prefix scan + combine."""
+    x2, n = _pad_to_blocks(x)
+    lo = jnp.iinfo(x.dtype).min
+    if n < x2.size:                 # padding must not win the max
+        flat = x2.reshape(-1)
+        flat = jnp.where(jnp.arange(flat.shape[0]) < n, flat, lo)
+        x2 = flat.reshape(x2.shape)
+    within = x2
+    d = 1
+    while d < _LANE:
+        shifted = jnp.concatenate(
+            [jnp.full((within.shape[0], d), lo, within.dtype),
+             within[:, :-d]], axis=1)
+        within = jnp.maximum(within, shifted)
+        d *= 2
+    totals = within[:, -1]
+    pre = _cummax_1d_doubling(totals)
+    pre = jnp.concatenate([jnp.full((1,), lo, x.dtype), pre[:-1]])
+    return jnp.maximum(within, pre[:, None]).reshape(-1)[:n]
+
+
 def fill_forward(vals: jnp.ndarray, present: jnp.ndarray,
                  init=None):
-    """Per-slot last `present` value at or before the slot (blocked
-    fill-forward scan). Slots before the first present value get `init`
-    (default: the dtype's zero). The merge-join propagation primitive:
-    after co-sorting build rows ahead of probe rows per key, every probe
-    slot reads its candidate build row without any random gather."""
-    import jax
+    """Per-slot last `present` value at or before the slot. Slots before
+    the first present value get `init` (default: the dtype's zero). The
+    merge-join propagation primitive.
 
+    Implemented as a blocked running-max of present POSITIONS + one
+    gather (never a value-carrying associative_scan: its custom-op
+    lowering compiles pathologically on this stack, and gathers run at
+    memory bandwidth)."""
     if init is None:
         init = jnp.zeros((), dtype=vals.dtype)
-    x2, n = _pad_to_blocks(vals)
-    p2, _ = _pad_to_blocks(present.astype(jnp.int8))
-    p2 = p2.astype(bool)
-
-    def op(a, b):
-        av, ap = a
-        bv, bp = b
-        return jnp.where(bp, bv, av), ap | bp
-
-    within_v, within_p = jax.lax.associative_scan(op, (x2, p2), axis=1)
-    blk_v, blk_p = within_v[:, -1], within_p[:, -1]
-    pre_v, pre_p = jax.lax.associative_scan(op, (blk_v, blk_p), axis=0)
-    # exclusive block prefix
-    pre_v = jnp.concatenate([jnp.full((1,), init, vals.dtype), pre_v[:-1]])
-    pre_p = jnp.concatenate([jnp.zeros((1,), bool), pre_p[:-1]])
-    out = jnp.where(within_p, within_v,
-                    jnp.where(pre_p[:, None], pre_v[:, None], init))
-    return out.reshape(-1)[:n]
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    pos = jnp.where(present, idx, jnp.int32(-1))
+    last = blocked_cummax(pos)
+    out = jnp.take(vals, jnp.clip(last, 0, n - 1), mode="clip")
+    return jnp.where(last >= 0, out, jnp.asarray(init, vals.dtype))
 
 
 def seg_scan(vals: jnp.ndarray, seg_start: jnp.ndarray, binop,
              ident) -> jnp.ndarray:
     """Inclusive segmented scan: out[i] = binop-fold of vals over
     [start_of_segment(i), i], where True in `seg_start` begins a new
-    segment. Blocked like cumsum/fill_forward (intra-block associative
-    scan + block-total scan + combine). `ident` is binop's identity
-    (used for padding and pre-first-segment slots). The running min/max
-    window-frame primitive."""
-    import jax
+    segment. `ident` is binop's identity (used for padding and
+    pre-first-segment slots). The running min/max window-frame
+    primitive.
 
+    Hillis-Steele doubling over the blocked [B, LANE] layout — plain
+    shifted elementwise steps, never lax.associative_scan (its
+    custom-op lowering compiles pathologically on this stack)."""
     n0 = vals.shape[0]
     blocks = max(1, (n0 + _LANE - 1) // _LANE)
     pad = blocks * _LANE - n0
@@ -92,19 +120,33 @@ def seg_scan(vals: jnp.ndarray, seg_start: jnp.ndarray, binop,
             [vals, jnp.full((pad,), ident, vals.dtype)])
         seg_start = jnp.concatenate(
             [seg_start, jnp.zeros((pad,), bool)])
-    x2 = vals.reshape(blocks, _LANE)
-    f2 = seg_start.reshape(blocks, _LANE)
+    v = vals.reshape(blocks, _LANE)
+    f = seg_start.reshape(blocks, _LANE)
 
-    def op(a, b):
-        av, af = a
-        bv, bf = b
-        return jnp.where(bf, bv, binop(av, bv)), af | bf
-
-    wv, wf = jax.lax.associative_scan(op, (x2, f2), axis=1)
-    pv, pf = jax.lax.associative_scan(op, (wv[:, -1], wf[:, -1]), axis=0)
-    # exclusive block prefix
-    pv = jnp.concatenate([jnp.full((1,), ident, vals.dtype), pv[:-1]])
-    out = jnp.where(wf, wv, binop(pv[:, None], wv))
+    # segmented doubling along the lane axis: fold in the value d slots
+    # left unless a segment boundary lies in between (the or-accumulated
+    # flag blocks propagation across starts)
+    d = 1
+    while d < _LANE:
+        v_sh = jnp.concatenate(
+            [jnp.full((blocks, d), ident, v.dtype), v[:, :-d]], axis=1)
+        f_sh = jnp.concatenate(
+            [jnp.zeros((blocks, d), bool), f[:, :-d]], axis=1)
+        v = jnp.where(f, v, binop(v, v_sh))
+        f = f | f_sh
+        d *= 2
+    # tiny exclusive prefix over the per-block (total, has-boundary)
+    bv, bf = v[:, -1], f[:, -1]
+    db = 1
+    while db < blocks:
+        bv_sh = jnp.concatenate(
+            [jnp.full((db,), ident, bv.dtype), bv[:-db]])
+        bf_sh = jnp.concatenate([jnp.zeros((db,), bool), bf[:-db]])
+        bv = jnp.where(bf, bv, binop(bv, bv_sh))
+        bf = bf | bf_sh
+        db *= 2
+    pv = jnp.concatenate([jnp.full((1,), ident, bv.dtype), bv[:-1]])
+    out = jnp.where(f, v, binop(pv[:, None], v))
     return out.reshape(-1)[:n0]
 
 

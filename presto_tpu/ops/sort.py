@@ -17,38 +17,17 @@ import jax
 import jax.numpy as jnp
 
 from presto_tpu.data.column import Column, Page
-from presto_tpu.ops.keys import SortKey, _orderable_lanes
-
-
-def _sort_key_operands(page: Page, keys: Sequence[SortKey]) -> List:
-    """Lexicographic key operands for lax.sort: padding rows last, then
-    per-SortKey (null rank, order-transformed value lanes — Decimal128
-    sums contribute two exact limb lanes, ops/keys._orderable_lanes)."""
-    cap = page.capacity
-    ops: List = [
-        (jnp.arange(cap, dtype=jnp.int32) >= page.num_rows).astype(jnp.int8)]
-    for k in keys:
-        col = page.columns[k.field]
-        null_rank = jnp.where(col.nulls,
-                              jnp.int8(0 if k.nulls_sort_first else 1),
-                              jnp.int8(1 if k.nulls_sort_first else 0))
-        ops.append(null_rank)
-        for v in _orderable_lanes(col):
-            if not k.ascending:
-                v = -v.astype(jnp.int64) if not jnp.issubdtype(
-                    v.dtype, jnp.floating) else -v
-            ops.append(v)
-    return ops
+from presto_tpu.ops.keys import SortKey, sort_perm
 
 
 def sort_page(page: Page, keys: Sequence[SortKey]) -> Page:
-    """Sort via ops/keys.lex_perm (composed 2-operand argsorts over the
-    key lanes) + one gather per column — never a wide variadic lax.sort
-    (compile cost explodes with operand count on this stack)."""
+    """Sort via ops/keys.sort_perm (composed 2-operand stable argsorts
+    over the key lanes — THE shared lexicographic-permutation
+    implementation) + one gather per column; never a wide variadic
+    lax.sort (compile cost explodes with operand count on this
+    stack)."""
     from presto_tpu.data.column import gather_page
-    from presto_tpu.ops.keys import lex_perm
-    perm = lex_perm(_sort_key_operands(page, keys))
-    return gather_page(page, perm)
+    return gather_page(page, sort_perm(page, keys))
 
 
 def top_n(page: Page, keys: Sequence[SortKey], n: int) -> Page:

@@ -60,8 +60,8 @@ def _pack_by_partition(arrs, pid, ndev: int, chunk: int, valid):
     idx = jnp.arange(cap, dtype=jnp.int32)
     is_start = jnp.concatenate(
         [jnp.ones((1,), bool), spid[1:] != spid[:-1]])
-    seg_start = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(is_start, idx, 0))
+    from presto_tpu.ops.scan import blocked_cummax
+    seg_start = blocked_cummax(jnp.where(is_start, idx, 0))
     rank = idx - seg_start
     counts = jnp.zeros((ndev + 1,), jnp.int32).at[spid].add(
         valid[order].astype(jnp.int32))[:ndev]

@@ -363,7 +363,8 @@ class TpuTaskManager:
             ex.set_splits(task.splits)
             task.total_splits = sum(len(v) for v in task.splits.values())
             task.start_time = time.time()
-            if not self._run_streaming(task, plan, ex):
+            if not self._run_streaming(task, plan, ex) \
+                    and not self._run_streaming_remote(task, plan, ex):
                 remote = self._pull_remote_inputs(task, plan)
                 ex.set_remote_pages(remote)
                 page = ex.execute(plan)
@@ -451,6 +452,91 @@ class TpuTaskManager:
         self._collect_stats(task, ex)
         return True
 
+    def _run_streaming_remote(self, task: Task, plan,
+                              ex: SplitExecutor) -> bool:
+        """Non-leaf streaming (reference: SqlTaskExecution.java:509 —
+        every stage of a section runs concurrently, pages flowing
+        through): a fragment whose DRIVING input is a RemoteSourceNode
+        executes once per pulled chunk, emitting each chunk's output
+        into the token/ack buffers while upstream tasks are still
+        producing — so a 3-stage pipeline's stage-2 tokens advance
+        before stage-1 finishes. Additivity rules are the lifespan
+        rules (exec/lifespan._streamable_from): row-preserving chains
+        and PARTIAL aggregations over the driving input; FINAL
+        aggregations, sorts and join build sides fall back to
+        single-shot. Returns False when the shape doesn't allow it."""
+        from presto_tpu.exec.lifespan import _streamable_from
+        from presto_tpu.plan.nodes import (
+            AggregationNode, FilterNode, OutputNode, ProjectNode,
+            RemoteSourceNode, Step,
+        )
+        from presto_tpu.protocol.exchange_client import (
+            PageStream, decode_pages,
+        )
+
+        rs = _remote_source_nodes(plan)
+        if not rs:
+            return False
+        # driving = the remote input with the most upstream tasks
+        driving = max(rs, key=lambda n: len(
+            task.remote_splits.get(n.node_id, [])))
+        if not task.remote_splits.get(driving.node_id):
+            return False
+
+        def is_driving(n):
+            return isinstance(n, RemoteSourceNode) \
+                and n.node_id == driving.node_id
+
+        node = plan
+        while isinstance(node, (OutputNode, ProjectNode, FilterNode)):
+            node = node.source
+        if isinstance(node, AggregationNode):
+            if node.step != Step.PARTIAL \
+                    or not _streamable_from(node.source, is_driving):
+                return False
+        elif not _streamable_from(node, is_driving):
+            return False
+
+        # non-driving remote inputs materialize fully up front
+        others = self._pull_remote_inputs(
+            task, plan, skip={driving.node_id})
+        ex.set_splits(task.splits)
+
+        emitted = [0]
+
+        def run_chunk(pages: List[Page]) -> None:
+            if not pages:
+                return
+            for p in pages:
+                p.names = driving.output_names
+            chunk = concat_pages_host(pages)
+            ex.set_remote_pages({**others, driving.node_id: chunk})
+            out = ex.execute(plan)
+            task.output_positions += int(out.num_rows)
+            self._emit_output(task, out)
+            emitted[0] += 1
+
+        for loc, buf in task.remote_splits[driving.node_id]:
+            stream = PageStream(loc, buffer_id=buf,
+                                max_size_bytes=self.REMOTE_CHUNK_BYTES)
+            while not stream.complete:
+                data = stream.fetch()
+                if data:
+                    run_chunk(decode_pages(
+                        data, list(driving.output_types)))
+            stream.close()
+        if emitted[0] == 0:
+            # no upstream rows at all: run once on an empty chunk so
+            # output shape/stats exist (PARTIAL aggs emit zero states)
+            from presto_tpu.data.column import Column
+            cols = [Column.from_numpy(np.zeros(0, t.dtype), t,
+                                      capacity=256)
+                    for t in driving.output_types]
+            run_chunk([Page.from_columns(cols, 0,
+                                         driving.output_names)])
+        self._collect_stats(task, ex)
+        return True
+
     def _collect_stats(self, task: Task, ex: SplitExecutor) -> None:
         """Executor per-node row counters -> OperatorStats summaries
         (reference: PrestoTask.cpp converting velox stats to protocol
@@ -487,16 +573,20 @@ class TpuTaskManager:
     #: raw wire bytes never accumulate past one chunk per upstream.
     REMOTE_CHUNK_BYTES = 4 << 20
 
-    def _pull_remote_inputs(self, task: Task, plan) -> Dict[str, Page]:
+    def _pull_remote_inputs(self, task: Task, plan,
+                            skip=None) -> Dict[str, Page]:
         """Pull every upstream page stream this task's remote splits name
         in bounded chunks and fuse them into one engine Page per
         RemoteSourceNode (consumer side of the pull protocol —
         ExchangeClient.java:255 semantics; the final materialization is
-        what the whole-fragment jit engine consumes)."""
+        what the whole-fragment jit engine consumes). `skip` excludes
+        node ids the caller streams itself (_run_streaming_remote)."""
         from presto_tpu.protocol.exchange_client import PageStream
 
         out: Dict[str, Page] = {}
         for node in _remote_source_nodes(plan):
+            if skip and node.node_id in skip:
+                continue
             splits = task.remote_splits.get(node.node_id, [])
             # concurrent pulls (reference: ExchangeClient's parallel
             # PageBufferClients) — producer latencies overlap
