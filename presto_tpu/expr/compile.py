@@ -399,6 +399,96 @@ def _dict_predicate(col: Column, fn) -> Column:
     return _bool(v, col.nulls)
 
 
+def _as_f64(col: Column) -> jnp.ndarray:
+    """Column values as float64 LOGICAL values (decimals descale)."""
+    v = col.values.astype(jnp.float64)
+    if col.type.is_decimal:
+        v = v / (10 ** col.type.scale)
+    return v
+
+
+def _dict_transform_nullable(col: Column, fn) -> Column:
+    """Like _dict_transform, but fn may return None: those codes become
+    NULL rows (split_part past the last field, regexp_extract with no
+    match, json paths that miss)."""
+    words = col.dictionary.words if col.dictionary else ()
+    out = [fn(w) for w in words]
+    null_tbl = np.array([o is None for o in out], dtype=bool)
+    filled = ["" if o is None else o for o in out]
+    newd, codes = StringDict.build(filled) if filled \
+        else (StringDict([]), np.zeros(0, np.int32))
+    remap = jnp.asarray(codes) if filled else jnp.zeros((1,), jnp.int32)
+    idx = jnp.clip(col.values, 0, max(len(words) - 1, 0))
+    nv = jnp.take(remap, idx)
+    extra_null = (jnp.take(jnp.asarray(null_tbl), idx)
+                  if len(words) else jnp.zeros_like(col.nulls))
+    return Column(nv, col.nulls | extra_null, col.type, newd)
+
+
+def _dict_int(col: Column, fn) -> Column:
+    """Host string->int fn over the dictionary -> device BIGINT gather."""
+    words = col.dictionary.words if col.dictionary else ()
+    tbl = jnp.asarray(np.array([int(fn(w)) for w in words], np.int64)
+                      if words else np.zeros(1, np.int64))
+    v = jnp.take(tbl, jnp.clip(col.values, 0, max(len(words) - 1, 0)))
+    return Column(v, col.nulls, BIGINT)
+
+
+def _days_from_civil_dev(y, m, d):
+    """Vectorized (year, month, day) -> days-since-epoch (inverse of
+    _civil_from_days; public-domain days_from_civil algorithm)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _pad_word(w: str, size: int, pad: str, left: bool) -> str:
+    """Presto lpad/rpad: truncate to size, else fill with `pad`
+    repeated."""
+    if size <= len(w):
+        return w[:size]
+    fill = (pad * size)[:size - len(w)] if pad else ""
+    return fill + w if left else w + fill
+
+
+def _regex_cache(pattern: str):
+    import re
+    key = ("re", pattern)
+    rx = _LIKE_CACHE.get(key)
+    if rx is None:
+        rx = _LIKE_CACHE[key] = re.compile(pattern)
+    return rx
+
+
+def _json_scalar_path(doc: str, path: str):
+    """Minimal $.a.b[0] JSONPath subset for json_extract_scalar."""
+    import json as _json
+    import re as _re
+    try:
+        v = _json.loads(doc)
+    except Exception:   # noqa: BLE001 — bad JSON -> NULL (Presto)
+        return None
+    if not path.startswith("$"):
+        return None
+    for tok in _re.findall(r"\.([^.\[\]]+)|\[(\d+)\]", path[1:]):
+        key, idx = tok
+        try:
+            v = v[int(idx)] if idx else v[key]
+        except Exception:   # noqa: BLE001 — missing path -> NULL
+            return None
+    if v is None or isinstance(v, (dict, list)):
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)
+
+
 def _call(e: Call, page: Page, ev) -> Column:
     name = e.name
     if name in ("add", "subtract", "multiply", "divide", "modulus"):
@@ -483,12 +573,12 @@ def _call(e: Call, page: Page, ev) -> Column:
         if name == "round" and len(e.args) > 1:
             nd = e.args[1].value
             f = 10.0 ** nd
-            v = jnp.round(c.values.astype(jnp.float64) * f) / f
+            v = jnp.round(_as_f64(c) * f) / f
             return Column(v, c.nulls, DOUBLE)
         fn = {"sqrt": jnp.sqrt, "ln": jnp.log, "log10": jnp.log10,
               "exp": jnp.exp, "floor": jnp.floor, "ceil": jnp.ceil,
               "round": jnp.round}[name]
-        v = fn(c.values.astype(jnp.float64))
+        v = fn(_as_f64(c))
         if name in ("floor", "ceil", "round") and c.type.is_integer:
             return Column(c.values, c.nulls, c.type)
         return Column(v, c.nulls, DOUBLE)
@@ -497,4 +587,251 @@ def _call(e: Call, page: Page, ev) -> Column:
         k = ev(e.args[1], page)
         return Column(c.values + k.values.astype(c.values.dtype),
                       c.nulls | k.nulls, c.type)
+
+    # ---- string functions over the dictionary (operator/scalar/
+    # String*.java family; host transform + device code gather) --------
+    def _litstr(i: int, what: str) -> str:
+        a = e.args[i]
+        if not isinstance(a, Literal):
+            raise NotImplementedError(f"{name} {what} must be a literal")
+        return a.value
+
+    def _litint(i: int, what: str) -> int:
+        a = e.args[i]
+        if not isinstance(a, Literal):
+            raise NotImplementedError(f"{name} {what} must be a literal")
+        return int(a.value)
+
+    if name == "replace":
+        c = ev(e.args[0], page)
+        find = _litstr(1, "search")
+        repl = _litstr(2, "replacement") if len(e.args) > 2 else ""
+        return _dict_transform(c, lambda w: w.replace(find, repl))
+    if name == "reverse":
+        c = ev(e.args[0], page)
+        return _dict_transform(c, lambda w: w[::-1])
+    if name in ("lpad", "rpad"):
+        c = ev(e.args[0], page)
+        size = _litint(1, "size")
+        pad = _litstr(2, "padstring") if len(e.args) > 2 else " "
+        left = name == "lpad"
+        return _dict_transform(
+            c, lambda w: _pad_word(w, size, pad, left))
+    if name == "split_part":
+        c = ev(e.args[0], page)
+        delim = _litstr(1, "delimiter")
+        index = _litint(2, "index")
+        if index <= 0:
+            raise NotImplementedError("split_part index must be > 0")
+
+        def part(w):
+            ps = w.split(delim) if delim else [w]
+            return ps[index - 1] if index <= len(ps) else None
+        return _dict_transform_nullable(c, part)
+    if name == "strpos":
+        c = ev(e.args[0], page)
+        sub = _litstr(1, "substring")
+        return _dict_int(c, lambda w: w.find(sub) + 1)
+    if name == "starts_with":
+        c = ev(e.args[0], page)
+        pre = _litstr(1, "prefix")
+        return _dict_predicate(c, lambda w: w.startswith(pre))
+    if name == "regexp_like":
+        c = ev(e.args[0], page)
+        rx = _regex_cache(_litstr(1, "pattern"))
+        return _dict_predicate(c, lambda w: rx.search(w) is not None)
+    if name == "regexp_extract":
+        c = ev(e.args[0], page)
+        rx = _regex_cache(_litstr(1, "pattern"))
+        group = _litint(2, "group") if len(e.args) > 2 else 0
+
+        def extract(w):
+            m = rx.search(w)
+            return m.group(group) if m else None
+        return _dict_transform_nullable(c, extract)
+    if name == "regexp_replace":
+        c = ev(e.args[0], page)
+        rx = _regex_cache(_litstr(1, "pattern"))
+        repl = _litstr(2, "replacement") if len(e.args) > 2 else ""
+        # Presto capture refs are $1; python's are \1
+        import re as _re
+        py_repl = _re.sub(r"\$(\d+)", r"\\\1", repl)
+        return _dict_transform(c, lambda w: rx.sub(py_repl, w))
+    if name == "json_extract_scalar":
+        c = ev(e.args[0], page)
+        path = _litstr(1, "path")
+        return _dict_transform_nullable(
+            c, lambda w: _json_scalar_path(w, path))
+    if name.startswith("url_extract_"):
+        c = ev(e.args[0], page)
+        part = name[len("url_extract_"):]
+        from urllib.parse import urlparse
+
+        def url_part(w):
+            try:
+                u = urlparse(w)
+                v = {"host": u.hostname, "path": u.path,
+                     "protocol": u.scheme, "query": u.query,
+                     "fragment": u.fragment}.get(part)
+            except Exception:   # noqa: BLE001 — bad URL -> NULL
+                return None
+            return None if v in (None, "") and part != "path" else str(v)
+        if part == "port":
+            # NULL when absent/malformed (Presto UrlFunctions.java)
+            words = c.dictionary.words if c.dictionary else ()
+            ports = []
+            for w in words:
+                try:
+                    ports.append(urlparse(w).port)
+                except Exception:   # noqa: BLE001 — bad port -> NULL
+                    ports.append(None)
+            null_tbl = np.array([p is None for p in ports], bool)
+            val_tbl = np.array([0 if p is None else p for p in ports],
+                               np.int64)
+            idx = jnp.clip(c.values, 0, max(len(words) - 1, 0))
+            if not words:
+                return Column(jnp.zeros_like(c.values, jnp.int64),
+                              jnp.ones_like(c.nulls), BIGINT)
+            v = jnp.take(jnp.asarray(val_tbl), idx)
+            extra = jnp.take(jnp.asarray(null_tbl), idx)
+            return Column(v, c.nulls | extra, BIGINT)
+        return _dict_transform_nullable(c, url_part)
+
+    # ---- date functions (operator/scalar/DateTimeFunctions.java) -----
+    if name in ("date_trunc", "day_of_week", "day_of_year", "quarter",
+                "week", "last_day_of_month"):
+        di = 1 if name == "date_trunc" else 0
+        c = ev(e.args[di], page)
+        days = c.values if c.type == DATE \
+            else c.values // 86_400_000_000
+        y, m, d = _civil_from_days(days)
+        if name == "date_trunc":
+            unit = _litstr(0, "unit").lower()
+            if unit == "day":
+                out = days
+            elif unit == "week":      # ISO week starts Monday
+                out = days - (days + 3) % 7
+            elif unit == "month":
+                out = _days_from_civil_dev(y, m, jnp.ones_like(d))
+            elif unit == "quarter":
+                qm = ((m - 1) // 3) * 3 + 1
+                out = _days_from_civil_dev(y, qm, jnp.ones_like(d))
+            elif unit == "year":
+                out = _days_from_civil_dev(y, jnp.ones_like(m),
+                                           jnp.ones_like(d))
+            else:
+                raise NotImplementedError(f"date_trunc unit {unit!r}")
+            if c.type != DATE:      # TIMESTAMP: back to microseconds
+                out = out * 86_400_000_000
+            return Column(out.astype(c.values.dtype), c.nulls, c.type)
+        if name == "day_of_week":
+            return Column(((days + 3) % 7 + 1).astype(jnp.int64),
+                          c.nulls, BIGINT)
+        if name == "day_of_year":
+            jan1 = _days_from_civil_dev(y, jnp.ones_like(m),
+                                        jnp.ones_like(d))
+            return Column((days - jan1 + 1).astype(jnp.int64),
+                          c.nulls, BIGINT)
+        if name == "quarter":
+            return Column(((m + 2) // 3).astype(jnp.int64), c.nulls,
+                          BIGINT)
+        if name == "week":
+            # ISO 8601 week of year: the week containing this date's
+            # Thursday, counted within that Thursday's calendar year
+            thu = days - (days + 3) % 7 + 3
+            ty, _tm, _td = _civil_from_days(thu)
+            jan1 = _days_from_civil_dev(ty, jnp.ones_like(m),
+                                        jnp.ones_like(d))
+            return Column(((thu - jan1) // 7 + 1).astype(jnp.int64),
+                          c.nulls, BIGINT)
+        # last_day_of_month: first day of next month - 1
+        ny = y + (m == 12)
+        nm = m % 12 + 1
+        out = _days_from_civil_dev(ny, nm, jnp.ones_like(d)) - 1
+        return Column(out.astype(c.values.dtype), c.nulls, DATE)
+    if name == "date_diff":
+        unit = _litstr(0, "unit").lower()
+        a = ev(e.args[1], page)
+        b = ev(e.args[2], page)
+        da = a.values if a.type == DATE else a.values // 86_400_000_000
+        db = b.values if b.type == DATE else b.values // 86_400_000_000
+        nulls = a.nulls | b.nulls
+        if unit == "day":
+            return Column((db - da).astype(jnp.int64), nulls, BIGINT)
+        if unit == "week":
+            return Column(((db - da) // 7).astype(jnp.int64), nulls,
+                          BIGINT)
+        if unit in ("month", "quarter", "year"):
+            ya, ma, dda = _civil_from_days(da)
+            yb, mb, ddb = _civil_from_days(db)
+            months = (yb - ya) * 12 + (mb - ma)
+            # complete months only, with end-of-month clamping (Joda
+            # monthsBetween: Jan-31 -> Feb-29 IS one month because the
+            # clamped add lands on the month's last day)
+            ones = jnp.ones_like(ma)
+
+            def eom_day(y, m):
+                ny = y + (m == 12)
+                nm = m % 12 + 1
+                return (_days_from_civil_dev(ny, nm, ones)
+                        - _days_from_civil_dev(y, m, ones))
+            short_fwd = (ddb < dda) & (ddb < eom_day(yb, mb))
+            short_back = (ddb > dda) & (dda < eom_day(ya, ma))
+            months = months - ((db >= da) & short_fwd) \
+                + ((db < da) & short_back)
+            div = {"month": 1, "quarter": 3, "year": 12}[unit]
+            # truncate toward zero
+            q = jnp.sign(months) * (jnp.abs(months) // div)
+            return Column(q.astype(jnp.int64), nulls, BIGINT)
+        raise NotImplementedError(f"date_diff unit {unit!r}")
+
+    # ---- math (operator/scalar/MathFunctions.java) -------------------
+    if name == "power":
+        x = ev(e.args[0], page)
+        p = ev(e.args[1], page)
+        v = jnp.power(_as_f64(x), _as_f64(p))
+        return Column(v, x.nulls | p.nulls, DOUBLE)
+    if name == "cbrt":
+        c = ev(e.args[0], page)
+        return Column(jnp.cbrt(_as_f64(c)), c.nulls, DOUBLE)
+    if name == "log2":
+        c = ev(e.args[0], page)
+        return Column(jnp.log2(_as_f64(c)), c.nulls, DOUBLE)
+    if name == "sign":
+        c = ev(e.args[0], page)
+        if c.type.is_decimal:     # sign of the unscaled == sign of the
+            return Column(jnp.sign(c.values), c.nulls, BIGINT)  # value
+        return Column(jnp.sign(c.values), c.nulls, c.type)
+    if name == "truncate":
+        c = ev(e.args[0], page)
+        if c.type.is_integer:
+            return Column(c.values, c.nulls, c.type)
+        return Column(jnp.trunc(_as_f64(c)), c.nulls, DOUBLE)
+    if name in ("pi", "e"):
+        import math
+        val = math.pi if name == "pi" else math.e
+        cap = page.capacity
+        return Column(jnp.full((cap,), val, jnp.float64),
+                      jnp.zeros((cap,), bool), DOUBLE)
+    if name in ("greatest", "least"):
+        binop = jnp.maximum if name == "greatest" else jnp.minimum
+        if e.type.is_string:
+            # dictionary codes only order within ONE dictionary: align
+            # pairwise, fold on aligned codes
+            acc_col = ev(e.args[0], page)
+            for a in e.args[1:]:
+                x, y = align_string_columns(acc_col, ev(a, page))
+                acc_col = Column(binop(x.values, y.values),
+                                 x.nulls | y.nulls, VARCHAR,
+                                 x.dictionary)
+            return acc_col
+        # coerce every arg to the common result type first (mixed
+        # decimal scales compare wrong as raw unscaled ints)
+        cols = [_cast(ev(a, page), e.type) for a in e.args]
+        acc = cols[0].values
+        nulls = cols[0].nulls
+        for c in cols[1:]:
+            acc = binop(acc, c.values.astype(acc.dtype))
+            nulls = nulls | c.nulls     # Presto: any NULL arg -> NULL
+        return Column(acc, nulls, e.type)
     raise NotImplementedError(f"function {name}")

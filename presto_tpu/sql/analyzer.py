@@ -81,6 +81,19 @@ _SCALAR_FUNCS = {"substr", "length", "lower", "upper", "trim", "ltrim",
                  "ceil", "ceiling", "round", "year", "month", "day",
                  "concat", "negate", "like"}
 
+# round-4 scalar sprint (reference: operator/scalar/ String/DateTime/
+# Math/Json/Url function families), typed by result
+_SCALAR_VARCHAR_FUNCS = {
+    "replace", "reverse", "lpad", "rpad", "split_part",
+    "regexp_extract", "regexp_replace", "json_extract_scalar",
+    "url_extract_host", "url_extract_path", "url_extract_protocol",
+    "url_extract_query", "url_extract_fragment"}
+_SCALAR_BIGINT_FUNCS = {
+    "strpos", "day_of_week", "day_of_year", "quarter", "week",
+    "date_diff", "url_extract_port"}
+_SCALAR_BOOLEAN_FUNCS = {"starts_with", "regexp_like"}
+_SCALAR_DOUBLE_FUNCS = {"power", "cbrt", "log2", "pi", "e"}
+
 
 def _conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:
     if e is None:
@@ -2311,7 +2324,49 @@ class Planner:
             return SpecialForm(Form.COALESCE, args, rt)
         if name in ("substr", "substring"):
             return Call("substr", args, VARCHAR)
-        name = {"ceiling": "ceil"}.get(name, name)
+        name = {"ceiling": "ceil", "pow": "power", "dow": "day_of_week",
+                "doy": "day_of_year", "week_of_year": "week",
+                "position": "strpos", "char_length": "length",
+                "character_length": "length"}.get(name, name)
+        if name == "mod":
+            if len(args) != 2:
+                raise AnalysisError("mod() takes two arguments")
+            return Call("modulus", args,
+                        self._arith_type("modulus", args[0].type,
+                                         args[1].type))
+        if name == "concat" and len(args) > 2:
+            # n-ary concat folds into nested binary concats
+            out = Call("concat", (args[0], args[1]), VARCHAR)
+            for a in args[2:]:
+                out = Call("concat", (out, a), VARCHAR)
+            return out
+        if name in _SCALAR_VARCHAR_FUNCS:
+            return Call(name, args, VARCHAR)
+        if name in _SCALAR_BIGINT_FUNCS:
+            return Call(name, args, BIGINT)
+        if name in _SCALAR_BOOLEAN_FUNCS:
+            return Call(name, args, BOOLEAN)
+        if name in _SCALAR_DOUBLE_FUNCS:
+            return Call(name, args, DOUBLE)
+        if name == "date_trunc":
+            return Call(name, args, args[1].type)
+        if name == "last_day_of_month":
+            return Call(name, args, DATE)
+        if name == "sign":
+            t0 = args[0].type
+            rt = t0 if t0.is_integer else (BIGINT if t0.is_decimal
+                                           else DOUBLE)
+            return Call(name, args, rt)
+        if name == "truncate":
+            rt = args[0].type if args[0].type.is_integer else DOUBLE
+            return Call(name, args, rt)
+        if name in ("greatest", "least"):
+            if not args:
+                raise AnalysisError(f"{name}() needs arguments")
+            rt = args[0].type
+            for x in args[1:]:
+                rt = common_super_type(rt, x.type) or rt
+            return Call(name, args, rt)
         if name in _SCALAR_FUNCS:
             if name in ("year", "month", "day", "length"):
                 rt = BIGINT

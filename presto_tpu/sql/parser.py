@@ -726,6 +726,15 @@ class Parser:
                     self.expect("op", "]")
                 return ast.ArrayLit(tuple(items))
             name = self.ident_text()
+            if name.lower() == "position" and self.peek().text == "(":
+                # POSITION(sub IN s) — SqlBase.g4 POSITION special form;
+                # maps to strpos(s, sub)
+                self.next()
+                sub = self.additive()
+                self.expect_kw("in")
+                s = self.expr()
+                self.expect("op", ")")
+                return ast.FuncCall("strpos", (s, sub))
             if self.peek().kind == "op" and self.peek().text == "(":
                 self.next()
                 if self.accept("op", ")"):
