@@ -65,8 +65,9 @@ def run_case(qnum, engine, oracle):
     types = engine.plan_sql(sql).output_types
     got = [tuple(_iso(v) if t.name == "date" and v is not None else v
                  for v, t in zip(row, types)) for row in got]
-    exp_sql = ({22: Q22_SQLITE, 27: Q27_SQLITE, **SQLITE_OVERRIDES}
-               .get(qnum) or to_sqlite(sql))
+    exp_sql = to_sqlite(
+        {22: Q22_SQLITE, 27: Q27_SQLITE, **SQLITE_OVERRIDES}
+        .get(qnum) or sql)
     exp = oracle.execute(exp_sql).fetchall()
 
     # floats sort ROUNDED so epsilon differences (summation order) can't

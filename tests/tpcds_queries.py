@@ -1865,3 +1865,940 @@ Q86_SQLITE = (
 #: qnum -> hand sqlite oracle (ROLLUP/GROUPING spelled as unions)
 SQLITE_OVERRIDES = {18: Q18_SQLITE, 36: Q36_SQLITE,
                     70: Q70_SQLITE, 86: Q86_SQLITE}
+
+# ---- round-4 additions (VERDICT #5: TPC-DS to >= 85) ---------------------
+
+QUERIES.update({
+    29: """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) store_sales_quantity,
+       sum(sr_return_quantity) store_returns_quantity,
+       sum(cs_quantity) catalog_sales_quantity
+from store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 7 and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_year in (2001, 2002, 2003)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+""",
+    40: """
+select w_state, i_item_id,
+       sum(case when d_date < date '2000-03-11'
+           then cs_sales_price - coalesce(cr_refunded_cash, 0)
+           else 0 end) as sales_before,
+       sum(case when d_date >= date '2000-03-11'
+           then cs_sales_price - coalesce(cr_refunded_cash, 0)
+           else 0 end) as sales_after
+from catalog_sales
+     left join catalog_returns
+       on cs_order_number = cr_order_number and cs_item_sk = cr_item_sk,
+     warehouse, item, date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '2000-02-10' and date '2000-04-10'
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+""",
+    50: """
+select s_store_name, s_company_id, s_city, s_county,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30)
+           then 1 else 0 end) as days_30,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30) and
+                     (sr_returned_date_sk - ss_sold_date_sk <= 60)
+           then 1 else 0 end) as days_31_60,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 60) and
+                     (sr_returned_date_sk - ss_sold_date_sk <= 90)
+           then 1 else 0 end) as days_61_90,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 90) and
+                     (sr_returned_date_sk - ss_sold_date_sk <= 120)
+           then 1 else 0 end) as days_91_120,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 120)
+           then 1 else 0 end) as days_over_120
+from store_sales, store_returns, store, date_dim d1, date_dim d2
+where d2.d_year = 2001 and d2.d_moy = 8
+  and ss_ticket_number = sr_ticket_number
+  and ss_item_sk = sr_item_sk
+  and ss_sold_date_sk = d1.d_date_sk
+  and sr_returned_date_sk = d2.d_date_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_store_sk = s_store_sk
+group by s_store_name, s_company_id, s_city, s_county
+order by s_store_name, s_company_id, s_city, s_county
+limit 100
+""",
+    83: """
+with sr_items as (
+  select i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+  from store_returns, item, date_dim
+  where sr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in
+                     (select d_week_seq from date_dim
+                      where d_date in (date '2001-07-13',
+                                       date '2001-09-10',
+                                       date '2001-11-16')))
+    and sr_returned_date_sk = d_date_sk
+  group by i_item_id),
+wr_items as (
+  select i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+  from web_returns, item, date_dim
+  where wr_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq in
+                     (select d_week_seq from date_dim
+                      where d_date in (date '2001-07-13',
+                                       date '2001-09-10',
+                                       date '2001-11-16')))
+    and wr_returned_date_sk = d_date_sk
+  group by i_item_id)
+select sr_items.item_id,
+       sr_item_qty,
+       sr_item_qty * 1.0 / (sr_item_qty + wr_item_qty) / 2.0 * 100
+         sr_dev,
+       wr_item_qty,
+       wr_item_qty * 1.0 / (sr_item_qty + wr_item_qty) / 2.0 * 100
+         wr_dev,
+       (sr_item_qty + wr_item_qty) / 2.0 as average
+from sr_items, wr_items
+where sr_items.item_id = wr_items.item_id
+order by sr_items.item_id, sr_item_qty
+limit 100
+""",
+    84: """
+select c_customer_id as customer_id,
+       coalesce(c_last_name, '') || ', ' ||
+         coalesce(c_first_name, '') as customername
+from customer, customer_address, customer_demographics,
+     household_demographics, income_band
+where ca_city = 'Edgewood'
+  and c_current_addr_sk = ca_address_sk
+  and ib_lower_bound >= 38128
+  and ib_upper_bound <= 38128 + 50000
+  and ib_income_band_sk = hd_income_band_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+order by customer_id
+limit 100
+""",
+    91: """
+select cc_call_center_id call_center, cc_name call_center_name,
+       cc_manager manager, sum(cr_net_loss) returns_loss
+from call_center, catalog_returns, date_dim, customer,
+     customer_address, customer_demographics, household_demographics
+where cr_call_center_sk = cc_call_center_sk
+  and cr_returned_date_sk = d_date_sk
+  and cr_returning_customer_sk = c_customer_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and ca_address_sk = c_current_addr_sk
+  and d_year = 2001 and d_moy = 11
+  and ((cd_marital_status = 'M' and cd_education_status = 'Unknown')
+    or (cd_marital_status = 'W'
+        and cd_education_status = 'Advanced Degree'))
+  and hd_buy_potential like 'Unknown%'
+  and ca_gmt_offset = -7
+group by cc_call_center_id, cc_name, cc_manager,
+         cd_marital_status, cd_education_status
+order by returns_loss desc
+""",
+})
+
+QUERIES.update({
+    47: """
+with v1 as (
+  select i_category, i_brand, s_store_name, s_company_name,
+         d_year, d_moy,
+         sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over
+           (partition by i_category, i_brand, s_store_name,
+                         s_company_name, d_year) avg_monthly_sales,
+         rank() over
+           (partition by i_category, i_brand, s_store_name,
+                         s_company_name
+            order by d_year, d_moy) rn
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and (d_year = 2000
+         or (d_year = 1999 and d_moy = 12)
+         or (d_year = 2001 and d_moy = 1))
+  group by i_category, i_brand, s_store_name, s_company_name,
+           d_year, d_moy)
+select v1.i_category, v1.d_year, v1.d_moy,
+       v1.avg_monthly_sales avg_ms, v1.sum_sales curr_sales,
+       v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+from v1, v1 v1_lag, v1 v1_lead
+where v1.i_category = v1_lag.i_category
+  and v1.i_category = v1_lead.i_category
+  and v1.i_brand = v1_lag.i_brand
+  and v1.i_brand = v1_lead.i_brand
+  and v1.s_store_name = v1_lag.s_store_name
+  and v1.s_store_name = v1_lead.s_store_name
+  and v1.s_company_name = v1_lag.s_company_name
+  and v1.s_company_name = v1_lead.s_company_name
+  and v1.rn = v1_lag.rn + 1
+  and v1.rn = v1_lead.rn - 1
+  and v1.d_year = 2000
+  and v1.avg_monthly_sales > 0
+  and abs(v1.sum_sales - v1.avg_monthly_sales)
+        / v1.avg_monthly_sales > 0.1
+order by curr_sales - avg_ms, v1.d_moy
+limit 100
+""",
+    57: """
+with v1 as (
+  select i_category, i_brand, cc_name, d_year, d_moy,
+         sum(cs_sales_price) sum_sales,
+         avg(sum(cs_sales_price)) over
+           (partition by i_category, i_brand, cc_name, d_year)
+           avg_monthly_sales,
+         rank() over
+           (partition by i_category, i_brand, cc_name
+            order by d_year, d_moy) rn
+  from item, catalog_sales, date_dim, call_center
+  where cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and cc_call_center_sk = cs_call_center_sk
+    and (d_year = 2000
+         or (d_year = 1999 and d_moy = 12)
+         or (d_year = 2001 and d_moy = 1))
+  group by i_category, i_brand, cc_name, d_year, d_moy)
+select v1.i_category, v1.d_year, v1.d_moy,
+       v1.avg_monthly_sales avg_ms, v1.sum_sales curr_sales,
+       v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+from v1, v1 v1_lag, v1 v1_lead
+where v1.i_category = v1_lag.i_category
+  and v1.i_category = v1_lead.i_category
+  and v1.i_brand = v1_lag.i_brand
+  and v1.i_brand = v1_lead.i_brand
+  and v1.cc_name = v1_lag.cc_name
+  and v1.cc_name = v1_lead.cc_name
+  and v1.rn = v1_lag.rn + 1
+  and v1.rn = v1_lead.rn - 1
+  and v1.d_year = 2000
+  and v1.avg_monthly_sales > 0
+  and abs(v1.sum_sales - v1.avg_monthly_sales)
+        / v1.avg_monthly_sales > 0.1
+order by curr_sales - avg_ms, v1.d_moy
+limit 100
+""",
+    51: """
+with web_v1 as (
+  select ws_item_sk item_sk, d_date,
+         sum(sum(ws_sales_price)) over
+           (partition by ws_item_sk order by d_date
+            rows between unbounded preceding and current row)
+           cume_sales
+  from web_sales, date_dim
+  where ws_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by ws_item_sk, d_date),
+store_v1 as (
+  select ss_item_sk item_sk, d_date,
+         sum(sum(ss_sales_price)) over
+           (partition by ss_item_sk order by d_date
+            rows between unbounded preceding and current row)
+           cume_sales
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by ss_item_sk, d_date)
+select *
+from (select item_sk, d_date, web_sales, store_sales,
+             max(web_sales) over
+               (partition by item_sk order by d_date
+                rows between unbounded preceding and current row)
+               web_cumulative,
+             max(store_sales) over
+               (partition by item_sk order by d_date
+                rows between unbounded preceding and current row)
+               store_cumulative
+      from (select case when web.item_sk is not null
+                        then web.item_sk else store.item_sk end item_sk,
+                   case when web.d_date is not null
+                        then web.d_date else store.d_date end d_date,
+                   web.cume_sales web_sales,
+                   store.cume_sales store_sales
+            from web_v1 web full outer join store_v1 store
+              on (web.item_sk = store.item_sk
+                  and web.d_date = store.d_date)) x) y
+where web_cumulative > store_cumulative
+order by item_sk, d_date
+limit 100
+""",
+    49: """
+select channel, item, return_ratio, return_rank, currency_rank
+from (
+  select 'web' as channel, web.item, web.return_ratio,
+         web.currency_ratio,
+         rank() over (order by web.return_ratio) as return_rank,
+         rank() over (order by web.currency_ratio) as currency_rank
+  from (
+    select ws_item_sk as item,
+           cast(sum(coalesce(wr_return_quantity, 0)) as double)
+             / sum(coalesce(ws_quantity, 0)) as return_ratio,
+           cast(sum(coalesce(wr_return_amt, 0)) as double)
+             / sum(coalesce(ws_net_paid, 0)) as currency_ratio
+    from web_sales
+         left join web_returns
+           on ws_order_number = wr_order_number
+          and ws_item_sk = wr_item_sk,
+         date_dim
+    where wr_return_amt > 100
+      and ws_net_profit > 1 and ws_net_paid > 0 and ws_quantity > 0
+      and ws_sold_date_sk = d_date_sk
+      and d_year = 2001 and d_moy = 12
+    group by ws_item_sk) web) w
+where return_rank <= 10 or currency_rank <= 10
+order by return_rank, currency_rank
+""",
+    67: """
+select *
+from (select i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() over (partition by i_category
+                          order by sumsales desc) rk
+      from (select i_category, i_class, i_brand, i_product_name,
+                   d_year, d_qoy, d_moy, s_store_id,
+                   round(sum(coalesce(ss_sales_price * ss_quantity,
+                                      0)), 2) sumsales
+            from store_sales, date_dim, store, item
+            where ss_sold_date_sk = d_date_sk
+              and ss_item_sk = i_item_sk
+              and ss_store_sk = s_store_sk
+              and d_month_seq between 1200 and 1211
+            group by rollup(i_category, i_class, i_brand,
+                            i_product_name, d_year, d_qoy, d_moy,
+                            s_store_id)) dw1) dw2
+where rk <= 100 and i_category is not null
+order by i_category, rk, sumsales, i_class, i_brand, i_product_name,
+         d_year, d_qoy, d_moy, s_store_id
+limit 100
+""",
+})
+
+
+def _q67_sqlite() -> str:
+    """sqlite has no ROLLUP: expand the 8-column rollup into 9 grouped
+    UNION ALL levels (same strategy as Q22_SQLITE)."""
+    cols = ["i_category", "i_class", "i_brand", "i_product_name",
+            "d_year", "d_qoy", "d_moy", "s_store_id"]
+    body = """
+    from store_sales, date_dim, store, item
+    where ss_sold_date_sk = d_date_sk
+      and ss_item_sk = i_item_sk
+      and ss_store_sk = s_store_sk
+      and d_month_seq between 1200 and 1211"""
+    levels = []
+    for k in range(len(cols), -1, -1):
+        keep = cols[:k]
+        sel = ", ".join(keep + [f"null as {c}" for c in cols[k:]])
+        if not keep:
+            levels.append(
+                f"select {sel}, round(sum(coalesce("
+                f"ss_sales_price * ss_quantity, 0)), 2) sumsales{body}")
+        else:
+            levels.append(
+                f"select {sel}, round(sum(coalesce("
+                f"ss_sales_price * ss_quantity, 0)), 2) sumsales{body}"
+                f"\n    group by {', '.join(keep)}")
+    union = "\nunion all\n".join(levels)
+    return f"""
+select * from (
+  select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales,
+         rank() over (partition by i_category
+                      order by sumsales desc) rk
+  from ({union}) dw1) dw2
+where rk <= 100 and i_category is not null
+order by i_category, rk, sumsales, i_class, i_brand, i_product_name,
+         d_year, d_qoy, d_moy, s_store_id
+limit 100
+"""
+
+
+SQLITE_OVERRIDES[67] = _q67_sqlite()
+
+QUERIES.update({
+    2: """
+with wscs as (
+  select sold_date_sk, sales_price
+  from (select ws_sold_date_sk sold_date_sk,
+               ws_ext_sales_price sales_price
+        from web_sales
+        union all
+        select cs_sold_date_sk sold_date_sk,
+               cs_ext_sales_price sales_price
+        from catalog_sales) x),
+wswscs as (
+  select d_week_seq,
+         sum(case when d_day_name = 'Sunday' then sales_price
+             else null end) sun_sales,
+         sum(case when d_day_name = 'Monday' then sales_price
+             else null end) mon_sales,
+         sum(case when d_day_name = 'Tuesday' then sales_price
+             else null end) tue_sales,
+         sum(case when d_day_name = 'Wednesday' then sales_price
+             else null end) wed_sales,
+         sum(case when d_day_name = 'Thursday' then sales_price
+             else null end) thu_sales,
+         sum(case when d_day_name = 'Friday' then sales_price
+             else null end) fri_sales,
+         sum(case when d_day_name = 'Saturday' then sales_price
+             else null end) sat_sales
+  from wscs, date_dim
+  where d_date_sk = sold_date_sk
+  group by d_week_seq)
+select d_week_seq1,
+       round(sun_sales1 / sun_sales2, 2),
+       round(mon_sales1 / mon_sales2, 2),
+       round(tue_sales1 / tue_sales2, 2),
+       round(wed_sales1 / wed_sales2, 2),
+       round(thu_sales1 / thu_sales2, 2),
+       round(fri_sales1 / fri_sales2, 2),
+       round(sat_sales1 / sat_sales2, 2)
+from (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq
+        and d_year = 2000) y,
+     (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq
+        and d_year = 2001) z
+where d_week_seq1 = d_week_seq2 - 53
+order by d_week_seq1
+""",
+    6: """
+select a.ca_state state, count(*) cnt
+from customer_address a, customer c, store_sales s, date_dim d, item i,
+     (select i_category cat_key, avg(i_current_price) cat_avg
+      from item group by i_category) j
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk
+  and s.ss_item_sk = i.i_item_sk
+  and j.cat_key = i.i_category
+  and d.d_month_seq =
+        (select distinct d_month_seq from date_dim
+         where d_year = 2001 and d_moy = 1)
+  and i.i_current_price > 1.2 * j.cat_avg
+group by a.ca_state
+having count(*) >= 2
+order by cnt, state
+limit 100
+""",
+    8: """
+select s_store_name, sum(ss_net_profit)
+from store_sales, date_dim, store,
+     (select ca_zip from (
+        select substr(ca_zip, 1, 5) ca_zip from customer_address
+        where substr(ca_zip, 1, 5) in
+          ('10023', '10712', '11640', '12155', '12197', '12497',
+           '24128', '76232', '65084', '87816', '83926', '77556')
+        intersect
+        select ca_zip from (
+          select substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+          from customer_address ca, customer c
+          where ca.ca_address_sk = c.c_current_addr_sk
+            and c_preferred_cust_flag = 'Y'
+          group by substr(ca_zip, 1, 5)
+          having count(*) > 1) a1) a2) v1
+where ss_store_sk = s_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 1999
+  and substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+group by s_store_name
+order by s_store_name
+limit 100
+""",
+    9: """
+select case when (select count(*) from store_sales
+                  where ss_quantity between 1 and 20) > 50
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 1 and 20)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 1 and 20) end bucket1,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 21 and 40) > 50
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 21 and 40)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 21 and 40) end bucket2,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 41 and 60) > 50
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 41 and 60)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 41 and 60) end bucket3,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 61 and 80) > 50
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 61 and 80)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 61 and 80) end bucket4,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 81 and 100) > 50
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 81 and 100)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 81 and 100) end bucket5
+from reason
+where r_reason_sk = 1
+""",
+})
+
+QUERIES.update({
+    58: """
+with ss_items as (
+  select i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+  from store_sales, item, date_dim
+  where ss_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq =
+                     (select d_week_seq from date_dim
+                      where d_date = date '2000-03-11'))
+    and ss_sold_date_sk = d_date_sk
+  group by i_item_id),
+cs_items as (
+  select i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+  from catalog_sales, item, date_dim
+  where cs_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq =
+                     (select d_week_seq from date_dim
+                      where d_date = date '2000-03-11'))
+    and cs_sold_date_sk = d_date_sk
+  group by i_item_id),
+ws_items as (
+  select i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+  from web_sales, item, date_dim
+  where ws_item_sk = i_item_sk
+    and d_date in (select d_date from date_dim
+                   where d_week_seq =
+                     (select d_week_seq from date_dim
+                      where d_date = date '2000-03-11'))
+    and ws_sold_date_sk = d_date_sk
+  group by i_item_id)
+select ss_items.item_id, ss_item_rev,
+       ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+         * 100 ss_dev,
+       cs_item_rev,
+       cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+         * 100 cs_dev,
+       ws_item_rev,
+       ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+         * 100 ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average
+from ss_items, cs_items, ws_items
+where ss_items.item_id = cs_items.item_id
+  and ss_items.item_id = ws_items.item_id
+  and ss_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+  and ss_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+  and cs_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+  and cs_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+  and ws_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+  and ws_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+order by ss_items.item_id, ss_item_rev
+limit 100
+""",
+    81: """
+with customer_total_return as (
+  select cr_returning_customer_sk ctr_customer_sk,
+         ca_state ctr_state,
+         sum(cr_return_amount) ctr_total_return
+  from catalog_returns, date_dim, customer_address
+  where cr_returned_date_sk = d_date_sk and d_year = 2001
+    and cr_returning_addr_sk = ca_address_sk
+  group by cr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ca_city, ca_zip, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return >
+        (select avg(ctr_total_return) * 1.2
+         from customer_total_return ctr2
+         where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'CA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         ca_city, ca_zip, ctr_total_return
+limit 100
+""",
+    95: """
+with ws_wh as (
+  select ws1.ws_order_number wh_order
+  from web_sales ws1, web_sales ws2
+  where ws1.ws_order_number = ws2.ws_order_number
+    and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales ws1, date_dim, customer_address, web_site
+where d_date between date '2000-02-01' and date '2000-04-30'
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk
+  and ca_state = 'CA'
+  and ws1.ws_web_site_sk = web_site_sk
+  and ws1.ws_order_number in (select wh_order from ws_wh)
+  and ws1.ws_order_number in (select wr_order_number from web_returns)
+""",
+    85: """
+select substr(r_reason_desc, 1, 20) reason,
+       avg(ws_quantity) avg_q,
+       avg(wr_refunded_cash) avg_cash,
+       avg(wr_fee) avg_fee
+from web_sales, web_returns, web_page, customer_demographics cd1,
+     customer_demographics cd2, customer_address, date_dim, reason
+where ws_web_page_sk = wp_web_page_sk
+  and ws_item_sk = wr_item_sk
+  and ws_order_number = wr_order_number
+  and ws_sold_date_sk = d_date_sk and d_year = 2000
+  and cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  and cd2.cd_demo_sk = wr_returning_cdemo_sk
+  and ca_address_sk = wr_refunded_addr_sk
+  and r_reason_sk = wr_reason_sk
+  and ((cd1.cd_marital_status = 'M'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = 'Advanced Degree'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 100 and 150)
+    or (cd1.cd_marital_status = 'S'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = 'College'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 50 and 100)
+    or (cd1.cd_marital_status = 'W'
+        and cd1.cd_marital_status = cd2.cd_marital_status
+        and cd1.cd_education_status = '2 yr Degree'
+        and cd1.cd_education_status = cd2.cd_education_status
+        and ws_sales_price between 150 and 200))
+  and ((ca_country = 'United States'
+        and ca_state in ('IN', 'OH', 'NJ')
+        and ws_net_profit between 100 and 200)
+    or (ca_country = 'United States'
+        and ca_state in ('WI', 'CT', 'KY')
+        and ws_net_profit between 150 and 300)
+    or (ca_country = 'United States'
+        and ca_state in ('LA', 'IA', 'AR')
+        and ws_net_profit between 50 and 250))
+group by r_reason_desc
+order by reason, avg_q, avg_cash, avg_fee
+limit 100
+""",
+    66: """
+select w_warehouse_name, w_warehouse_sq_ft, w_state, ship_carriers,
+       year1,
+       sum(jan_sales) jan_sales, sum(feb_sales) feb_sales,
+       sum(mar_sales) mar_sales, sum(apr_sales) apr_sales,
+       sum(may_sales) may_sales, sum(jun_sales) jun_sales,
+       sum(jul_sales) jul_sales, sum(aug_sales) aug_sales,
+       sum(sep_sales) sep_sales, sum(oct_sales) oct_sales,
+       sum(nov_sales) nov_sales, sum(dec_sales) dec_sales
+from (
+  select w_warehouse_name, w_warehouse_sq_ft, w_state,
+         'DHL,BARIAN' as ship_carriers, d_year as year1,
+         sum(case when d_moy = 1 then ws_ext_sales_price * ws_quantity
+             else 0 end) as jan_sales,
+         sum(case when d_moy = 2 then ws_ext_sales_price * ws_quantity
+             else 0 end) as feb_sales,
+         sum(case when d_moy = 3 then ws_ext_sales_price * ws_quantity
+             else 0 end) as mar_sales,
+         sum(case when d_moy = 4 then ws_ext_sales_price * ws_quantity
+             else 0 end) as apr_sales,
+         sum(case when d_moy = 5 then ws_ext_sales_price * ws_quantity
+             else 0 end) as may_sales,
+         sum(case when d_moy = 6 then ws_ext_sales_price * ws_quantity
+             else 0 end) as jun_sales,
+         sum(case when d_moy = 7 then ws_ext_sales_price * ws_quantity
+             else 0 end) as jul_sales,
+         sum(case when d_moy = 8 then ws_ext_sales_price * ws_quantity
+             else 0 end) as aug_sales,
+         sum(case when d_moy = 9 then ws_ext_sales_price * ws_quantity
+             else 0 end) as sep_sales,
+         sum(case when d_moy = 10 then ws_ext_sales_price * ws_quantity
+             else 0 end) as oct_sales,
+         sum(case when d_moy = 11 then ws_ext_sales_price * ws_quantity
+             else 0 end) as nov_sales,
+         sum(case when d_moy = 12 then ws_ext_sales_price * ws_quantity
+             else 0 end) as dec_sales
+  from web_sales, warehouse, date_dim, time_dim, ship_mode
+  where ws_warehouse_sk = w_warehouse_sk
+    and ws_sold_date_sk = d_date_sk
+    and ws_sold_time_sk = t_time_sk
+    and ws_ship_mode_sk = sm_ship_mode_sk
+    and d_year = 2000
+    and t_time between 30838 and 30838 + 28800
+    and sm_carrier in ('DHL', 'BARIAN')
+  group by w_warehouse_name, w_warehouse_sq_ft, w_state, d_year
+  union all
+  select w_warehouse_name, w_warehouse_sq_ft, w_state,
+         'DHL,BARIAN' as ship_carriers, d_year as year1,
+         sum(case when d_moy = 1 then cs_sales_price * cs_quantity
+             else 0 end) as jan_sales,
+         sum(case when d_moy = 2 then cs_sales_price * cs_quantity
+             else 0 end) as feb_sales,
+         sum(case when d_moy = 3 then cs_sales_price * cs_quantity
+             else 0 end) as mar_sales,
+         sum(case when d_moy = 4 then cs_sales_price * cs_quantity
+             else 0 end) as apr_sales,
+         sum(case when d_moy = 5 then cs_sales_price * cs_quantity
+             else 0 end) as may_sales,
+         sum(case when d_moy = 6 then cs_sales_price * cs_quantity
+             else 0 end) as jun_sales,
+         sum(case when d_moy = 7 then cs_sales_price * cs_quantity
+             else 0 end) as jul_sales,
+         sum(case when d_moy = 8 then cs_sales_price * cs_quantity
+             else 0 end) as aug_sales,
+         sum(case when d_moy = 9 then cs_sales_price * cs_quantity
+             else 0 end) as sep_sales,
+         sum(case when d_moy = 10 then cs_sales_price * cs_quantity
+             else 0 end) as oct_sales,
+         sum(case when d_moy = 11 then cs_sales_price * cs_quantity
+             else 0 end) as nov_sales,
+         sum(case when d_moy = 12 then cs_sales_price * cs_quantity
+             else 0 end) as dec_sales
+  from catalog_sales, warehouse, date_dim, time_dim, ship_mode
+  where cs_warehouse_sk = w_warehouse_sk
+    and cs_sold_date_sk = d_date_sk
+    and cs_sold_time_sk = t_time_sk
+    and cs_ship_mode_sk = sm_ship_mode_sk
+    and d_year = 2000
+    and t_time between 30838 and 30838 + 28800
+    and sm_carrier in ('DHL', 'BARIAN')
+  group by w_warehouse_name, w_warehouse_sq_ft, w_state, d_year) x
+group by w_warehouse_name, w_warehouse_sq_ft, w_state, ship_carriers,
+         year1
+order by w_warehouse_name
+limit 100
+""",
+})
+
+QUERIES.update({
+    75: """
+with all_sales as (
+  select d_year, i_brand_id, i_class_id, i_category_id,
+         i_manufact_id,
+         sum(sales_cnt) sales_cnt, sum(sales_amt) sales_amt
+  from (
+    select d_year, i_brand_id, i_class_id, i_category_id,
+           i_manufact_id,
+           cs_quantity - coalesce(cr_return_quantity, 0) sales_cnt,
+           cs_ext_sales_price - coalesce(cr_return_amount, 0.0)
+             sales_amt
+    from catalog_sales
+         join item on i_item_sk = cs_item_sk
+         join date_dim on d_date_sk = cs_sold_date_sk
+         left join catalog_returns
+           on cs_order_number = cr_order_number
+          and cs_item_sk = cr_item_sk
+    where i_category = 'Books'
+    union all
+    select d_year, i_brand_id, i_class_id, i_category_id,
+           i_manufact_id,
+           ss_quantity - coalesce(sr_return_quantity, 0) sales_cnt,
+           ss_ext_sales_price - coalesce(sr_return_amt, 0.0) sales_amt
+    from store_sales
+         join item on i_item_sk = ss_item_sk
+         join date_dim on d_date_sk = ss_sold_date_sk
+         left join store_returns
+           on ss_ticket_number = sr_ticket_number
+          and ss_item_sk = sr_item_sk
+    where i_category = 'Books'
+    union all
+    select d_year, i_brand_id, i_class_id, i_category_id,
+           i_manufact_id,
+           ws_quantity - coalesce(wr_return_quantity, 0) sales_cnt,
+           ws_ext_sales_price - coalesce(wr_return_amt, 0.0) sales_amt
+    from web_sales
+         join item on i_item_sk = ws_item_sk
+         join date_dim on d_date_sk = ws_sold_date_sk
+         left join web_returns
+           on ws_order_number = wr_order_number
+          and ws_item_sk = wr_item_sk
+    where i_category = 'Books') sales_detail
+  group by d_year, i_brand_id, i_class_id, i_category_id,
+           i_manufact_id)
+select prev_yr.d_year prev_year, curr_yr.d_year year1,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id,
+       prev_yr.sales_cnt prev_yr_cnt, curr_yr.sales_cnt curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt sales_amt_diff
+from all_sales curr_yr, all_sales prev_yr
+where curr_yr.i_brand_id = prev_yr.i_brand_id
+  and curr_yr.i_class_id = prev_yr.i_class_id
+  and curr_yr.i_category_id = prev_yr.i_category_id
+  and curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  and curr_yr.d_year = 2001
+  and prev_yr.d_year = 2000
+  and cast(curr_yr.sales_cnt as double)
+        / cast(prev_yr.sales_cnt as double) < 0.9
+order by sales_cnt_diff, sales_amt_diff
+limit 100
+""",
+    78: """
+with ws as (
+  select d_year as ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk ws_customer_sk,
+         sum(ws_quantity) ws_qty,
+         sum(ws_wholesale_cost) ws_wc,
+         sum(ws_sales_price) ws_sp
+  from web_sales
+       left join web_returns
+         on wr_order_number = ws_order_number
+        and ws_item_sk = wr_item_sk
+       join date_dim on ws_sold_date_sk = d_date_sk
+  where wr_order_number is null
+  group by d_year, ws_item_sk, ws_bill_customer_sk),
+cs as (
+  select d_year as cs_sold_year, cs_item_sk,
+         cs_bill_customer_sk cs_customer_sk,
+         sum(cs_quantity) cs_qty,
+         sum(cs_wholesale_cost) cs_wc,
+         sum(cs_sales_price) cs_sp
+  from catalog_sales
+       left join catalog_returns
+         on cr_order_number = cs_order_number
+        and cs_item_sk = cr_item_sk
+       join date_dim on cs_sold_date_sk = d_date_sk
+  where cr_order_number is null
+  group by d_year, cs_item_sk, cs_bill_customer_sk),
+ss as (
+  select d_year as ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         sum(ss_quantity) ss_qty,
+         sum(ss_wholesale_cost) ss_wc,
+         sum(ss_sales_price) ss_sp
+  from store_sales
+       left join store_returns
+         on sr_ticket_number = ss_ticket_number
+        and ss_item_sk = sr_item_sk
+       join date_dim on ss_sold_date_sk = d_date_sk
+  where sr_ticket_number is null
+  group by d_year, ss_item_sk, ss_customer_sk)
+select ss_item_sk,
+       round(ss_qty * 1.0
+             / coalesce(ws_qty + cs_qty, 1), 2) ratio,
+       ss_qty store_qty, ss_wc store_wholesale_cost,
+       ss_sp store_sales_price,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0)
+         other_chan_qty,
+       coalesce(ws_wc, 0) + coalesce(cs_wc, 0)
+         other_chan_wholesale_cost,
+       coalesce(ws_sp, 0) + coalesce(cs_sp, 0)
+         other_chan_sales_price
+from ss
+     left join ws on (ws_sold_year = ss_sold_year
+                      and ws_item_sk = ss_item_sk
+                      and ws_customer_sk = ss_customer_sk)
+     left join cs on (cs_sold_year = ss_sold_year
+                      and cs_item_sk = ss_item_sk
+                      and cs_customer_sk = ss_customer_sk)
+where (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)
+  and ss_sold_year = 2000
+order by ss_item_sk, store_qty desc, store_wholesale_cost desc,
+         store_sales_price desc, other_chan_qty
+limit 100
+""",
+})
+
+
+_Q77_INNER = """
+  select 'store channel' as channel, ss.s_store_sk as id,
+         sales, coalesce(returns1, 0) returns1,
+         profit - coalesce(profit_loss, 0) profit
+  from (select s_store_sk, sum(ss_ext_sales_price) as sales,
+               sum(ss_net_profit) as profit
+        from store_sales, date_dim, store
+        where ss_sold_date_sk = d_date_sk
+          and d_date between date '2000-08-03' and date '2000-09-02'
+          and ss_store_sk = s_store_sk
+        group by s_store_sk) ss
+       left join
+       (select sr_store_sk, sum(sr_return_amt) as returns1,
+               sum(sr_net_loss) as profit_loss
+        from store_returns, date_dim
+        where sr_returned_date_sk = d_date_sk
+          and d_date between date '2000-08-03' and date '2000-09-02'
+        group by sr_store_sk) sr
+       on ss.s_store_sk = sr.sr_store_sk
+  union all
+  select 'catalog channel' as channel, cs_call_center_sk as id,
+         sales, returns1, profit - profit_loss profit
+  from (select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+               sum(cs_net_profit) as profit
+        from catalog_sales, date_dim
+        where cs_sold_date_sk = d_date_sk
+          and d_date between date '2000-08-03' and date '2000-09-02'
+        group by cs_call_center_sk) cs,
+       (select sum(cr_return_amount) as returns1,
+               sum(cr_net_loss) as profit_loss
+        from catalog_returns, date_dim
+        where cr_returned_date_sk = d_date_sk
+          and d_date between date '2000-08-03'
+                         and date '2000-09-02') cr
+  union all
+  select 'web channel' as channel, ws.wp_web_page_sk as id,
+         sales, coalesce(returns1, 0) returns1,
+         profit - coalesce(profit_loss, 0) profit
+  from (select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
+               sum(ws_net_profit) as profit
+        from web_sales, date_dim, web_page
+        where ws_sold_date_sk = d_date_sk
+          and d_date between date '2000-08-03' and date '2000-09-02'
+          and ws_web_page_sk = wp_web_page_sk
+        group by wp_web_page_sk) ws
+       left join
+       (select wr_web_page_sk, sum(wr_return_amt) as returns1,
+               sum(wr_net_loss) as profit_loss
+        from web_returns, date_dim
+        where wr_returned_date_sk = d_date_sk
+          and d_date between date '2000-08-03' and date '2000-09-02'
+        group by wr_web_page_sk) wr
+       on ws.wp_web_page_sk = wr.wr_web_page_sk
+"""
+
+QUERIES[77] = f"""
+select channel, id, sum(sales) sales, sum(returns1) returns1,
+       sum(profit) profit
+from ({_Q77_INNER}) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+"""
+
+SQLITE_OVERRIDES[77] = f"""
+select channel, id, sum(sales) sales, sum(returns1) returns1,
+       sum(profit) profit
+from ({_Q77_INNER}) x group by channel, id
+union all
+select channel, null, sum(sales), sum(returns1), sum(profit)
+from ({_Q77_INNER}) x group by channel
+union all
+select null, null, sum(sales), sum(returns1), sum(profit)
+from ({_Q77_INNER}) x
+order by channel, id
+limit 100
+"""
