@@ -3,11 +3,12 @@ sqlite baseline over the IDENTICAL generated data.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Headline metric: lineitem rows/sec through the full jit-compiled Q1
-fragment (scan pages resident on device), median of BENCH_RUNS timed runs
-after warmup. `detail` carries the same measurement for Q6 (fused
-scan-filter global agg), Q3 (join + large-domain agg + topN) and Q18
-(double join + group-by-orderkey), each with its own vs_baseline.
+Headline metric: geomean rows/s over the full 22-query TPC-H suite
+(scan pages resident on device), per-query median of BENCH_RUNS timed
+runs after warmup; `detail` carries every query's median/rows-per-sec/
+vs_baseline. Scan/agg shapes run as one fused program; join/window
+plans run as per-operator islands (exec/executor.py) — the same paths a
+worker uses.
 
 Baseline: the reference publishes no absolute numbers (BASELINE.md), and
 no JVM exists in this environment, so the measured proxy is sqlite3
@@ -19,16 +20,10 @@ touches ~7 of 16 lineitem columns ~= 0.4 GB at SF1; at v5e HBM bandwidth
 (~820 GB/s) one pass is ~0.5 ms, so wall time is dominated by how few
 passes the compiled fragment makes, not FLOPs.
 
-Join-heavy queries (Q3/Q18) run LIFESPAN-BATCHED (BENCH_FRAG_QUERIES,
-default "3,18"; BENCH_LIFESPAN_BATCHES, default 8): the driving scan
-streams in 8 row-range lifespans through one prepared executor, which
-shrinks every program's shapes 8x — the only mode whose join programs
-the remote TPU compile service survives (whole-plan AND per-fragment
-compiles get SIGKILLed).
-
 Env knobs: BENCH_SF (default 1.0), BENCH_RUNS (5), BENCH_WARMUP (2),
-BENCH_QUERIES (comma list, default "1,6,3,18"), BENCH_FRAG_QUERIES
-(comma list run fragment-wise, default "3,18").
+BENCH_QUERIES (comma list or "all", the default), BENCH_FRAG_QUERIES
+(comma list run lifespan-batched instead, default none),
+BENCH_QUERY_TIMEOUT (s, default 2400).
 """
 
 import json
@@ -109,10 +104,11 @@ def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     runs = int(os.environ.get("BENCH_RUNS", "5"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
-    qids = [int(q) for q in
-            os.environ.get("BENCH_QUERIES", "1,6,3,18").split(",")]
+    spec = os.environ.get("BENCH_QUERIES", "all")
+    qids = (list(range(1, 23)) if spec == "all"
+            else [int(q) for q in spec.split(",")])
     frag_qids = {int(q) for q in os.environ.get(
-        "BENCH_FRAG_QUERIES", "3,18").split(",") if q}
+        "BENCH_FRAG_QUERIES", "").split(",") if q}
     if os.environ.get("BENCH_CHILD") != "1":
         return _main_orchestrator(sf, qids)
 
@@ -158,16 +154,31 @@ def main() -> None:
 
 
 def _headline(detail):
-    """Prefer q01; fall back to the first query that actually ran (a
-    timed-out compile must not zero out the whole report)."""
-    clean = {k: v for k, v in detail.items() if "error" not in v}
+    """Suite geomean over every query that ran (rows/s and
+    vs_baseline); a single query's failure lowers coverage but cannot
+    zero the report. Falls back to q01 when fewer than 3 queries
+    succeeded (e.g. a smoke run)."""
+    import math
+
+    clean = {k: v for k, v in detail.items()
+             if "error" not in v and v.get("rows_per_sec", 0) > 0}
+    if len(clean) >= 3:
+        rps = [v["rows_per_sec"] for v in clean.values()]
+        vsb = [v["vs_baseline"] for v in clean.values()
+               if v.get("vs_baseline", 0) > 0]
+        geo = math.exp(sum(math.log(x) for x in rps) / len(rps))
+        geo_vs = (math.exp(sum(math.log(x) for x in vsb) / len(vsb))
+                  if vsb else 0.0)
+        return f"geomean{len(clean)}q", {
+            "rows_per_sec": round(geo, 1),
+            "vs_baseline": round(geo_vs, 3)}
     for pref in ("q01", "q06"):
         if pref in clean:
             return pref, clean[pref]
     if clean:
         k = sorted(clean)[0]
         return k, clean[k]
-    k = sorted(detail)[0]
+    k = sorted(detail)[0] if detail else "none"
     return k, {"rows_per_sec": 0.0, "vs_baseline": 0.0}
 
 
@@ -215,21 +226,16 @@ def _main_orchestrator(sf, qids) -> None:
         }))
         return
 
+    # Per-query budget: warm (cached) queries run in seconds; a cold
+    # island-program compile through the remote service takes minutes.
     timeout_s = float(os.environ.get("BENCH_QUERY_TIMEOUT", "2400"))
-    # Lifespan-batched join queries compile ~8 smaller programs through
-    # the remote service; a measured cold q3 takes ~23 min and tunnel
-    # contention can stretch it — give the same budget as whole-plan
-    # queries (the device probe already guards true wedges).
-    join_timeout_s = float(os.environ.get("BENCH_JOIN_QUERY_TIMEOUT",
-                                          "2400"))
     detail = {}
     for qid in qids:
         env = dict(os.environ, BENCH_CHILD="1", BENCH_QUERIES=str(qid))
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True,
-                timeout=join_timeout_s if qid in (3, 18) else timeout_s)
+                capture_output=True, text=True, timeout=timeout_s)
             sys.stderr.write(r.stderr.splitlines()[-1] + "\n"
                              if r.stderr.splitlines() else "")
             line = next((ln for ln in r.stdout.splitlines()
@@ -241,11 +247,9 @@ def _main_orchestrator(sf, qids) -> None:
             else:
                 detail.update(json.loads(line).get("detail", {}))
         except subprocess.TimeoutExpired:
-            used = join_timeout_s if qid in (3, 18) else timeout_s
             detail[f"q{qid:02d}"] = {
-                "error": f"timeout after {used:.0f}s (join-heavy "
-                         "programs OOM the remote compile service)"}
-            print(f"# q{qid:02d}: TIMEOUT after {used:.0f}s",
+                "error": f"timeout after {timeout_s:.0f}s"}
+            print(f"# q{qid:02d}: TIMEOUT after {timeout_s:.0f}s",
                   file=sys.stderr)
     # whole-plan q1 can hit remote-compile stalls; retry it
     # lifespan-batched (small programs) before giving up on a number
@@ -324,43 +328,36 @@ def _bench_one_batched(conn, qid, sql, baseline, runs, warmup, detail,
 
 
 def _bench_one(engine, qid, sql, baseline, runs, warmup, detail):
+    """Time the production execution path (Executor.execute: fused
+    whole-plan programs for scan/agg shapes, per-operator islands for
+    join/window plans — exactly what a worker runs). Scans come from the
+    device-resident page cache, so timed runs measure compute, not
+    host->device upload."""
     import jax
 
     from presto_tpu.sql.parser import parse_sql
 
+    ex = engine.executor
     plan = engine.planner.plan_query(parse_sql(sql))
-    plan = engine.executor._resolve_subqueries(plan)
-    # Converge capacities (overflow retries) before timing.
-    caps = {}
-    for _ in range(8):
-        fn, scans, watch = engine.executor._lower(plan, caps)
-        jitted = jax.jit(fn)
-        pages = [engine.executor._fetch(s) for s in scans]
-        out, needed = jitted(pages)
-        import numpy as np
-        needed = np.asarray(needed)
-        grew = False
-        for nid, need in zip(watch, needed):
-            if int(need) > caps[nid]:
-                from presto_tpu.data.column import bucket_capacity
-                caps[nid] = bucket_capacity(int(need))
-                grew = True
-        if not grew:
-            break
-    else:
-        raise RuntimeError(
-            f"q{qid}: capacity retries did not converge; refusing to "
-            "time a truncated fragment")
-    in_rows = sum(int(p.num_rows) for p in pages)
+    plan = ex._resolve_subqueries(plan)
+    plan = ex._prepare(plan)
+    in_rows = sum(
+        engine.connector.table(t).num_rows
+        for t in sorted(_scan_tables(plan)))
+
+    def once():
+        out = ex._execute_tree(plan)
+        leaves = [c.values if hasattr(c, "values") else c.hi
+                  for c in out.columns] + [out.num_rows]
+        jax.block_until_ready(leaves)
+        return out
+
     for _ in range(warmup):
-        out, _n = jitted(pages)
-        jax.block_until_ready(out.num_rows)
+        once()
     times = []
     for _ in range(runs):
         t0 = time.perf_counter()
-        out, _n = jitted(pages)
-        jax.block_until_ready((out.columns[0].values if out.columns
-                               else out.num_rows, out.num_rows))
+        once()
         times.append(time.perf_counter() - t0)
     med = statistics.median(times)
     base_s = baseline.get(str(qid), 0.0)
@@ -374,6 +371,20 @@ def _bench_one(engine, qid, sql, baseline, runs, warmup, detail):
     print(f"# q{qid:02d}: median={med:.4f}s rows={in_rows} "
           f"sqlite={base_s:.2f}s speedup={base_s/med if base_s else 0:.1f}x",
           file=sys.stderr)
+
+
+def _scan_tables(plan) -> set:
+    from presto_tpu.plan.nodes import TableScanNode
+    out = set()
+
+    def walk(n):
+        if isinstance(n, TableScanNode):
+            out.add(n.table)
+        for c in n.children():
+            if c is not None:
+                walk(c)
+    walk(plan)
+    return out
 
 
 if __name__ == "__main__":
