@@ -87,7 +87,8 @@ PROPERTIES = [
              "enable_dynamic_filtering / DynamicFilterSourceOperator)",
              _parse_bool, True),
     Property("exchange_compression_codec",
-             "Compress exchange pages: none | zlib (reference: "
+             "Compress exchange pages: none | zlib | gzip | lz4 "
+             "(LZ4 block format in the native C++ codec; reference: "
              "exchange_compression_codec, PagesSerdeFactory + "
              "CompressionCodec.java:16)", str.strip, "none"),
 ]

@@ -69,6 +69,15 @@ def load() -> Optional[ctypes.CDLL]:
             lib.pt_crc32.restype = ctypes.c_uint32
             lib.pt_crc32.argtypes = [
                 ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
+            if hasattr(lib, "pt_lz4_compress"):
+                lib.pt_lz4_compress.restype = ctypes.c_size_t
+                lib.pt_lz4_compress.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t]
+                lib.pt_lz4_decompress.restype = ctypes.c_size_t
+                lib.pt_lz4_decompress.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t]
             _lib = lib
         except OSError:
             _lib = None
@@ -97,6 +106,37 @@ def unpack_nulls(bits: bytes, n: int) -> Optional[np.ndarray]:
     out = np.empty(n, dtype=np.uint8)
     lib.pt_unpack_nulls(src.ctypes.data, n, out.ctypes.data)
     return out.astype(bool)
+
+
+def lz4_compress(data: bytes) -> Optional[bytes]:
+    """LZ4 block compress (native); None if the library is absent."""
+    lib = load()
+    if lib is None or not hasattr(lib, "pt_lz4_compress"):
+        return None
+    n = len(data)
+    src = np.frombuffer(data, dtype=np.uint8)
+    cap = n + n // 255 + 64
+    out = np.empty(cap, dtype=np.uint8)
+    got = lib.pt_lz4_compress(
+        src.ctypes.data if n else None, n, out.ctypes.data, cap)
+    if got == 0:
+        return None
+    return out[:got].tobytes()
+
+
+def lz4_decompress(data: bytes, uncompressed: int) -> Optional[bytes]:
+    """LZ4 block decompress to the declared size; None on failure."""
+    lib = load()
+    if lib is None or not hasattr(lib, "pt_lz4_decompress"):
+        return None
+    src = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(max(uncompressed, 1), dtype=np.uint8)
+    got = lib.pt_lz4_decompress(
+        src.ctypes.data if len(data) else None, len(data),
+        out.ctypes.data, uncompressed)
+    if got != uncompressed:
+        return None
+    return out[:uncompressed].tobytes()
 
 
 def crc32(data: bytes, crc: int = 0) -> Optional[int]:

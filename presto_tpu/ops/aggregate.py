@@ -194,6 +194,43 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
 
     false_w = jnp.zeros((width,), bool)
     for a in aggs:
+        if a.kind in ("sum128_merge", "avg128_merge"):
+            # FINAL over Decimal128 partial states (global/no-GROUP-BY
+            # distributed DECIMAL aggregation routes here): sum the
+            # limb lanes independently per bin.
+            from presto_tpu.data.column import Decimal128Column
+            pc = page.columns[a.field]
+            live_m = [m & ~pc.nulls for m in masks]
+            n_per = jnp.stack([jnp.sum(lv) for lv in live_m])
+            hi_b = jnp.stack([jnp.sum(jnp.where(lv, pc.hi, 0))
+                              for lv in live_m])
+            lo_b = jnp.stack([jnp.sum(jnp.where(lv, pc.lo, 0))
+                              for lv in live_m])
+            count_b = None
+            if a.kind == "avg128_merge":
+                cc = page.columns[a.field2]
+                cl = [m & ~cc.nulls for m in masks]
+                count_b = jnp.stack(
+                    [jnp.sum(jnp.where(lv2, cc.values, 0))
+                     for lv2 in cl]).astype(jnp.int64)
+            is_null = (n_per == 0)[take] | ~out_valid_w
+
+            def lane128(bins_arr, fill=0):
+                v = jnp.where(is_null, fill, bins_arr[take])
+                if width < out_cap:
+                    v = jnp.concatenate(
+                        [v, jnp.full((out_cap - width,), fill,
+                                     dtype=v.dtype)])
+                return v
+            nl = is_null
+            if width < out_cap:
+                nl = jnp.concatenate(
+                    [nl, jnp.ones((out_cap - width,), bool)])
+            cols.append(Decimal128Column(
+                lane128(hi_b), lane128(lo_b), nl, a.output_type,
+                count=(lane128(count_b) if count_b is not None
+                       else None)))
+            continue
         vals, nulls = _agg_inputs(a, page)
         dictionary = (page.columns[a.field].dictionary
                       if a.field is not None and a.output_type.is_string
@@ -372,7 +409,11 @@ def grouped_aggregate(page: Page, group_fields: Sequence[int],
         return _direct_grouped_aggregate(page, (), aggs, out_cap, valid,
                                          [], 1, min_groups=1)
 
-    d = _direct_domains(page, group_fields, direct_max_bins)
+    # Decimal128 merge steps read limb-lane columns — sorted path only
+    merge128 = any(a.kind in ("sum128_merge", "avg128_merge")
+                   for a in aggs)
+    d = None if merge128 else _direct_domains(page, group_fields,
+                                              direct_max_bins)
     if d is not None:
         domains, prod = d
         return _direct_grouped_aggregate(
@@ -454,6 +495,28 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
     """Evaluate one aggregate over contiguous sorted segments."""
     t = a.output_type
     out_cap = starts.shape[0]
+    if a.kind in ("sum128_merge", "avg128_merge"):
+        # FINAL step over Decimal128 partial states (limb lanes summed
+        # independently — the distributed DECIMAL(38) merge; reference:
+        # the FINAL accumulator of DecimalSumAggregation re-expressed
+        # over limb lanes). avg128_merge also sums the count column.
+        from presto_tpu.data.column import Decimal128Column
+        pc = sp.columns[a.field]
+        assert isinstance(pc, Decimal128Column), type(pc)
+        live = ~pc.nulls & gvalid
+        hi = pscan.segment_sums(jnp.where(live, pc.hi, 0), starts, ends)
+        lo = pscan.segment_sums(jnp.where(live, pc.lo, 0), starts, ends)
+        n = pscan.segment_sums(live.astype(jnp.int64), starts, ends)
+        count = None
+        if a.kind == "avg128_merge":
+            cc = sp.columns[a.field2]
+            cv = jnp.where(cc.nulls | ~gvalid, 0, cc.values)
+            count = pscan.segment_sums(cv.astype(jnp.int64), starts,
+                                       ends)
+        is_null = (n == 0) | ~out_valid
+        return [Decimal128Column(
+            jnp.where(is_null, 0, hi), jnp.where(is_null, 0, lo),
+            is_null, t, count=count)]
     if a.field is not None:
         col = sp.columns[a.field]
         vals = col.values

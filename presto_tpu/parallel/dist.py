@@ -60,6 +60,22 @@ def split_agg_specs(aggs: Sequence[AggSpec], n_group: int
                                    mask_field=a.mask_field))
             final.append(AggSpec("sum", pos, a.output_type))
             pos += 1
+        elif a.kind == "sum128":
+            # DECIMAL(38) limb lanes: partial = Decimal128 sum state,
+            # final sums the limbs independently (sum128_merge)
+            partial.append(AggSpec("sum128", a.field, a.output_type,
+                                   mask_field=a.mask_field))
+            final.append(AggSpec("sum128_merge", pos, a.output_type))
+            pos += 1
+        elif a.kind == "avg128":
+            # exact decimal avg: (limb-lane sum, count) partial state
+            partial.append(AggSpec("sum128", a.field, a.output_type,
+                                   mask_field=a.mask_field))
+            partial.append(AggSpec("count", a.field, BIGINT,
+                                   mask_field=a.mask_field))
+            final.append(AggSpec("avg128_merge", pos, a.output_type,
+                                 field2=pos + 1))
+            pos += 2
         elif a.kind in ("sum", "min", "max", "bool_or", "bool_and"):
             partial.append(AggSpec(a.kind, a.field, a.output_type,
                                    mask_field=a.mask_field))

@@ -91,9 +91,11 @@ def repartition_page(page: Page, pid: jnp.ndarray, ndev: int,
     valid = page.row_valid()
 
     arrs = []
+    lane_counts = []
     for c in page.columns:
-        arrs.append(c.values)
-        arrs.append(c.nulls)
+        lanes = _col_lanes(c)
+        lane_counts.append(len(lanes))
+        arrs.extend(lanes)
     packed, counts, max_send = _pack_by_partition(
         arrs, pid, ndev, chunk, valid)
 
@@ -112,17 +114,31 @@ def repartition_page(page: Page, pid: jnp.ndarray, ndev: int,
             ).reshape(ndev * chunk)
     total = jnp.sum(recv_counts)
 
-    flat = [(recv[2 * i].reshape(ndev * chunk),
-             recv[2 * i + 1].reshape(ndev * chunk), c)
-            for i, c in enumerate(page.columns)]
+    flat = []
+    pos = 0
+    for c, nl in zip(page.columns, lane_counts):
+        flat.append(([r.reshape(ndev * chunk)
+                      for r in recv[pos:pos + nl]], c))
+        pos += nl
     out = _compact_flat(flat, live, out_capacity, page.names)
     return out, total, max_send
+
+
+def _col_lanes(c):
+    """A column's row-wise device lanes (Decimal128 = hi/lo/nulls[/cnt],
+    plain = values/nulls) — the unit the all-to-all exchange moves."""
+    from presto_tpu.data.column import Decimal128Column
+    if isinstance(c, Decimal128Column):
+        return list(c.row_lanes())
+    return [c.values, c.nulls]
 
 
 def _compact_flat(flat_cols, live: jnp.ndarray, out_capacity: int,
                   names) -> Page:
     """Stable-partition live rows to the front of an out_capacity page.
-    flat_cols: [(values, nulls, template Column)] with 1-D arrays."""
+    flat_cols: [(lane arrays, template Column)] with 1-D arrays."""
+    from presto_tpu.data.column import Decimal128Column
+
     flat_cap = live.shape[0]
     order_key = jnp.where(live, 0, flat_cap) + jnp.arange(
         flat_cap, dtype=jnp.int32)
@@ -133,7 +149,15 @@ def _compact_flat(flat_cols, live: jnp.ndarray, out_capacity: int,
     out_valid = take < jnp.minimum(n, out_capacity)
 
     cols = []
-    for vals, nulls, c in flat_cols:
+    for lanes, c in flat_cols:
+        if isinstance(c, Decimal128Column):
+            g = [lane[src] for lane in lanes]
+            g[0] = jnp.where(out_valid, g[0], 0)
+            g[1] = jnp.where(out_valid, g[1], 0)
+            g[2] = jnp.where(out_valid, g[2], True)
+            cols.append(c.from_lanes(g))
+            continue
+        vals, nulls = lanes
         v = vals[src]
         nl = nulls[src]
         sent = jnp.asarray(c.type.null_sentinel(), dtype=v.dtype)
@@ -200,7 +224,7 @@ def all_gather_page(page: Page, ndev: int, axis: str = AXIS) -> Page:
     live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
             < nums[:, None]).reshape(flat_cap)
 
-    flat = [(jax.lax.all_gather(c.values, axis).reshape(flat_cap),
-             jax.lax.all_gather(c.nulls, axis).reshape(flat_cap), c)
+    flat = [([jax.lax.all_gather(lane, axis).reshape(flat_cap)
+              for lane in _col_lanes(c)], c)
             for c in page.columns]
     return _compact_flat(flat, live, flat_cap, page.names)

@@ -35,10 +35,10 @@ from presto_tpu.types import BIGINT, DOUBLE
 
 # Aggregates whose state has no fixed-width column form (sketches/runs):
 # distributed by resharding rows, not by splitting into partial+final.
-_UNSPLITTABLE = {"approx_distinct", "approx_percentile",
-                 # DECIMAL(38) limb-lane accumulators: the partial state
-                 # is a Decimal128Column (no wire/final-merge path yet)
-                 "sum128", "avg128"}
+# (DECIMAL(38) sum128/avg128 split since round 4: the partial state is a
+# Decimal128Column whose limb lanes ride INT128_ARRAY wire blocks and
+# merge via sum128_merge/avg128_merge.)
+_UNSPLITTABLE = {"approx_distinct", "approx_percentile"}
 
 
 def _partial_agg_layout(node: AggregationNode):

@@ -9,6 +9,7 @@ RemoteSource lowering fans out over upstream locations."""
 
 from __future__ import annotations
 
+import urllib.error
 import urllib.request
 from typing import List, Optional, Tuple
 
@@ -30,13 +31,31 @@ class PageStream:
         self.complete = False
         self.task_instance_id: Optional[str] = None
 
+    #: transient-failure retry schedule (reference: PageBufferClient's
+    #: exponential backoff, ExchangeClient.java:322)
+    RETRIES = 4
+    BACKOFF_BASE_S = 0.1
+
     def _get(self, url: str) -> Tuple[bytes, dict]:
+        import time as _time
+
         headers = {"X-Presto-Max-Wait": self.max_wait}
         if self.max_size_bytes is not None:
             headers["X-Presto-Max-Size"] = f"{self.max_size_bytes}B"
-        req = urllib.request.Request(url, headers=headers)
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return resp.read(), dict(resp.headers)
+        last: Optional[BaseException] = None
+        for attempt in range(self.RETRIES + 1):
+            try:
+                req = urllib.request.Request(url, headers=headers)
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.read(), dict(resp.headers)
+            except (urllib.error.URLError, OSError) as e:
+                # token-sequenced GETs are idempotent: the server
+                # re-serves un-acknowledged frames, so a retry after a
+                # dropped response cannot skip or duplicate pages
+                last = e
+                if attempt < self.RETRIES:
+                    _time.sleep(self.BACKOFF_BASE_S * (2 ** attempt))
+        raise last
 
     def fetch(self) -> bytes:
         """One round: GET next frames, acknowledge, advance the token."""

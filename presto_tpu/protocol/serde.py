@@ -337,12 +337,12 @@ def encode_serialized_page(blocks: List[WireBlock],
     payload = bytes(payload)
     markers = CHECKSUMMED if checksummed else 0
     uncompressed = len(payload)
-    if compression == "zlib" and uncompressed > 256:
-        comp = zlib.compress(payload, 6)
-        if len(comp) < uncompressed:   # keep raw when incompressible
-            payload = comp
+    if compression in ("zlib", "gzip", "lz4") and uncompressed > 256:
+        comp = _compress(payload, compression)
+        if comp is not None and len(comp) < uncompressed:
+            payload = comp             # keep raw when incompressible
             markers |= COMPRESSED
-    elif compression not in (None, "none", "zlib"):
+    elif compression not in (None, "none", "zlib", "gzip", "lz4"):
         raise ValueError(f"unsupported exchange compression "
                          f"{compression!r}")
     # checksum covers the payload AS TRANSMITTED
@@ -352,6 +352,48 @@ def encode_serialized_page(blocks: List[WireBlock],
     header = struct.pack("<ibiiq", position_count, markers, uncompressed,
                          len(payload), checksum)
     return header + payload
+
+
+def _compress(payload: bytes, codec: str):
+    """Compress per the session codec (CompressionCodec.java:16 — the
+    reference offers GZIP/LZ4/ZSTD next to NONE). LZ4 block format runs
+    in the native C++ layer (native/page_codec.cc); zstd has no library
+    in this image and is rejected at the session-property level."""
+    if codec == "zlib":
+        return zlib.compress(payload, 6)
+    if codec == "gzip":
+        co = zlib.compressobj(6, zlib.DEFLATED, 31)   # gzip wrapper
+        return co.compress(payload) + co.flush()
+    # lz4 block
+    from presto_tpu import native
+    out = native.lz4_compress(payload)
+    if out is None:
+        raise ValueError(
+            "lz4 codec requires the native page codec library")
+    return out
+
+
+def _decompress(payload: bytes, uncompressed: int) -> bytes:
+    """Codec auto-detection on the pull side (the consumer does not see
+    the producer's session): zlib/gzip by their magic bytes, LZ4 block
+    as the fallback — every path is validated against the frame's
+    declared uncompressed size afterwards."""
+    if len(payload) >= 2 and payload[0] == 0x78:
+        try:
+            return zlib.decompress(payload)
+        except zlib.error:
+            pass                       # an LZ4 block may start 0x78
+    if len(payload) >= 2 and payload[0] == 0x1F and payload[1] == 0x8B:
+        try:
+            return zlib.decompress(payload, 31)
+        except zlib.error:
+            pass                   # an LZ4 block may start 0x1F 0x8B too
+    from presto_tpu import native
+    out = native.lz4_decompress(payload, uncompressed)
+    if out is None:
+        raise ValueError("cannot decompress page (unknown codec or "
+                         "native library unavailable)")
+    return out
 
 
 def decode_serialized_page(data: bytes, offset: int = 0
@@ -368,7 +410,7 @@ def decode_serialized_page(data: bytes, offset: int = 0
         if want != checksum:
             raise ValueError(f"page checksum mismatch: {want} != {checksum}")
     if markers & COMPRESSED:
-        payload = zlib.decompress(payload)
+        payload = _decompress(payload, uncompressed)
         if len(payload) != uncompressed:
             raise ValueError(
                 f"decompressed size {len(payload)} != declared "

@@ -1045,6 +1045,22 @@ class TaskUpdateRequest(Struct):
     ]
 
 
+@dataclasses.dataclass
+class BatchTaskUpdateRequest(Struct):
+    """presto_protocol BatchTaskUpdateRequest — the Spark/batch-mode
+    update envelope (presto_cpp/main/TaskResource.cpp:115-180
+    /v1/task/{id}/batch): a TaskUpdateRequest plus optional shuffle
+    read/write descriptors carried as raw JSON."""
+    taskUpdateRequest: TaskUpdateRequest = None
+    shuffleWriteInfo: Optional[str] = None
+    broadcastBasePath: Optional[str] = None
+    _SCHEMA = [
+        ("taskUpdateRequest", "taskUpdateRequest", TaskUpdateRequest),
+        ("shuffleWriteInfo", "shuffleWriteInfo", ("opt", None)),
+        ("broadcastBasePath", "broadcastBasePath", ("opt", None)),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # Task status/info (worker -> coordinator)
 # ---------------------------------------------------------------------------

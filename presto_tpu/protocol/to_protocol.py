@@ -242,8 +242,8 @@ class _FragmentConverter:
                 v = names.var(node.output_names[col], t)
                 if spec.kind == "count_star":
                     call = _agg_call("count", [], type_sig(t))
-                elif spec.kind == "avg_final":
-                    call = _agg_call("avg_final",
+                elif spec.kind in ("avg_final", "avg128_merge"):
+                    call = _agg_call(spec.kind,
                                      [in_vars[spec.field],
                                       in_vars[spec.field2]], type_sig(t))
                 elif spec.kind == "approx_percentile":

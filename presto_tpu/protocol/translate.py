@@ -241,9 +241,10 @@ def translate_expr(x, scope: Scope) -> E.RowExpression:
 
 _AGG_KINDS = {"sum", "count", "min", "max", "avg", "bool_or", "bool_and",
               "avg_partial", "approx_distinct", "approx_percentile",
-              # DECIMAL(38) limb-lane accumulators (engine extension,
-              # like avg_final — the wire carries the qualified name)
-              "sum128", "avg128"}
+              # DECIMAL(38) limb-lane accumulators + their FINAL merge
+              # steps (engine extension, like avg_final — the wire
+              # carries the qualified name)
+              "sum128", "avg128", "sum128_merge", "avg128_merge"}
 
 _JOIN_TYPES = {"INNER": P.JoinType.INNER, "LEFT": P.JoinType.LEFT,
                "FULL": P.JoinType.FULL}
@@ -396,10 +397,11 @@ def _node(n) -> P.PlanNode:
                         "projects arguments first)")
                 field = scope.index[a0.name]
             param = None
-            if kind == "avg_final":
-                # Engine-extension two-state final: avg_final(sum, count)
-                # (the split the fragmenter makes; Presto carries the same
-                # pair as a ROW intermediate — SURVEY §7.3 hard part #7).
+            if kind in ("avg_final", "avg128_merge"):
+                # Engine-extension two-state finals: avg_final(sum,
+                # count) / avg128_merge(limb_sum, count) (the split the
+                # fragmenter makes; Presto carries the same pair as a
+                # ROW intermediate — SURVEY §7.3 hard part #7).
                 a1 = agg.call.arguments[1]
                 field2 = scope.index[a1.name]
             elif kind == "approx_percentile" \

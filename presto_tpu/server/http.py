@@ -37,6 +37,9 @@ _STATUS = re.compile(r"^/v1/task/([^/?]+)/status$")
 _RESULTS = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)/(\d+)$")
 _ACK = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)/(\d+)/acknowledge$")
 _ABORT = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)$")
+_BATCH = re.compile(r"^/v1/task/([^/?]+)/batch$")
+_REMOTE_SOURCE = re.compile(
+    r"^/v1/task/([^/?]+)/remote-source/([^/?]+)$")
 
 _SERVER_START = time.time()
 
@@ -98,7 +101,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- POST
     def do_POST(self):
-        m = _TASK.match(self.path.split("?")[0])
+        path = self.path.split("?")[0]
+        m = _BATCH.match(path)
+        if m:
+            # /v1/task/{id}/batch (TaskResource.cpp:115-180): unwrap the
+            # BatchTaskUpdateRequest envelope; shuffle descriptors are
+            # accepted and ignored (no Spark shuffle backend)
+            n = int(self.headers.get("Content-Length", 0))
+            breq = S.BatchTaskUpdateRequest.loads(
+                self.rfile.read(n).decode())
+            info = self.tm.create_or_update(m.group(1),
+                                            breq.taskUpdateRequest)
+            return self._json(200, S.TaskInfo.to_json(info))
+        m = _TASK.match(path)
         if m:
             n = int(self.headers.get("Content-Length", 0))
             req = S.TaskUpdateRequest.loads(self.rfile.read(n).decode())
@@ -226,6 +241,11 @@ class _Handler(BaseHTTPRequestHandler):
     # ----------------------------------------------------------- DELETE
     def do_DELETE(self):
         path = self.path.split("?")[0]
+        m = _REMOTE_SOURCE.match(path)
+        if m:
+            if not self.tm.remove_remote_source(m.group(1), m.group(2)):
+                return self._json(404, {"error": "no task"})
+            return self._json(200, {})
         m = _ABORT.match(path)
         if m:
             task = self.tm.get(m.group(1))

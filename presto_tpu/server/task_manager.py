@@ -268,12 +268,23 @@ class TpuTaskManager:
         self.tasks: Dict[str, Task] = {}
         self.total_bytes_out = 0      # monotonic (survives task delete)
         self.lifetime_tasks = 0       # monotonic created-task count
+        import collections
+        # DELETE-before-create tombstones (bounded FIFO; membership
+        # checks scan — the deque stays tiny in practice)
+        self.aborted_ids: "collections.deque" = collections.deque()
         self.lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def create_or_update(self, task_id: str,
                          req: S.TaskUpdateRequest) -> S.TaskInfo:
         with self.lock:
+            if task_id in self.aborted_ids:      # deque scan: tiny
+                # the task was aborted before it was created — never run
+                # it (reference: TaskManager.cpp:564 out-of-order
+                # delete/create handling)
+                t = Task(task_id)
+                t.set_state("ABORTED")
+                return t.info(self.base_uri)
             task = self.tasks.get(task_id)
             if task is None:
                 task = Task(task_id)
@@ -706,13 +717,49 @@ class TpuTaskManager:
                     max(0.0, deadline - time.time()))
         return task.status(self.base_uri)
 
+    #: tombstone bound (the reference caps its zombie task list too) —
+    #: enough to cover any realistic coordinator retry window
+    MAX_TOMBSTONES = 4096
+
     def delete(self, task_id: str) -> Optional[S.TaskInfo]:
-        task = self.tasks.pop(task_id, None)
+        with self.lock:
+            # pop + tombstone under ONE lock acquisition: a concurrent
+            # create must observe either the live task or the tombstone,
+            # never neither (TaskManager.cpp:564 ordering)
+            task = self.tasks.pop(task_id, None)
+            if task is None:
+                self.aborted_ids.append(task_id)
+                if len(self.aborted_ids) > self.MAX_TOMBSTONES:
+                    self.aborted_ids.popleft()
         if task is None:
-            return None
+            t = Task(task_id)
+            t.set_state("ABORTED")
+            return t.info(self.base_uri)
         if task.state in ("PLANNED", "RUNNING"):
             task.set_state("ABORTED")
         return task.info(self.base_uri)
+
+    @staticmethod
+    def _loc_task_id(location: str) -> str:
+        """The task-id path segment of an upstream location URI."""
+        return location.rstrip("/").rsplit("/", 1)[-1]
+
+    def remove_remote_source(self, task_id: str,
+                             remote_source_task_id: str) -> bool:
+        """DELETE /v1/task/{id}/remote-source/{sourceId} (reference:
+        TaskResource.cpp removeRemoteSource): drop the given upstream
+        task's splits so future pulls skip it. Matches the exact
+        task-id path segment (never a substring — '1.0.0' must not
+        drop '11.0.0')."""
+        task = self.tasks.get(task_id)
+        if task is None:
+            return False
+        with self.lock:
+            for nid, splits in list(task.remote_splits.items()):
+                task.remote_splits[nid] = [
+                    (loc, buf) for loc, buf in splits
+                    if self._loc_task_id(loc) != remote_source_task_id]
+        return True
 
     def memory_bytes(self) -> int:
         return sum(t.bytes_out for t in self.tasks.values())

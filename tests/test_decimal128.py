@@ -156,3 +156,78 @@ def test_insert_values_decimal_literal_exact():
     eng.execute_sql("INSERT INTO dv VALUES (DECIMAL '12345678901234567.89')")
     rows = eng.execute_sql("SELECT v FROM dv")
     assert rows == [(Decimal("12345678901234567.89"),)]
+
+
+def _decimal_fixture():
+    import random
+    mem = MemoryConnector()
+    mem.create("dli", [("flag", VARCHAR), ("qty", DecimalType(38, 2))])
+    rows, exp = [], {}
+    rng = random.Random(3)
+    for i in range(500):
+        f = "ABC"[i % 3]
+        v = Decimal(rng.randrange(10 ** 15, 10 ** 16)) / 100
+        rows.append((f, v))
+        exp[f] = exp.get(f, Decimal(0)) + v
+    mem.append_rows("dli", rows)
+    counts = {f: sum(1 for r in rows if r[0] == f) for f in "ABC"}
+    return mem, exp, counts
+
+
+_DIST_DECIMAL_SQL = ("select flag, sum(qty), avg(qty), count(*) "
+                     "from dli group by flag order by flag")
+
+
+def _check_exact(got, exp, counts):
+    for f, s, a, n in got:
+        assert s == exp[f], (f, s, exp[f])
+        ea = (exp[f] / counts[f]).quantize(Decimal("0.01"))
+        assert a == ea, (f, a, ea)
+        assert n == counts[f]
+
+
+def test_distributed_decimal128_mesh_exact():
+    """Round-4 VERDICT #8: DECIMAL(38) sum/avg distribute — limb-lane
+    partial states ride the all-to-all exchange and merge exactly
+    (sums past 2^53 where float64 images collapse)."""
+    from presto_tpu.exec.dist_executor import DistEngine
+    from presto_tpu.parallel import device_mesh
+
+    mem, exp, counts = _decimal_fixture()
+    eng = DistEngine(mem, device_mesh(8))
+    _check_exact(eng.execute_sql(_DIST_DECIMAL_SQL), exp, counts)
+
+
+def test_distributed_decimal128_cluster_exact():
+    """Same exactness across the HTTP cluster: partial Decimal128
+    states serialize as INT128_ARRAY wire blocks between workers."""
+    from presto_tpu.server.cluster import TpuCluster
+
+    mem, exp, counts = _decimal_fixture()
+    c = TpuCluster(mem, n_workers=2)
+    try:
+        _check_exact(c.execute_sql(_DIST_DECIMAL_SQL), exp, counts)
+    finally:
+        c.stop()
+
+
+def test_distributed_decimal128_global_exact():
+    """No-GROUP-BY distributed DECIMAL(38): the merge kinds route
+    through the direct (one-bin) aggregation path."""
+    import random
+
+    from presto_tpu.exec.dist_executor import DistEngine
+    from presto_tpu.parallel import device_mesh
+
+    mem = MemoryConnector()
+    mem.create("dg", [("v", DecimalType(38, 2))])
+    rng = random.Random(5)
+    rows = [(Decimal(rng.randrange(10 ** 15, 10 ** 16)) / 100,)
+            for _ in range(300)]
+    mem.append_rows("dg", rows)
+    exp = sum(r[0] for r in rows)
+    eng = DistEngine(mem, device_mesh(8))
+    s, a, n = eng.execute_sql(
+        "select sum(v), avg(v), count(*) from dg")[0]
+    assert s == exp and n == 300
+    assert a == (exp / 300).quantize(Decimal("0.01"))
