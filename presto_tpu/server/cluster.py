@@ -66,11 +66,28 @@ def _unshare(plan: PlanNode) -> PlanNode:
     return visit(plan)
 
 
-def _derange(plan: PlanNode) -> PlanNode:
-    """RANGE exchanges (distributed sort) become SINGLE gathers in the
-    HTTP cluster path: range splitters need a sampling pass the streaming
-    protocol doesn't carry yet; the in-worker ICI path (DistExecutor)
-    keeps the true range exchange."""
+def _derange(plan: PlanNode):
+    """Distributed ORDER BY in the HTTP cluster: the ROOT sort's RANGE
+    exchange is dropped entirely — each task sorts its own shard and the
+    COORDINATOR k-way merges the sorted page streams (the ordered merge
+    exchange, operator/MergeOperator.java + MergeHashSort.java). Peak
+    per-worker memory stays O(shard); the coordinator holds one page per
+    stream. Returns (plan', merge_keys or None). Any OTHER RANGE
+    exchange (nested sorts) still degrades to a SINGLE gather: range
+    splitters need a sampling pass the streaming protocol doesn't carry;
+    the in-worker ICI path (DistExecutor) keeps true range exchanges."""
+    from presto_tpu.plan.nodes import OutputNode, SortNode
+
+    merge_keys = None
+    if isinstance(plan, OutputNode) \
+            and isinstance(plan.source, SortNode) \
+            and isinstance(plan.source.source, ExchangeNode) \
+            and plan.source.source.partitioning == Partitioning.RANGE:
+        sort = plan.source
+        local_sort = dataclasses.replace(sort, source=sort.source.source)
+        plan = dataclasses.replace(plan, source=local_sort)
+        merge_keys = tuple(sort.keys)
+
     def visit(n: PlanNode) -> PlanNode:
         kids = n.children()
         if not kids:
@@ -88,7 +105,7 @@ def _derange(plan: PlanNode) -> PlanNode:
             n = dataclasses.replace(n, partitioning=Partitioning.SINGLE,
                                     keys=(), sort_keys=())
         return n
-    return visit(plan)
+    return visit(plan), merge_keys
 
 
 @dataclasses.dataclass
@@ -382,15 +399,18 @@ class TpuCluster:
         known = {p.name for p in PROPERTIES}
         session = Session({k: v for k, v in
                            self.session_properties.items() if k in known})
-        ex_plan = _derange(add_exchanges(_unshare(plan), self.connector,
-                                         session, self.history))
+        ex_plan, merge_keys = _derange(
+            add_exchanges(_unshare(plan), self.connector, session,
+                          self.history))
         frags = create_fragments(ex_plan)
         return self._run_fragments(frags, list(plan.output_types),
-                                   capture=capture)
+                                   capture=capture,
+                                   merge_keys=merge_keys)
 
     # ------------------------------------------------------------------
     def _run_fragments(self, frags, out_types,
-                       capture: bool = False) -> List[tuple]:
+                       capture: bool = False, merge_keys=None
+                       ) -> List[tuple]:
         with self._lock:
             self._query_counter += 1
             qid = f"q{self._query_counter}_{int(time.time())}"
@@ -463,7 +483,7 @@ class TpuCluster:
             self._await_all(stages)
             if capture:
                 self._capture_task_infos(stages)
-            return self._collect_root(stages[0], out_types)
+            return self._collect_root(stages[0], out_types, merge_keys)
         finally:
             self._cleanup(stages)
 
@@ -608,13 +628,69 @@ class TpuCluster:
             if results.get(uri) is None:
                 raise ClusterQueryError(f"no status from {uri}")
 
-    def _collect_root(self, root: _Stage, out_types) -> List[tuple]:
+    def _collect_root(self, root: _Stage, out_types,
+                      merge_keys=None) -> List[tuple]:
+        if merge_keys:
+            return self._merge_root(root, out_types, merge_keys)
         rows: List[tuple] = []
         for uri in root.task_uris:
             data = PageStream(uri, buffer_id="0").drain()
             for p in decode_pages(data, out_types):
                 rows.extend(p.to_pylist())
         return rows
+
+    def _merge_root(self, root: _Stage, out_types,
+                    merge_keys) -> List[tuple]:
+        """K-way merge of per-task SORTED page streams (the ordered
+        merge exchange: operator/MergeOperator.java semantics at the
+        coordinator's root ExchangeClient). Streams decode page by page,
+        so the in-flight window is one page per task — never the whole
+        result per node."""
+        import heapq
+
+        from presto_tpu.server.task_manager import TpuTaskManager
+
+        def row_iter(uri):
+            stream = PageStream(
+                uri, buffer_id="0",
+                max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES)
+            while not stream.complete:
+                data = stream.fetch()
+                for p in decode_pages(data, out_types):
+                    yield from p.to_pylist()
+            stream.close()
+
+        class _Key:
+            """SQL sort-order comparison over python row values (null
+            ordering + per-key direction)."""
+            __slots__ = ("row",)
+
+            def __init__(self, row):
+                self.row = row
+
+            def __lt__(self, other):
+                for k in merge_keys:
+                    a = self.row[k.field]
+                    b = other.row[k.field]
+                    if a is None or b is None:
+                        if (a is None) != (b is None):
+                            return (a is None) == k.nulls_sort_first
+                        continue
+                    # NaN sorts after every non-null value regardless of
+                    # direction (the shard sort is total-order NaN-last)
+                    a_nan = isinstance(a, float) and a != a
+                    b_nan = isinstance(b, float) and b != b
+                    if a_nan or b_nan:
+                        if a_nan != b_nan:
+                            return b_nan
+                        continue
+                    if a == b:
+                        continue
+                    return (a < b) == k.ascending
+                return False
+
+        return list(heapq.merge(*[row_iter(u) for u in root.task_uris],
+                                key=_Key))
 
     def _cleanup(self, stages: Dict[int, _Stage]):
         for stage in stages.values():

@@ -86,3 +86,22 @@ def test_worker_task_accounting(cluster, oracle):  # noqa: F811
                                     timeout=10) as r:
             body = r.read().decode()
         assert "presto_tpu_task_bytes_out" in body
+
+
+def test_kway_merge_order_by_across_workers():
+    """Distributed ORDER BY (round-4 VERDICT #6): each task sorts its
+    shard and the coordinator k-way merges the sorted streams
+    (MergeOperator semantics) — no node ever holds the whole result."""
+    from presto_tpu.exec import LocalEngine
+
+    sql = ("select l_orderkey, l_linenumber, l_extendedprice "
+           "from lineitem order by l_extendedprice desc, l_orderkey, "
+           "l_linenumber")
+    c = TpuCluster(TpchConnector(0.01), n_workers=3)
+    try:
+        got = c.execute_sql(sql)
+        exp = LocalEngine(TpchConnector(0.01)).execute_sql(sql)
+        assert len(got) == len(exp) and len(got) > 50000
+        assert got == exp
+    finally:
+        c.stop()
