@@ -1,0 +1,9 @@
+"""Client package: the DBAPI 2.0 driver over the statement REST
+protocol (the python-ecosystem analog of presto-jdbc's
+PrestoDriver/PrestoConnection/PrestoStatement stack; same protocol as
+presto-python-client)."""
+
+from presto_tpu.client.dbapi import (  # noqa: F401
+    Connection, Cursor, DatabaseError, Error, InterfaceError,
+    OperationalError, apilevel, connect, paramstyle, threadsafety,
+)

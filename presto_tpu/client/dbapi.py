@@ -1,0 +1,225 @@
+"""PEP 249 (DBAPI 2.0) driver over the statement REST protocol.
+
+Reference roles: presto-jdbc's PrestoDriver/PrestoConnection/
+PrestoStatement/PrestoResultSet over StatementClientV1 (presto-client).
+Java's JDBC has no Python runtime here; PEP 249 is the ecosystem's
+equivalent contract — `connect()`, `Connection`, `Cursor` with
+execute/fetchone/fetchmany/fetchall/description — carried over the same
+POST /v1/statement + nextUri advance loop the CLI uses
+(server/statement.py), so anything speaking DBAPI (pandas read_sql,
+SQLAlchemy dialects, plain scripts) can drive the engine.
+
+Usage:
+    import presto_tpu.client as client
+    conn = client.connect("http://127.0.0.1:8080")
+    cur = conn.cursor()
+    cur.execute("select l_returnflag, count(*) from lineitem group by 1")
+    cur.fetchall()
+"""
+
+from __future__ import annotations
+
+import decimal
+import json
+import time
+import urllib.request
+from typing import Any, List, Optional, Sequence, Tuple
+
+apilevel = "2.0"
+threadsafety = 2           # threads may share the module and connections
+paramstyle = "qmark"       # execute("... where x = ?", [v])
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class OperationalError(DatabaseError):
+    pass
+
+
+def connect(base_uri: str, timeout_s: float = 600.0) -> "Connection":
+    """Open a connection to a statement server
+    (server/statement.StatementServer.base)."""
+    return Connection(base_uri, timeout_s)
+
+
+class Connection:
+    def __init__(self, base_uri: str, timeout_s: float):
+        self.base = base_uri.rstrip("/")
+        self.timeout_s = timeout_s
+        self.closed = False
+
+    def cursor(self) -> "Cursor":
+        if self.closed:
+            raise InterfaceError("connection is closed")
+        return Cursor(self)
+
+    def close(self):
+        self.closed = True
+
+    # Presto has no client-visible transactions on this surface; commit
+    # is a no-op and rollback is unsupported (PEP 249 allows this).
+    def commit(self):
+        pass
+
+    def rollback(self):
+        raise DatabaseError("transactions are not supported")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _substitute(sql: str, params: Sequence[Any]) -> str:
+    """qmark substitution with SQL-literal quoting (the protocol has no
+    server-side prepared statements yet)."""
+    out = []
+    it = iter(params)
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "?" and not in_str:
+            try:
+                v = next(it)
+            except StopIteration:
+                raise InterfaceError("not enough parameters") from None
+            out.append(_literal(v))
+        else:
+            out.append(ch)
+    if next(it, _DONE) is not _DONE:
+        raise InterfaceError("too many parameters")
+    return "".join(out)
+
+
+_DONE = object()
+
+
+def _literal(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, decimal.Decimal):
+        return f"DECIMAL '{v}'"
+    return "'" + str(v).replace("'", "''") + "'"
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: Connection):
+        self._conn = conn
+        self.description: Optional[List[tuple]] = None
+        self.rowcount = -1
+        self._rows: List[tuple] = []
+        self._pos = 0
+        self.closed = False
+
+    # ------------------------------------------------------------ execute
+    def execute(self, sql: str, params: Optional[Sequence[Any]] = None
+                ) -> "Cursor":
+        if self.closed or self._conn.closed:
+            raise InterfaceError("cursor is closed")
+        if params:
+            sql = _substitute(sql, list(params))
+        payload = self._post(sql)
+        columns, rows = None, []
+        deadline = time.time() + self._conn.timeout_s
+        while True:
+            if "error" in payload:
+                raise DatabaseError(payload["error"]["message"])
+            if payload.get("columns"):
+                columns = payload["columns"]
+            rows.extend(payload.get("data", []))
+            nxt = payload.get("nextUri")
+            if not nxt:
+                break
+            if time.time() > deadline:
+                raise OperationalError("query timed out")
+            payload = self._get(nxt)
+        self.description = [
+            (c["name"], c["type"], None, None, None, None, None)
+            for c in (columns or [])]
+        types = [c["type"] for c in (columns or [])]
+        self._rows = [tuple(_decode(v, t) for v, t in zip(r, types))
+                      for r in rows]
+        self._pos = 0
+        self.rowcount = len(self._rows)
+        return self
+
+    def executemany(self, sql: str, seq_of_params) -> "Cursor":
+        for p in seq_of_params:
+            self.execute(sql, p)
+        return self
+
+    # -------------------------------------------------------------- fetch
+    def fetchone(self) -> Optional[tuple]:
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[tuple]:
+        n = size or self.arraysize
+        out = self._rows[self._pos:self._pos + n]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[tuple]:
+        out = self._rows[self._pos:]
+        self._pos = len(self._rows)
+        return out
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    def close(self):
+        self.closed = True
+        self._rows = []
+
+    # ---------------------------------------------------------- transport
+    def _post(self, sql: str) -> dict:
+        req = urllib.request.Request(
+            f"{self._conn.base}/v1/statement", data=sql.encode(),
+            method="POST", headers={"Content-Type": "text/plain"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except OSError as e:
+            raise OperationalError(str(e)) from e
+
+    def _get(self, uri: str) -> dict:
+        try:
+            with urllib.request.urlopen(uri, timeout=30) as resp:
+                return json.loads(resp.read())
+        except OSError as e:
+            raise OperationalError(str(e)) from e
+
+
+def _decode(v: Any, type_name: str):
+    """Wire value -> python value (decimals travel as exact strings)."""
+    if v is None:
+        return None
+    if type_name.startswith("decimal"):
+        return decimal.Decimal(v)
+    return v

@@ -50,9 +50,36 @@ class LocalEngine:
     def execute_sql(self, sql: str) -> List[tuple]:
         from presto_tpu.utils.tracing import query_lifecycle
 
+        # plugin access control (spi/security SystemAccessControl):
+        # query entry + every scanned table
+        from presto_tpu.spi import manager as _plugins
+        user = getattr(self, "user", "") or ""
+        _plugins.check_can_execute(user, sql)
+
         LocalEngine._qid += 1
         qid = f"local_{LocalEngine._qid}"
         with query_lifecycle(qid, sql) as box:
+            if _plugins.access_controls:
+                from presto_tpu.spi import AccessDeniedError
+                try:
+                    plan = self.plan_sql(sql)
+                except AccessDeniedError:
+                    raise
+                except Exception:   # noqa: BLE001 — DDL: check the
+                    plan = None     # inner SELECT's plan instead
+                if plan is None:
+                    from presto_tpu.sql.parser import parse_statement
+                    try:
+                        stmt = parse_statement(sql)
+                        q = getattr(stmt, "query", None)
+                        plan = (self.planner.plan_query(q)
+                                if q is not None else None)
+                    except Exception:   # noqa: BLE001 — bare DDL
+                        plan = None
+                if plan is not None:
+                    from presto_tpu.plan.nodes import scan_tables_deep
+                    for table in scan_tables_deep(plan):
+                        _plugins.check_can_select(user, table)
             box[0] = self._execute_sql_inner(sql, qid)
         return box[0]
 

@@ -834,4 +834,19 @@ def _call(e: Call, page: Page, ev) -> Column:
             acc = binop(acc, c.values.astype(acc.dtype))
             nulls = nulls | c.nulls     # Presto: any NULL arg -> NULL
         return Column(acc, nulls, e.type)
+
+    # plugin-registered vectorized scalar functions (spi.ScalarFunction:
+    # jnp arrays in, jnp array out — the UDF compiles into the fragment
+    # program like a built-in)
+    from presto_tpu.spi import manager as _plugins
+    pf = _plugins.get_function(name)
+    if pf is not None:
+        cols = [ev(a, page) for a in e.args]
+        arrs = [(_as_f64(c) if pf.descale_decimals and c.type.is_decimal
+                 else c.values) for c in cols]
+        v = pf.impl(*arrs)
+        nulls = jnp.zeros((page.capacity,), bool)
+        for c in cols:
+            nulls = nulls | c.nulls     # NULL propagates
+        return Column(jnp.asarray(v), nulls, e.type)
     raise NotImplementedError(f"function {name}")

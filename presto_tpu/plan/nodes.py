@@ -70,6 +70,42 @@ class PlanNode:
         return len(self.output_types)
 
 
+def scan_tables_deep(plan: "PlanNode"):
+    """Every table name the plan can read — node children AND plans
+    embedded in expressions (scalar Subquery nodes live inside
+    predicates/projections, not in children()). The access-control
+    surface: a walk that missed subquery plans would let
+    `select (select ... from denied_table)` bypass the check."""
+    from presto_tpu.expr.nodes import RowExpression
+
+    seen = set()
+
+    def walk_expr(e):
+        plan_attr = getattr(e, "plan", None)
+        if plan_attr is not None and isinstance(plan_attr, PlanNode):
+            walk(plan_attr)
+        for c in e.children():
+            walk_expr(c)
+
+    def walk(n):
+        if isinstance(n, TableScanNode):
+            seen.add(n.table)
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name, None)
+            if isinstance(v, RowExpression):
+                walk_expr(v)
+            elif isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, RowExpression):
+                        walk_expr(x)
+        for c in n.children():
+            if c is not None:
+                walk(c)
+
+    walk(plan)
+    return sorted(seen)
+
+
 @dataclasses.dataclass(frozen=True)
 class TableScanNode(PlanNode):
     table: str

@@ -217,6 +217,32 @@ class TpuCluster:
                     _capture: bool = False) -> List[tuple]:
         from presto_tpu.utils.tracing import query_lifecycle
 
+        # plugin access control: the cluster is the network-exposed
+        # entry point (statement server / DBAPI), so it must enforce the
+        # security SPI exactly like LocalEngine
+        from presto_tpu.spi import manager as _plugins
+        user = self.session_properties.get("user", "")
+        _plugins.check_can_execute(user, sql)
+        if _plugins.access_controls:
+            from presto_tpu.spi import AccessDeniedError
+            from presto_tpu.plan.nodes import scan_tables_deep
+            from presto_tpu.sql.parser import parse_statement
+            try:
+                plan = self.plan_sql(sql)
+            except AccessDeniedError:
+                raise
+            except Exception:   # noqa: BLE001 — DDL: check inner SELECT
+                try:
+                    stmt = parse_statement(sql)
+                    q = getattr(stmt, "query", None)
+                    plan = (self.planner.plan_query(q)
+                            if q is not None else None)
+                except Exception:   # noqa: BLE001 — bare DDL
+                    plan = None
+            if plan is not None:
+                for table in scan_tables_deep(plan):
+                    _plugins.check_can_select(user, table)
+
         with self._lock:
             self._query_counter += 1
             qid = f"cluster_q{self._query_counter}"

@@ -1,0 +1,177 @@
+"""Plugin SPI — the boundary third-party code plugs into.
+
+Reference: presto-spi/.../Plugin.java:42 (getConnectorFactories,
+getFunctions, getSystemAccessControlFactories, getEventListenerFactories
+via presto-spi/.../eventlistener) + presto-main's PluginManager loading
+them into the engine registries. TPU-first re-expression: scalar
+functions are VECTORIZED array transforms (a python impl over the
+column's device arrays — jnp in, jnp out — so a UDF compiles into the
+fragment program like a built-in, instead of the reference's per-row
+@ScalarFunction methods).
+
+Surface:
+  Plugin                   — subclass and override the get_* hooks
+  ScalarFunction           — name + return type + vectorized impl
+  ConnectorFactory         — catalog name -> connector instance
+  EventListenerFactory     — query lifecycle event callbacks
+  SystemAccessControl      — can-select checks (raise AccessDenied)
+  PluginManager / install  — registration (the PluginManager.java role)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from presto_tpu.types import Type
+
+
+class AccessDeniedError(RuntimeError):
+    """Reference: spi/security/AccessDeniedException."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarFunction:
+    """A vectorized scalar function: `impl(*value_arrays) -> array`
+    receives one jnp array per argument (decimals pre-descaled to
+    float64 when `descale_decimals`); NULLs propagate automatically
+    (any NULL argument -> NULL result), matching the reference's
+    default @SqlNullable-free convention."""
+    name: str
+    return_type: Type
+    impl: Callable
+    descale_decimals: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ConnectorFactory:
+    """Reference: spi/connector/ConnectorFactory — `create(config)`
+    returns a connector serving a catalog."""
+    name: str
+    create: Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class EventListenerFactory:
+    """Reference: spi/eventlistener/EventListenerFactory — `create`
+    returns a callable receiving utils.tracing.QueryEvent objects."""
+    name: str
+    create: Callable
+
+
+class SystemAccessControl:
+    """Reference: spi/security/SystemAccessControl. Override checks;
+    default allows everything. Raise AccessDeniedError to deny."""
+
+    def check_can_select_from_table(self, user: str, table: str) -> None:
+        pass
+
+    def check_can_execute_query(self, user: str, sql: str) -> None:
+        pass
+
+
+class Plugin:
+    """Subclass and override any hook (all default empty — the
+    reference's default-method pattern)."""
+
+    def get_connector_factories(self) -> Sequence[ConnectorFactory]:
+        return ()
+
+    def get_functions(self) -> Sequence[ScalarFunction]:
+        return ()
+
+    def get_event_listener_factories(self) -> Sequence[
+            EventListenerFactory]:
+        return ()
+
+    def get_system_access_control_factories(self) -> Sequence[Callable]:
+        """Each factory: () -> SystemAccessControl."""
+        return ()
+
+
+class PluginManager:
+    """Engine-side registries (reference: presto-main
+    PluginManager.java + ConnectorManager + FunctionAndTypeManager's
+    namespace registration). One process-wide instance (`manager`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.functions: Dict[str, ScalarFunction] = {}
+        self.connector_factories: Dict[str, ConnectorFactory] = {}
+        self.catalogs: Dict[str, object] = {}
+        self.access_controls: List[SystemAccessControl] = []
+        self.loaded_plugins: List[Plugin] = []
+        self._listeners: List[Callable] = []
+
+    def install(self, plugin: Plugin) -> None:
+        from presto_tpu.utils.tracing import EVENTS
+        with self._lock:
+            self.loaded_plugins.append(plugin)
+            for f in plugin.get_functions():
+                self.functions[f.name.lower()] = f
+            for cf in plugin.get_connector_factories():
+                self.connector_factories[cf.name] = cf
+            for ac_factory in \
+                    plugin.get_system_access_control_factories():
+                self.access_controls.append(ac_factory())
+        for lf in plugin.get_event_listener_factories():
+            cb = lf.create()
+            self._listeners.append(cb)
+            EVENTS.register(cb)
+
+    def shutdown(self) -> None:
+        """Unregister this manager's event listeners from the global
+        event pipeline (they would otherwise outlive the manager —
+        tests swapping managers, server restarts)."""
+        from presto_tpu.utils.tracing import EVENTS
+        for cb in self._listeners:
+            EVENTS.unregister(cb)
+        self._listeners = []
+
+    def install_module(self, module_name: str) -> Plugin:
+        """Load a plugin by module path (the plugin-directory loading
+        analog: the module must expose `PLUGIN`, or a Plugin SUBCLASS
+        defined in that module)."""
+        mod = importlib.import_module(module_name)
+        plugin = getattr(mod, "PLUGIN", None)
+        if plugin is None:
+            cls = getattr(mod, "Plugin", None)
+            if not (isinstance(cls, type) and issubclass(cls, Plugin)
+                    and cls is not Plugin):
+                # the imported SPI BASE class is not a plugin — a module
+                # that only re-imports it must still error loudly
+                raise ValueError(
+                    f"module {module_name!r} exposes no PLUGIN")
+            plugin = cls()
+        self.install(plugin)
+        return plugin
+
+    def create_catalog(self, catalog_name: str, connector_name: str,
+                       config: Optional[dict] = None):
+        """Reference: ConnectorManager.createConnection — instantiate a
+        registered factory as a named catalog."""
+        cf = self.connector_factories.get(connector_name)
+        if cf is None:
+            raise ValueError(f"no connector factory {connector_name!r}")
+        conn = cf.create(dict(config or {}))
+        with self._lock:
+            self.catalogs[catalog_name] = conn
+        return conn
+
+    def get_function(self, name: str) -> Optional[ScalarFunction]:
+        return self.functions.get(name.lower())
+
+    def check_can_select(self, user: str, table: str) -> None:
+        for ac in list(self.access_controls):
+            ac.check_can_select_from_table(user, table)
+
+    def check_can_execute(self, user: str, sql: str) -> None:
+        for ac in list(self.access_controls):
+            ac.check_can_execute_query(user, sql)
+
+
+#: the process-wide plugin manager (reference: the PluginManager
+#: singleton owned by the server injector)
+manager = PluginManager()

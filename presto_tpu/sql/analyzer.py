@@ -2381,6 +2381,12 @@ class Planner:
             else:
                 rt = DOUBLE
             return Call(name, args, rt)
+        # plugin-registered scalar functions (spi.PluginManager —
+        # the FunctionAndTypeManager namespace lookup)
+        from presto_tpu.spi import manager as _plugins
+        pf = _plugins.get_function(name)
+        if pf is not None:
+            return Call(name, args, pf.return_type)
         raise AnalysisError(f"unknown function {name}")
 
 

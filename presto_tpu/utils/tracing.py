@@ -91,6 +91,11 @@ class EventListenerManager:
         with self._lock:
             self._listeners.append(listener)
 
+    def unregister(self, listener: Callable[[QueryEvent], None]):
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
     def emit(self, event: QueryEvent):
         with self._lock:
             listeners = list(self._listeners)

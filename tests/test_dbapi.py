@@ -1,0 +1,65 @@
+"""DBAPI 2.0 driver over the statement REST protocol (round-4; the
+python-ecosystem analog of presto-jdbc — PrestoDriver/PrestoStatement
+over StatementClientV1)."""
+
+from decimal import Decimal
+
+import pytest
+
+import presto_tpu.client as client
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.server.cluster import TpuCluster
+from presto_tpu.server.statement import StatementServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    cluster = TpuCluster(TpchConnector(0.01), n_workers=2)
+    srv = StatementServer(cluster).start()
+    yield srv
+    srv.stop()
+    cluster.stop()
+
+
+def test_connect_execute_fetch(server):
+    with client.connect(server.base) as conn:
+        cur = conn.cursor()
+        cur.execute("select l_returnflag, count(*) c from lineitem "
+                    "group by l_returnflag order by l_returnflag")
+        assert [d[0] for d in cur.description] == ["l_returnflag", "c"]
+        rows = cur.fetchall()
+        assert len(rows) == 3 and rows[0][0] == "A"
+        assert cur.rowcount == 3
+        # fetchone/fetchmany cursor position semantics
+        cur.execute("select n_nationkey from nation order by n_nationkey")
+        assert cur.fetchone() == (0,)
+        assert cur.fetchmany(3) == [(1,), (2,), (3,)]
+        assert len(cur.fetchall()) == 21
+
+
+def test_qmark_parameters(server):
+    cur = client.connect(server.base).cursor()
+    cur.execute("select count(*) from nation where n_regionkey = ? "
+                "and n_name <> ?", [1, "O'BRIEN"])
+    assert cur.fetchall() == [(5,)]
+
+
+def test_decimal_and_null_decoding(server):
+    cur = client.connect(server.base).cursor()
+    cur.execute("select cast(1.5 as decimal(10,2)), null")
+    row = cur.fetchone()
+    assert row == (Decimal("1.50"), None)
+    assert isinstance(row[0], Decimal)
+
+
+def test_errors_and_iteration(server):
+    conn = client.connect(server.base)
+    cur = conn.cursor()
+    with pytest.raises(client.DatabaseError, match="no_such"):
+        cur.execute("select no_such_col from nation")
+    cur.execute("select n_name from nation where n_regionkey = 0 "
+                "order by n_name")
+    assert len(list(cur)) == 5
+    conn.close()
+    with pytest.raises(client.InterfaceError):
+        conn.cursor()
