@@ -73,9 +73,13 @@ PROPERTIES = [
              "PhysicalCteOptimizer / cte_materialization_strategy)",
              _parse_bool, False),
     Property("spill_enabled",
-             "Offload accumulated lifespan partials from device HBM to "
-             "host RAM (reference: spiller/ + revocable memory)",
+             "Offload accumulated lifespan partials out of device HBM "
+             "(reference: spiller/ + revocable memory): host RAM by "
+             "default, disk when spill_path is set",
              _parse_bool, True),
+    Property("spill_path",
+             "Directory for spill files (FileSingleStreamSpiller role; "
+             "empty = host-RAM offload only)", str.strip, ""),
     Property("broadcast_join_threshold_rows",
              "Estimated build-side rows under which a join replicates "
              "its build instead of hash-exchanging both sides "

@@ -202,7 +202,10 @@ def _spill_to_host(p: Page) -> _HostPartial:
     return _HostPartial(cols, n, p.names)
 
 
-def _part_cols(p):
+def _part_cols(p, spiller=None):
+    from presto_tpu.exec.spill import SpillHandle
+    if isinstance(p, SpillHandle):
+        p = spiller.read(p)            # disk -> device page
     if isinstance(p, _HostPartial):
         return p.columns
     n = int(p.num_rows)
@@ -210,10 +213,11 @@ def _part_cols(p):
              c.dictionary) for c in p.columns]
 
 
-def _concat_pages(pages: List) -> Page:
+def _concat_pages(pages: List, spiller=None) -> Page:
     """Host-side concatenation of the valid rows of several partials
-    (device Pages or spilled _HostPartials) with identical schemas."""
-    parts = [_part_cols(p) for p in pages]
+    (device Pages, host-RAM _HostPartials, or disk SpillHandles) with
+    identical schemas."""
+    parts = [_part_cols(p, spiller) for p in pages]
     total = sum(int(p.num_rows) for p in pages)
     cap = bucket_capacity(max(total, 1))
     cols = []
@@ -269,12 +273,19 @@ class BatchedRunner:
             self.dyn = _dynamic_filter(connector, self.ex,
                                        self.agg.source, driving)
         self.spill = bool(self.ex.session["spill_enabled"])
+        # spill_path set -> partials revoke to DISK files
+        # (FileSingleStreamSpiller role); empty -> host RAM offload
+        self.spill_dir = self.ex.session["spill_path"] or None
 
     def run(self, stats: Optional[dict] = None) -> Page:
         if not self.batchable:
             return self.ex.execute(self.plan)
         connector, ex = self.connector, self.ex
         driving, num_batches = self.driving, self.num_batches
+        spiller = None
+        if self.spill and self.spill_dir:
+            from presto_tpu.exec.spill import FileSpiller
+            spiller = FileSpiller(self.spill_dir)
         skipped = 0
         partials: List[Page] = []
         for b in range(num_batches):
@@ -290,7 +301,10 @@ class BatchedRunner:
             ex.set_splits({driving: [(b, num_batches)]})
             p = ex.execute(self.partial_plan)
             if self.spill:
-                p = _spill_to_host(p)
+                if spiller is not None:
+                    p = spiller.spill(p)
+                else:
+                    p = _spill_to_host(p)
             partials.append(p)
         if stats is not None:
             stats.update(batches=num_batches, skipped=skipped)
@@ -301,7 +315,12 @@ class BatchedRunner:
             ex.set_splits({driving: [(0, num_batches)]})
             partials.append(ex.execute(self.partial_plan))
 
-        merged = _concat_pages(partials)
+        if stats is not None and spiller is not None:
+            stats.update(spilled_bytes=spiller.total_spilled_bytes,
+                         spill_files=len(spiller.handles))
+        merged = _concat_pages(partials, spiller)
+        if spiller is not None:
+            spiller.close()
         k = len(self.agg.group_fields)
         out_cap = bucket_capacity(max(int(merged.num_rows), 256))
         page, _groups = grouped_aggregate(merged, tuple(range(k)),

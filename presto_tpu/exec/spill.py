@@ -1,0 +1,159 @@
+"""Disk spill — file-backed partials and external sort.
+
+Reference roles: spiller/FileSingleStreamSpiller.java (+ SpillerFactory,
+GenericSpiller) writing serialized pages to spill files, and
+MemoryRevokingScheduler revoking operator memory into those files. The
+spill format here is the engine's own SerializedPage wire codec
+(protocol/serde) with LZ4 — the same dogfooding the reference does with
+its PagesSerde, so a spill file is bit-identical to an exchange stream
+and every type (strings, DECIMAL(38) limb lanes, nested) round-trips.
+
+Two consumers:
+  - exec/lifespan.BatchedRunner: aggregation partials revoke to disk
+    under `spill_enabled` + `spill_path` (was: host RAM only).
+  - external_sort(): sorted run files + streaming k-way merge — the
+    sort spill the reference gets from OrderByOperator + spiller.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import tempfile
+import uuid
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from presto_tpu.data.column import Page
+
+
+class SpillHandle:
+    __slots__ = ("path", "num_rows", "types", "names", "bytes")
+
+    def __init__(self, path: str, num_rows: int, types, names,
+                 nbytes: int):
+        self.path = path
+        self.num_rows = num_rows
+        self.types = types
+        self.names = names
+        self.bytes = nbytes
+
+
+class FileSpiller:
+    """Write pages to spill files; read them back page by page.
+    One directory per spiller instance, deleted on close (the
+    reference's per-query spill-path lifecycle)."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 codec: str = "lz4"):
+        self._own_dir = directory is None
+        self.directory = directory or tempfile.mkdtemp(
+            prefix="presto_tpu_spill_")
+        os.makedirs(self.directory, exist_ok=True)
+        self.codec = codec
+        self.handles: List[SpillHandle] = []
+        self.total_spilled_bytes = 0
+
+    def spill(self, page: Page) -> SpillHandle:
+        from presto_tpu.protocol.serde import (
+            encode_serialized_page, page_to_wire_blocks,
+        )
+        frame = encode_serialized_page(
+            page_to_wire_blocks(page), checksummed=True,
+            compression=self.codec)
+        path = os.path.join(self.directory,
+                            f"run_{len(self.handles)}_{uuid.uuid4().hex[:8]}")
+        with open(path, "wb") as f:
+            f.write(frame)
+        h = SpillHandle(path, int(page.num_rows),
+                        [c.type for c in page.columns],
+                        tuple(page.names), len(frame))
+        self.handles.append(h)
+        self.total_spilled_bytes += len(frame)
+        return h
+
+    def read(self, handle: SpillHandle) -> Page:
+        from presto_tpu.protocol.serde import (
+            decode_serialized_page, wire_blocks_to_page,
+        )
+        with open(handle.path, "rb") as f:
+            data = f.read()
+        blocks, n, _ = decode_serialized_page(data)
+        page = wire_blocks_to_page(blocks, list(handle.types), n)
+        page.names = handle.names
+        return page
+
+    def read_rows(self, handle: SpillHandle) -> Iterator[tuple]:
+        yield from self.read(handle).to_pylist()
+
+    def close(self):
+        for h in self.handles:
+            try:
+                os.unlink(h.path)
+            except OSError:
+                pass
+        self.handles = []
+        if self._own_dir:
+            try:
+                os.rmdir(self.directory)
+            except OSError:
+                pass
+
+
+def merge_sorted_rows(iters: Sequence[Iterator[tuple]], keys
+                      ) -> Iterator[tuple]:
+    """Streaming k-way merge of row iterators already sorted by `keys`
+    (ops/keys.SortKey sequence) — SQL null ordering, per-key direction,
+    total-order NaN placement. Shared by the external sort and the
+    coordinator's ordered merge exchange."""
+
+    class _Key:
+        __slots__ = ("row",)
+
+        def __init__(self, row):
+            self.row = row
+
+        def __lt__(self, other):
+            for k in keys:
+                a = self.row[k.field]
+                b = other.row[k.field]
+                if a is None or b is None:
+                    if (a is None) != (b is None):
+                        return (a is None) == k.nulls_sort_first
+                    continue
+                a_nan = isinstance(a, float) and a != a
+                b_nan = isinstance(b, float) and b != b
+                if a_nan or b_nan:
+                    if a_nan != b_nan:
+                        return b_nan
+                    continue
+                if a == b:
+                    continue
+                return (a < b) == k.ascending
+            return False
+
+    return heapq.merge(*iters, key=_Key)
+
+
+def external_sort(ex, plan, driving: str, num_batches: int,
+                  spill_dir: Optional[str] = None
+                  ) -> Tuple[List[tuple], int]:
+    """Disk-backed external sort: run the sort plan once per driving-scan
+    lifespan (each run sorts its slice on device), spill every sorted
+    run file, then stream-merge the runs. Peak device/host memory is one
+    lifespan + the merge window, not the whole table (reference:
+    OrderByOperator spilling through FileSingleStreamSpiller).
+
+    `ex` is a SplitExecutor; `plan` must be the SORT subtree (its output
+    is sorted rows). Returns (rows, spilled_bytes)."""
+    spiller = FileSpiller(spill_dir)
+    try:
+        for b in range(num_batches):
+            ex.set_splits({driving: [(b, num_batches)]})
+            run = ex.execute(plan)
+            spiller.spill(run)
+        keys = plan.keys
+        merged = merge_sorted_rows(
+            [spiller.read_rows(h) for h in spiller.handles], keys)
+        return list(merged), spiller.total_spilled_bytes
+    finally:
+        spiller.close()
