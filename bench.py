@@ -43,15 +43,13 @@ def _err(e) -> str:
     return f"{type(e).__name__}: {e}"[:200]
 
 
-def measure_sqlite_baseline(conn, sf, qids):
-    """Wall time per query in sqlite3 over the same generated rows."""
+def _sqlite_db(conn):
+    """Load the generated tables into sqlite once (minutes at SF1)."""
     import sqlite3
 
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "tests"))
-    from test_tpch_full import to_sqlite  # dialect bridge
     from oracle import table_df
-    from tpch_queries import QUERIES
 
     db = sqlite3.connect(":memory:")
     tables = ["region", "nation", "supplier", "customer", "part",
@@ -67,13 +65,27 @@ def measure_sqlite_baseline(conn, sf, qids):
                     lambda d: (epoch + datetime.timedelta(days=int(d))
                                ).isoformat())
         df.to_sql(t, db, index=False)
+    return db
+
+
+def measure_sqlite_baseline(conn, sf, qids, db=None):
+    """Wall time per query in sqlite3 over the same generated rows."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from test_tpch_full import to_sqlite  # dialect bridge
+    from tpch_queries import QUERIES
+
+    own = db is None
+    if own:
+        db = _sqlite_db(conn)
     out = {}
     for qid in qids:
         sql = to_sqlite(QUERIES[qid])
         t0 = time.perf_counter()
         db.execute(sql).fetchall()
         out[str(qid)] = time.perf_counter() - t0
-    db.close()
+    if own:
+        db.close()
     return out
 
 
@@ -86,17 +98,29 @@ def load_or_measure_baseline(conn, sf, qids):
     missing = [q for q in qids
                if str(q) not in data.get(key, {}).get("sqlite_seconds", {})]
     if missing:
-        measured = measure_sqlite_baseline(conn, sf, missing)
-        entry = data.setdefault(key, {}).setdefault("sqlite_seconds", {})
-        entry.update(measured)
-        data[key]["note"] = (
-            "sqlite3 :memory: wall seconds on identical generated data; "
-            "measured on this machine, cached (delete file to re-measure)")
-        try:
-            with open(BASELINE_FILE, "w") as f:
-                json.dump(data, f, indent=1, sort_keys=True)
-        except OSError:
-            pass
+        # measure AND save one query at a time (single shared db load):
+        # heavy sqlite joins at SF1 take many minutes each, and a
+        # timeout mid-way must not discard the queries already measured
+        db = _sqlite_db(conn)
+        for qid in missing:
+            measured = measure_sqlite_baseline(conn, sf, [qid], db=db)
+            if os.path.exists(BASELINE_FILE):
+                with open(BASELINE_FILE) as f:
+                    data = json.load(f)
+            entry = data.setdefault(key, {}).setdefault(
+                "sqlite_seconds", {})
+            entry.update(measured)
+            data[key]["note"] = (
+                "sqlite3 :memory: wall seconds on identical generated "
+                "data; measured on this machine, cached (delete file "
+                "to re-measure)")
+            try:
+                tmp = f"{BASELINE_FILE}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                os.replace(tmp, BASELINE_FILE)
+            except OSError:
+                pass
     return data[key]["sqlite_seconds"]
 
 
