@@ -102,14 +102,16 @@ def load_or_measure_baseline(conn, sf, qids):
         # heavy sqlite joins at SF1 take many minutes each, and a
         # timeout mid-way must not discard the queries already measured
         db = _sqlite_db(conn)
+        run_measured = {}       # survives a failed/raced file write
         for qid in missing:
-            measured = measure_sqlite_baseline(conn, sf, [qid], db=db)
+            run_measured.update(
+                measure_sqlite_baseline(conn, sf, [qid], db=db))
             if os.path.exists(BASELINE_FILE):
                 with open(BASELINE_FILE) as f:
                     data = json.load(f)
             entry = data.setdefault(key, {}).setdefault(
                 "sqlite_seconds", {})
-            entry.update(measured)
+            entry.update(run_measured)
             data[key]["note"] = (
                 "sqlite3 :memory: wall seconds on identical generated "
                 "data; measured on this machine, cached (delete file "

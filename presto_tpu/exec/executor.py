@@ -458,7 +458,9 @@ class Executor:
                     repl["filter"] = rewrite_expr(node.filter)
             elif kids:
                 names = [f.name for f in dataclasses.fields(node)]
-                if "source" in names:
+                if "sources" in names:      # UnionAllNode and friends
+                    repl = {"sources": kids}
+                elif "source" in names:
                     repl = {"source": kids[0]}
             return dataclasses.replace(node, **repl) if repl else node
 

@@ -48,6 +48,10 @@ def to_sqlite(sql: str) -> str:
     sql = re.sub(r"date\s+'(\d{4}-\d\d-\d\d)'\s*([-+])\s*interval\s+"
                  r"'(\d+)'\s+(day|month|year)", fold, sql)
     sql = re.sub(r"date\s+'(\d{4}-\d\d-\d\d)'", r"'\1'", sql)
+    # column ± interval 'n' day -> sqlite date(col, '±n days')
+    sql = re.sub(r"([a-zA-Z_][\w.]*)\s*([-+])\s*interval\s+'(\d+)'\s+day",
+                 lambda m: "date(%s, '%s%s days')" % (
+                     m.group(1), m.group(2), m.group(3)), sql)
     sql = re.sub(r"extract\s*\(\s*(year|month|day)\s+from\s+([a-z0-9_.]+)"
                  r"\s*\)",
                  lambda m: "cast(strftime('%%%s', %s) as integer)" % (

@@ -2802,3 +2802,563 @@ from ({_Q77_INNER}) x
 order by channel, id
 limit 100
 """
+
+
+_Q5_INNER = """
+  select 'store channel' as channel,
+         'store' || s_store_id as id,
+         sales, returns1, profit
+  from (select s_store_id,
+               sum(sales_price) as sales,
+               sum(return_amt) as returns1,
+               sum(profit) - sum(net_loss) as profit
+        from (select ss_store_sk as store_sk,
+                     ss_sold_date_sk as date_sk,
+                     ss_ext_sales_price as sales_price,
+                     ss_net_profit as profit,
+                     cast(0 as double) as return_amt,
+                     cast(0 as double) as net_loss
+              from store_sales
+              union all
+              select sr_store_sk as store_sk,
+                     sr_returned_date_sk as date_sk,
+                     cast(0 as double) as sales_price,
+                     cast(0 as double) as profit,
+                     sr_return_amt as return_amt,
+                     sr_net_loss as net_loss
+              from store_returns) salesreturns,
+             date_dim, store
+        where date_sk = d_date_sk
+          and d_date between date '2000-08-23'
+                         and date '2000-09-06'
+          and store_sk = s_store_sk
+        group by s_store_id) ssr
+  union all
+  select 'catalog channel' as channel,
+         'call_center' || cc_call_center_id as id,
+         sales, returns1, profit
+  from (select cc_call_center_id,
+               sum(sales_price) as sales,
+               sum(return_amt) as returns1,
+               sum(profit) - sum(net_loss) as profit
+        from (select cs_call_center_sk as center_sk,
+                     cs_sold_date_sk as date_sk,
+                     cs_ext_sales_price as sales_price,
+                     cs_net_profit as profit,
+                     cast(0 as double) as return_amt,
+                     cast(0 as double) as net_loss
+              from catalog_sales
+              union all
+              select cr_call_center_sk as center_sk,
+                     cr_returned_date_sk as date_sk,
+                     cast(0 as double) as sales_price,
+                     cast(0 as double) as profit,
+                     cr_return_amount as return_amt,
+                     cr_net_loss as net_loss
+              from catalog_returns) salesreturns,
+             date_dim, call_center
+        where date_sk = d_date_sk
+          and d_date between date '2000-08-23'
+                         and date '2000-09-06'
+          and center_sk = cc_call_center_sk
+        group by cc_call_center_id) csr
+  union all
+  select 'web channel' as channel,
+         'web_site' || web_site_id as id,
+         sales, returns1, profit
+  from (select web_site_id,
+               sum(sales_price) as sales,
+               sum(return_amt) as returns1,
+               sum(profit) - sum(net_loss) as profit
+        from (select ws_web_site_sk as wsr_web_site_sk,
+                     ws_sold_date_sk as date_sk,
+                     ws_ext_sales_price as sales_price,
+                     ws_net_profit as profit,
+                     cast(0 as double) as return_amt,
+                     cast(0 as double) as net_loss
+              from web_sales
+              union all
+              select ws_web_site_sk as wsr_web_site_sk,
+                     wr_returned_date_sk as date_sk,
+                     cast(0 as double) as sales_price,
+                     cast(0 as double) as profit,
+                     wr_return_amt as return_amt,
+                     wr_net_loss as net_loss
+              from web_returns
+                   left join web_sales
+                     on wr_item_sk = ws_item_sk
+                    and wr_order_number = ws_order_number)
+             salesreturns,
+             date_dim, web_site
+        where date_sk = d_date_sk
+          and d_date between date '2000-08-23'
+                         and date '2000-09-06'
+          and wsr_web_site_sk = web_site_sk
+        group by web_site_id) wsr
+"""
+
+QUERIES[5] = f"""
+select channel, id, sum(sales) sales, sum(returns1) returns1,
+       sum(profit) profit
+from ({_Q5_INNER}) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+"""
+
+SQLITE_OVERRIDES[5] = f"""
+select channel, id, sum(sales) sales, sum(returns1) returns1,
+       sum(profit) profit
+from ({_Q5_INNER}) x group by channel, id
+union all
+select channel, null, sum(sales), sum(returns1), sum(profit)
+from ({_Q5_INNER}) x group by channel
+union all
+select null, null, sum(sales), sum(returns1), sum(profit)
+from ({_Q5_INNER}) x
+order by channel, id
+limit 100
+"""
+
+_Q80_INNER = """
+  select 'store channel' as channel, s_store_id as id,
+         sum(ss_ext_sales_price) as sales,
+         sum(coalesce(sr_return_amt, 0)) as returns1,
+         sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit
+  from store_sales
+       left join store_returns
+         on ss_item_sk = sr_item_sk
+        and ss_ticket_number = sr_ticket_number,
+       date_dim, store, item, promotion
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23' and date '2000-09-22'
+    and ss_store_sk = s_store_sk
+    and ss_item_sk = i_item_sk
+    and i_current_price > 50
+    and ss_promo_sk = p_promo_sk
+    and p_channel_tv = 'N'
+  group by s_store_id
+  union all
+  select 'catalog channel' as channel,
+         cc_call_center_id as id,
+         sum(cs_ext_sales_price) as sales,
+         sum(coalesce(cr_return_amount, 0)) as returns1,
+         sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit
+  from catalog_sales
+       left join catalog_returns
+         on cs_item_sk = cr_item_sk
+        and cs_order_number = cr_order_number,
+       date_dim, call_center, item, promotion
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23' and date '2000-09-22'
+    and cs_call_center_sk = cc_call_center_sk
+    and cs_item_sk = i_item_sk
+    and i_current_price > 50
+    and cs_promo_sk = p_promo_sk
+    and p_channel_tv = 'N'
+  group by cc_call_center_id
+  union all
+  select 'web channel' as channel, web_site_id as id,
+         sum(ws_ext_sales_price) as sales,
+         sum(coalesce(wr_return_amt, 0)) as returns1,
+         sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit
+  from web_sales
+       left join web_returns
+         on ws_item_sk = wr_item_sk
+        and ws_order_number = wr_order_number,
+       date_dim, web_site, item, promotion
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23' and date '2000-09-22'
+    and ws_web_site_sk = web_site_sk
+    and ws_item_sk = i_item_sk
+    and i_current_price > 50
+    and ws_promo_sk = p_promo_sk
+    and p_channel_tv = 'N'
+  group by web_site_id
+"""
+
+QUERIES[80] = f"""
+select channel, id, sum(sales) sales, sum(returns1) returns1,
+       sum(profit) profit
+from ({_Q80_INNER}) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+"""
+
+SQLITE_OVERRIDES[80] = f"""
+select channel, id, sum(sales) sales, sum(returns1) returns1,
+       sum(profit) profit
+from ({_Q80_INNER}) x group by channel, id
+union all
+select channel, null, sum(sales), sum(returns1), sum(profit)
+from ({_Q80_INNER}) x group by channel
+union all
+select null, null, sum(sales), sum(returns1), sum(profit)
+from ({_Q80_INNER}) x
+order by channel, id
+limit 100
+"""
+
+QUERIES.update({
+    24: """
+with ssales as (
+  select c_last_name, c_first_name, s_store_name, ca_state,
+         s_state, i_color, i_current_price, i_manager_id,
+         c_birth_country, sum(ss_net_paid) netpaid
+  from store_sales, store_returns, store, item, customer,
+       customer_address
+  where ss_ticket_number = sr_ticket_number
+    and ss_item_sk = sr_item_sk
+    and ss_customer_sk = c_customer_sk
+    and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk
+    and c_current_addr_sk = ca_address_sk
+    and c_birth_country <> upper(ca_country)
+    and s_market_id = 5
+  group by c_last_name, c_first_name, s_store_name, ca_state,
+           s_state, i_color, i_current_price, i_manager_id,
+           c_birth_country)
+select c_last_name, c_first_name, s_store_name,
+       sum(netpaid) paid
+from ssales
+where i_color = 'chiffon'
+group by c_last_name, c_first_name, s_store_name
+having sum(netpaid) >
+         (select 0.05 * avg(netpaid) from ssales)
+order by c_last_name, c_first_name, s_store_name
+""",
+})
+
+QUERIES.update({
+    39: """
+with inv as (
+  select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+         stddev_samp(inv_quantity_on_hand) stdev,
+         avg(inv_quantity_on_hand) mean
+  from inventory, item, warehouse, date_dim
+  where inv_item_sk = i_item_sk
+    and inv_warehouse_sk = w_warehouse_sk
+    and inv_date_sk = d_date_sk
+    and d_year = 2000
+  group by w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy)
+select inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy,
+       inv1.mean, inv1.stdev,
+       inv2.w_warehouse_sk wsk2, inv2.i_item_sk isk2, inv2.d_moy moy2,
+       inv2.mean mean2, inv2.stdev stdev2
+from inv inv1, inv inv2
+where inv1.i_item_sk = inv2.i_item_sk
+  and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  and inv1.d_moy = 1
+  and inv2.d_moy = 2
+  and inv1.mean > 0
+  and inv1.stdev / inv1.mean > 1.0
+order by inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+         inv1.stdev
+""",
+})
+
+
+
+QUERIES.update({
+    72: """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+       sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+       count(*) total_cnt
+from catalog_sales
+     join inventory on (cs_item_sk = inv_item_sk)
+     join warehouse on (w_warehouse_sk = inv_warehouse_sk)
+     join item on (i_item_sk = cs_item_sk)
+     join customer_demographics
+       on (cs_bill_cdemo_sk = cd_demo_sk)
+     join customer on (cs_bill_customer_sk = c_customer_sk)
+     join household_demographics
+       on (c_current_hdemo_sk = hd_demo_sk)
+     join date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)
+     join date_dim d2 on (inv_date_sk = d2.d_date_sk)
+     join date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)
+     left join promotion on (cs_promo_sk = p_promo_sk)
+     left join catalog_returns
+       on (cr_item_sk = cs_item_sk
+           and cr_order_number = cs_order_number)
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and d3.d_date > d1.d_date + interval '5' day
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 2000
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
+limit 100
+""",
+    54: """
+with my_customers as (
+  select distinct c_customer_sk, c_current_addr_sk
+  from (select cs_sold_date_sk sold_date_sk,
+               cs_bill_customer_sk customer_sk,
+               cs_item_sk item_sk
+        from catalog_sales
+        union all
+        select ws_sold_date_sk sold_date_sk,
+               ws_bill_customer_sk customer_sk,
+               ws_item_sk item_sk
+        from web_sales) cs_or_ws_sales,
+       item, date_dim, customer
+  where sold_date_sk = d_date_sk
+    and item_sk = i_item_sk
+    and i_category = 'Books'
+    and c_customer_sk = cs_or_ws_sales.customer_sk
+    and d_moy = 3 and d_year = 2000),
+my_revenue as (
+  select c_customer_sk, sum(ss_ext_sales_price) as revenue
+  from my_customers, store_sales, customer_address, store, date_dim
+  where c_current_addr_sk = ca_address_sk
+    and ca_county = s_county
+    and ca_state = s_state
+    and ss_customer_sk = c_customer_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_month_seq between
+          (select distinct d_month_seq + 1 from date_dim
+           where d_year = 2000 and d_moy = 3)
+      and (select distinct d_month_seq + 3 from date_dim
+           where d_year = 2000 and d_moy = 3)
+  group by c_customer_sk),
+segments as (
+  select cast(floor(revenue / 50) as bigint) as segment
+  from my_revenue)
+select segment, count(*) as num_customers,
+       segment * 50 as segment_base
+from segments
+group by segment
+order by segment, num_customers
+limit 100
+""",
+})
+
+QUERIES.update({
+    23: """
+with frequent_ss_items as (
+  select substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+         d_date solddate, count(*) cnt
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk
+    and ss_item_sk = i_item_sk
+    and d_year in (2000, 2001, 2002, 2003)
+  group by substr(i_item_desc, 1, 30), i_item_sk, d_date
+  having count(*) > 2),
+max_store_sales as (
+  select max(csales) tpcds_cmax
+  from (select c_customer_sk,
+               sum(ss_quantity * ss_sales_price) csales
+        from store_sales, customer, date_dim
+        where ss_customer_sk = c_customer_sk
+          and ss_sold_date_sk = d_date_sk
+          and d_year in (2000, 2001, 2002, 2003)
+        group by c_customer_sk) x),
+best_ss_customer as (
+  select c_customer_sk,
+         sum(ss_quantity * ss_sales_price) ssales
+  from store_sales, customer
+  where ss_customer_sk = c_customer_sk
+  group by c_customer_sk
+  having sum(ss_quantity * ss_sales_price) >
+           0.5 * (select tpcds_cmax from max_store_sales))
+select sum(sales) total
+from (select cs_quantity * cs_list_price sales
+      from catalog_sales, date_dim
+      where d_year = 2000 and d_moy = 2
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk in (select item_sk from frequent_ss_items)
+        and cs_bill_customer_sk in
+              (select c_customer_sk from best_ss_customer)
+      union all
+      select ws_quantity * ws_list_price sales
+      from web_sales, date_dim
+      where d_year = 2000 and d_moy = 2
+        and ws_sold_date_sk = d_date_sk
+        and ws_item_sk in (select item_sk from frequent_ss_items)
+        and ws_bill_customer_sk in
+              (select c_customer_sk from best_ss_customer)) y
+""",
+})
+
+
+_Q14_INNER = """
+  select 'store' channel, i_brand_id, i_class_id, i_category_id,
+         sum(ss_quantity * ss_list_price) sales,
+         count(*) number_sales
+  from store_sales, item, date_dim
+  where ss_item_sk in (select ss_item_sk from cross_items)
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 11
+  group by i_brand_id, i_class_id, i_category_id
+  having sum(ss_quantity * ss_list_price) >
+           (select average_sales from avg_sales)
+  union all
+  select 'catalog' channel, i_brand_id, i_class_id, i_category_id,
+         sum(cs_quantity * cs_list_price) sales,
+         count(*) number_sales
+  from catalog_sales, item, date_dim
+  where cs_item_sk in (select ss_item_sk from cross_items)
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 11
+  group by i_brand_id, i_class_id, i_category_id
+  having sum(cs_quantity * cs_list_price) >
+           (select average_sales from avg_sales)
+  union all
+  select 'web' channel, i_brand_id, i_class_id, i_category_id,
+         sum(ws_quantity * ws_list_price) sales,
+         count(*) number_sales
+  from web_sales, item, date_dim
+  where ws_item_sk in (select ss_item_sk from cross_items)
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 11
+  group by i_brand_id, i_class_id, i_category_id
+  having sum(ws_quantity * ws_list_price) >
+           (select average_sales from avg_sales)
+"""
+
+_Q14_CTES = """
+with cross_items as (
+  select i_item_sk ss_item_sk
+  from item,
+       (select iss.i_brand_id brand_id, iss.i_class_id class_id,
+               iss.i_category_id category_id
+        from store_sales, item iss, date_dim d1
+        where ss_item_sk = iss.i_item_sk
+          and ss_sold_date_sk = d1.d_date_sk
+          and d1.d_year between 1999 and 2001
+        intersect
+        select ics.i_brand_id, ics.i_class_id, ics.i_category_id
+        from catalog_sales, item ics, date_dim d2
+        where cs_item_sk = ics.i_item_sk
+          and cs_sold_date_sk = d2.d_date_sk
+          and d2.d_year between 1999 and 2001
+        intersect
+        select iws.i_brand_id, iws.i_class_id, iws.i_category_id
+        from web_sales, item iws, date_dim d3
+        where ws_item_sk = iws.i_item_sk
+          and ws_sold_date_sk = d3.d_date_sk
+          and d3.d_year between 1999 and 2001) x
+  where i_brand_id = brand_id
+    and i_class_id = class_id
+    and i_category_id = category_id),
+avg_sales as (
+  select avg(quantity * list_price) average_sales
+  from (select ss_quantity quantity, ss_list_price list_price
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk
+          and d_year between 1999 and 2001
+        union all
+        select cs_quantity quantity, cs_list_price list_price
+        from catalog_sales, date_dim
+        where cs_sold_date_sk = d_date_sk
+          and d_year between 1999 and 2001
+        union all
+        select ws_quantity quantity, ws_list_price list_price
+        from web_sales, date_dim
+        where ws_sold_date_sk = d_date_sk
+          and d_year between 1999 and 2001) x)
+"""
+
+QUERIES[14] = f"""{_Q14_CTES}
+select channel, i_brand_id, i_class_id, i_category_id,
+       sum(sales) sum_sales, sum(number_sales) num_sales
+from ({_Q14_INNER}) y
+group by rollup(channel, i_brand_id, i_class_id, i_category_id)
+order by channel nulls first, i_brand_id nulls first,
+         i_class_id nulls first, i_category_id nulls first
+limit 100
+"""
+
+SQLITE_OVERRIDES[14] = f"""{_Q14_CTES}
+select channel, i_brand_id, i_class_id, i_category_id,
+       sum(sales) sum_sales, sum(number_sales) num_sales
+from ({_Q14_INNER}) y
+group by channel, i_brand_id, i_class_id, i_category_id
+union all
+select channel, i_brand_id, i_class_id, null, sum(sales),
+       sum(number_sales)
+from ({_Q14_INNER}) y group by channel, i_brand_id, i_class_id
+union all
+select channel, i_brand_id, null, null, sum(sales),
+       sum(number_sales)
+from ({_Q14_INNER}) y group by channel, i_brand_id
+union all
+select channel, null, null, null, sum(sales), sum(number_sales)
+from ({_Q14_INNER}) y group by channel
+union all
+select null, null, null, null, sum(sales), sum(number_sales)
+from ({_Q14_INNER}) y
+order by channel nulls first, i_brand_id nulls first,
+         i_class_id nulls first, i_category_id nulls first
+limit 100
+"""
+
+QUERIES.update({
+    64: """
+with cs_ui as (
+  select cs_item_sk,
+         sum(cs_ext_list_price) as sale,
+         sum(cr_refunded_cash + cr_reversed_charge
+             + cr_store_credit) as refund
+  from catalog_sales, catalog_returns
+  where cs_item_sk = cr_item_sk
+    and cs_order_number = cr_order_number
+  group by cs_item_sk
+  having sum(cs_ext_list_price) >
+           2 * sum(cr_refunded_cash + cr_reversed_charge
+                   + cr_store_credit)),
+cross_sales as (
+  select i_product_name product_name, i_item_sk item_sk,
+         s_store_name store_name, s_zip store_zip,
+         ad2.ca_county c_county, ad2.ca_city c_city,
+         ad2.ca_zip c_zip, d1.d_year as syear, count(*) cnt,
+         sum(ss_wholesale_cost) s1, sum(ss_list_price) s2,
+         sum(ss_coupon_amt) s3
+  from store_sales, store_returns, cs_ui, date_dim d1, store,
+       customer, customer_demographics cd1,
+       customer_demographics cd2, household_demographics hd1,
+       household_demographics hd2, customer_address ad1,
+       customer_address ad2, income_band ib1, income_band ib2, item
+  where ss_store_sk = s_store_sk
+    and ss_sold_date_sk = d1.d_date_sk
+    and ss_item_sk = i_item_sk
+    and ss_customer_sk = c_customer_sk
+    and ss_cdemo_sk = cd1.cd_demo_sk
+    and ss_hdemo_sk = hd1.hd_demo_sk
+    and ss_addr_sk = ad1.ca_address_sk
+    and ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+    and ss_item_sk = cs_ui.cs_item_sk
+    and c_current_cdemo_sk = cd2.cd_demo_sk
+    and c_current_hdemo_sk = hd2.hd_demo_sk
+    and c_current_addr_sk = ad2.ca_address_sk
+    and ss_promo_sk is not null
+    and hd1.hd_income_band_sk = ib1.ib_income_band_sk
+    and hd2.hd_income_band_sk = ib2.ib_income_band_sk
+    and cd1.cd_marital_status <> cd2.cd_marital_status
+    and i_current_price between 15 and 15 + 58
+  group by i_product_name, i_item_sk, s_store_name, s_zip,
+           ad2.ca_county, ad2.ca_city, ad2.ca_zip, d1.d_year)
+select cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.c_county, cs1.c_city, cs1.c_zip,
+       cs1.syear, cs1.cnt, cs1.s1 as s11, cs1.s2 as s21,
+       cs1.s3 as s31,
+       cs2.s1 as s12, cs2.s2 as s22, cs2.s3 as s32,
+       cs2.syear as syear2, cs2.cnt as cnt2
+from cross_sales cs1, cross_sales cs2
+where cs1.item_sk = cs2.item_sk
+  and cs1.syear = 2000
+  and cs2.syear = 2000 + 1
+  and cs2.cnt <= cs1.cnt
+  and cs1.store_name = cs2.store_name
+  and cs1.store_zip = cs2.store_zip
+order by cs1.product_name, cs1.store_name, cnt2, s12, s22
+limit 100
+""",
+})
