@@ -89,7 +89,7 @@ class LocalEngine:
         from presto_tpu.utils import TRACER
 
         head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
-        if head in ("create", "insert", "drop"):
+        if head in ("create", "insert", "drop", "delete"):
             return self._execute_statement(sql)
         if self.session["cte_materialization_enabled"]:
             q = parse_sql(sql)
@@ -182,6 +182,35 @@ class LocalEngine:
             return [(0,)]
         if not writable:
             raise AnalysisError("connector is not writable")
+
+        if isinstance(stmt, A.Delete):
+            # DELETE FROM t WHERE pred (reference: sql/tree/Delete ->
+            # DeleteNode + ConnectorMetadata.beginDelete): a row
+            # survives iff pred IS NOT TRUE; the surviving rows rewrite
+            # the table (memory-style connectors rewrite; the count row
+            # is deleted rows, the TableWriter contract).
+            if not conn.exists(stmt.name):
+                raise AnalysisError(f"unknown table {stmt.name}")
+            total = conn.table(stmt.name).num_rows
+            if stmt.where is None:
+                kept = []
+            else:
+                keep_pred = A.BinaryOp(
+                    "or", A.UnaryOp("not", stmt.where),
+                    A.IsNull(stmt.where))
+                keep_q = A.Select(
+                    items=(A.SelectItem(A.Star()),),
+                    relations=(A.TableRef(stmt.name),),
+                    where=keep_pred)
+                plan = self.planner.plan_query(keep_q)
+                page = self.executor.execute(plan)
+                kept = page.to_pylist()
+            schema = conn.schema(stmt.name)
+            conn.drop(stmt.name)
+            conn.create(stmt.name, schema)
+            if kept:
+                conn.append_rows(stmt.name, kept)
+            return [(total - len(kept),)]
 
         if isinstance(stmt, A.CreateTable):
             if stmt.if_not_exists and conn.exists(stmt.name):

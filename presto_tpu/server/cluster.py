@@ -253,7 +253,7 @@ class TpuCluster:
             with group.acquire(timeout_s=600):
                 head = (sql.lstrip().split(None, 1)[0].lower()
                         if sql.strip() else "")
-                if head in ("create", "insert", "drop"):
+                if head in ("create", "insert", "drop", "delete"):
                     box[0] = self._execute_write(sql)
                 else:
                     box[0] = self._execute_plan(self.plan_sql(sql),

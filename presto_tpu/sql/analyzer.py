@@ -2147,6 +2147,8 @@ class Planner:
             unscaled = int(d.scaleb(scale))
             prec = max(len(str(abs(unscaled))), scale + 1)
             return Literal(unscaled, DecimalType(prec, scale))
+        if isinstance(e, ast.BoolLit):
+            return Literal(e.value, BOOLEAN)
         if isinstance(e, ast.StringLit):
             return Literal(e.value, VARCHAR)
         if isinstance(e, ast.DateLit):

@@ -45,6 +45,11 @@ class NullLit:
 
 
 @dataclasses.dataclass(frozen=True)
+class BoolLit:
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
 class Star:
     qualifier: Optional[str] = None
 
@@ -274,6 +279,15 @@ class Insert:
 class DropTable:
     name: str
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete:
+    """DELETE FROM t [WHERE pred] — reference: sql/tree/Delete.java ->
+    the DeleteNode/TableWriter pipeline; this engine rewrites the
+    surviving rows (a row where pred is not TRUE survives)."""
+    name: str
+    where: Optional["Expr"] = None
 
 
 Statement = object   # Select | CreateTableAs | CreateTable | Insert | DropTable

@@ -241,6 +241,14 @@ class Parser:
             self.accept("op", ";")
             self.expect("eof")
             return ast.DropTable(name, ife)
+        if word == "delete":
+            self.next()
+            self.expect_kw("from")
+            name = self.ident_text()
+            where = self.expr() if self.accept_kw("where") else None
+            self.accept("op", ";")
+            self.expect("eof")
+            return ast.Delete(name, where)
         return self.parse()
 
     def query(self) -> ast.Select:
@@ -639,6 +647,9 @@ class Parser:
         if t.kind == "string":
             self.next()
             return ast.StringLit(t.text)
+        if t.kind == "ident" and t.text.lower() in ("true", "false"):
+            self.next()
+            return ast.BoolLit(t.text.lower() == "true")
         if t.kind == "ident" and t.text.lower() == "decimal" \
                 and self.peek(1).kind == "string":
             # DECIMAL '123.45' — exact, always DECIMAL-typed literal

@@ -125,3 +125,33 @@ def test_failed_insert_leaves_table_unchanged(cluster):
         c._execute_plan_once = real
     assert c.execute_sql("SELECT count(*) FROM t3") == before
     assert not [t for t in mem.tables if t.startswith("stage_")]
+
+
+def test_delete_from_table(cluster):
+    """DELETE FROM t WHERE pred (round 4; reference: sql/tree/Delete ->
+    DeleteNode/ConnectorPageSink): a row survives iff pred IS NOT TRUE,
+    and the count row reports deleted rows."""
+    c, mem = cluster
+    eng = LocalEngine(mem)
+    eng.execute_sql("CREATE TABLE del_t AS SELECT n_nationkey k, "
+                    "n_regionkey r FROM nation")
+    assert eng.execute_sql("DELETE FROM del_t WHERE r = 0") == [(5,)]
+    assert eng.execute_sql("SELECT count(*) FROM del_t") == [(20,)]
+    # NULL predicate rows survive (pred IS NOT TRUE)
+    assert eng.execute_sql(
+        "DELETE FROM del_t WHERE case when k > 100 then true "
+        "else null end") == [(0,)]
+    # through the cluster entry point too
+    assert c.execute_sql("DELETE FROM del_t WHERE r >= 3") == [(10,)]
+    assert eng.execute_sql("SELECT count(*) FROM del_t") == [(10,)]
+    # unconditional delete empties the table
+    assert eng.execute_sql("DELETE FROM del_t") == [(10,)]
+    assert eng.execute_sql("SELECT count(*) FROM del_t") == [(0,)]
+
+
+def test_boolean_literals():
+    eng = LocalEngine(TpchConnector(0.001))
+    assert eng.execute_sql("SELECT true, false, not true") == \
+        [(True, False, False)]
+    assert eng.execute_sql(
+        "SELECT count(*) FROM nation WHERE true") == [(25,)]
