@@ -90,6 +90,21 @@ PROPERTIES = [
              "match the build side (reference: "
              "enable_dynamic_filtering / DynamicFilterSourceOperator)",
              _parse_bool, True),
+    Property("join_distribution_type",
+             "AUTOMATIC (cost-based broadcast-vs-repartition) | "
+             "PARTITIONED (always hash exchanges) | BROADCAST (force "
+             "replicated builds where legal); reference: "
+             "SystemSessionProperties.JOIN_DISTRIBUTION_TYPE",
+             str.strip, "AUTOMATIC"),
+    Property("query_max_execution_time",
+             "Wall-clock budget per query in seconds (0 = unlimited); "
+             "exceeded -> the query FAILS (reference: "
+             "QUERY_MAX_EXECUTION_TIME + QueryTracker enforcement)",
+             float, 0.0),
+    Property("hash_partition_count",
+             "Tasks per hash-partitioned intermediate stage in the "
+             "cluster (0 = one per worker; reference: "
+             "SystemSessionProperties.HASH_PARTITION_COUNT)", int, 0),
     Property("exchange_compression_codec",
              "Compress exchange pages: none | zlib | gzip | lz4 "
              "(LZ4 block format in the native C++ codec; reference: "

@@ -78,6 +78,13 @@ class Overflow(Exception):
         self.needed = needed
 
 
+class QueryTimeoutError(RuntimeError):
+    """query_max_execution_time exceeded (reference:
+    QUERY_MAX_EXECUTION_TIME enforced by the QueryTracker). Checked at
+    operator-island boundaries — a single compiled program is never
+    interrupted mid-flight."""
+
+
 class MemoryLimitExceeded(Exception):
     """Static plan footprint exceeds the executor's memory limit —
     the caller should batch (exec/lifespan.py) or reject the query.
@@ -121,11 +128,22 @@ class Executor:
         self._stats_ids: List[int] = []
 
     def execute(self, plan: PlanNode) -> Page:
+        import time
+        budget = self.session["query_max_execution_time"]
+        self._deadline = (time.time() + budget) if budget else None
         plan = self._resolve_subqueries(plan)
         plan = self._prepare(plan)
         if isinstance(plan, TableWriterNode):
             return self._execute_writer(plan)
         return self._execute_tree(plan)
+
+    def _check_deadline(self):
+        import time
+        dl = getattr(self, "_deadline", None)
+        if dl is not None and time.time() > dl:
+            raise QueryTimeoutError(
+                f"query exceeded query_max_execution_time "
+                f"({self.session['query_max_execution_time']:.0f}s)")
 
     def _execute_writer(self, node: TableWriterNode) -> Page:
         """Writer root: run the source pipeline on device, then sink the
@@ -238,6 +256,7 @@ class Executor:
         def run(node: PlanNode) -> Page:
             if id(node) in run_memo:
                 return run_memo[id(node)]
+            self._check_deadline()
             mini, children = self._island_of(node)
             pages = [run(c) for c in children]
             self._island_inputs = pages

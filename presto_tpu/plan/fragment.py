@@ -85,9 +85,11 @@ def add_exchanges(plan: PlanNode, connector=None, session=None,
         est = lambda n: estimate_rows(n, connector, history)  # noqa: E731
     if session is not None:
         threshold = session["broadcast_join_threshold_rows"]
+        dist_type = session["join_distribution_type"].upper()
     else:
         from presto_tpu.config import _BY_NAME
         threshold = _BY_NAME["broadcast_join_threshold_rows"].default
+        dist_type = _BY_NAME["join_distribution_type"].default.upper()
     # property: (Partitioning, keys) — keys are positions in the node's
     # output, meaningful for HASH only.
     Prop = Tuple[PlanNode, Tuple[Partitioning, Tuple[int, ...]]]
@@ -222,7 +224,15 @@ def add_exchanges(plan: PlanNode, connector=None, session=None,
                         (Partitioning.SINGLE, ()))
             broadcast = (not node.probe_keys or string_keys
                          or node.join_type == JoinType.ANTI)
-            if (not broadcast and est is not None
+            if (not broadcast and dist_type == "BROADCAST"
+                    and node.join_type in (JoinType.INNER, JoinType.LEFT,
+                                           JoinType.SEMI,
+                                           JoinType.ANTI_EXISTS)):
+                # session-forced replication (join_distribution_type;
+                # reference: SystemSessionProperties.JOIN_DISTRIBUTION_TYPE)
+                broadcast = True
+            if (not broadcast and dist_type == "AUTOMATIC"
+                    and est is not None
                     and node.join_type in (JoinType.INNER, JoinType.LEFT,
                                            JoinType.SEMI,
                                            JoinType.ANTI_EXISTS)

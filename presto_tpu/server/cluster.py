@@ -462,13 +462,23 @@ class TpuCluster:
 
         stages: Dict[int, _Stage] = {}
 
+        # hash_partition_count (SystemSessionProperties.
+        # HASH_PARTITION_COUNT): tasks per hash-partitioned intermediate
+        # stage; 0 = one per worker
+        hpc = 0
+        try:
+            hpc = int(float(self.session_properties.get(
+                "hash_partition_count", 0) or 0))
+        except (TypeError, ValueError):
+            hpc = 0
+
         def n_tasks(fid: int) -> int:
             spec = specs[fid]
             if spec.scan_nodes:
                 return W
             for pfid in spec.remote_nodes.values():
                 if by_id[pfid].partitioning == Partitioning.HASH:
-                    return W
+                    return hpc if hpc > 0 else W
             return 1
 
         for f in frags:
@@ -601,7 +611,15 @@ class TpuCluster:
         """Long-poll every task CONCURRENTLY (reference: one
         ContinuousTaskStatusFetcher per task) — a straggler in one stage
         no longer hides a failure in another, and N tasks cost one
-        round-trip time per sweep instead of N."""
+        round-trip time per sweep instead of N. query_max_execution_time
+        (when set) caps the wait below the scheduler default."""
+        try:
+            budget = float(self.session_properties.get(
+                "query_max_execution_time", 0) or 0)
+        except (TypeError, ValueError):
+            budget = 0
+        if budget > 0:
+            timeout_s = min(timeout_s, budget)
         deadline = time.time() + timeout_s
         uris = [u for st in stages.values() for u in st.task_uris]
         results: Dict[str, Optional[dict]] = {}

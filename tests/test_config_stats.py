@@ -96,3 +96,61 @@ def test_worker_consumes_session_properties():
                    for f in st["failures"])
     finally:
         srv.stop()
+
+
+def test_join_distribution_type_forced():
+    """join_distribution_type steers AddExchanges: PARTITIONED forces
+    hash exchanges where AUTOMATIC would broadcast a small build, and
+    BROADCAST forces replication."""
+    from presto_tpu.config import Session
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.plan.fragment import add_exchanges
+    from presto_tpu.plan.nodes import ExchangeNode, Partitioning
+    from presto_tpu.sql.analyzer import Planner
+    from presto_tpu.sql.parser import parse_sql
+
+    conn = TpchConnector(0.01)
+    plan = Planner(conn).plan_query(parse_sql(
+        "select count(*) from lineitem, nation "
+        "where l_suppkey % 25 = n_nationkey"))
+
+    def kinds(p):
+        out = []
+
+        def walk(n):
+            if isinstance(n, ExchangeNode):
+                out.append(n.partitioning)
+            for c in n.children():
+                if c is not None:
+                    walk(c)
+        walk(p)
+        return out
+
+    auto = kinds(add_exchanges(plan, conn,
+                               Session({})))
+    part = kinds(add_exchanges(plan, conn, Session(
+        {"join_distribution_type": "PARTITIONED"})))
+    bc = kinds(add_exchanges(plan, conn, Session(
+        {"join_distribution_type": "BROADCAST"})))
+    # tiny nation build: AUTOMATIC and BROADCAST replicate...
+    assert Partitioning.BROADCAST in auto
+    assert Partitioning.BROADCAST in bc
+    # ...PARTITIONED must not
+    assert Partitioning.BROADCAST not in part
+    assert Partitioning.HASH in part
+
+
+def test_query_max_execution_time_enforced():
+    from presto_tpu.config import Session
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.exec.executor import QueryTimeoutError
+    import pytest
+
+    eng = LocalEngine(TpchConnector(0.01), session=Session(
+        {"query_max_execution_time": "0.000001"}))
+    with pytest.raises(QueryTimeoutError, match="exceeded"):
+        # join plan -> island path -> deadline checked between islands
+        eng.execute_sql(
+            "select count(*) from lineitem, orders "
+            "where l_orderkey = o_orderkey")
