@@ -202,7 +202,25 @@ class TpuCluster:
                 self.dead.add(uri)
         return self.worker_uris
 
+    def start_heartbeat(self, interval_s: float = 5.0) -> "TpuCluster":
+        """Periodic background liveness prober (reference:
+        failureDetector/HeartbeatFailureDetector.java:76 — continuous
+        monitoring, not only the on-failure probe): dead workers leave
+        the schedulable set BEFORE the next query fails on them."""
+        self._hb_stop = threading.Event()
+
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                self.check_workers()
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+        return self
+
     def stop(self):
+        hb = getattr(self, "_hb_stop", None)
+        if hb is not None:
+            hb.set()
         for w in self.workers:
             w.stop()
 

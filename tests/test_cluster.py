@@ -105,3 +105,26 @@ def test_kway_merge_order_by_across_workers():
         assert got == exp
     finally:
         c.stop()
+
+
+def test_heartbeat_prober_marks_dead_worker():
+    """The heartbeat failure detector (HeartbeatFailureDetector.java:76
+    role) removes a crashed worker from the schedulable set WITHOUT a
+    query having to fail on it first."""
+    import time as _t
+
+    c = TpuCluster(TpchConnector(0.001), n_workers=3)
+    try:
+        c.start_heartbeat(interval_s=0.2)
+        victim = c.all_worker_uris[1]
+        c.workers[1].stop()
+        for _ in range(50):                     # <= 10 s
+            if victim in c.dead:
+                break
+            _t.sleep(0.2)
+        assert victim in c.dead
+        # scheduling proceeds on the survivors
+        rows = c.execute_sql("select count(*) from nation")
+        assert rows == [(25,)]
+    finally:
+        c.stop()
