@@ -89,6 +89,16 @@ class LocalEngine:
         from presto_tpu.utils import TRACER
 
         head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
+        if head == "explain":
+            # EXPLAIN [ANALYZE] <query> (reference: sql/tree/Explain ->
+            # ExplainRewrite): one VARCHAR row per plan line
+            rest = sql.lstrip()[len("explain"):].lstrip()
+            if rest.lower().startswith("analyze"):
+                text = self.explain_analyze_sql(
+                    rest[len("analyze"):].lstrip())
+            else:
+                text = self.explain_sql(rest)
+            return [(line,) for line in text.splitlines()]
         if head in ("create", "insert", "drop", "delete"):
             return self._execute_statement(sql)
         if self.session["cte_materialization_enabled"]:

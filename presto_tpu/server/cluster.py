@@ -232,7 +232,8 @@ class TpuCluster:
         return self._plans[sql]
 
     def execute_sql(self, sql: str,
-                    _capture: bool = False) -> List[tuple]:
+                    _capture: bool = False,
+                    cancel_event=None) -> List[tuple]:
         from presto_tpu.utils.tracing import query_lifecycle
 
         # plugin access control: the cluster is the network-exposed
@@ -271,11 +272,21 @@ class TpuCluster:
             with group.acquire(timeout_s=600):
                 head = (sql.lstrip().split(None, 1)[0].lower()
                         if sql.strip() else "")
-                if head in ("create", "insert", "drop", "delete"):
+                if head == "explain":
+                    from presto_tpu.plan.nodes import explain as _ex
+                    rest = sql.lstrip()[len("explain"):].lstrip()
+                    if rest.lower().startswith("analyze"):
+                        text = self.explain_analyze_sql(
+                            rest[len("analyze"):].lstrip())
+                    else:
+                        text = _ex(self.plan_sql(rest))
+                    box[0] = [(line,) for line in text.splitlines()]
+                elif head in ("create", "insert", "drop", "delete"):
                     box[0] = self._execute_write(sql)
                 else:
-                    box[0] = self._execute_plan(self.plan_sql(sql),
-                                                capture=_capture)
+                    box[0] = self._execute_plan(
+                        self.plan_sql(sql), capture=_capture,
+                        cancel_event=cancel_event)
         return box[0]
 
     def _execute_write(self, sql: str) -> List[tuple]:
@@ -417,22 +428,28 @@ class TpuCluster:
         return "\n".join(lines)
 
     def _execute_plan(self, plan: PlanNode, _retried: bool = False,
-                      capture: bool = False) -> List[tuple]:
+                      capture: bool = False,
+                      cancel_event=None) -> List[tuple]:
         """Streaming-mode recovery (reference: a worker failure fails the
         query; the dispatcher retries on the surviving nodes once the
         failure detector excludes the dead worker)."""
         try:
-            return self._execute_plan_once(plan, capture=capture)
+            return self._execute_plan_once(plan, capture=capture,
+                                           cancel_event=cancel_event)
         except (ClusterQueryError, OSError):
+            if cancel_event is not None and cancel_event.is_set():
+                raise
             before = set(self.worker_uris)
             alive = set(self.check_workers())
             if _retried or alive == before or not alive:
                 raise
             return self._execute_plan(plan, _retried=True,
-                                      capture=capture)
+                                      capture=capture,
+                                      cancel_event=cancel_event)
 
     def _execute_plan_once(self, plan: PlanNode,
-                           capture: bool = False) -> List[tuple]:
+                           capture: bool = False,
+                           cancel_event=None) -> List[tuple]:
         # Uncorrelated scalar subqueries execute through the cluster
         # itself (recursively), not a local engine: distributed partial/
         # final aggregation orders float summation differently, and a
@@ -449,12 +466,13 @@ class TpuCluster:
         frags = create_fragments(ex_plan)
         return self._run_fragments(frags, list(plan.output_types),
                                    capture=capture,
-                                   merge_keys=merge_keys)
+                                   merge_keys=merge_keys,
+                                   cancel_event=cancel_event)
 
     # ------------------------------------------------------------------
     def _run_fragments(self, frags, out_types,
-                       capture: bool = False, merge_keys=None
-                       ) -> List[tuple]:
+                       capture: bool = False, merge_keys=None,
+                       cancel_event=None) -> List[tuple]:
         with self._lock:
             self._query_counter += 1
             qid = f"q{self._query_counter}_{int(time.time())}"
@@ -534,7 +552,7 @@ class TpuCluster:
 
         try:
             schedule(0)
-            self._await_all(stages)
+            self._await_all(stages, cancel_event=cancel_event)
             if capture:
                 self._capture_task_infos(stages)
             return self._collect_root(stages[0], out_types, merge_keys)
@@ -625,7 +643,7 @@ class TpuCluster:
             return json.loads(resp.read())
 
     def _await_all(self, stages: Dict[int, _Stage],
-                   timeout_s: float = 1800):
+                   timeout_s: float = 1800, cancel_event=None):
         """Long-poll every task CONCURRENTLY (reference: one
         ContinuousTaskStatusFetcher per task) — a straggler in one stage
         no longer hides a failure in another, and N tasks cost one
@@ -682,7 +700,14 @@ class TpuCluster:
             t.start()
         # wake on the FIRST failure (fail-fast) or when every watcher
         # finished; stragglers are daemons and die with their long-poll
-        wake.wait(max(0.0, deadline - time.time()) + 60)
+        # wait in slices so a client DELETE (statement cancellation)
+        # interrupts the query instead of merely flagging it: tasks are
+        # aborted by the caller's cleanup once we raise
+        end = deadline + 60
+        while not wake.is_set() and time.time() < end:
+            if cancel_event is not None and cancel_event.is_set():
+                raise ClusterQueryError("Query was canceled by the user")
+            wake.wait(0.25)
         for uri, e in errs.items():
             raise e if isinstance(e, (ClusterQueryError, OSError)) \
                 else ClusterQueryError(f"task {uri}: {e}")
