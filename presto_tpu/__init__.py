@@ -23,7 +23,22 @@ A ground-up re-design of the capabilities of Presto (reference:
   worker (reference: presto-native-execution/presto_cpp/main/TaskResource.cpp).
 """
 
+import os as _os
+
 import jax
+
+# Inheritable platform pin: this environment's sitecustomize registers the
+# remote-TPU platform *programmatically*, so the JAX_PLATFORMS env var alone
+# is ignored by child processes. Subprocesses we spawn (CLI under test, bench
+# children, cluster workers) honor PRESTO_TPU_PLATFORM instead — set before
+# any backend initializes, so a wedged TPU tunnel can't hang a child that
+# was meant to run on CPU.
+_plat = _os.environ.get("PRESTO_TPU_PLATFORM")
+if _plat:
+    try:
+        jax.config.update("jax_platforms", _plat)
+    except Exception:   # noqa: BLE001 — backend already initialized
+        pass
 
 # SQL semantics need exact 64-bit integers (BIGINT) and doubles. TPU emulates
 # f64/i64; the hot paths (filter masks, hashes, group codes) stay in 32-bit.
@@ -48,8 +63,6 @@ except (ImportError, ValueError, OSError):  # non-POSIX or locked down
 # the compile. Reference role: the JVM's C2-warmed operator factories
 # simply persist in-process; here the cache file is the analog.
 # Opt out with PRESTO_TPU_NO_COMPILE_CACHE=1.
-import os as _os
-
 if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE"):
     _cache_dir = _os.environ.get(
         "PRESTO_TPU_COMPILE_CACHE",

@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Child processes (the CLI under test, cluster workers) import presto_tpu,
+# which honors this pin before any backend initializes — without it a child
+# re-registers the remote TPU platform and hangs when the tunnel is wedged.
+os.environ["PRESTO_TPU_PLATFORM"] = "cpu"
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
