@@ -71,6 +71,13 @@ class SystemAccessControl:
     def check_can_execute_query(self, user: str, sql: str) -> None:
         pass
 
+    def check_can_delete_from_table(self, user: str, table: str) -> None:
+        """Reference: SystemAccessControl.checkCanDeleteFromTable. The
+        default defers to the select check: a user who may not read a
+        table must not be able to probe it (or destroy rows) via
+        DELETE ... WHERE either."""
+        self.check_can_select_from_table(user, table)
+
 
 class Plugin:
     """Subclass and override any hook (all default empty — the
@@ -170,6 +177,59 @@ class PluginManager:
     def check_can_execute(self, user: str, sql: str) -> None:
         for ac in list(self.access_controls):
             ac.check_can_execute_query(user, sql)
+
+    def check_can_delete(self, user: str, table: str) -> None:
+        for ac in list(self.access_controls):
+            ac.check_can_delete_from_table(user, table)
+
+    def check_statement_access(self, user, sql, plan_full, plan_query):
+        """Shared entry-point guard (LocalEngine.execute_sql and the
+        cluster coordinator): resolve the tables a statement touches and
+        run the select/delete checks. `plan_full` plans the raw SQL (may
+        raise for DDL/DML); `plan_query` plans an ast.Select.
+
+        DML needs explicit handling — a Delete has no .query, so without
+        the special case a user denied SELECT on a table could still run
+        DELETE FROM t WHERE <pred> and read predicate matches out of the
+        deleted-row count (and destroy the rows)."""
+        if not self.access_controls:
+            return
+        from presto_tpu.plan.nodes import scan_tables_deep
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.parser import parse_statement
+
+        plan = None
+        try:
+            plan = plan_full()
+        except AccessDeniedError:
+            raise
+        except Exception:   # noqa: BLE001 — DDL/DML: check by statement
+            try:
+                stmt = parse_statement(sql)
+            except Exception:   # noqa: BLE001 — unparseable: let the
+                stmt = None     # execution path raise its own error
+            if isinstance(stmt, A.Delete):
+                self.check_can_delete(user, stmt.name)
+                self.check_can_select(user, stmt.name)
+                if stmt.where is not None:
+                    # the predicate can scan other tables via subqueries
+                    try:
+                        plan = plan_query(A.Select(
+                            items=(A.SelectItem(A.Star()),),
+                            relations=(A.TableRef(stmt.name),),
+                            where=stmt.where))
+                    except Exception:   # noqa: BLE001
+                        plan = None
+            elif stmt is not None:
+                q = getattr(stmt, "query", None)
+                if q is not None:
+                    try:
+                        plan = plan_query(q)
+                    except Exception:   # noqa: BLE001 — bare DDL
+                        plan = None
+        if plan is not None:
+            for table in scan_tables_deep(plan):
+                self.check_can_select(user, table)
 
 
 #: the process-wide plugin manager (reference: the PluginManager

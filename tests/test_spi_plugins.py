@@ -121,6 +121,55 @@ def test_access_control_denies_table(loaded):
     assert eng.execute_sql("select count(*) from widgets") == [(8,)]
 
 
+def test_access_control_covers_delete(loaded):
+    """DELETE must not bypass the table checks: a user denied SELECT on
+    a table could otherwise probe it (the deleted-row count leaks
+    predicate matches) and destroy rows. Reference:
+    SystemAccessControl.checkCanDeleteFromTable."""
+    mgr, _ = loaded
+    conn = mgr.create_catalog("w", "sample-memory", {"n": 8})
+    eng = LocalEngine(conn)
+    eng.user = "mallory"
+    with pytest.raises(AccessDeniedError, match="mallory"):
+        eng.execute_sql("delete from widgets where id > 3")
+    with pytest.raises(AccessDeniedError, match="mallory"):
+        eng.execute_sql("delete from widgets")
+    assert conn.table("widgets").num_rows == 8   # nothing was destroyed
+    # a subquery inside the DELETE predicate is checked too
+    conn.create("other", [("id", BIGINT)])
+    conn.append_rows("other", [(1,)])
+
+    class _DenyOther(SystemAccessControl):
+        def check_can_select_from_table(self, user, table):
+            if table == "other" and user == "eve":
+                raise AccessDeniedError(f"user {user!r} denied {table}")
+
+    mgr.access_controls.append(_DenyOther())
+    eng.user = "eve"
+    with pytest.raises(AccessDeniedError, match="eve"):
+        eng.execute_sql(
+            "delete from widgets where id in (select id from other)")
+    # allowed user: the delete goes through and reports the count
+    eng.user = "alice"
+    assert eng.execute_sql("delete from widgets where id > 3") == [(4,)]
+    assert conn.table("widgets").num_rows == 4
+
+
+def test_access_control_delete_denied_on_cluster(loaded):
+    from presto_tpu.server.cluster import TpuCluster
+
+    mgr, _ = loaded
+    conn = mgr.create_catalog("w", "sample-memory", {"n": 4})
+    cluster = TpuCluster(conn, n_workers=1,
+                         session_properties={"user": "mallory"})
+    try:
+        with pytest.raises(AccessDeniedError, match="mallory"):
+            cluster.execute_sql("delete from widgets where id = 1")
+        assert conn.table("widgets").num_rows == 4
+    finally:
+        cluster.stop()
+
+
 def test_access_control_enforced_on_cluster(loaded):
     """The network-exposed entry point (TpuCluster under the statement
     server / DBAPI) enforces the same security SPI."""
