@@ -88,6 +88,10 @@ class MemoryConnector(SplitSource):
                 # nested values stored as python objects host-side;
                 # page() builds offset-encoded NestedColumns
                 arrays[c] = np.zeros(0, object)
+            elif t.is_decimal and t.uses_int128:
+                # python-int unscaled values (exact 38-digit range);
+                # page() builds Decimal128Column limb lanes
+                arrays[c] = np.zeros(0, object)
             elif t.is_string:
                 arrays[c] = np.zeros(0, np.int32)
                 dicts[c] = StringDict([])
@@ -185,7 +189,16 @@ class MemoryConnector(SplitSource):
                 new_dicts[c] = union
             else:
                 filled = [0 if v is None else v for v in vals]
-                if typ.is_decimal:
+                if typ.is_decimal and typ.uses_int128:
+                    # DECIMAL(p>18): python-int unscaled values in an
+                    # object array — exact for the full 38-digit range
+                    # (int64 storage capped exactness at 2^63; the page
+                    # builds four 32-bit limb lanes from these)
+                    from presto_tpu.data.column import unscale_decimal
+                    arr = np.empty(n_new, object)
+                    arr[:] = [int(unscale_decimal(v, typ.scale))
+                              for v in filled]
+                elif typ.is_decimal:
                     # exact unscale, one shared rounding rule
                     from presto_tpu.data.column import unscale_decimal
                     arr = np.asarray(

@@ -220,6 +220,13 @@ class HostTable:
                     from presto_tpu.data.column import NestedColumn
                     col = NestedColumn.from_pylist(
                         list(self.arrays[c][:self.num_rows]), t, cap)
+                elif getattr(t, "uses_int128", False):
+                    # DECIMAL(p>18) at rest: python-int unscaled values
+                    # -> four 32-bit limb lanes (exact 38-digit range)
+                    from presto_tpu.data.column import Decimal128Column
+                    col = Decimal128Column.from_unscaled_ints(
+                        list(self.arrays[c][:self.num_rows]), t,
+                        nulls=self.null_mask(c), capacity=cap)
                 else:
                     col = Column.from_numpy(
                         self.arrays[c][:self.num_rows], t,

@@ -37,11 +37,19 @@ import numpy as np
 from presto_tpu.types import Type, DecimalType, VARCHAR
 
 
+#: explicit wide context for every engine-side Decimal op: python's
+#: DEFAULT context is per-THREAD with prec=28, so scaleb on a 38-digit
+#: value silently rounds when it happens to run on a worker thread (the
+#: round-5 distributed-DECIMAL truncation bug). 80 digits covers
+#: DECIMAL(38) sums with huge counts.
+DEC_CTX = _decimal.Context(prec=80)
+
+
 def scale_down_decimal(unscaled: int, scale: int) -> _decimal.Decimal:
     """Unscaled int -> exact python Decimal at `scale`. THE conversion
     for every decimal read path (never a float64 image; the reference
     client protocol carries decimals as exact strings)."""
-    return _decimal.Decimal(unscaled).scaleb(-scale)
+    return DEC_CTX.scaleb(_decimal.Decimal(unscaled), -scale)
 
 
 def unscale_decimal(v, scale: int) -> int:
@@ -52,7 +60,7 @@ def unscale_decimal(v, scale: int) -> int:
     binary-scaled round()."""
     if not isinstance(v, _decimal.Decimal):
         v = _decimal.Decimal(str(v))
-    return int(v.scaleb(scale).to_integral_value(
+    return int(DEC_CTX.scaleb(v, scale).to_integral_value(
         rounding=_decimal.ROUND_HALF_UP))
 
 
@@ -196,47 +204,93 @@ class Column:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Decimal128Column:
-    """DECIMAL(p>18) aggregate results: exact value = (hi << 32) + lo,
-    recombined with python big ints on the host. `hi` accumulates the
-    signed high limbs (x >> 32) and `lo` the unsigned low limbs
-    (x & 0xFFFFFFFF) — both plain int64 segment sums, so a 6e8-row SF100
-    sum that would overflow a scaled int64 stays exact (reference:
+    """DECIMAL(p>18) values — table storage, partial states AND final
+    aggregates — as FOUR 32-bit limb lanes in int64 arrays:
+
+        exact value = (l3 << 96) + (l2 << 64) + (l1 << 32) + l0
+
+    with l3 signed (carries the sign via arithmetic-shift decomposition)
+    and l2/l1/l0 unsigned 32-bit limbs. Reference:
     presto-common/.../type/UnscaledDecimal128Arithmetic.java, re-expressed
-    as limb lanes because the TPU X64 pass lowers no 128-bit ops).
-    With `count` set the logical value is the AVERAGE: exact_sum / count
-    rounded HALF_UP to the type's scale (Presto avg(decimal))."""
-    hi: jnp.ndarray              # [capacity] int64 (signed high limbs)
-    lo: jnp.ndarray              # [capacity] int64 (unsigned low limbs)
+    as limb LANES because the TPU X64 pass lowers no 128-bit ops. The
+    four-lane form covers the full +-(10^38-1) < 2^127 range at rest
+    (round 4's two-lane hi/lo capped exactness at 2^95 — the 'input
+    storage int64-bounded' gap), and each int64 lane can accumulate 2^31
+    row-limbs carry-free, so SUM partials are plain per-lane segment
+    sums; carries are resolved host-side with python big ints at
+    value_at. With `count` set the logical value is the AVERAGE:
+    exact_sum / count rounded HALF_UP at the type's scale."""
+    l3: jnp.ndarray              # [capacity] int64 (signed top limbs)
+    l2: jnp.ndarray              # [capacity] int64 (unsigned 32-bit limbs)
+    l1: jnp.ndarray              # [capacity] int64
+    l0: jnp.ndarray              # [capacity] int64
     nulls: jnp.ndarray           # [capacity] bool
     type: Type                   # aux: DecimalType(p>18, s)
     count: Optional[jnp.ndarray] = None   # avg denominator
 
     def tree_flatten(self):
+        lanes = (self.l3, self.l2, self.l1, self.l0, self.nulls)
         if self.count is None:
-            return (self.hi, self.lo, self.nulls), (self.type, False)
-        return ((self.hi, self.lo, self.nulls, self.count),
-                (self.type, True))
+            return lanes, (self.type, False)
+        return lanes + (self.count,), (self.type, True)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         t, has_count = aux
         if has_count:
-            hi, lo, nulls, count = leaves
-            return cls(hi, lo, nulls, t, count)
-        hi, lo, nulls = leaves
-        return cls(hi, lo, nulls, t, None)
+            l3, l2, l1, l0, nulls, count = leaves
+            return cls(l3, l2, l1, l0, nulls, t, count)
+        l3, l2, l1, l0, nulls = leaves
+        return cls(l3, l2, l1, l0, nulls, t, None)
 
     @property
     def capacity(self) -> int:
-        return self.hi.shape[0]
+        return self.l3.shape[0]
 
     @property
     def dictionary(self):
         return None
 
+    @property
+    def value_lanes(self):
+        return (self.l3, self.l2, self.l1, self.l0)
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def decompose_int64(v: jnp.ndarray):
+        """Device-side limb decomposition of int64 unscaled values (the
+        DECIMAL(<=18) storage feeding a 128-bit accumulator); delegates
+        to the one shared definition in data/int128.py."""
+        from presto_tpu.data import int128
+        return int128.from_int64(v)
+
+    @staticmethod
+    def from_unscaled_ints(ints, type: Type, nulls=None,
+                           capacity: Optional[int] = None,
+                           ) -> "Decimal128Column":
+        """Host build from python-int unscaled values (exact for the
+        full 38-digit range)."""
+        n = len(ints)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        lanes = [np.zeros(cap, np.int64) for _ in range(4)]
+        nl = np.ones(cap, dtype=bool)
+        for i, v in enumerate(ints):
+            if v is None or (nulls is not None and nulls[i]):
+                continue
+            nl[i] = False
+            v = int(v)
+            lanes[0][i] = v >> 96
+            lanes[1][i] = (v >> 64) & 0xFFFFFFFF
+            lanes[2][i] = (v >> 32) & 0xFFFFFFFF
+            lanes[3][i] = v & 0xFFFFFFFF
+        return Decimal128Column(
+            jnp.asarray(lanes[0]), jnp.asarray(lanes[1]),
+            jnp.asarray(lanes[2]), jnp.asarray(lanes[3]),
+            jnp.asarray(nl), type)
+
     # -- generic row-lane protocol (compact/sort payload) -----------------
     def row_lanes(self):
-        lanes = [self.hi, self.lo, self.nulls]
+        lanes = [self.l3, self.l2, self.l1, self.l0, self.nulls]
         if self.count is not None:
             lanes.append(self.count)
         return lanes
@@ -244,22 +298,36 @@ class Decimal128Column:
     def from_lanes(self, lanes):
         if self.count is not None:
             return Decimal128Column(lanes[0], lanes[1], lanes[2],
-                                    self.type, lanes[3])
-        return Decimal128Column(lanes[0], lanes[1], lanes[2], self.type)
+                                    lanes[3], lanes[4], self.type,
+                                    lanes[5])
+        return Decimal128Column(lanes[0], lanes[1], lanes[2], lanes[3],
+                                lanes[4], self.type)
+
+    @staticmethod
+    def mask_lanes(lanes, valid):
+        """Zero value/count lanes and null out rows where ~valid; lane
+        order matches row_lanes() (nulls at index 4)."""
+        out = list(lanes)
+        for j in (0, 1, 2, 3):
+            out[j] = jnp.where(valid, out[j], 0)
+        out[4] = jnp.where(valid, out[4], True)
+        if len(out) > 5:
+            out[5] = jnp.where(valid, out[5], 0)
+        return out
 
     def gather(self, idx: jnp.ndarray, valid=None) -> "Decimal128Column":
         lanes = [jnp.take(x, idx, mode="clip") for x in self.row_lanes()]
         if valid is not None:
-            lanes[0] = jnp.where(valid, lanes[0], 0)
-            lanes[1] = jnp.where(valid, lanes[1], 0)
-            lanes[2] = jnp.where(valid, lanes[2], True)
+            lanes = Decimal128Column.mask_lanes(lanes, valid)
         return self.from_lanes(lanes)
 
     def to_numpy(self, num_rows: Optional[int] = None):
         """(approximate float values, nulls) — ordering/debug only; exact
         values come from value_at."""
-        v = (np.asarray(self.hi, dtype=np.float64) * float(1 << 32)
-             + np.asarray(self.lo, dtype=np.float64))
+        v = (np.asarray(self.l3, dtype=np.float64) * float(2 ** 96)
+             + np.asarray(self.l2, dtype=np.float64) * float(2 ** 64)
+             + np.asarray(self.l1, dtype=np.float64) * float(2 ** 32)
+             + np.asarray(self.l0, dtype=np.float64))
         n = np.asarray(self.nulls)
         if num_rows is not None:
             v, n = v[:num_rows], n[:num_rows]
@@ -267,22 +335,28 @@ class Decimal128Column:
 
     def _host(self):
         """One host transfer per lane, memoized (value_at is called per
-        row by to_pylist / wire encode loops)."""
+        row by to_pylist / wire encode loops). Returns
+        (lanes_tuple, nulls, count|None)."""
         cached = getattr(self, "_host_cache", None)
         if cached is None:
-            cached = (np.asarray(self.hi), np.asarray(self.lo),
+            cached = (tuple(np.asarray(x) for x in self.value_lanes),
                       np.asarray(self.nulls),
                       None if self.count is None
                       else np.asarray(self.count))
             object.__setattr__(self, "_host_cache", cached)
         return cached
 
+    def unscaled_at(self, i: int) -> int:
+        lanes, _nulls, _count = self._host()
+        return ((int(lanes[0][i]) << 96) + (int(lanes[1][i]) << 64)
+                + (int(lanes[2][i]) << 32) + int(lanes[3][i]))
+
     def value_at(self, i: int):
         """Exact python value of row i (scaled down per the type)."""
-        hi, lo, nulls, count = self._host()
+        _lanes, nulls, count = self._host()
         if bool(nulls[i]):
             return None
-        unscaled = (int(hi[i]) << 32) + int(lo[i])
+        unscaled = self.unscaled_at(i)
         scale = self.type.scale
         if self.count is not None:
             n = int(count[i])
@@ -298,8 +372,7 @@ class Decimal128Column:
             unscaled = sign * q
         if scale == 0:
             return unscaled
-        from decimal import Decimal
-        return Decimal(unscaled).scaleb(-scale)   # exact, not float
+        return scale_down_decimal(unscaled, scale)   # exact, not float
 
 
 @jax.tree_util.register_pytree_node_class
@@ -644,7 +717,7 @@ def select_page_host(page: Page, idx: np.ndarray) -> Page:
             lanes = []
             for li, lane in enumerate(c.row_lanes()):
                 a = np.asarray(lane)[idx]
-                fill = True if li == 2 else 0
+                fill = True if li == 4 else 0   # row_lanes: l3..l0, nulls
                 lanes.append(jnp.asarray(
                     np.pad(a, (0, pad), constant_values=fill)))
             cols.append(c.from_lanes(lanes))

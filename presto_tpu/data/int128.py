@@ -1,0 +1,212 @@
+"""TPU-native 128-bit limb arithmetic for DECIMAL(p>18).
+
+Reference role: presto-common/.../type/UnscaledDecimal128Arithmetic.java
+(add/subtract/multiply/compare over int128), re-expressed over FOUR
+32-bit limb LANES held in int64 arrays, because the TPU X64 pass lowers
+no 128-bit scalar ops. A value is
+
+    v = (l3 << 96) + (l2 << 64) + (l1 << 32) + l0
+
+where the lanes are *redundant* accumulators: any int64 per lane is a
+valid representation (carries resolve on normalize or host-side
+recombination). That redundancy is what makes add/subtract/negate pure
+lane-wise vector ops — no carry chains inside the XLA program.
+
+Multiplication is 32-bit schoolbook on sign-magnitude normalized limbs;
+64-bit partial products are split with the two's-complement mask trick
+(`p & M` / `(p >> 32) & M` recover the unsigned halves even when the
+int64 product wrapped).
+"""
+
+import jax.numpy as jnp
+
+_M = 0xFFFFFFFF
+
+
+def normalize(lanes):
+    """Carry-normalize arbitrary int64 lanes to (t3, n2, n1, n0) with
+    n* in [0, 2^32) and t3 signed. Lexicographic order of the result ==
+    numeric order. Works for negative lanes too: `x & M` is x mod 2^32
+    and `x >> 32` is floor(x / 2^32) in two's complement."""
+    m = jnp.int64(_M)
+    l3, l2, l1, l0 = lanes
+    n0 = l0 & m
+    t1 = l1 + (l0 >> 32)
+    n1 = t1 & m
+    t2 = l2 + (t1 >> 32)
+    n2 = t2 & m
+    t3 = l3 + (t2 >> 32)
+    return (t3, n2, n1, n0)
+
+
+def add(a, b):
+    return tuple(x + y for x, y in zip(a, b))
+
+
+def negate(a):
+    return tuple(-x for x in a)
+
+
+def sub(a, b):
+    return tuple(x - y for x, y in zip(a, b))
+
+
+def is_negative(a):
+    return normalize(a)[0] < 0
+
+
+def _magnitude(a):
+    """(sign_is_negative, normalized magnitude limbs m3..m0)."""
+    t3, n2, n1, n0 = normalize(a)
+    neg = t3 < 0
+    limbs = [jnp.where(neg, -x, x) for x in (t3, n2, n1, n0)]
+    m3, m2, m1, m0 = normalize(limbs)
+    return neg, (m3, m2, m1, m0)
+
+
+def _split(p):
+    """Unsigned halves of a 64-bit product that may have wrapped int64."""
+    m = jnp.int64(_M)
+    return (p >> 32) & m, p & m
+
+
+def mul(a, b):
+    """Exact product of two 128-bit lane values; returns
+    (result_lanes, overflow_flag_per_row). overflow = true 256-bit
+    product does not fit 128 bits (Presto: DECIMAL overflow)."""
+    neg_a, am = _magnitude(a)
+    neg_b, bm = _magnitude(b)
+    a3, a2, a1, a0 = am
+    b3, b2, b1, b0 = bm
+
+    def P(x, y):
+        return _split(x * y)
+
+    h00, l00 = P(a0, b0)
+    h01, l01 = P(a0, b1)
+    h10, l10 = P(a1, b0)
+    h11, l11 = P(a1, b1)
+    h02, l02 = P(a0, b2)
+    h20, l20 = P(a2, b0)
+    h03, l03 = P(a0, b3)
+    h30, l30 = P(a3, b0)
+    h12, l12 = P(a1, b2)
+    h21, l21 = P(a2, b1)
+
+    r0 = l00
+    r1 = h00 + l01 + l10
+    r2 = h01 + h10 + l11 + l02 + l20
+    r3 = h11 + h02 + h20 + l03 + l30 + l12 + l21
+
+    # any product contributing at or above bit 128 must be zero
+    zero = jnp.int64(0)
+    high = (h03 | h30 | h12 | h21
+            | (a1 * b3) | (a3 * b1) | (a2 * b2)
+            | (a2 * b3) | (a3 * b2) | (a3 * b3))
+    overflow = high != zero
+    # the magnitude must stay below 2^127 (representation bound; Presto
+    # additionally caps at 10^38-1 — checked at the result's rescale)
+    t3 = normalize((r3, r2, r1, r0))[0]
+    overflow = overflow | (t3 >= jnp.int64(1) << 31)
+
+    neg = neg_a != neg_b
+    out = tuple(jnp.where(neg, -x, x) for x in (r3, r2, r1, r0))
+    return out, overflow
+
+
+def mul_pow10(a, k: int):
+    """a * 10**k for a small non-negative python exponent (decimal
+    upscale). Returns (lanes, overflow)."""
+    if k == 0:
+        return a, jnp.zeros(a[0].shape, dtype=bool)
+    f = 10 ** k
+    shaped = [jnp.full_like(a[0], (f >> s) & _M)
+              for s in (96, 64, 32, 0)]
+    return mul(a, tuple(shaped))
+
+
+def _div_small(mag, d: int):
+    """Long division of normalized non-negative magnitude lanes by a
+    scalar d <= 10^9: classic limb-by-limb schoolbook. Each step's
+    dividend r*2^32 + limb stays under 2^62 because r < d < 2^30, so
+    int64 arithmetic is exact; quotient lanes come out denormalized
+    (any int64 per lane is a valid representation)."""
+    dd = jnp.int64(d)
+    r = jnp.zeros_like(mag[0])
+    out = []
+    for limb in mag:
+        cur = (r << 32) | limb
+        q = cur // dd
+        r = cur - q * dd
+        out.append(q)
+    return tuple(out), r
+
+
+def div_pow10(a, k: int):
+    """a // 10**k with HALF_UP rounding (decimal downscale; reference:
+    UnscaledDecimal128Arithmetic.rescale truncating path). Works on the
+    sign-magnitude form; divisors beyond 10^9 apply in <=10^9 chunks
+    (floor division composes: (v // d1) // d2 == v // (d1*d2))."""
+    if k == 0:
+        return a
+    neg, mag = _magnitude(a)
+    q = mag
+    left = k
+    while left > 0:
+        step = min(left, 9)
+        q, _r = _div_small(normalize(q), 10 ** step)
+        left -= step
+    # remainder for rounding: r = |a| - q * 10^k (multiply-back, exact)
+    back, _ovf = mul_pow10(q, k)
+    rem = sub(mag, back)
+    twice = add(rem, rem)
+    d_lanes = from_python_int(10 ** k, a[0].shape)
+    lt, eq = compare(d_lanes, twice)          # 10^k <?=? 2r
+    round_up = lt | eq                        # HALF_UP: 2r >= 10^k
+    one = tuple(jnp.where(round_up, jnp.int64(x), jnp.int64(0))
+                for x in (0, 0, 0, 1))
+    q = add(q, one)
+    return tuple(jnp.where(neg, -x, x) for x in q)
+
+
+DEC38_MAX = 10 ** 38 - 1
+
+
+def exceeds_decimal38(lanes):
+    """Per-row |value| > 10^38-1 — Presto's DECIMAL(38) range bound
+    (Decimals.MAX_UNSCALED_DECIMAL). Exact for any value whose lanes
+    have not wrapped (lane-wise add/sub of in-range inputs never
+    wraps)."""
+    _neg, mag = _magnitude(lanes)
+    lim = from_python_int(DEC38_MAX, lanes[0].shape)
+    lt, _eq = compare(lim, mag)          # lim < |v|
+    return lt
+
+
+def compare(a, b):
+    """(lt, eq) element-wise over the exact values."""
+    ta = normalize(a)
+    tb = normalize(b)
+    lt = jnp.zeros(a[0].shape, dtype=bool)
+    eq = jnp.ones(a[0].shape, dtype=bool)
+    for x, y in zip(ta, tb):
+        lt = lt | (eq & (x < y))
+        eq = eq & (x == y)
+    return lt, eq
+
+
+def from_int64(v):
+    """Sign-extending limb decomposition of int64 values."""
+    v = v.astype(jnp.int64)
+    m = jnp.int64(_M)
+    sign = v >> 63
+    return (sign, sign & m, (v >> 32) & m, v & m)
+
+
+def from_python_int(v: int, shape):
+    """Broadcast a python int (full 128-bit range) to constant lanes —
+    python's arbitrary-precision >> and & give two's-complement limbs
+    directly (top limb signed, lower limbs in [0, 2^32))."""
+    v = int(v)
+    vals = (v >> 96, (v >> 64) & _M, (v >> 32) & _M, v & _M)
+    return tuple(jnp.full(shape, x, dtype=jnp.int64) for x in vals)

@@ -121,6 +121,12 @@ class Executor:
         # lower time). None = unlimited.
         self.memory_limit_bytes = self.session["query_max_memory_per_node"]
         self.last_memory_estimate = 0
+        # Optional MemoryPool (exec/memory.py): static footprints
+        # reserve against it at lower time (admission control BEFORE
+        # execution — the TPU analog of MemoryPool.java's runtime
+        # accounting); the engine frees per query.
+        self.memory_pool = None
+        self.pool_query_id: str = ""
         # EXPLAIN ANALYZE support (collect_stats session property):
         # per-node output row counts from the last execution.
         self.last_node_rows: Dict[int, int] = {}
@@ -347,10 +353,20 @@ class Executor:
         caps: Dict = self._learned.setdefault(plan, None)
         if caps is None:
             caps = self._learned[plan] = self._load_caps(plan)
+        pool_prev = 0                 # this plan's live reservation
         for _attempt in range(8):
             # _lower is cheap (no tracing) and fills `caps` with its chosen
             # capacities, which completes the compilation cache key.
             fn, scans, watch = self._lower(plan, caps)
+            if self.memory_pool is not None:
+                # admission control: swap the PREVIOUS attempt's
+                # reservation for this one (capacity-grow retries must
+                # not double-count); islands of one query accumulate —
+                # their pages stay device-resident
+                self.memory_pool.free(self.pool_query_id, pool_prev)
+                self.memory_pool.reserve(self.pool_query_id,
+                                         self.last_memory_estimate)
+                pool_prev = self.last_memory_estimate
             key = (plan, tuple(sorted(caps.items(), key=repr)),
                    bool(self.session["collect_stats"]))
             entry = self._compiled.get(key)
@@ -373,8 +389,10 @@ class Executor:
                     caps[nid] = bucket_capacity(need)
                     grew = True
             if not grew:
+                from presto_tpu.expr import errors as _E
+                _E.raise_for_mask(int(needed[len(watch)]))
                 if stats_box:
-                    stats = needed[len(watch):]
+                    stats = needed[len(watch) + 1:]
                     self.last_node_rows = {
                         nid: int(r) for nid, r in zip(stats_box, stats)}
                 self._save_caps(plan, caps)
@@ -934,21 +952,22 @@ class Executor:
                                       self.memory_limit_bytes)
 
         def run(pages):
+            from presto_tpu.expr import errors as E
             _needed.clear()
             run_cache.clear()
             _node_rows.clear()
-            out = root(pages)
-            # Stats ride behind the overflow counters in the same stacked
-            # array (one host transfer); their node-id order is fixed at
+            with E.collecting() as coll:
+                out = root(pages)
+                err = coll.combined()
+            # The checked-arithmetic error lane rides right after the
+            # capacity counters, then stats, in one stacked array (a
+            # single host transfer); the stats node-id order is fixed at
             # trace time.
             self._stats_ids = [nid for nid, _ in _node_rows]
             extras = [r for _nid, r in _node_rows]
-            all_counters = list(_needed) + extras
-            if all_counters:
-                counters = jnp.stack(
-                    [jnp.asarray(n, jnp.int64) for n in all_counters])
-            else:
-                counters = jnp.zeros((0,), jnp.int64)
+            all_counters = list(_needed) + [err] + extras
+            counters = jnp.stack(
+                [jnp.asarray(n, jnp.int64) for n in all_counters])
             return out, counters
 
         return run, scans, watch

@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.data.column import Column, Page, bucket_capacity
@@ -193,37 +194,74 @@ class _HostPartial:
     names: tuple
 
 
+def _dec128_host(c, n: int):
+    """Exact host image of a Decimal128Column's limb lanes (the float
+    image to_numpy produces loses exactness past 2^53 — the round-4
+    `_HostPartial` hole). Marker tuple:
+    ("dec128", (l3, l2, l1, l0), count|None)."""
+    lanes, nl, cnt = c._host()
+    return (("dec128", tuple(np.array(x[:n]) for x in lanes),
+             None if cnt is None else np.array(cnt[:n])),
+            np.array(nl[:n]), c.type, None)
+
+
 def _spill_to_host(p: Page) -> _HostPartial:
+    from presto_tpu.data.column import Decimal128Column
     n = int(p.num_rows)
     cols = []
     for c in p.columns:
+        if isinstance(c, Decimal128Column):
+            cols.append(_dec128_host(c, n))
+            continue
         v, nl = c.to_numpy(n)
         cols.append((np.array(v), np.array(nl), c.type, c.dictionary))
     return _HostPartial(cols, n, p.names)
 
 
 def _part_cols(p, spiller=None):
+    from presto_tpu.data.column import Decimal128Column
     from presto_tpu.exec.spill import SpillHandle
     if isinstance(p, SpillHandle):
         p = spiller.read(p)            # disk -> device page
     if isinstance(p, _HostPartial):
         return p.columns
     n = int(p.num_rows)
-    return [(np.asarray(c.values)[:n], np.asarray(c.nulls)[:n], c.type,
-             c.dictionary) for c in p.columns]
+    return [(_dec128_host(c, n) if isinstance(c, Decimal128Column)
+             else (np.asarray(c.values)[:n], np.asarray(c.nulls)[:n],
+                   c.type, c.dictionary)) for c in p.columns]
 
 
 def _concat_pages(pages: List, spiller=None) -> Page:
     """Host-side concatenation of the valid rows of several partials
     (device Pages, host-RAM _HostPartials, or disk SpillHandles) with
-    identical schemas."""
+    identical schemas. Decimal128 limb lanes concatenate exactly."""
+    from presto_tpu.data.column import Decimal128Column
     parts = [_part_cols(p, spiller) for p in pages]
     total = sum(int(p.num_rows) for p in pages)
     cap = bucket_capacity(max(total, 1))
     cols = []
-    for i, (_v0, _n0, t0, d0) in enumerate(parts[0]):
-        vals = np.concatenate([pc[i][0] for pc in parts])
+    for i, (v0, _n0, t0, d0) in enumerate(parts[0]):
         nulls = np.concatenate([pc[i][1] for pc in parts])
+        if isinstance(v0, tuple) and v0 and v0[0] == "dec128":
+            def lane(j):
+                a = np.concatenate([pc[i][0][1][j] for pc in parts])
+                out = np.zeros(cap, dtype=np.int64)
+                out[:total] = a
+                return jnp.asarray(out)
+            cnts = [pc[i][0][2] for pc in parts]
+            count = None
+            if cnts[0] is not None:
+                ca = np.concatenate(cnts)
+                cout = np.zeros(cap, dtype=np.int64)
+                cout[:total] = ca
+                count = jnp.asarray(cout)
+            nl = np.ones(cap, dtype=bool)
+            nl[:total] = nulls
+            cols.append(Decimal128Column(
+                lane(0), lane(1), lane(2), lane(3),
+                jnp.asarray(nl), t0, count))
+            continue
+        vals = np.concatenate([pc[i][0] for pc in parts])
         cols.append(Column.from_numpy(vals, t0, nulls=nulls,
                                       dictionary=d0, capacity=cap))
     return Page.from_columns(cols, total, pages[0].names)
@@ -366,7 +404,8 @@ def execute_batched(connector, plan: PlanNode, num_batches: int,
 
 def execute_bounded(connector, plan: PlanNode,
                     memory_limit_bytes: int,
-                    max_batches: int = 64) -> Tuple[Page, int]:
+                    max_batches: int = 64,
+                    session=None) -> Tuple[Page, int]:
     """Execute under a hard memory limit, doubling the lifespan count
     until the static plan footprint fits. Returns (page, batches_used).
     Reference role: the memory-pool + grouped-execution pairing that lets
@@ -379,7 +418,8 @@ def execute_bounded(connector, plan: PlanNode,
     while True:
         try:
             return (execute_batched(connector, plan, batches,
-                                    memory_limit_bytes), batches)
+                                    memory_limit_bytes,
+                                    session=session), batches)
         except MemoryLimitExceeded:
             if not batchable or batches >= max_batches:
                 raise

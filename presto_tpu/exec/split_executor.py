@@ -74,12 +74,43 @@ class SplitExecutor(Executor):
                 cols.append(NestedColumn.from_pylist(
                     vals, t0.types[c], s.capacity))
                 continue
+            if t0.types[c].is_string and len(tables) > 1 and any(
+                    t.dicts.get(c) is not tables[0].dicts.get(c)
+                    for t in tables[1:]):
+                # splits with PER-SPLIT dictionaries (parquet row-group
+                # units decode their own dictionary pages): remap all
+                # code spaces into one union dictionary
+                from presto_tpu.data.column import merge_string_dicts
+                union, remaps = merge_string_dicts(
+                    [t.dicts.get(c) for t in tables])
+                parts = []
+                for t, remap in zip(tables, remaps):
+                    codes = np.asarray(t.arrays[c][:t.num_rows])
+                    parts.append(remap[codes] if len(remap) else codes)
+                arr = np.concatenate(parts)
+                masks = [t.null_mask(c) for t in tables]
+                nulls = (np.concatenate(
+                    [m if m is not None else np.zeros(t.num_rows, bool)
+                     for m, t in zip(masks, tables)])
+                    if any(m is not None for m in masks) else None)
+                cols.append(Column.from_numpy(
+                    arr, t0.types[c], nulls=nulls, dictionary=union,
+                    capacity=s.capacity))
+                continue
             arr = np.concatenate([t.arrays[c][:t.num_rows] for t in tables])
             masks = [t.null_mask(c) for t in tables]
             nulls = (np.concatenate(
                 [m if m is not None else np.zeros(t.num_rows, bool)
                  for m, t in zip(masks, tables)])
                 if any(m is not None for m in masks) else None)
+            if getattr(t0.types[c], "uses_int128", False):
+                # DECIMAL(p>18) at rest: python-int unscaled values ->
+                # limb lanes (see HostTable.page)
+                from presto_tpu.data.column import Decimal128Column
+                cols.append(Decimal128Column.from_unscaled_ints(
+                    list(arr), t0.types[c], nulls=nulls,
+                    capacity=s.capacity))
+                continue
             cols.append(Column.from_numpy(
                 arr, t0.types[c], nulls=nulls, dictionary=t0.dicts.get(c),
                 capacity=s.capacity))

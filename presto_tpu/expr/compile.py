@@ -133,47 +133,104 @@ def _like_regex(pattern: str, escape: Optional[str] = None) -> "re.Pattern":
     return _LIKE_CACHE[key]
 
 
-def _rescale_decimal(v: jnp.ndarray, from_scale: int, to_scale: int):
+def _valid_rows(page: Page, *cols) -> jnp.ndarray:
+    """Rows that participate in checked-arithmetic detection: inside
+    page.num_rows and non-NULL in every operand (padding slots carry
+    arbitrary values; NULL propagation beats overflow in Presto)."""
+    cap = cols[0].capacity
+    v = jnp.arange(cap) < page.num_rows
+    for c in cols:
+        v = v & ~c.nulls.astype(bool)
+    return v
+
+
+def _rescale_decimal(v: jnp.ndarray, from_scale: int, to_scale: int,
+                     valid=None):
     if to_scale == from_scale:
         return v
     if to_scale > from_scale:
-        return v * (10 ** (to_scale - from_scale))
+        out = v * (10 ** (to_scale - from_scale))
+        if valid is not None:
+            from presto_tpu.expr import errors as E
+            f = jnp.asarray(10 ** (to_scale - from_scale), v.dtype)
+            E.record(E.OVF_DECIMAL, jnp.any(
+                E.mul_overflows(v, f, out) & valid))
+        return out
     f = 10 ** (from_scale - to_scale)  # round half away from zero
     return jnp.where(v >= 0, (v + f // 2) // f, -((-v + f // 2) // f))
 
 
-def _cast(col: Column, to: Type) -> Column:
+def _cast(col: Column, to: Type, valid=None) -> Column:
+    """`valid`: rows participating in checked range/overflow detection
+    (user-facing CASTs pass it; internal coercions — widening promotions,
+    comparisons — leave it None and stay unchecked, matching the
+    reference where implicit coercions are always-safe widenings)."""
+    from presto_tpu.expr import errors as E
+
     frm = col.type
     if frm == to:
         return col
+    if _is_wide(col) or (isinstance(to, DecimalType) and to.uses_int128):
+        return _cast_wide(col, to, valid)
     if frm.name == "unknown":  # typed NULL literal
         sent = jnp.asarray(to.null_sentinel(), dtype=to.dtype)
         return Column(jnp.full(col.values.shape, sent, dtype=to.dtype),
                       jnp.ones_like(col.nulls), to,
                       StringDict([]) if to.is_string else None)
     v, n = col.values, col.nulls
+
+    def _check_int_range(vals, dt):
+        if valid is None or not jnp.issubdtype(vals.dtype, jnp.integer):
+            return
+        info = jnp.iinfo(dt)
+        if jnp.iinfo(vals.dtype).bits <= info.bits:
+            return
+        E.record(E.OVF_CAST, jnp.any(
+            ((vals < info.min) | (vals > info.max)) & valid))
+
     if isinstance(to, DecimalType):
         if isinstance(frm, DecimalType):
-            return Column(_rescale_decimal(v, frm.scale, to.scale), n, to)
+            return Column(
+                _rescale_decimal(v, frm.scale, to.scale, valid), n, to)
         if frm.is_integer:
-            return Column(v.astype(jnp.int64) * (10 ** to.scale), n, to)
+            out = v.astype(jnp.int64) * (10 ** to.scale)
+            if valid is not None and to.scale:
+                f = jnp.asarray(10 ** to.scale, jnp.int64)
+                E.record(E.OVF_DECIMAL, jnp.any(E.mul_overflows(
+                    v.astype(jnp.int64), f, out) & valid))
+            return Column(out, n, to)
         if frm.is_floating:
-            return Column(jnp.round(v * (10 ** to.scale)).astype(jnp.int64),
-                          n, to)
+            scaled = v * (10 ** to.scale)
+            if valid is not None:
+                E.record(E.OVF_DECIMAL, jnp.any(
+                    (jnp.abs(scaled) >= 2.0 ** 63) & valid))
+            return Column(jnp.round(scaled).astype(jnp.int64), n, to)
         raise NotImplementedError(f"cast {frm} -> {to}")
     if isinstance(frm, DecimalType):
         if to.is_floating:
             return Column((v / (10 ** frm.scale)).astype(to.dtype), n, to)
         if to.is_integer:
-            return Column(_rescale_decimal(v, frm.scale, 0).astype(to.dtype),
-                          n, to)
+            unscaled = _rescale_decimal(v, frm.scale, 0)
+            _check_int_range(unscaled, to.dtype)
+            return Column(unscaled.astype(to.dtype), n, to)
         raise NotImplementedError(f"cast {frm} -> {to}")
     if to.is_floating or to.is_integer:
         if frm.is_floating and to.is_integer:
-            return Column(jnp.round(v).astype(to.dtype), n, to)
+            r = jnp.round(v)
+            if valid is not None:
+                # check the ROUNDED value; 2^(bits-1) is exactly
+                # representable in float64, so use it as the exclusive
+                # upper bound (iinfo.max itself rounds up to 2^63 for
+                # bigint and would let exactly-2^63 slip through)
+                hi = 2.0 ** (jnp.iinfo(to.dtype).bits - 1)
+                E.record(E.OVF_CAST, jnp.any(
+                    ((r >= hi) | (r < -hi)) & valid))
+            return Column(r.astype(to.dtype), n, to)
         if frm.name == "boolean":
             return Column(v.astype(to.dtype), n, to)
         if frm.is_integer or frm.is_floating or frm.is_temporal:
+            if to.is_integer:
+                _check_int_range(v, to.dtype)
             return Column(v.astype(to.dtype), n, to)
     if to == DATE and frm.is_string:
         words = col.dictionary.words
@@ -187,6 +244,65 @@ def _cast(col: Column, to: Type) -> Column:
         return Column(v != 0, n, to)
     if to.is_string and frm.is_string:
         return Column(v, n, to, col.dictionary)
+    raise NotImplementedError(f"cast {frm} -> {to}")
+
+
+def _cast_wide(col, to: Type, valid=None):
+    """Casts touching the 128-bit limb representation."""
+    from presto_tpu.data import int128 as I
+    from presto_tpu.data.column import Decimal128Column
+
+    frm = col.type
+    if _is_wide(col):
+        if to.is_floating:
+            img = (col.l3.astype(jnp.float64) * float(2 ** 96)
+                   + col.l2.astype(jnp.float64) * float(2 ** 64)
+                   + col.l1.astype(jnp.float64) * float(2 ** 32)
+                   + col.l0.astype(jnp.float64))
+            return Column((img / (10 ** frm.scale)).astype(to.dtype),
+                          col.nulls, to)
+        if isinstance(to, DecimalType) and to.uses_int128:
+            lanes = _wide_lanes(col, to.scale, valid)
+            return Decimal128Column(*lanes, col.nulls, to)
+        if to.is_integer or isinstance(to, DecimalType):
+            # downscale to scale 0 (integers) or to.scale, then the
+            # value must FIT the narrow representation — range-checked
+            from presto_tpu.expr import errors as E
+            target_scale = to.scale if isinstance(to, DecimalType) else 0
+            lanes = _wide_lanes(col, target_scale, valid)
+            t3, n2, n1, n0 = I.normalize(lanes)
+            v64 = (n1 << 32) | n0          # low 64 bits, signed image
+            sign = v64 >> 63               # 0 or -1
+            fits = (t3 == sign) & (n2 == (sign & jnp.int64(0xFFFFFFFF)))
+            if valid is not None:
+                E.record(E.OVF_CAST, jnp.any(~fits & valid))
+            if to.is_integer and to.dtype != jnp.int64:
+                info = jnp.iinfo(to.dtype)
+                if valid is not None:
+                    E.record(E.OVF_CAST, jnp.any(
+                        ((v64 < info.min) | (v64 > info.max)) & valid))
+            return Column(v64.astype(to.dtype), col.nulls, to)
+        raise NotImplementedError(f"cast {frm} -> {to}")
+    if frm.name == "unknown":
+        z = jnp.zeros(col.capacity, jnp.int64)
+        return Decimal128Column(z, z, z, z,
+                                jnp.ones(col.capacity, bool), to)
+    if frm.is_floating:
+        # double -> DECIMAL(38): floats carry 53 significant bits, so a
+        # float-space limb decomposition is already exact wherever the
+        # input was
+        x = jnp.round(col.values.astype(jnp.float64) * (10 ** to.scale))
+        l3 = jnp.floor(x / 2.0 ** 96)
+        x = x - l3 * 2.0 ** 96
+        l2 = jnp.floor(x / 2.0 ** 64)
+        x = x - l2 * 2.0 ** 64
+        l1 = jnp.floor(x / 2.0 ** 32)
+        l0 = x - l1 * 2.0 ** 32
+        lanes = tuple(a.astype(jnp.int64) for a in (l3, l2, l1, l0))
+        return Decimal128Column(*lanes, col.nulls, to)
+    if frm.is_integer or isinstance(frm, DecimalType):
+        lanes = _wide_lanes(col, to.scale, valid)
+        return Decimal128Column(*lanes, col.nulls, to)
     raise NotImplementedError(f"cast {frm} -> {to}")
 
 
@@ -246,6 +362,15 @@ def _literal_column(e: Literal, cap: int) -> Column:
             return _const_column(None, t, cap, StringDict([]))
         d = StringDict([e.value])
         return _const_column(0, t, cap, d)
+    if isinstance(t, DecimalType) and t.uses_int128:
+        # literal decimal values are stored UNSCALED in the Literal
+        from presto_tpu.data import int128 as I
+        from presto_tpu.data.column import Decimal128Column
+        if e.value is None:
+            z = jnp.zeros(cap, jnp.int64)
+            return Decimal128Column(z, z, z, z, jnp.ones(cap, bool), t)
+        lanes = I.from_python_int(int(e.value), (cap,))
+        return Decimal128Column(*lanes, jnp.zeros(cap, bool), t)
     return _const_column(e.value, t, cap)
 
 
@@ -321,7 +446,45 @@ _CMP = {
 }
 
 
+def _is_wide(col) -> bool:
+    from presto_tpu.data.column import Decimal128Column
+    return isinstance(col, Decimal128Column)
+
+
+def _wide_lanes(col, scale_to: int, valid=None):
+    """Column -> 128-bit limb lanes at scale_to (reference:
+    UnscaledDecimal128Arithmetic.rescale). Narrow int64 decimals /
+    integers decompose device-side; upscaling multiplies by 10^d with
+    overflow recorded."""
+    from presto_tpu.data import int128 as I
+    from presto_tpu.expr import errors as E
+    if _is_wide(col):
+        lanes = col.value_lanes
+        frm = col.type.scale
+    else:
+        lanes = I.from_int64(col.values)
+        frm = col.type.scale if isinstance(col.type, DecimalType) else 0
+    d = scale_to - frm
+    if d > 0:
+        lanes, ovf = I.mul_pow10(lanes, d)
+        if valid is not None:
+            E.record(E.OVF_DECIMAL, jnp.any(ovf & valid))
+    elif d < 0:
+        lanes = I.div_pow10(lanes, -d)   # HALF_UP, exact
+    return lanes
+
+
 def _compare(op: str, x: Column, y: Column) -> Column:
+    if _is_wide(x) or _is_wide(y):
+        # exact 128-bit comparison at the common scale
+        from presto_tpu.data import int128 as I
+        xs = x.type.scale if isinstance(x.type, DecimalType) else 0
+        ys = y.type.scale if isinstance(y.type, DecimalType) else 0
+        s = max(xs, ys)
+        lt, eq = I.compare(_wide_lanes(x, s), _wide_lanes(y, s))
+        v = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+             "gt": ~(lt | eq), "ge": ~lt}[op]
+        return _bool(v, x.nulls | y.nulls)
     if x.type.is_string and y.type.is_string:
         x, y = align_string_columns(x, y)
         return _bool(_CMP[op](x.values, y.values), x.nulls | y.nulls)
@@ -334,9 +497,25 @@ def _compare(op: str, x: Column, y: Column) -> Column:
     return _bool(_CMP[op](x.values, y.values), x.nulls | y.nulls)
 
 
-def _arith(op: str, e: Call, x: Column, y: Column) -> Column:
+def _arith(op: str, e: Call, x: Column, y: Column, page: Page) -> Column:
+    """Checked arithmetic (reference: BigintOperators.java:73 — the
+    Math.addExact family): integer/decimal overflow on valid rows sets
+    the program's error lane (expr/errors.py) and the executor raises
+    NUMERIC_VALUE_OUT_OF_RANGE after the device round-trip."""
+    from presto_tpu.expr import errors as E
+
     rt = e.type
     nulls = x.nulls | y.nulls
+    valid = _valid_rows(page, x, y)
+    wide_in = _is_wide(x) or _is_wide(y)
+    if isinstance(rt, DecimalType) and (rt.uses_int128 or wide_in):
+        return _arith_wide(op, rt, x, y, nulls, valid)
+    if wide_in:
+        # non-decimal result (decimal division types as DOUBLE): wide
+        # operands go through their float image like any decimal/double
+        # mix
+        x = _cast_wide(x, DOUBLE) if _is_wide(x) else x
+        y = _cast_wide(y, DOUBLE) if _is_wide(y) else y
     if isinstance(rt, DecimalType):
         xs = x.type.scale if isinstance(x.type, DecimalType) else 0
         ys = y.type.scale if isinstance(y.type, DecimalType) else 0
@@ -344,28 +523,48 @@ def _arith(op: str, e: Call, x: Column, y: Column) -> Column:
         yv = y.values.astype(jnp.int64)
         if op == "multiply":
             v = xv * yv
-            return Column(_rescale_decimal(v, xs + ys, rt.scale), nulls, rt)
-        xv = _rescale_decimal(xv, xs, rt.scale)
-        yv = _rescale_decimal(yv, ys, rt.scale)
+            E.record(E.OVF_DECIMAL,
+                     jnp.any(E.mul_overflows(xv, yv, v) & valid))
+            return Column(
+                _rescale_decimal(v, xs + ys, rt.scale, valid), nulls, rt)
+        xv = _rescale_decimal(xv, xs, rt.scale, valid)
+        yv = _rescale_decimal(yv, ys, rt.scale, valid)
         if op == "add":
-            return Column(xv + yv, nulls, rt)
+            v = xv + yv
+            E.record(E.OVF_DECIMAL,
+                     jnp.any(E.add_overflows(xv, yv, v) & valid))
+            return Column(v, nulls, rt)
         if op == "subtract":
-            return Column(xv - yv, nulls, rt)
+            v = xv - yv
+            E.record(E.OVF_DECIMAL,
+                     jnp.any(E.sub_overflows(xv, yv, v) & valid))
+            return Column(v, nulls, rt)
         raise NotImplementedError(f"decimal {op}")
-    x = _cast(x, rt)
-    y = _cast(y, rt)
+    x = _cast(x, rt, valid)
+    y = _cast(y, rt, valid)
     xv, yv = x.values, y.values
+    checked = rt.is_integer
     if op == "add":
         v = xv + yv
+        if checked:
+            E.record(E.OVF_ADD, jnp.any(E.add_overflows(xv, yv, v) & valid))
     elif op == "subtract":
         v = xv - yv
+        if checked:
+            E.record(E.OVF_SUB, jnp.any(E.sub_overflows(xv, yv, v) & valid))
     elif op == "multiply":
         v = xv * yv
+        if checked:
+            E.record(E.OVF_MUL, jnp.any(E.mul_overflows(xv, yv, v) & valid))
     elif op == "divide":
         if rt.is_integer:
             zero = yv == 0
             v = jax.lax.div(xv, jnp.where(zero, 1, yv))
             nulls = nulls | zero
+            # the single non-representable quotient: MIN / -1
+            lo = jnp.asarray(jnp.iinfo(v.dtype).min, v.dtype)
+            E.record(E.OVF_DIV, jnp.any(
+                (xv == lo) & (yv == -1) & valid))
         else:
             zero = yv == 0
             v = xv / jnp.where(zero, 1, yv)
@@ -377,6 +576,43 @@ def _arith(op: str, e: Call, x: Column, y: Column) -> Column:
     else:
         raise NotImplementedError(op)
     return Column(v, nulls, rt)
+
+
+def _arith_wide(op: str, rt, x: Column, y: Column, nulls, valid) -> "Column":
+    """DECIMAL arithmetic on the 128-bit limb-lane representation
+    (reference: UnscaledDecimal128Arithmetic.java add/subtract/multiply).
+    Presto's decimal type rules make multiply's result scale exactly
+    xs + ys (no rescale after the product) and add/subtract's the max
+    input scale — so the only rescales here are upscales, which the
+    limb multiply handles exactly."""
+    from presto_tpu.data import int128 as I
+    from presto_tpu.data.column import Decimal128Column
+    from presto_tpu.expr import errors as E
+
+    if not isinstance(rt, DecimalType):
+        raise NotImplementedError(f"wide decimal {op} -> {rt}")
+    xs = x.type.scale if isinstance(x.type, DecimalType) else 0
+    ys = y.type.scale if isinstance(y.type, DecimalType) else 0
+    if op == "multiply":
+        if rt.scale != xs + ys:
+            raise NotImplementedError(
+                f"decimal multiply rescale {xs}+{ys}->{rt.scale}")
+        lanes, ovf = I.mul(_wide_lanes(x, xs, valid),
+                           _wide_lanes(y, ys, valid))
+        # representation wrap (>= 2^127) OR past the DECIMAL(38)
+        # value bound (Decimals.MAX_UNSCALED_DECIMAL = 10^38-1)
+        E.record(E.OVF_DECIMAL, jnp.any(
+            (ovf | I.exceeds_decimal38(lanes)) & valid))
+    elif op in ("add", "subtract"):
+        xl = _wide_lanes(x, rt.scale, valid)
+        yl = _wide_lanes(y, rt.scale, valid)
+        lanes = I.add(xl, yl) if op == "add" else I.sub(xl, yl)
+        E.record(E.OVF_DECIMAL,
+                 jnp.any(I.exceeds_decimal38(lanes) & valid))
+    else:
+        raise NotImplementedError(f"DECIMAL(38) {op} (128-bit division)")
+    lanes = tuple(jnp.where(nulls, 0, ln) for ln in lanes)
+    return Decimal128Column(*lanes, nulls, rt)
 
 
 def _dict_transform(col: Column, fn) -> Column:
@@ -492,7 +728,8 @@ def _json_scalar_path(doc: str, path: str):
 def _call(e: Call, page: Page, ev) -> Column:
     name = e.name
     if name in ("add", "subtract", "multiply", "divide", "modulus"):
-        return _arith(name, e, ev(e.args[0], page), ev(e.args[1], page))
+        return _arith(name, e, ev(e.args[0], page), ev(e.args[1], page),
+                      page)
     if name in _CMP:
         return _compare(name, ev(e.args[0], page), ev(e.args[1], page))
     if name == "not":
@@ -500,12 +737,34 @@ def _call(e: Call, page: Page, ev) -> Column:
         return _bool(~c.values.astype(bool), c.nulls)
     if name == "negate":
         c = ev(e.args[0], page)
+        if _is_wide(c):
+            from presto_tpu.data import int128 as I
+            from presto_tpu.data.column import Decimal128Column
+            return Decimal128Column(*I.negate(c.value_lanes), c.nulls,
+                                    c.type)
+        if c.type.is_integer:   # -MIN is not representable
+            from presto_tpu.expr import errors as E
+            lo = jnp.asarray(jnp.iinfo(c.values.dtype).min, c.values.dtype)
+            E.record(E.OVF_NEG, jnp.any(
+                (c.values == lo) & _valid_rows(page, c)))
         return Column(-c.values, c.nulls, c.type)
     if name == "abs":
         c = ev(e.args[0], page)
+        if _is_wide(c):
+            from presto_tpu.data import int128 as I
+            from presto_tpu.data.column import Decimal128Column
+            neg = I.is_negative(c.value_lanes)
+            lanes = tuple(jnp.where(neg, -x, x) for x in c.value_lanes)
+            return Decimal128Column(*lanes, c.nulls, c.type)
+        if c.type.is_integer:   # abs(MIN) is not representable
+            from presto_tpu.expr import errors as E
+            lo = jnp.asarray(jnp.iinfo(c.values.dtype).min, c.values.dtype)
+            E.record(E.OVF_ABS, jnp.any(
+                (c.values == lo) & _valid_rows(page, c)))
         return Column(jnp.abs(c.values), c.nulls, c.type)
     if name == "cast":
-        return _cast(ev(e.args[0], page), e.type)
+        c = ev(e.args[0], page)
+        return _cast(c, e.type, _valid_rows(page, c))
     if name in ("extract_year", "extract_month", "extract_day", "year",
                 "month", "day"):
         c = ev(e.args[0], page)

@@ -1,0 +1,152 @@
+"""Checked-arithmetic error channel.
+
+Presto raises NUMERIC_VALUE_OUT_OF_RANGE on integer overflow
+(reference: presto-main-base/.../type/BigintOperators.java:73 — the
+Math.addExact family — and IntegerOperators.java); silent two's-
+complement wrap is a wrong result. XLA kernels cannot raise mid-program,
+so the TPU-native design is an *error lane*: every checked operation
+computes a scalar "did any valid row overflow" flag at trace time, the
+collector ORs them into one int64 bitmask that rides the program's
+existing stacked counter output (one host transfer, no extra sync), and
+the executor raises after the device round-trip.
+
+Outside a traced program (eager/host paths, unit tests) `record`
+checks the concrete flag immediately.
+
+A row participates in the check only if it is *valid*: within
+page.num_rows and non-NULL in every operand — padding rows carry
+arbitrary values and NULL propagation wins over overflow in Presto
+(NULL + x IS NULL, never an error).
+"""
+
+import contextlib
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+# bit codes -> Presto-style messages (PrestoException NUMERIC_VALUE_OUT_OF_RANGE)
+OVF_ADD = 1
+OVF_SUB = 2
+OVF_MUL = 4
+OVF_DIV = 8
+OVF_NEG = 16
+OVF_ABS = 32
+OVF_SUM = 64
+OVF_CAST = 128
+OVF_DECIMAL = 256
+
+MESSAGES = {
+    OVF_ADD: "bigint addition overflow",
+    OVF_SUB: "bigint subtraction overflow",
+    OVF_MUL: "bigint multiplication overflow",
+    OVF_DIV: "bigint division overflow",
+    OVF_NEG: "bigint negation overflow",
+    OVF_ABS: "bigint abs overflow",
+    OVF_SUM: "bigint sum overflow",
+    OVF_CAST: "out of range for integer cast",
+    OVF_DECIMAL: "DECIMAL overflow",
+}
+
+INT64_MIN = -(2 ** 63)
+INT64_MAX = 2 ** 63 - 1
+
+
+class ArithmeticOverflowError(ArithmeticError):
+    """Maps to PrestoException(NUMERIC_VALUE_OUT_OF_RANGE)."""
+
+    error_code = "NUMERIC_VALUE_OUT_OF_RANGE"
+
+
+class _Collector:
+    def __init__(self):
+        self.flag: Optional[jnp.ndarray] = None
+
+    def record(self, code: int, any_flag) -> None:
+        t = jnp.where(any_flag, jnp.int64(code), jnp.int64(0))
+        self.flag = t if self.flag is None else (self.flag | t)
+
+    def combined(self) -> jnp.ndarray:
+        return (self.flag if self.flag is not None
+                else jnp.zeros((), jnp.int64))
+
+
+import threading
+
+_TLS = threading.local()
+
+
+def _stack() -> List[_Collector]:
+    """Per-thread collector stack: worker tasks trace programs
+    concurrently on different threads, and a flag tracer must land in
+    the collector of ITS OWN trace (a shared stack leaks tracers across
+    traces)."""
+    s = getattr(_TLS, "stack", None)
+    if s is None:
+        s = _TLS.stack = []
+    return s
+
+
+@contextlib.contextmanager
+def collecting():
+    """Install an error collector for the duration of a program trace."""
+    c = _Collector()
+    s = _stack()
+    s.append(c)
+    try:
+        yield c
+    finally:
+        s.pop()
+
+
+def record(code: int, any_flag) -> None:
+    """`any_flag`: scalar bool — a tracer inside jit (collected into the
+    program's error lane) or concrete in eager paths (checked now)."""
+    s = _stack()
+    if s:
+        s[-1].record(code, any_flag)
+        return
+    import jax
+    if isinstance(any_flag, jax.core.Tracer):
+        # traced without a collector (a caller jits ops directly, e.g.
+        # the mesh data-parallel aggregate): there is no error lane to
+        # ride and raising mid-trace is impossible — skip the check
+        # rather than crash the trace
+        return
+    import numpy as np
+    if bool(np.asarray(any_flag)):
+        raise_for_mask(code)
+
+
+def raise_for_mask(mask: int) -> None:
+    mask = int(mask)
+    if not mask:
+        return
+    for code, msg in MESSAGES.items():
+        if mask & code:
+            raise ArithmeticOverflowError(msg)
+    raise ArithmeticOverflowError(f"arithmetic error (mask={mask})")
+
+
+# ---- detection math (all on the already-wrapped two's-complement result)
+def add_overflows(x, y, s):
+    """s = x + y wrapped. Overflow iff operands share a sign the sum
+    lost: ((x ^ s) & (y ^ s)) < 0 (the Hacker's Delight identity
+    Math.addExact also uses)."""
+    return ((x ^ s) & (y ^ s)) < 0
+
+
+def sub_overflows(x, y, s):
+    """s = x - y wrapped."""
+    return ((x ^ y) & (x ^ s)) < 0
+
+
+def mul_overflows(x, y, s):
+    """s = x * y wrapped: recover y by division and compare; the one
+    non-recoverable case is MIN * -1 (at the result dtype's width)."""
+    import jax
+    lo = jnp.asarray(jnp.iinfo(s.dtype).min, s.dtype)
+    x = jnp.asarray(x, s.dtype)
+    y = jnp.asarray(y, s.dtype)
+    safe_x = jnp.where(x == 0, jnp.asarray(1, s.dtype), x)
+    bad_div = (x == -1) & (y == lo)
+    return (x != 0) & ((jax.lax.div(s, safe_x) != y) | bad_div)

@@ -202,10 +202,9 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
             pc = page.columns[a.field]
             live_m = [m & ~pc.nulls for m in masks]
             n_per = jnp.stack([jnp.sum(lv) for lv in live_m])
-            hi_b = jnp.stack([jnp.sum(jnp.where(lv, pc.hi, 0))
-                              for lv in live_m])
-            lo_b = jnp.stack([jnp.sum(jnp.where(lv, pc.lo, 0))
-                              for lv in live_m])
+            lane_b = [jnp.stack([jnp.sum(jnp.where(lv, lane, 0))
+                                 for lv in live_m])
+                      for lane in pc.value_lanes]
             count_b = None
             if a.kind == "avg128_merge":
                 cc = page.columns[a.field2]
@@ -227,7 +226,7 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
                 nl = jnp.concatenate(
                     [nl, jnp.ones((out_cap - width,), bool)])
             cols.append(Decimal128Column(
-                lane128(hi_b), lane128(lo_b), nl, a.output_type,
+                *[lane128(b) for b in lane_b], nl, a.output_type,
                 count=(lane128(count_b) if count_b is not None
                        else None)))
             continue
@@ -237,6 +236,13 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
                       else None)
         t = a.output_type
         kind = a.kind
+        if (a.field is not None
+                and not hasattr(page.columns[a.field], "values")
+                and kind not in ("sum128", "avg128", "count",
+                                 "min", "max")):
+            # vals is only the l0 limb for wide inputs — anything that
+            # would consume it as a value must reject, not mis-compute
+            raise NotImplementedError(f"{kind} over DECIMAL(38) input")
         live = [m & ~nulls for m in masks]
         n_per = jnp.stack([jnp.sum(lv) for lv in live])
         if kind == "count_star":
@@ -249,6 +255,17 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
             zero = jnp.asarray(0, dtype=acc)
             s = jnp.stack([jnp.sum(jnp.where(lv, vals, zero).astype(acc))
                            for lv in live])
+            if acc == jnp.int64:
+                # checked SUM (BigintOperators-style): an int64 total that
+                # wrapped is ~2^64 away from the float64 shadow sum, far
+                # beyond float rounding error (~n * 2^11 at n=10^7)
+                from presto_tpu.expr import errors as E
+                fs = jnp.stack([jnp.sum(
+                    jnp.where(lv, vals, zero).astype(jnp.float64))
+                    for lv in live])
+                code = E.OVF_DECIMAL if t.is_decimal else E.OVF_SUM
+                E.record(code, jnp.any(
+                    jnp.abs(fs - s.astype(jnp.float64)) > 2.0 ** 62))
             if kind == "avg_final":
                 c2 = page.columns[a.field2]
                 c2v = jnp.where(c2.nulls, 0, c2.values)
@@ -265,15 +282,17 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
                 cols.append(widen(s, DOUBLE, (n_per == 0)[take]))
                 cols.append(widen(n_per.astype(jnp.int64), BIGINT, false_w))
         elif kind in ("sum128", "avg128"):
-            # DECIMAL(38): signed-high/unsigned-low 32-bit limb sums per
-            # bin; exact recombination happens host-side
+            # DECIMAL(38): four 32-bit limb sums per bin (int64 inputs
+            # decompose device-side; wide inputs already carry lanes);
+            # exact recombination happens host-side
             # (Decimal128Column.value_at)
             from presto_tpu.data.column import Decimal128Column
-            masked = jnp.where(jnp.stack(live), vals, 0).astype(jnp.int64)
-            lo32 = masked & jnp.int64(0xFFFFFFFF)
-            hi32 = masked >> 32
-            lo_b = jnp.sum(lo32, axis=1)
-            hi_b = jnp.sum(hi32, axis=1)
+            pc = page.columns[a.field]
+            in_lanes = (pc.value_lanes if isinstance(pc, Decimal128Column)
+                        else Decimal128Column.decompose_int64(vals))
+            live_s = jnp.stack(live)
+            lane_b = [jnp.sum(jnp.where(live_s, x.astype(jnp.int64), 0),
+                              axis=1) for x in in_lanes]
             nulls_w = (n_per == 0)[take]
             is_null = nulls_w | ~out_valid_w
 
@@ -289,10 +308,46 @@ def _direct_grouped_aggregate(page: Page, group_fields: Sequence[int],
                 nl = jnp.concatenate(
                     [nl, jnp.ones((out_cap - width,), bool)])
             cols.append(Decimal128Column(
-                lane(hi_b), lane(lo_b), nl, t,
+                *[lane(b) for b in lane_b], nl, t,
                 count=(lane(n_per.astype(jnp.int64))
                        if kind == "avg128" else None)))
         elif kind in ("min", "max"):
+            pc = page.columns[a.field] if a.field is not None else None
+            if pc is not None and not hasattr(pc, "values"):
+                # DECIMAL(p>18): exact lexicographic min/max over the
+                # carry-normalized limb lanes — narrow the live mask
+                # lane by lane (most-significant first); 4 masked
+                # reductions, no 128-bit compare needed
+                from presto_tpu.data import int128 as I
+                from presto_tpu.data.column import Decimal128Column
+                norm = I.normalize(pc.value_lanes)
+                win_lanes = []
+                masks_nar = [lv for lv in live]
+                for li, lane_v in enumerate(norm):
+                    ident = (jnp.iinfo(jnp.int64).max if kind == "min"
+                             else jnp.iinfo(jnp.int64).min)
+                    red = jnp.min if kind == "min" else jnp.max
+                    w = jnp.stack([red(jnp.where(m, lane_v, ident))
+                                   for m in masks_nar])
+                    masks_nar = [m & (lane_v == w[bi])
+                                 for bi, m in enumerate(masks_nar)]
+                    win_lanes.append(w)
+                is_null = (n_per == 0)[take] | ~out_valid_w
+
+                def lane_mm(bins_arr, fill=0):
+                    v2 = jnp.where(is_null, fill, bins_arr[take])
+                    if width < out_cap:
+                        v2 = jnp.concatenate(
+                            [v2, jnp.full((out_cap - width,), fill,
+                                          dtype=v2.dtype)])
+                    return v2
+                nl2 = is_null
+                if width < out_cap:
+                    nl2 = jnp.concatenate(
+                        [nl2, jnp.ones((out_cap - width,), bool)])
+                cols.append(Decimal128Column(
+                    *[lane_mm(w) for w in win_lanes], nl2, t))
+                continue
             v = vals.astype(jnp.int32) if vals.dtype == jnp.bool_ else vals
             if jnp.issubdtype(v.dtype, jnp.floating):
                 ident = jnp.inf if kind == "min" else -jnp.inf
@@ -371,7 +426,10 @@ def _agg_inputs(a: AggSpec, page: Page):
     """(values, null-or-masked-out) for an aggregate input, unpermuted."""
     if a.field is not None:
         col = page.columns[a.field]
-        vals = col.values
+        # Decimal128 inputs have limb lanes, not a single values lane;
+        # sum128/avg128 read the lanes themselves — hand them l0 so the
+        # null/mask plumbing stays uniform
+        vals = col.values if hasattr(col, "values") else col.l0
         nulls = col.nulls
     else:
         vals = jnp.zeros((page.capacity,), dtype=jnp.int64)
@@ -504,8 +562,8 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
         pc = sp.columns[a.field]
         assert isinstance(pc, Decimal128Column), type(pc)
         live = ~pc.nulls & gvalid
-        hi = pscan.segment_sums(jnp.where(live, pc.hi, 0), starts, ends)
-        lo = pscan.segment_sums(jnp.where(live, pc.lo, 0), starts, ends)
+        lanes = [pscan.segment_sums(jnp.where(live, x, 0), starts, ends)
+                 for x in pc.value_lanes]
         n = pscan.segment_sums(live.astype(jnp.int64), starts, ends)
         count = None
         if a.kind == "avg128_merge":
@@ -515,11 +573,16 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
                                        ends)
         is_null = (n == 0) | ~out_valid
         return [Decimal128Column(
-            jnp.where(is_null, 0, hi), jnp.where(is_null, 0, lo),
+            *[jnp.where(is_null, 0, x) for x in lanes],
             is_null, t, count=count)]
     if a.field is not None:
         col = sp.columns[a.field]
-        vals = col.values
+        if not hasattr(col, "values") \
+                and a.kind not in ("sum128", "avg128", "count",
+                                   "min", "max"):
+            raise NotImplementedError(
+                f"{a.kind} over DECIMAL(38) input")
+        vals = col.values if hasattr(col, "values") else col.l0
         nulls = col.nulls | ~gvalid
     else:
         vals = jnp.zeros((sp.capacity,), dtype=jnp.int64)
@@ -547,22 +610,24 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
     if kind == "count":
         return [out(seg_count(~nulls), jnp.zeros_like(out_valid))]
     if kind in ("sum128", "avg128"):
-        # DECIMAL(38) accumulation: per-row scaled-int64 inputs split
-        # into signed-high / unsigned-low 32-bit limbs, segment-summed
-        # separately — each limb sum fits int64 for any realistic row
-        # count, and the exact 128-bit value recombines on the host
-        # (reference: UnscaledDecimal128Arithmetic.java; limb lanes
-        # because no 128-bit ops lower on TPU)
+        # DECIMAL(38) accumulation: inputs as four 32-bit limb lanes
+        # (int64 storage decomposes device-side; wide Decimal128 inputs
+        # already carry lanes), segment-summed separately — each limb
+        # sum fits int64 for any realistic row count, and the exact
+        # 128-bit value recombines on the host (reference:
+        # UnscaledDecimal128Arithmetic.java; limb lanes because no
+        # 128-bit ops lower on TPU)
         from presto_tpu.data.column import Decimal128Column
-        live = jnp.where(nulls, 0, vals).astype(jnp.int64)
-        lo32 = live & jnp.int64(0xFFFFFFFF)
-        hi32 = live >> 32                       # arithmetic shift
-        lo = pscan.segment_sums(lo32, starts, ends)
-        hi = pscan.segment_sums(hi32, starts, ends)
+        pc = sp.columns[a.field]
+        in_lanes = (pc.value_lanes if isinstance(pc, Decimal128Column)
+                    else Decimal128Column.decompose_int64(vals))
+        lanes = [pscan.segment_sums(
+            jnp.where(nulls, 0, x.astype(jnp.int64)), starts, ends)
+            for x in in_lanes]
         n = seg_count(~nulls)
         is_null = (n == 0) | ~out_valid
         col = Decimal128Column(
-            jnp.where(is_null, 0, hi), jnp.where(is_null, 0, lo),
+            *[jnp.where(is_null, 0, x) for x in lanes],
             is_null, t, count=(n if kind == "avg128" else None))
         return [col]
     if kind in ("sum", "avg", "avg_partial"):
@@ -571,6 +636,13 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
         contrib = jnp.where(nulls, 0, vals).astype(acc_dtype)
         s = pscan.segment_sums(contrib, starts, ends)
         n = seg_count(~nulls)
+        if acc_dtype == jnp.int64:
+            from presto_tpu.expr import errors as E
+            fs = pscan.segment_sums(contrib.astype(jnp.float64),
+                                    starts, ends)
+            E.record(E.OVF_DECIMAL if t.is_decimal else E.OVF_SUM,
+                     jnp.any(jnp.abs(fs - s.astype(jnp.float64))
+                             > 2.0 ** 62))
         if kind == "sum":
             return [out(s, n == 0)]
         if kind == "avg":
@@ -593,6 +665,27 @@ def _eval_agg_sorted(a: AggSpec, sp: Page, gvalid, gid, starts, ends,
 
         from presto_tpu.ops.keys import _orderable_values
 
+        pc_mm = sp.columns[a.field] if a.field is not None else None
+        if pc_mm is not None and not hasattr(pc_mm, "values"):
+            # DECIMAL(p>18): sort by (gid, null, normalized limb lanes)
+            # — lexicographic lane order IS exact 128-bit value order —
+            # and gather the winner's lanes at each segment start
+            from presto_tpu.data import int128 as I
+            from presto_tpu.data.column import Decimal128Column
+            norm = I.normalize(pc_mm.value_lanes)
+            if kind == "max":
+                norm = I.normalize(I.negate(norm))
+            s_ops = jax.lax.sort(
+                (gid, nulls.astype(jnp.int8)) + tuple(norm) + (nulls,),
+                num_keys=6, is_stable=False)
+            win = [jnp.take(x, starts, mode="clip") for x in s_ops[2:6]]
+            if kind == "max":
+                win = list(I.negate(tuple(win)))
+            win_nulls = jnp.take(s_ops[6], starts, mode="clip")
+            n = seg_count(~nulls)
+            is_null = win_nulls | (n == 0) | ~out_valid
+            win = [jnp.where(is_null, 0, w) for w in win]
+            return [Decimal128Column(*win, is_null, t)]
         v = _orderable_values(Column(vals, nulls, a.output_type if
                                      a.field is None else
                                      sp.columns[a.field].type, dictionary))

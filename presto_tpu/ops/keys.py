@@ -41,8 +41,10 @@ def _orderable_values(col: Column) -> jnp.ndarray:
     uses `_orderable_lanes` instead for exact 128-bit ordering."""
     from presto_tpu.data.column import Decimal128Column
     if isinstance(col, Decimal128Column):
-        img = (col.hi.astype(jnp.float64) * float(1 << 32)
-               + col.lo.astype(jnp.float64))
+        img = (col.l3.astype(jnp.float64) * float(2 ** 96)
+               + col.l2.astype(jnp.float64) * float(2 ** 64)
+               + col.l1.astype(jnp.float64) * float(2 ** 32)
+               + col.l0.astype(jnp.float64))
         if col.count is not None:
             img = img / jnp.maximum(col.count, 1).astype(jnp.float64)
         return img
@@ -54,16 +56,24 @@ def _orderable_values(col: Column) -> jnp.ndarray:
 
 def _orderable_lanes(col: Column):
     """Sort-key lanes, most-significant first; lexicographic comparison
-    of the lanes == SQL ascending order. Decimal128 SUMS sort exactly:
-    normalize the limb sums (lo accumulates unsigned 32-bit limbs, so
-    carry its overflow into hi), then (hi, lo) lexicographic IS value
-    order because lo lands in [0, 2^32). Averages (count set) keep the
-    float64 image of sum/count — a ratio has no per-row sort key that is
-    exact without division."""
+    of the lanes == SQL ascending order. Decimal128 values/SUMS sort
+    exactly: normalize carries up the four limb lanes (l2/l1/l0
+    accumulate unsigned 32-bit limbs, so each lane's overflow carries
+    into the next), then (l3, l2, l1, l0) lexicographic IS value order
+    because the lower lanes land in [0, 2^32) and l3 keeps the sign.
+    Averages (count set) keep the float64 image of sum/count — a ratio
+    has no per-row sort key that is exact without division."""
     from presto_tpu.data.column import Decimal128Column
     if isinstance(col, Decimal128Column) and col.count is None:
-        carry = col.lo >> jnp.int64(32)      # lo >= 0: limb sums
-        return [col.hi + carry, col.lo & jnp.int64(0xFFFFFFFF)]
+        m = jnp.int64(0xFFFFFFFF)
+        t0 = col.l0
+        n0 = t0 & m
+        t1 = col.l1 + (t0 >> 32)
+        n1 = t1 & m
+        t2 = col.l2 + (t1 >> 32)
+        n2 = t2 & m
+        t3 = col.l3 + (t2 >> 32)
+        return [t3, n2, n1, n0]
     return [_orderable_values(col)]
 
 

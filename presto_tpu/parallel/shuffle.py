@@ -151,10 +151,8 @@ def _compact_flat(flat_cols, live: jnp.ndarray, out_capacity: int,
     cols = []
     for lanes, c in flat_cols:
         if isinstance(c, Decimal128Column):
-            g = [lane[src] for lane in lanes]
-            g[0] = jnp.where(out_valid, g[0], 0)
-            g[1] = jnp.where(out_valid, g[1], 0)
-            g[2] = jnp.where(out_valid, g[2], True)
+            g = Decimal128Column.mask_lanes(
+                [lane[src] for lane in lanes], out_valid)
             cols.append(c.from_lanes(g))
             continue
         vals, nulls = lanes

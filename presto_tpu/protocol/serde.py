@@ -526,11 +526,17 @@ def page_to_wire_blocks(page) -> List[WireBlock]:
             lanes = np.zeros((n, 2), dtype=np.int64)
             nulls = np.asarray(c.nulls)[:n].copy()
             scale = c.type.scale
+            from presto_tpu.data.column import DEC_CTX
             for i in range(n):
                 if nulls[i]:
                     continue
-                v = c.value_at(i)
-                unscaled = int(v.scaleb(scale)) if scale else int(v)
+                if c.count is None:
+                    # pure-int path, no Decimal context involved at all
+                    unscaled = c.unscaled_at(i)
+                else:
+                    v = c.value_at(i)   # avg pre-divides host-side
+                    unscaled = (int(DEC_CTX.scaleb(v, scale)) if scale
+                                else int(v))
                 lanes[i, 0] = (unscaled & ((1 << 64) - 1)) - (
                     1 << 64 if unscaled & (1 << 63) else 0)
                 lanes[i, 1] = unscaled >> 64
@@ -575,24 +581,19 @@ def _wire_to_column(b: WireBlock, t, position_count: int, capacity: int):
                                constant_values=True)),
             children, t)
     if b.encoding == "INT128_ARRAY" and getattr(t, "uses_int128", False):
-        import jax.numpy as jnp2
         from presto_tpu.data.column import Decimal128Column
         n = position_count
         nulls = (b.nulls if b.nulls is not None
                  else np.zeros(n, dtype=bool))
-        hi = np.zeros(capacity, np.int64)
-        lo = np.zeros(capacity, np.int64)
-        nl = np.ones(capacity, bool)
+        ints = []
         for i in range(n):
-            nl[i] = bool(nulls[i])
-            if nl[i]:
+            if bool(nulls[i]):
+                ints.append(None)
                 continue
             low = int(b.values[i, 0]) & ((1 << 64) - 1)
-            unscaled = (int(b.values[i, 1]) << 64) | low
-            hi[i] = unscaled >> 32
-            lo[i] = unscaled & 0xFFFFFFFF
-        return Decimal128Column(jnp2.asarray(hi), jnp2.asarray(lo),
-                                jnp2.asarray(nl), t)
+            ints.append((int(b.values[i, 1]) << 64) | low)
+        return Decimal128Column.from_unscaled_ints(
+            ints, t, capacity=capacity)
     if t.is_string:
         words, codes, nulls = _block_to_strings(b, position_count)
         return Column.from_numpy(codes, t, nulls=nulls,

@@ -2131,6 +2131,13 @@ class Planner:
                 prec = max(len(str(abs(unscaled))), scale + 1)
                 return Literal(unscaled, DecimalType(prec, scale))
             v = int(e.text)
+            if not (-(2 ** 63) <= v < 2 ** 63):
+                # beyond BIGINT: a long-decimal literal (Presto parses
+                # such literals as DECIMAL, bounded at 38 digits)
+                if abs(v) > 10 ** 38 - 1:
+                    raise AnalysisError(
+                        f"literal out of DECIMAL(38) range: {e.text}")
+                return Literal(v, DecimalType(len(str(abs(v))), 0))
             return Literal(v, BIGINT)
         if isinstance(e, ast.DecimalLit):
             # always DECIMAL-typed, whatever the text shape ('10',
@@ -2266,11 +2273,23 @@ class Planner:
                 return DOUBLE
             da = a if isinstance(a, DecimalType) else DecimalType(18, 0)
             db = b if isinstance(b, DecimalType) else DecimalType(18, 0)
+            # Presto's decimal type combination (DecimalOperators /
+            # Decimals.java): multiply keeps scale s1+s2 at precision
+            # p1+p2 (capped 38 — runtime overflow checks catch what no
+            # longer fits); add/sub keep max scale with one carry digit.
             if op == "multiply":
-                return DecimalType(18, min(da.scale + db.scale, 10))
+                s = da.scale + db.scale
+                if s > 38:
+                    raise AnalysisError(
+                        f"DECIMAL scale {s} out of range in multiply")
+                return DecimalType(
+                    min(da.precision + db.precision, 38), s)
             if op == "divide":
                 return DOUBLE
-            return DecimalType(18, max(da.scale, db.scale))
+            s = max(da.scale, db.scale)
+            p = min(max(da.precision - da.scale,
+                        db.precision - db.scale) + s + 1, 38)
+            return DecimalType(p, s)
         if a == DATE and b == DATE and op == "subtract":
             return BIGINT
         t = common_super_type(a, b)

@@ -1,0 +1,137 @@
+"""Checked integer-overflow semantics (reference:
+presto-main-base/.../type/BigintOperators.java:73 — Math.addExact /
+subtractExact / multiplyExact raising NUMERIC_VALUE_OUT_OF_RANGE, and
+IntegerOperators.java for the 32-bit type): silent two's-complement wrap
+is a wrong result under the bit-identical acceptance bar.
+
+The engine computes overflow flags inside the compiled program (an error
+lane riding the counter output — expr/errors.py) and raises after the
+device round-trip; NULL rows and padding never trigger."""
+
+import pytest
+
+from presto_tpu.connectors import MemoryConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.expr.errors import ArithmeticOverflowError
+from presto_tpu.types import BIGINT, DOUBLE, INTEGER
+
+I64_MAX = 2 ** 63 - 1
+I64_MIN = -(2 ** 63)
+I32_MAX = 2 ** 31 - 1
+
+
+def _engine(rows, coltype=BIGINT, extra=None):
+    conn = MemoryConnector()
+    cols = [("x", coltype)] + (extra or [])
+    conn.create("t", cols)
+    conn.append_rows("t", rows)
+    return LocalEngine(conn)
+
+
+@pytest.mark.parametrize("expr,rows", [
+    ("x + 1", [(I64_MAX,)]),
+    ("x + x", [(I64_MAX // 2 + 1,)]),
+    ("x - 1", [(I64_MIN,)]),
+    ("x * 3", [(I64_MAX // 2,)]),
+    ("x * x", [(2 ** 32,)]),
+    ("-x", [(I64_MIN,)]),
+    ("abs(x)", [(I64_MIN,)]),
+    ("x / -1", [(I64_MIN,)]),
+])
+def test_bigint_overflow_raises(expr, rows):
+    eng = _engine(rows)
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql(f"select {expr} from t")
+
+
+@pytest.mark.parametrize("expr,rows,want", [
+    ("x + 1", [(I64_MAX - 1,)], I64_MAX),
+    ("x - 1", [(I64_MIN + 1,)], I64_MIN),
+    ("x * 2", [(I64_MAX // 2,)], (I64_MAX // 2) * 2),
+    ("-x", [(I64_MIN + 1,)], I64_MAX),
+    ("abs(x)", [(I64_MIN + 1,)], I64_MAX),
+])
+def test_bigint_boundary_values_pass(expr, rows, want):
+    eng = _engine(rows)
+    assert eng.execute_sql(f"select {expr} from t") == [(want,)]
+
+
+def test_null_rows_do_not_trigger():
+    # NULL + 1 IS NULL (never an overflow error), and a NULL slot's
+    # backing value must not leak into the check
+    eng = _engine([(None,), (5,)])
+    assert sorted(eng.execute_sql("select x + 1 from t"),
+                  key=lambda r: (r[0] is None, r[0])) == [(6,), (None,)]
+
+
+def test_filtered_rows_do_not_trigger():
+    # the overflowing row is removed by the pushed-down filter before
+    # the projection evaluates (Presto evaluates in plan order too)
+    eng = _engine([(I64_MAX,), (7,)])
+    assert eng.execute_sql("select x + 1 from t where x < 100") == [(8,)]
+
+
+def test_overflow_under_where_still_raises():
+    eng = _engine([(I64_MAX,), (7,)])
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select x + 1 from t where x > 100")
+
+
+def test_sum_overflow_raises_and_fitting_sum_passes():
+    eng = _engine([(I64_MAX,), (I64_MAX,)])
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select sum(x) from t")
+    # a total that fits is fine even with large terms
+    eng2 = _engine([(I64_MAX,), (-I64_MAX,), (41,)])
+    assert eng2.execute_sql("select sum(x) from t") == [(41,)]
+
+
+def test_grouped_sum_overflow_raises():
+    eng = _engine([(I64_MAX, "a"), (I64_MAX, "a"), (1, "b")],
+                  extra=[("g", __import__(
+                      "presto_tpu.types", fromlist=["VARCHAR"]).VARCHAR)])
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select g, sum(x) from t group by g")
+
+
+def test_cast_out_of_range_raises():
+    eng = _engine([(I32_MAX + 1,)])
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select cast(x as integer) from t")
+    eng2 = _engine([(I32_MAX,)])
+    assert eng2.execute_sql("select cast(x as integer) from t") \
+        == [(I32_MAX,)]
+
+
+def test_double_to_bigint_cast_out_of_range_raises():
+    eng = _engine([(1e19,)], coltype=DOUBLE)
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select cast(x as bigint) from t")
+
+
+def test_integer_arithmetic_stays_in_32_bits():
+    # INTEGER (int32) ops check at 32-bit width like IntegerOperators
+    # (x + 1 promotes to bigint here — this engine types bare integer
+    # literals as BIGINT — so the pure-int32 shape is x + x)
+    eng = _engine([(I32_MAX,)], coltype=INTEGER)
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select x + x from t")
+
+
+def test_decimal_rescale_overflow_raises():
+    # DECIMAL(18, s) upscale past the int64 representation must error,
+    # not wrap (reference: UnscaledDecimal128Arithmetic.rescale throws)
+    eng = _engine([(10 ** 17,)])
+    with pytest.raises(ArithmeticOverflowError):
+        eng.execute_sql("select cast(x as decimal(18, 4)) * "
+                        "cast(x as decimal(18, 4)) from t")
+
+
+def test_tpch_suite_unaffected_smoke():
+    # q1-style aggregation over sane values must not false-positive
+    from presto_tpu.connectors import TpchConnector
+    eng = LocalEngine(TpchConnector(0.01))
+    rows = eng.execute_sql(
+        "select sum(l_quantity), sum(l_extendedprice * (1 - l_discount)) "
+        "from lineitem where l_shipdate <= date '1998-09-02'")
+    assert rows and rows[0][0] > 0
