@@ -16,7 +16,8 @@ from presto_tpu.sql.parser import parse_sql
 
 
 class LocalEngine:
-    def __init__(self, connector, session=None, history=None):
+    def __init__(self, connector, session=None, history=None,
+                 memory_pool=None, cluster_memory=None):
         from presto_tpu.config import Session
 
         s = session or Session()
@@ -30,6 +31,12 @@ class LocalEngine:
         self.connector = connector
         self.planner = Planner(connector)
         self.executor = Executor(connector, session=s)
+        # memory-management hierarchy (exec/memory.py; reference:
+        # MemoryPool.java + ClusterMemoryManager.java:106): reservations
+        # per query, spill-before-fail revocation, cluster kill checks
+        self.memory_pool = memory_pool
+        self.cluster_memory = cluster_memory
+        self.executor.memory_pool = memory_pool
         self._plans = {}
         # HBO store (plan/stats.HistoryStore): observed node row counts
         # recorded after execution, consulted by the next planning
@@ -59,29 +66,56 @@ class LocalEngine:
         LocalEngine._qid += 1
         qid = f"local_{LocalEngine._qid}"
         with query_lifecycle(qid, sql) as box:
-            if _plugins.access_controls:
-                from presto_tpu.spi import AccessDeniedError
-                try:
-                    plan = self.plan_sql(sql)
-                except AccessDeniedError:
-                    raise
-                except Exception:   # noqa: BLE001 — DDL: check the
-                    plan = None     # inner SELECT's plan instead
-                if plan is None:
-                    from presto_tpu.sql.parser import parse_statement
-                    try:
-                        stmt = parse_statement(sql)
-                        q = getattr(stmt, "query", None)
-                        plan = (self.planner.plan_query(q)
-                                if q is not None else None)
-                    except Exception:   # noqa: BLE001 — bare DDL
-                        plan = None
-                if plan is not None:
-                    from presto_tpu.plan.nodes import scan_tables_deep
-                    for table in scan_tables_deep(plan):
-                        _plugins.check_can_select(user, table)
-            box[0] = self._execute_sql_inner(sql, qid)
+            _plugins.check_statement_access(
+                user, sql,
+                plan_full=lambda: self.plan_sql(sql),
+                plan_query=self.planner.plan_query)
+            if self.memory_pool is None:
+                box[0] = self._execute_sql_inner(sql, qid)
+            else:
+                box[0] = self._execute_under_pool(sql, qid)
         return box[0]
+
+    def _execute_under_pool(self, sql: str, qid: str) -> List[tuple]:
+        """Memory-governed execution (reference: MemoryPool admission +
+        MemoryRevokingScheduler spill-before-fail + ClusterMemoryManager
+        kill): reservations are static lowering footprints; an admission
+        failure retries lifespan-batched under the pool's remaining
+        headroom (partials leave HBM between lifespans — the revocation
+        behavior) before surfacing an error; a cluster-level kill beats
+        everything."""
+        from presto_tpu.exec.memory import ExceededMemoryLimitError
+        if self.cluster_memory is not None:
+            self.cluster_memory.check_killed(qid)
+        self.executor.pool_query_id = qid
+        try:
+            try:
+                out = self._execute_sql_inner(sql, qid)
+            except ExceededMemoryLimitError:
+                if self.cluster_memory is not None:
+                    self.cluster_memory.check_killed(qid)
+                from presto_tpu.exec.lifespan import execute_bounded
+                plan = self.plan_sql(sql)
+                # the aborted attempt's buffers are unwound — release
+                # its reservations BEFORE sizing the batched retry
+                self.memory_pool.free(qid)
+                headroom = max(self.memory_pool.budget
+                               - self.memory_pool.reserved, 1)
+                page, batches = execute_bounded(
+                    self.connector, plan, headroom,
+                    session=self.session)
+                self.last_memory_fallback_batches = batches
+                out = page.to_pylist()
+            if self.cluster_memory is not None:
+                # kill sweep runs while this query's reservations are
+                # still live; if WE are the biggest over-budget query,
+                # the kill lands on us (mid-flight LowMemoryKiller
+                # semantics in this sequential engine)
+                self.cluster_memory.maybe_kill()
+                self.cluster_memory.check_killed(qid)
+            return out
+        finally:
+            self.memory_pool.free(qid)
 
     _qid = 0
 

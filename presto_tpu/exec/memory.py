@@ -1,0 +1,173 @@
+"""Memory-management hierarchy: pools, revocation, cluster-level kill.
+
+Reference roles:
+- `MemoryPool.java` (presto-main-base/.../memory/): per-node pool with
+  per-query reservations and a hard budget;
+- `MemoryRevokingScheduler.java:60`: when pool usage crosses a
+  threshold, ask the largest revocable operators to SPILL before the
+  pool is exhausted;
+- `ClusterMemoryManager.java:106` (presto-main): cluster-wide view;
+  on pool exhaustion, kill the single biggest query
+  (`resource-overcommit` / LowMemoryKiller) with EXCEEDED_MEMORY_LIMIT.
+
+TPU-native shape: reservations are page/program byte estimates from the
+executor's static lowering (capacity x dtype — exact for padded device
+arrays, known BEFORE execution because shapes are static; the JVM has
+to sample at runtime, we can admission-check at compile time). The
+revocation hook drives the existing lifespan spill machinery
+(exec/lifespan.py `spill_path` partial revocation).
+"""
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class ExceededMemoryLimitError(RuntimeError):
+    """PrestoException(EXCEEDED_GLOBAL_MEMORY_LIMIT) analog."""
+
+    def __init__(self, query_id: str, reserved: int, budget: int,
+                 killed_by: str = "node"):
+        self.query_id = query_id
+        self.reserved = reserved
+        self.budget = budget
+        super().__init__(
+            f"Query {query_id} exceeded {killed_by} memory limit: "
+            f"reserved {reserved} bytes, budget {budget} bytes")
+
+
+class MemoryPool:
+    """Per-node pool: queries reserve/free bytes against one budget.
+
+    `revoke_hook(query_id, bytes_needed)` is consulted when a
+    reservation would cross `revoke_threshold` (fraction of budget):
+    it should spill revocable state and return the bytes it freed —
+    the MemoryRevokingScheduler contract."""
+
+    def __init__(self, budget_bytes: int,
+                 revoke_threshold: float = 0.8):
+        self.budget = int(budget_bytes)
+        self.revoke_threshold = revoke_threshold
+        self._lock = threading.Lock()
+        self._by_query: Dict[str, int] = {}
+        self._revoke_hooks: List[Callable[[str, int], int]] = []
+        self.revocations = 0            # observability counters
+        self.revoked_bytes = 0
+
+    @property
+    def reserved(self) -> int:
+        with self._lock:
+            return sum(self._by_query.values())
+
+    def query_reserved(self, query_id: str) -> int:
+        with self._lock:
+            return self._by_query.get(query_id, 0)
+
+    def add_revoke_hook(self, hook: Callable[[str, int], int]) -> None:
+        self._revoke_hooks.append(hook)
+
+    def reserve(self, query_id: str, nbytes: int) -> None:
+        """Reserve or raise ExceededMemoryLimitError for THIS query.
+        Crossing the revoke threshold first runs the revocation hooks
+        (largest-reservation queries first — spill-before-fail)."""
+        nbytes = int(nbytes)
+        with self._lock:
+            total = sum(self._by_query.values())
+        if total + nbytes > self.budget * self.revoke_threshold:
+            self._try_revoke(total + nbytes
+                             - int(self.budget * self.revoke_threshold))
+        with self._lock:
+            total = sum(self._by_query.values())
+            if total + nbytes > self.budget:
+                raise ExceededMemoryLimitError(
+                    query_id,
+                    self._by_query.get(query_id, 0) + nbytes,
+                    self.budget)
+            self._by_query[query_id] = \
+                self._by_query.get(query_id, 0) + nbytes
+
+    def _try_revoke(self, need: int) -> int:
+        freed = 0
+        # biggest reservations revoke first (MemoryRevokingScheduler's
+        # TaskRevocableMemoryComparator order)
+        with self._lock:
+            order = sorted(self._by_query, key=self._by_query.get,
+                           reverse=True)
+        for qid in order:
+            if freed >= need:
+                break
+            for hook in self._revoke_hooks:
+                got = int(hook(qid, need - freed) or 0)
+                if got > 0:
+                    freed += got
+                    self.revocations += 1
+                    self.revoked_bytes += got
+                    with self._lock:
+                        self._by_query[qid] = max(
+                            0, self._by_query.get(qid, 0) - got)
+        return freed
+
+    def free(self, query_id: str, nbytes: Optional[int] = None) -> None:
+        with self._lock:
+            if nbytes is None:
+                self._by_query.pop(query_id, None)
+            else:
+                cur = self._by_query.get(query_id, 0)
+                nxt = max(0, cur - int(nbytes))
+                if nxt:
+                    self._by_query[query_id] = nxt
+                else:
+                    self._by_query.pop(query_id, None)
+
+
+class ClusterMemoryManager:
+    """Coordinator-side view over every worker pool. On sustained
+    exhaustion, kills the single biggest query cluster-wide
+    (ClusterMemoryManager.java:106 + LowMemoryKiller)."""
+
+    def __init__(self, pools: List[MemoryPool],
+                 budget_bytes: Optional[int] = None):
+        """`budget_bytes` is the CLUSTER query-memory limit
+        (query_max_memory) — independent of the per-node pool budgets,
+        exactly like the reference's general-pool accounting; defaults
+        to the sum of node budgets."""
+        self.pools = pools
+        self._budget = budget_bytes
+        self.killed: Dict[str, ExceededMemoryLimitError] = {}
+
+    def cluster_reserved(self) -> int:
+        return sum(p.reserved for p in self.pools)
+
+    def cluster_budget(self) -> int:
+        if self._budget is not None:
+            return self._budget
+        return sum(p.budget for p in self.pools)
+
+    def biggest_query(self) -> Optional[str]:
+        totals: Dict[str, int] = {}
+        for p in self.pools:
+            with p._lock:
+                for qid, b in p._by_query.items():
+                    totals[qid] = totals.get(qid, 0) + b
+        if not totals:
+            return None
+        return max(totals, key=totals.get)
+
+    def maybe_kill(self) -> Optional[str]:
+        """If the cluster is over budget, mark the biggest query killed
+        and free its reservations everywhere. Returns the victim id."""
+        if self.cluster_reserved() <= self.cluster_budget():
+            return None
+        victim = self.biggest_query()
+        if victim is None:
+            return None
+        reserved = sum(p.query_reserved(victim) for p in self.pools)
+        self.killed[victim] = ExceededMemoryLimitError(
+            victim, reserved, self.cluster_budget(), killed_by="cluster")
+        for p in self.pools:
+            p.free(victim)
+        return victim
+
+    def check_killed(self, query_id: str) -> None:
+        err = self.killed.pop(query_id, None)
+        if err is not None:
+            raise err

@@ -1,0 +1,137 @@
+"""Memory pools, revocation, cluster kill (round-5 VERDICT #7).
+Reference: memory/MemoryPool.java, MemoryRevokingScheduler.java:60,
+ClusterMemoryManager.java:106."""
+
+import pytest
+
+from presto_tpu.exec.memory import (
+    ClusterMemoryManager, ExceededMemoryLimitError, MemoryPool,
+)
+
+
+def test_reserve_and_free():
+    p = MemoryPool(1000)
+    p.reserve("q1", 300)
+    p.reserve("q2", 200)
+    assert p.reserved == 500
+    assert p.query_reserved("q1") == 300
+    p.free("q1", 100)
+    assert p.query_reserved("q1") == 200
+    p.free("q1")
+    assert p.reserved == 200
+
+
+def test_over_budget_raises_presto_style():
+    p = MemoryPool(1000, revoke_threshold=1.0)
+    p.reserve("q1", 900)
+    with pytest.raises(ExceededMemoryLimitError,
+                       match="exceeded node memory limit"):
+        p.reserve("q2", 200)
+    # q1 unaffected, q2 not partially reserved
+    assert p.query_reserved("q1") == 900
+    assert p.query_reserved("q2") == 0
+
+
+def test_revocation_spills_before_failing():
+    """Crossing the revoke threshold triggers the spill hook on the
+    BIGGEST query first; the reservation then succeeds."""
+    p = MemoryPool(1000, revoke_threshold=0.8)
+    spilled = []
+
+    def hook(qid, need):
+        spilled.append((qid, need))
+        return 400          # "spilled 400 bytes to disk"
+
+    p.add_revoke_hook(hook)
+    p.reserve("big", 600)
+    p.reserve("small", 100)
+    # 600+100+200 = 900 > 800 threshold -> revoke, then fits
+    p.reserve("small", 200)
+    assert spilled and spilled[0][0] == "big"
+    assert p.revocations == 1 and p.revoked_bytes == 400
+    assert p.query_reserved("big") == 200     # 600 - 400 revoked
+    assert p.reserved == 500
+
+
+def test_revocation_insufficient_then_raises():
+    p = MemoryPool(1000, revoke_threshold=0.8)
+    p.add_revoke_hook(lambda qid, need: 0)    # nothing revocable
+    p.reserve("q1", 700)
+    with pytest.raises(ExceededMemoryLimitError):
+        p.reserve("q2", 400)
+
+
+def test_cluster_kills_biggest_query():
+    # node pools have headroom; the CLUSTER query-memory budget
+    # (query_max_memory analog) is the binding limit
+    w1 = MemoryPool(800, revoke_threshold=1.0)
+    w2 = MemoryPool(800, revoke_threshold=1.0)
+    mgr = ClusterMemoryManager([w1, w2], budget_bytes=1000)
+    w1.reserve("qa", 400)
+    w2.reserve("qa", 300)
+    w1.reserve("qb", 100)
+    w2.reserve("qb", 150)
+    # 950 <= 1000: nobody dies
+    assert mgr.maybe_kill() is None
+    w2.reserve("qb", 50)                       # 1000, still fine
+    assert mgr.maybe_kill() is None
+    # push over: qa (700) is the biggest -> victim
+    w1.reserve("qb", 80)
+    victim = mgr.maybe_kill()
+    assert victim == "qa"
+    assert w1.query_reserved("qa") == 0 and w2.query_reserved("qa") == 0
+    with pytest.raises(ExceededMemoryLimitError,
+                       match="cluster memory limit"):
+        mgr.check_killed("qa")
+    # killed entry consumed; other queries unaffected
+    mgr.check_killed("qa")
+    mgr.check_killed("qb")
+
+
+def test_engine_over_budget_query_spills_instead_of_oom():
+    """VERDICT r4 #7 'Done' test 1: a query whose static footprint
+    exceeds the pool budget completes lifespan-batched (partials leave
+    HBM between lifespans) instead of failing."""
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+
+    sql = ("select l_returnflag, count(*), sum(l_extendedprice) "
+           "from lineitem group by l_returnflag")
+    free = LocalEngine(TpchConnector(0.01))
+    want = sorted(free.execute_sql(sql))
+
+    pool = MemoryPool(2 * 1024 * 1024, revoke_threshold=1.0)  # 2 MB
+    eng = LocalEngine(TpchConnector(0.01), memory_pool=pool)
+    got = sorted(eng.execute_sql(sql))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1]
+        # batched partial sums order float addition differently
+        assert abs(g[2] - w[2]) <= 1e-9 * abs(w[2])
+    assert getattr(eng, "last_memory_fallback_batches", 0) >= 2
+    assert pool.reserved == 0        # freed at query end
+
+
+def test_engine_killed_query_raises_presto_style():
+    """VERDICT r4 #7 'Done' test 2: on cluster-pool exhaustion the
+    biggest query is killed with an EXCEEDED_MEMORY_LIMIT-style error
+    and later work under that query id refuses to run."""
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+
+    pool = MemoryPool(1 << 40, revoke_threshold=1.0)   # node: unbounded
+    mgr = ClusterMemoryManager([pool], budget_bytes=1000)
+    eng = LocalEngine(TpchConnector(0.01), memory_pool=pool,
+                      cluster_memory=mgr)
+    # a small competing query below the cluster budget
+    pool.reserve("small", 10)
+    # our query's static footprint (hundreds of KB) dwarfs it and blows
+    # the 1000-byte cluster budget: the kill sweep (run while our
+    # reservations are live) selects the biggest query — ours — and the
+    # query fails with the Presto-style error
+    with pytest.raises(ExceededMemoryLimitError,
+                       match="cluster memory limit"):
+        eng.execute_sql("select count(*) from region")
+    # the small query survives untouched; our reservations are gone
+    assert pool.query_reserved("small") == 10
+    assert pool.reserved == 10
