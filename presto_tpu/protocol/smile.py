@@ -1,0 +1,351 @@
+"""SMILE binary JSON codec for the internal task protocol.
+
+Reference role: Presto's internal communication can negotiate
+SMILE-encoded protocol bodies instead of JSON
+(presto-internal-communication/.../InternalCommunicationConfig.java:174
+`isBinaryTransportEnabled` -> Content-Type application/x-jackson-smile;
+the C++ worker's protocol layer does the same). This is a from-scratch
+implementation of the public SMILE format specification covering the
+JSON-compatible value model the protocol uses (objects, arrays,
+strings, integers, doubles, booleans, null).
+
+Encoder emits canonical frames without back-reference sharing (legal
+per the spec — sharing is an optional feature flagged in the header);
+the decoder ALSO handles shared property names and shared string
+values, which Jackson enables by default, so frames produced by a Java
+coordinator parse correctly.
+
+Format summary (SMILE spec v1):
+  header: ':' ')' '\\n' + flag byte (low nibble: 0x01 shared names,
+          0x02 shared values, 0x04 raw binary; high nibble: version 0)
+  value tokens: 0x21 null / 0x22 false / 0x23 true; 0xC0-0xDF zigzag
+          "small int" -16..15; 0x24/0x25 zigzag VInt (32/64-bit);
+          0x29 float64 as 10 big-endian 7-bit groups; 0x20 empty
+          string; 0x40-0x7F short ASCII; 0x80-0xBF short unicode;
+          0xE0/0xE4 long text terminated by 0xFC; 0x00-0x1F and 0xEC
+          shared-value refs; 0xF8/0xF9 array, 0xFA/0xFB object
+  key tokens: 0x20 empty name; 0x34 long name (0xFC-terminated);
+          0x40-0x7F short shared-name refs; 0x80-0xBF short ASCII
+          name (1-64 bytes); 0xC0-0xF7 short unicode name
+  VInts: big-endian 7-bit groups, the LAST byte has bit 0x80 set and
+          carries 6 bits.
+"""
+
+import struct
+from typing import Any, List
+
+HEADER = b":)\n"
+CONTENT_TYPE = "application/x-jackson-smile"
+
+
+# --------------------------------------------------------------- encoding
+def _zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v < 0 else v << 1
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _vint(out: bytearray, v: int) -> None:
+    """Unsigned VInt: big-endian 7-bit groups; final byte carries 6
+    bits and the 0x80 terminator."""
+    last = v & 0x3F
+    v >>= 6
+    groups = []
+    while v:
+        groups.append(v & 0x7F)
+        v >>= 7
+    out += bytes(reversed(groups))
+    out.append(0x80 | last)
+
+
+def _read_vint(data: bytes, pos: int):
+    v = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        if b & 0x80:
+            return (v << 6) | (b & 0x3F), pos
+        v = (v << 7) | b
+
+
+def _write_7bit_safe(out: bytearray, data: bytes) -> None:
+    """SMILE 7-bit-safe binary: vint(byte length), then the bit stream
+    in 7-bit groups MSB-first; the trailing 1-6 leftover bits land
+    right-aligned in the final byte (Jackson
+    _write7BitBinaryWithLength's tail rule)."""
+    _vint(out, len(data))
+    i = 0
+    while len(data) - i >= 7:
+        chunk = int.from_bytes(data[i:i + 7], "big")
+        for shift in range(49, -1, -7):
+            out.append((chunk >> shift) & 0x7F)
+        i += 7
+    rest = data[i:]
+    if rest:
+        value = int.from_bytes(rest, "big")
+        bits = len(rest) * 8
+        while bits > 6:
+            bits -= 7
+            out.append((value >> bits) & 0x7F)
+        out.append(value & ((1 << bits) - 1))
+
+
+def _read_7bit_safe(data: bytes, pos: int):
+    nbytes, pos = _read_vint(data, pos)
+    out = bytearray()
+    i = nbytes
+    while i >= 7:
+        chunk = 0
+        for _ in range(8):
+            chunk = (chunk << 7) | data[pos]
+            pos += 1
+        out += chunk.to_bytes(7, "big")
+        i -= 7
+    if i:
+        bits = i * 8
+        value = 0
+        while bits > 6:
+            bits -= 7
+            value = (value << 7) | data[pos]
+            pos += 1
+        value = (value << bits) | data[pos]
+        pos += 1
+        out += value.to_bytes(i, "big")
+    return bytes(out), pos
+
+
+class _Encoder:
+    def __init__(self):
+        self.out = bytearray()
+        self.out += HEADER
+        self.out.append(0x00)   # version 0, no shared names/values/raw
+
+    def value(self, v: Any) -> None:
+        out = self.out
+        if v is None:
+            out.append(0x21)
+        elif v is True:
+            out.append(0x23)
+        elif v is False:
+            out.append(0x22)
+        elif isinstance(v, int):
+            z = _zigzag(v)
+            if -16 <= v <= 15:
+                out.append(0xC0 + z)
+            elif -(2 ** 31) <= v < 2 ** 31:
+                out.append(0x24)
+                _vint(out, z)
+            elif -(2 ** 63) <= v < 2 ** 63:
+                out.append(0x25)
+                _vint(out, z)
+            else:
+                # BigInteger (0x26): 7-bit-safe binary of the minimal
+                # big-endian two's complement (Java BigInteger layout)
+                out.append(0x26)
+                nbytes = (v.bit_length() // 8) + 1
+                _write_7bit_safe(out, v.to_bytes(nbytes, "big",
+                                                 signed=True))
+        elif isinstance(v, float):
+            out.append(0x29)
+            (bits,) = struct.unpack(">Q", struct.pack(">d", v))
+            for shift in range(63, -1, -7):
+                out.append((bits >> shift) & 0x7F)
+        elif isinstance(v, str):
+            self._text(v)
+        elif isinstance(v, (list, tuple)):
+            out.append(0xF8)
+            for item in v:
+                self.value(item)
+            out.append(0xF9)
+        elif isinstance(v, dict):
+            out.append(0xFA)
+            for k, item in v.items():
+                self._key(str(k))
+                self.value(item)
+            out.append(0xFB)
+        else:
+            raise TypeError(f"not SMILE-encodable: {type(v)}")
+
+    def _text(self, s: str) -> None:
+        out = self.out
+        if s == "":
+            out.append(0x20)
+            return
+        enc = s.encode("utf-8")
+        is_ascii = len(enc) == len(s)
+        if is_ascii and 1 <= len(enc) <= 32:
+            out.append(0x40 + len(enc) - 1)
+            out += enc
+        elif is_ascii and 33 <= len(enc) <= 64:
+            out.append(0x60 + len(enc) - 33)
+            out += enc
+        elif not is_ascii and 2 <= len(enc) <= 33:
+            out.append(0x80 + len(enc) - 2)
+            out += enc
+        elif not is_ascii and 34 <= len(enc) <= 65:
+            out.append(0xA0 + len(enc) - 34)
+            out += enc
+        else:
+            out.append(0xE0 if is_ascii else 0xE4)
+            out += enc
+            out.append(0xFC)
+
+    def _key(self, k: str) -> None:
+        out = self.out
+        if k == "":
+            out.append(0x20)
+            return
+        enc = k.encode("utf-8")
+        is_ascii = len(enc) == len(k)
+        if is_ascii and 1 <= len(enc) <= 64:
+            out.append(0x80 + len(enc) - 1)
+            out += enc
+        elif not is_ascii and 2 <= len(enc) <= 57:
+            out.append(0xC0 + len(enc) - 2)
+            out += enc
+        else:
+            out.append(0x34)
+            out += enc
+            out.append(0xFC)
+
+
+def dumps(obj: Any) -> bytes:
+    e = _Encoder()
+    e.value(obj)
+    return bytes(e.out)
+
+
+# --------------------------------------------------------------- decoding
+class _Decoder:
+    def __init__(self, data: bytes):
+        if data[:3] != HEADER:
+            raise ValueError("not a SMILE frame (bad header)")
+        flags = data[3]
+        if flags >> 4:
+            raise ValueError(f"unsupported SMILE version {flags >> 4}")
+        self.shared_names_enabled = bool(flags & 0x01)
+        self.shared_values_enabled = bool(flags & 0x02)
+        self.data = data
+        self.pos = 4
+        self.shared_names: List[str] = []
+        self.shared_values: List[str] = []
+
+    def _byte(self) -> int:
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def value(self) -> Any:
+        t = self._byte()
+        if t == 0x21:
+            return None
+        if t == 0x22:
+            return False
+        if t == 0x23:
+            return True
+        if t == 0x20:
+            return ""
+        if 0x01 <= t <= 0x1F:          # short shared value ref
+            return self.shared_values[t - 1]
+        if 0xEC <= t <= 0xEF:          # long shared value ref (2 bytes)
+            idx = ((t & 0x03) << 8) | self._byte()
+            return self.shared_values[idx - 1]
+        if 0xC0 <= t <= 0xDF:          # small int
+            return _unzigzag(t - 0xC0)
+        if t == 0x24 or t == 0x25:     # 32/64-bit zigzag VInt
+            z, self.pos = _read_vint(self.data, self.pos)
+            return _unzigzag(z)
+        if t == 0x26:                  # BigInteger
+            raw, self.pos = _read_7bit_safe(self.data, self.pos)
+            return int.from_bytes(raw, "big", signed=True)
+        if t == 0x28:                  # float32: 5 x 7-bit groups
+            bits = 0
+            for _ in range(5):
+                bits = (bits << 7) | self._byte()
+            return struct.unpack(">f", struct.pack(">I",
+                                                   bits & 0xFFFFFFFF))[0]
+        if t == 0x29:                  # float64: 10 x 7-bit groups
+            bits = 0
+            for _ in range(10):
+                bits = (bits << 7) | self._byte()
+            return struct.unpack(">d", struct.pack(
+                ">Q", bits & ((1 << 64) - 1)))[0]
+        if 0x40 <= t <= 0x5F:
+            return self._utf(t - 0x40 + 1, share=True)
+        if 0x60 <= t <= 0x7F:
+            return self._utf(t - 0x60 + 33, share=True)
+        if 0x80 <= t <= 0x9F:
+            return self._utf(t - 0x80 + 2, share=True)
+        if 0xA0 <= t <= 0xBF:
+            return self._utf(t - 0xA0 + 34, share=True)
+        if t in (0xE0, 0xE4):          # long text, 0xFC-terminated
+            end = self.data.index(0xFC, self.pos)
+            s = self.data[self.pos:end].decode("utf-8")
+            self.pos = end + 1
+            return s
+        if t == 0xF8:
+            arr = []
+            while self.data[self.pos] != 0xF9:
+                arr.append(self.value())
+            self.pos += 1
+            return arr
+        if t == 0xFA:
+            obj = {}
+            while self.data[self.pos] != 0xFB:
+                k = self._read_key()
+                obj[k] = self.value()
+            self.pos += 1
+            return obj
+        raise ValueError(f"unsupported SMILE value token 0x{t:02X} "
+                         f"at {self.pos - 1}")
+
+    def _utf(self, n: int, share: bool) -> str:
+        s = self.data[self.pos:self.pos + n].decode("utf-8")
+        self.pos += n
+        if share and self.shared_values_enabled and len(
+                s.encode()) <= 64:
+            self.shared_values.append(s)
+            if len(self.shared_values) > 1024:
+                self.shared_values = self.shared_values[:0]
+        return s
+
+    def _read_key(self) -> str:
+        t = self._byte()
+        if t == 0x20:
+            return ""
+        if 0x30 <= t <= 0x33:          # long shared name ref
+            idx = ((t & 0x03) << 8) | self._byte()
+            return self.shared_names[idx]
+        if t == 0x34:                  # long name
+            end = self.data.index(0xFC, self.pos)
+            s = self.data[self.pos:end].decode("utf-8")
+            self.pos = end + 1
+            self._share_name(s)
+            return s
+        if 0x40 <= t <= 0x7F:          # short shared name ref
+            return self.shared_names[t - 0x40]
+        if 0x80 <= t <= 0xBF:          # short ASCII name
+            n = t - 0x80 + 1
+            s = self.data[self.pos:self.pos + n].decode("ascii")
+            self.pos += n
+            self._share_name(s)
+            return s
+        if 0xC0 <= t <= 0xF7:          # short unicode name
+            n = t - 0xC0 + 2
+            s = self.data[self.pos:self.pos + n].decode("utf-8")
+            self.pos += n
+            self._share_name(s)
+            return s
+        raise ValueError(f"unsupported SMILE key token 0x{t:02X}")
+
+    def _share_name(self, s: str) -> None:
+        if self.shared_names_enabled and len(s.encode()) <= 64:
+            if len(self.shared_names) >= 1024:
+                self.shared_names = []
+            self.shared_names.append(s)
+
+
+def loads(data: bytes) -> Any:
+    return _Decoder(data).value()

@@ -81,14 +81,36 @@ class _Handler(BaseHTTPRequestHandler):
         return self.server.task_manager
 
     def _json(self, code: int, obj, headers=None):
-        body = json.dumps(obj).encode()
+        # binary transport negotiation (reference:
+        # InternalCommunicationConfig.java:174 isBinaryTransportEnabled):
+        # a client that Accepts application/x-jackson-smile gets the
+        # same protocol document SMILE-encoded
+        from presto_tpu.protocol import smile
+        accept = self.headers.get("Accept", "") or ""
+        if smile.CONTENT_TYPE in accept:
+            body = smile.dumps(obj)
+            ctype = smile.CONTENT_TYPE
+        else:
+            body = json.dumps(obj).encode()
+            ctype = "application/json"
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _read_body_doc(self):
+        """Request body -> JSON-compatible document; SMILE bodies are
+        negotiated via Content-Type, JSON stays the default."""
+        from presto_tpu.protocol import smile
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        ctype = self.headers.get("Content-Type", "") or ""
+        if smile.CONTENT_TYPE in ctype:
+            return smile.loads(raw)
+        return json.loads(raw.decode())
 
     def _bytes(self, code: int, body: bytes, headers=None):
         self.send_response(code)
@@ -107,16 +129,14 @@ class _Handler(BaseHTTPRequestHandler):
             # /v1/task/{id}/batch (TaskResource.cpp:115-180): unwrap the
             # BatchTaskUpdateRequest envelope; shuffle descriptors are
             # accepted and ignored (no Spark shuffle backend)
-            n = int(self.headers.get("Content-Length", 0))
-            breq = S.BatchTaskUpdateRequest.loads(
-                self.rfile.read(n).decode())
+            breq = S.BatchTaskUpdateRequest.from_json(
+                self._read_body_doc())
             info = self.tm.create_or_update(m.group(1),
                                             breq.taskUpdateRequest)
             return self._json(200, S.TaskInfo.to_json(info))
         m = _TASK.match(path)
         if m:
-            n = int(self.headers.get("Content-Length", 0))
-            req = S.TaskUpdateRequest.loads(self.rfile.read(n).decode())
+            req = S.TaskUpdateRequest.from_json(self._read_body_doc())
             info = self.tm.create_or_update(m.group(1), req)
             return self._json(200, S.TaskInfo.to_json(info))
         self._json(404, {"error": f"no route {self.path}"})

@@ -1,0 +1,125 @@
+"""SMILE binary task-protocol encoding (round-5 VERDICT #9). Reference:
+InternalCommunicationConfig.java:174 binary transport — the captured
+Java coordinator fixtures must survive a JSON -> SMILE -> JSON round
+trip losslessly."""
+
+import json
+import math
+import os
+
+import pytest
+
+from presto_tpu.protocol import smile
+
+FIXDIR = ("/root/reference/presto-native-execution/presto_cpp/"
+          "presto_protocol/tests/data")
+
+
+@pytest.mark.parametrize("v", [
+    None, True, False, 0, 1, -1, 15, -16, 16, -17, 2 ** 31 - 1,
+    -(2 ** 31), 2 ** 62, -(2 ** 62), 0.0, 1.5, -2.75, 1e300, "",
+    "a", "hello", "x" * 32, "x" * 33, "x" * 64, "x" * 65, "x" * 500,
+    "üñïçødé", "ü" * 40, [], [1, 2, 3], {"a": 1},
+    {"k": [1, {"n": None}], "s": "v"},
+])
+def test_scalar_roundtrip(v):
+    assert smile.loads(smile.dumps(v)) == v
+
+
+def test_float_bits_exact():
+    for f in (0.1, math.pi, -1e-300, 3.4028234663852886e38):
+        out = smile.loads(smile.dumps(f))
+        assert out == f and isinstance(out, float)
+
+
+def test_header_and_tokens():
+    data = smile.dumps({"a": 1})
+    assert data[:3] == b":)\n" and data[3] == 0x00
+    assert data[4] == 0xFA and data[-1] == 0xFB
+
+
+def test_java_fixture_roundtrip():
+    """Every captured Java coordinator JSON fixture re-encodes to SMILE
+    and back without loss."""
+    if not os.path.isdir(FIXDIR):
+        pytest.skip("reference fixture dir not present")
+    n = 0
+    for name in sorted(os.listdir(FIXDIR)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(FIXDIR, name)) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue
+        enc = smile.dumps(doc)
+        assert smile.loads(enc) == doc, name
+        n += 1
+    assert n >= 5  # the conformance corpus is non-trivial
+
+
+def test_worker_negotiates_smile_transport():
+    """End-to-end binary transport: POST a real TaskUpdateRequest as
+    SMILE, long-poll TaskInfo back as SMILE, matching the JSON replies
+    byte-for-semantics (InternalCommunicationConfig binary mode)."""
+    import urllib.request
+
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.server import TpuWorkerServer
+    from tests.protocol_fixtures import q6_fragment, task_update_request
+
+    srv = TpuWorkerServer(TpchConnector(0.01)).start()
+    try:
+        tur = task_update_request(q6_fragment())
+        body = smile.dumps(json.loads(tur.dumps()))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/task/tsmile.0.0.0.0",
+            data=body, method="POST",
+            headers={"Content-Type": smile.CONTENT_TYPE,
+                     "Accept": smile.CONTENT_TYPE})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"] == smile.CONTENT_TYPE
+            info = smile.loads(resp.read())
+        assert info["taskId"] == "tsmile.0.0.0.0"
+        # same document via JSON for comparison
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/task/tsmile.0.0.0.0")
+        with urllib.request.urlopen(req2, timeout=60) as resp:
+            jinfo = json.loads(resp.read())
+        assert jinfo["taskId"] == info["taskId"]
+        assert jinfo["taskStatus"]["self"] == info["taskStatus"]["self"]
+    finally:
+        srv.stop()
+
+
+def test_decoder_handles_shared_names():
+    """Jackson writes shared property names by default: synthesize a
+    frame with the shared-names flag and back-references."""
+    frame = bytearray(b":)\n")
+    frame.append(0x01)            # shared names enabled
+    frame.append(0xFA)            # {
+    frame += bytes([0x80 + 2]) + b"abc"     # key "abc" (short ascii)
+    frame.append(0xC0 + 2)        # 1
+    frame.append(0x40)            # shared name ref #0 -> "abc" again
+    frame.append(0xC0 + 4)        # 2
+    frame.append(0xFB)            # }
+    out = smile.loads(bytes(frame))
+    assert out == {"abc": 2}      # later key wins, ref resolved
+
+
+def test_decoder_handles_shared_values():
+    frame = bytearray(b":)\n")
+    frame.append(0x02)            # shared string values enabled
+    frame.append(0xF8)            # [
+    frame += bytes([0x40 + 2]) + b"abc"     # "abc" (registers as #1)
+    frame.append(0x01)            # shared value ref -> "abc"
+    frame.append(0xF9)            # ]
+    assert smile.loads(bytes(frame)) == ["abc", "abc"]
+
+
+@pytest.mark.parametrize("v", [
+    2 ** 63, -(2 ** 63) - 1, 13300328506565083905, 10 ** 38,
+    -(10 ** 38), 2 ** 200, -(2 ** 200) + 7,
+])
+def test_biginteger_roundtrip(v):
+    assert smile.loads(smile.dumps(v)) == v
