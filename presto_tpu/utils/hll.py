@@ -1,0 +1,306 @@
+"""Airlift-layout HyperLogLog sketches (cross-engine approx_distinct).
+
+Reference role: com.facebook.airlift.stats.cardinality.{HyperLogLog,
+DenseHll, SparseHll} — the serialized form Presto ships between engines
+for approx_distinct partial states
+(presto-main-base/.../aggregation/ApproximateCountDistinctAggregation.java
+merges partials with HyperLogLog.deserialize/mergeWith). This module
+implements that wire layout from its public specification so partials
+can cross an engine boundary; estimation stays engine-local.
+
+Wire layout (all little-endian, airlift Slice convention):
+
+  DENSE_V2 (tag 3):
+      byte    tag = 3
+      byte    indexBitLength p            (buckets m = 2^p)
+      byte    baseline                    (min bucket value)
+      byte[m/2] deltas                    4-bit (value - baseline) per
+                                          bucket; bucket i lives in
+                                          deltas[i>>1], even i = low
+                                          nibble, odd i = high nibble
+      short   overflowEntries             count of buckets whose delta
+                                          exceeds 15
+      short[overflowEntries] overflowBucket indexes
+      byte[overflowEntries]  overflowValue  (delta - 15 excess)
+
+  SPARSE_V2 (tag 2):
+      byte    tag = 2
+      byte    indexBitLength p
+      short   numberOfEntries
+      int[numberOfEntries] entries        sorted; each entry packs the
+                                          top 26 bits of the 64-bit
+                                          hash and the bucket value in
+                                          the low 6 bits
+
+Hashing: Murmur3 x64 128's first word (airlift Murmur3Hash128.hash64,
+seed 0) over the value's 8-byte two's-complement (BIGINT) or UTF-8
+(VARCHAR) representation; bucket index = top p bits of the hash, bucket
+value = number of leading zeros of the remaining bits + 1 (capped so it
+fits 6 bits).
+"""
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+TAG_SPARSE_V2 = 2
+TAG_DENSE_V2 = 3
+MAX_DELTA = 15
+VALUE_BITS = 6
+_M64 = (1 << 64) - 1
+
+_C1 = 0x87C37B91114253D5
+_C2 = 0x4CF5AD432745937F
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _fmix64(x: int) -> int:
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & _M64
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & _M64
+    x ^= x >> 33
+    return x
+
+
+def murmur3_hash64_bytes(data: bytes, seed: int = 0) -> int:
+    """Murmur3 x64 128, first 64-bit word (Murmur3Hash128.hash64)."""
+    h1 = seed
+    h2 = seed
+    length = len(data)
+    n_blocks = length // 16
+    for i in range(n_blocks):
+        k1, k2 = struct.unpack_from("<qq", data, i * 16)
+        k1 &= _M64
+        k2 &= _M64
+        k1 = (k1 * _C1) & _M64
+        k1 = _rotl(k1, 31)
+        k1 = (k1 * _C2) & _M64
+        h1 ^= k1
+        h1 = _rotl(h1, 27)
+        h1 = (h1 + h2) & _M64
+        h1 = (h1 * 5 + 0x52DCE729) & _M64
+        k2 = (k2 * _C2) & _M64
+        k2 = _rotl(k2, 33)
+        k2 = (k2 * _C1) & _M64
+        h2 ^= k2
+        h2 = _rotl(h2, 31)
+        h2 = (h2 + h1) & _M64
+        h2 = (h2 * 5 + 0x38495AB5) & _M64
+    tail = data[n_blocks * 16:]
+    k1 = 0
+    k2 = 0
+    for i in range(len(tail) - 1, 7, -1):
+        k2 = (k2 << 8) | tail[i]
+    for i in range(min(len(tail), 8) - 1, -1, -1):
+        k1 = (k1 << 8) | tail[i]
+    if len(tail) > 8:
+        k2 = (k2 * _C2) & _M64
+        k2 = _rotl(k2, 33)
+        k2 = (k2 * _C1) & _M64
+        h2 ^= k2
+    if len(tail) > 0:
+        k1 = (k1 * _C1) & _M64
+        k1 = _rotl(k1, 31)
+        k1 = (k1 * _C2) & _M64
+        h1 ^= k1
+    h1 ^= length
+    h2 ^= length
+    h1 = (h1 + h2) & _M64
+    h2 = (h2 + h1) & _M64
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = (h1 + h2) & _M64
+    return h1
+
+
+def murmur3_hash64_long(value: int) -> int:
+    """hash64 of a BIGINT: its 8-byte little-endian representation."""
+    return murmur3_hash64_bytes(struct.pack("<q", value))
+
+
+def _index_and_value(hash64: int, p: int):
+    """bucket = top p bits; value = leading zeros of the remaining
+    (64 - p) bits + 1, capped to fit VALUE_BITS."""
+    index = hash64 >> (64 - p)
+    rest = (hash64 << p) & _M64
+    # leading zeros of `rest` within 64 bits, guarded so an all-zero
+    # suffix yields the max value
+    if rest == 0:
+        value = 64 - p + 1
+    else:
+        value = 65 - rest.bit_length()
+    return index, min(value, (1 << VALUE_BITS) - 1)
+
+
+class DenseHll:
+    """Dense register file + airlift DENSE_V2 serialization."""
+
+    def __init__(self, index_bit_length: int,
+                 registers: Optional[np.ndarray] = None):
+        if not (1 <= index_bit_length <= 16):
+            raise ValueError(f"indexBitLength {index_bit_length}")
+        self.p = index_bit_length
+        m = 1 << index_bit_length
+        self.registers = (np.zeros(m, dtype=np.uint8) if registers is None
+                          else registers.astype(np.uint8))
+
+    @property
+    def num_buckets(self) -> int:
+        return 1 << self.p
+
+    def insert_hash(self, h: int) -> None:
+        idx, val = _index_and_value(h & _M64, self.p)
+        if val > self.registers[idx]:
+            self.registers[idx] = val
+
+    def add_long(self, v: int) -> None:
+        self.insert_hash(murmur3_hash64_long(v))
+
+    def add_bytes(self, b: bytes) -> None:
+        self.insert_hash(murmur3_hash64_bytes(b))
+
+    def merge(self, other: "DenseHll") -> "DenseHll":
+        if other.p != self.p:
+            raise ValueError(
+                f"cannot merge HLLs with different indexBitLength "
+                f"({self.p} vs {other.p})")
+        np.maximum(self.registers, other.registers, out=self.registers)
+        return self
+
+    def cardinality(self) -> int:
+        m = float(self.num_buckets)
+        regs = self.registers.astype(np.float64)
+        zeros = int(np.sum(regs == 0))
+        if zeros:
+            linear = m * np.log(m / zeros)
+            if linear <= 2.5 * m:
+                return int(round(linear))
+        alpha = 0.7213 / (1 + 1.079 / m)
+        raw = alpha * m * m / float(np.sum(np.exp2(-regs)))
+        return int(round(raw))
+
+    # ---- serialization ------------------------------------------------
+    def serialize(self) -> bytes:
+        baseline = int(self.registers.min())
+        deltas_full = self.registers.astype(np.int32) - baseline
+        overflow_idx = np.nonzero(deltas_full > MAX_DELTA)[0]
+        nibbles = np.minimum(deltas_full, MAX_DELTA).astype(np.uint8)
+        packed = (nibbles[0::2] | (nibbles[1::2] << 4)).astype(np.uint8)
+        out = bytearray()
+        out += struct.pack("<BBB", TAG_DENSE_V2, self.p, baseline)
+        out += packed.tobytes()
+        out += struct.pack("<H", len(overflow_idx))
+        for b in overflow_idx:
+            out += struct.pack("<H", int(b))
+        for b in overflow_idx:
+            out += struct.pack("<B", int(deltas_full[b]) - MAX_DELTA)
+        return bytes(out)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "DenseHll":
+        tag, p, baseline = struct.unpack_from("<BBB", data, 0)
+        if tag != TAG_DENSE_V2:
+            raise ValueError(f"not a DENSE_V2 sketch (tag {tag})")
+        m = 1 << p
+        off = 3
+        packed = np.frombuffer(data, dtype=np.uint8, count=m // 2,
+                               offset=off)
+        off += m // 2
+        regs = np.zeros(m, dtype=np.int32)
+        regs[0::2] = packed & 0xF
+        regs[1::2] = packed >> 4
+        (n_over,) = struct.unpack_from("<H", data, off)
+        off += 2
+        buckets = struct.unpack_from(f"<{n_over}H", data, off)
+        off += 2 * n_over
+        values = struct.unpack_from(f"<{n_over}B", data, off)
+        for b, v in zip(buckets, values):
+            regs[b] += v
+        regs += baseline
+        return DenseHll(p, regs.astype(np.uint8))
+
+
+class SparseHll:
+    """Sparse entry list + airlift SPARSE_V2 serialization. Entries
+    keep the top 26 bits of the hash plus the 6-bit bucket value, so a
+    sparse sketch can promote to dense at any p <= 26 - VALUE_BITS."""
+
+    ENTRY_HASH_BITS = 26
+
+    def __init__(self, index_bit_length: int, entries=None):
+        self.p = index_bit_length
+        self.entries = set(entries or ())
+
+    def insert_hash(self, h: int) -> None:
+        h &= _M64
+        prefix = h >> (64 - self.ENTRY_HASH_BITS)
+        _idx, val = _index_and_value(h, self.p)
+        self.entries.add((prefix << VALUE_BITS) | val)
+
+    def add_long(self, v: int) -> None:
+        self.insert_hash(murmur3_hash64_long(v))
+
+    def add_bytes(self, b: bytes) -> None:
+        self.insert_hash(murmur3_hash64_bytes(b))
+
+    def to_dense(self) -> DenseHll:
+        d = DenseHll(self.p)
+        for e in self.entries:
+            prefix = e >> VALUE_BITS
+            val = e & ((1 << VALUE_BITS) - 1)
+            idx = prefix >> (self.ENTRY_HASH_BITS - self.p)
+            if val > d.registers[idx]:
+                d.registers[idx] = val
+        return d
+
+    def cardinality(self) -> int:
+        # linear counting over the 26-bit prefix space (distinct
+        # prefixes are a near-perfect distinct count at sparse sizes)
+        m = float(1 << self.ENTRY_HASH_BITS)
+        distinct = len({e >> VALUE_BITS for e in self.entries})
+        if distinct == 0:
+            return 0
+        return int(round(m * np.log(m / (m - distinct))))
+
+    def serialize(self) -> bytes:
+        out = bytearray()
+        out += struct.pack("<BBH", TAG_SPARSE_V2, self.p,
+                           len(self.entries))
+        for e in sorted(self.entries):
+            out += struct.pack("<I", e)
+        return bytes(out)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "SparseHll":
+        tag, p, n = struct.unpack_from("<BBH", data, 0)
+        if tag != TAG_SPARSE_V2:
+            raise ValueError(f"not a SPARSE_V2 sketch (tag {tag})")
+        entries = struct.unpack_from(f"<{n}I", data, 4)
+        return SparseHll(p, entries)
+
+
+def deserialize(data: bytes):
+    """Tag-dispatched deserialization (HyperLogLog.newInstance role)."""
+    tag = data[0]
+    if tag == TAG_DENSE_V2:
+        return DenseHll.deserialize(data)
+    if tag == TAG_SPARSE_V2:
+        return SparseHll.deserialize(data)
+    raise ValueError(f"unsupported HLL format tag {tag}")
+
+
+def merge_serialized(a: bytes, b: bytes) -> bytes:
+    """Merge two serialized sketches (MergeHyperLogLogAggregation
+    role); result serializes dense."""
+    x = deserialize(a)
+    y = deserialize(b)
+    if isinstance(x, SparseHll):
+        x = x.to_dense()
+    if isinstance(y, SparseHll):
+        y = y.to_dense()
+    return x.merge(y).serialize()
