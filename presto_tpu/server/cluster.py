@@ -118,6 +118,10 @@ class _Stage:
     buffer_offset: Dict[int, int] = dataclasses.field(default_factory=dict)
     task_ids: List[str] = dataclasses.field(default_factory=list)
     task_uris: List[str] = dataclasses.field(default_factory=list)
+    # scan-node id -> (connector id, per-task split payloads); kept so
+    # task-level recovery re-posts the SAME lifespans elsewhere
+    scan_splits: Dict = dataclasses.field(default_factory=dict)
+    recovered_tasks: int = 0
 
 
 class ClusterQueryError(RuntimeError):
@@ -242,25 +246,10 @@ class TpuCluster:
         from presto_tpu.spi import manager as _plugins
         user = self.session_properties.get("user", "")
         _plugins.check_can_execute(user, sql)
-        if _plugins.access_controls:
-            from presto_tpu.spi import AccessDeniedError
-            from presto_tpu.plan.nodes import scan_tables_deep
-            from presto_tpu.sql.parser import parse_statement
-            try:
-                plan = self.plan_sql(sql)
-            except AccessDeniedError:
-                raise
-            except Exception:   # noqa: BLE001 — DDL: check inner SELECT
-                try:
-                    stmt = parse_statement(sql)
-                    q = getattr(stmt, "query", None)
-                    plan = (self.planner.plan_query(q)
-                            if q is not None else None)
-                except Exception:   # noqa: BLE001 — bare DDL
-                    plan = None
-            if plan is not None:
-                for table in scan_tables_deep(plan):
-                    _plugins.check_can_select(user, table)
+        _plugins.check_statement_access(
+            user, sql,
+            plan_full=lambda: self.plan_sql(sql),
+            plan_query=self.planner.plan_query)
 
         with self._lock:
             self._query_counter += 1
@@ -552,12 +541,55 @@ class TpuCluster:
 
         try:
             schedule(0)
-            self._await_all(stages, cancel_event=cancel_event)
+            try:
+                self._await_all(stages, cancel_event=cancel_event)
+            except (ClusterQueryError, OSError):
+                if cancel_event is not None and cancel_event.is_set():
+                    raise
+                # task-level recovery (reference: scheduler/group
+                # recoverable grouped execution,
+                # SystemSessionProperties recoverable_grouped_execution):
+                # for a single-stage query, re-run ONLY the tasks that
+                # lived on dead workers — their split assignment is
+                # deterministic, so exactly the lost lifespans re-run
+                if not self._recover_dead_tasks(qid, stages, by_id):
+                    raise
+                self._await_all(stages, cancel_event=cancel_event)
             if capture:
                 self._capture_task_infos(stages)
             return self._collect_root(stages[0], out_types, merge_keys)
         finally:
             self._cleanup(stages)
+
+    def _recover_dead_tasks(self, qid: str, stages: Dict[int, _Stage],
+                            by_id) -> bool:
+        """Reschedule tasks stranded on dead workers onto survivors.
+        Only safe when every stage's output is still pullable, i.e. the
+        single-fragment shape (consumers re-pull from token 0 of the
+        replacement task); multi-stage plans fall back to the
+        whole-query retry. Returns True if recovery was performed."""
+        if len(stages) != 1:
+            return False
+        alive = set(self.check_workers())
+        if not alive:
+            return False
+        stage = stages[0]
+        survivors = sorted(alive)
+        recovered = False
+        for t, uri in enumerate(list(stage.task_uris)):
+            worker = uri.split("/v1/task/")[0]
+            if worker in alive:
+                continue
+            attempt = int(stage.task_ids[t].rsplit(".", 1)[1]) + 1
+            new_worker = survivors[t % len(survivors)]
+            task_id, new_uri = self._post_stage_task(
+                qid, 0, stages, by_id, new_worker, t, attempt)
+            stage.task_ids[t] = task_id
+            stage.task_uris[t] = new_uri
+            stage.recovered_tasks += 1
+            recovered = True
+        self.last_recovered_tasks = stage.recovered_tasks
+        return recovered
 
     def _capture_task_infos(self, stages: Dict[int, _Stage]):
         """Fetch every task's TaskInfo (stats tree included) before
@@ -578,61 +610,72 @@ class TpuCluster:
     def _start_stage(self, qid: str, fid: int, stages: Dict[int, _Stage],
                      by_id, placement: List[str]):
         stage = stages[fid]
-        spec = stage.spec
-        frag_bytes = spec.fragment.to_bytes()
         # connector-provided splits, one list per scan node (reference:
         # ConnectorSplitManager; split t goes to task t)
-        scan_splits = {
+        stage.scan_splits = {
             node_id: (self.connector.connector_id(table),
                       self.connector.table_splits(table, stage.n_tasks))
-            for node_id, table in spec.scan_nodes.items()}
+            for node_id, table in stage.spec.scan_nodes.items()}
         for t in range(stage.n_tasks):
             w = t % len(placement)
-            task_id = f"{qid}.{fid}.0.{t}.0"
-            uri = f"{placement[w]}/v1/task/{task_id}"
-            sources: List[S.TaskSource] = []
-            seq = 0
-            for node_id, (cid, all_splits) in scan_splits.items():
-                splits = [S.ScheduledSplit(
-                    sequenceId=seq, planNodeId=node_id,
-                    split=S.Split(connectorId=cid,
-                                  connectorSplit=all_splits[t]))]
-                seq += 1
-                sources.append(S.TaskSource(planNodeId=node_id,
-                                            splits=splits,
-                                            noMoreSplits=True))
-            for node_id, pfid in spec.remote_nodes.items():
-                producer = stages[pfid]
-                part = by_id[pfid].partitioning
-                off = producer.buffer_offset.get(fid, 0)
-                buffer_id = (str(off) if part == Partitioning.SINGLE
-                             else str(off + t))
-                splits = []
-                for u in producer.task_uris:
-                    splits.append(S.ScheduledSplit(
-                        sequenceId=seq, planNodeId=node_id,
-                        split=S.Split(connectorId="$remote",
-                                      connectorSplit={
-                                          "@type": "$remote",
-                                          "location": u,
-                                          "bufferId": buffer_id})))
-                    seq += 1
-                sources.append(S.TaskSource(planNodeId=node_id,
-                                            splits=splits,
-                                            noMoreSplits=True))
-            tur = S.TaskUpdateRequest(
-                session=S.SessionRepresentation(
-                    queryId=qid, user="cluster",
-                    systemProperties=dict(self.session_properties)),
-                extraCredentials={},
-                fragment=frag_bytes,
-                sources=sources,
-                outputIds=S.OutputBuffers(
-                    type="PARTITIONED", version=1, noMoreBufferIds=True,
-                    buffers={str(j): j for j in range(stage.n_buffers)}))
-            self._post(uri, tur.dumps().encode())
+            task_id, uri = self._post_stage_task(
+                qid, fid, stages, by_id, placement[w], t, attempt=0)
             stage.task_ids.append(task_id)
             stage.task_uris.append(uri)
+
+    def _post_stage_task(self, qid: str, fid: int, stages, by_id,
+                         worker_uri: str, t: int, attempt: int):
+        """POST task index `t` of fragment `fid` to one worker. The
+        split assignment is a pure function of (fragment, t), so a
+        recovery re-post on another worker re-reads exactly the same
+        lifespans (reference: scheduler/group recoverable grouped
+        execution; attempt is the Presto task-id attempt field)."""
+        stage = stages[fid]
+        spec = stage.spec
+        task_id = f"{qid}.{fid}.0.{t}.{attempt}"
+        uri = f"{worker_uri}/v1/task/{task_id}"
+        sources: List[S.TaskSource] = []
+        seq = 0
+        for node_id, (cid, all_splits) in stage.scan_splits.items():
+            splits = [S.ScheduledSplit(
+                sequenceId=seq, planNodeId=node_id,
+                split=S.Split(connectorId=cid,
+                              connectorSplit=all_splits[t]))]
+            seq += 1
+            sources.append(S.TaskSource(planNodeId=node_id,
+                                        splits=splits,
+                                        noMoreSplits=True))
+        for node_id, pfid in spec.remote_nodes.items():
+            producer = stages[pfid]
+            part = by_id[pfid].partitioning
+            off = producer.buffer_offset.get(fid, 0)
+            buffer_id = (str(off) if part == Partitioning.SINGLE
+                         else str(off + t))
+            splits = []
+            for u in producer.task_uris:
+                splits.append(S.ScheduledSplit(
+                    sequenceId=seq, planNodeId=node_id,
+                    split=S.Split(connectorId="$remote",
+                                  connectorSplit={
+                                      "@type": "$remote",
+                                      "location": u,
+                                      "bufferId": buffer_id})))
+                seq += 1
+            sources.append(S.TaskSource(planNodeId=node_id,
+                                        splits=splits,
+                                        noMoreSplits=True))
+        tur = S.TaskUpdateRequest(
+            session=S.SessionRepresentation(
+                queryId=qid, user="cluster",
+                systemProperties=dict(self.session_properties)),
+            extraCredentials={},
+            fragment=spec.fragment.to_bytes(),
+            sources=sources,
+            outputIds=S.OutputBuffers(
+                type="PARTITIONED", version=1, noMoreBufferIds=True,
+                buffers={str(j): j for j in range(stage.n_buffers)}))
+        self._post(uri, tur.dumps().encode())
+        return task_id, uri
 
     # ------------------------------------------------------------------
     def _post(self, uri: str, body: bytes) -> dict:

@@ -1,0 +1,70 @@
+"""Task/lifespan-level recovery (round-5 VERDICT #8). Reference:
+scheduler/group recoverable grouped execution +
+SystemSessionProperties.RECOVERABLE_GROUPED_EXECUTION — a worker death
+mid-query re-runs ONLY the lifespans that lived on the dead worker;
+survivors' results are reused, and row counts prove no duplication."""
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.server.cluster import TpuCluster
+
+SF = 0.01
+
+
+def test_dead_worker_recovers_only_lost_tasks():
+    conn = TpchConnector(SF)
+    want = LocalEngine(TpchConnector(SF)).execute_sql(
+        "select o_orderkey from orders where o_totalprice > 100000")
+    c = TpuCluster(conn, n_workers=3)
+    try:
+        state = {"killed": False}
+        orig_await = c._await_all
+
+        def await_and_kill(stages, **kw):
+            # tasks exist on every worker; one worker dies before the
+            # coordinator sees completion — the mid-query death window
+            if not state["killed"]:
+                state["killed"] = True
+                c.workers[1].stop()
+            return orig_await(stages, **kw)
+
+        c._await_all = await_and_kill
+        got = c.execute_sql(
+            "select o_orderkey from orders where o_totalprice > 100000")
+        # only the dead worker's tasks were re-posted
+        assert getattr(c, "last_recovered_tasks", 0) >= 1
+        assert c.last_recovered_tasks < 3          # survivors reused
+        # exactness: same multiset of rows — nothing lost, nothing
+        # duplicated by the re-run
+        assert sorted(got) == sorted(want)
+    finally:
+        c.stop()
+
+
+def test_recovery_attempt_ids_follow_presto_format():
+    """Replacement tasks bump the attempt field of the Presto task id
+    ({query}.{stage}.0.{task}.{attempt})."""
+    conn = TpchConnector(SF)
+    c = TpuCluster(conn, n_workers=2)
+    try:
+        state = {"killed": False}
+        orig_await = c._await_all
+
+        def await_and_kill(stages, **kw):
+            if not state["killed"]:
+                state["killed"] = True
+                c.workers[0].stop()
+                self_stages = stages
+                await_and_kill.stages = self_stages
+            return orig_await(stages, **kw)
+
+        c._await_all = await_and_kill
+        c.execute_sql("select r_name from region")
+        stage = await_and_kill.stages[0]
+        attempts = [tid.rsplit(".", 1)[1] for tid in stage.task_ids]
+        assert "1" in attempts          # a recovered task
+        assert "0" in attempts          # an original survivor
+    finally:
+        c.stop()
