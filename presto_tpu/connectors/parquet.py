@@ -1,14 +1,35 @@
-"""Parquet connector — columnar files -> engine Pages via Arrow.
+"""Parquet connector — columnar files -> engine Pages.
 
-Reference roles: presto-parquet (the Parquet->Page reader feeding scans)
-+ presto-hive's file-split model, realized the way SURVEY.md §7.2 step 8
-prescribes: Parquet -> Arrow -> numpy -> the engine's dictionary-coded
-HostTable form. Row-group boundaries are the natural split unit
-(reference: ParquetPageSourceFactory splitting by row group).
+Reference roles: presto-parquet's reader
+(presto-parquet/.../reader/ParquetReader.java — predicate/projection
+pushdown into row groups, dictionary pages, nested columns) +
+presto-hive's directory/split model
+(BackgroundHiveSplitLoader.java: a table is a directory of files, a
+split is a file byte-range — here a row-group range, parquet's natural
+split unit via ParquetPageSourceFactory).
 
-Reads through pyarrow (in-image); the write side serializes engine rows
-back to Parquet so CTAS-style round-trips are testable without external
-files."""
+TPU-first realization:
+- **Projection pushdown**: columns load LAZILY — `page(columns=...)`
+  touches only the requested columns, and each loads straight from the
+  column chunk (never the whole file).
+- **Dictionary pages**: string columns read as Arrow dictionary arrays
+  (the parquet dictionary page survives decode), then remap into the
+  engine's *sorted* StringDict codes — one vectorized indirection, no
+  per-value python.
+- **Row-group statistics**: `column_minmax()` serves min/max from file
+  metadata without reading data; the lifespan dynamic filter and split
+  pruning consult it.
+- **Multi-file tables**: `<dir>/<table>/` holds N parquet files
+  (Hive-style layout); `<dir>/<table>.parquet` stays the single-file
+  form. Splits are (file, row-group) pairs.
+- **Nested columns**: arrow list/map/struct map to the engine's
+  ARRAY/MAP/ROW with offset-encoded NestedColumns.
+
+The decode layer is pyarrow (in-image), playing the role the reference
+delegates to its parquet-mr-derived decoder; everything above it —
+lazy projection, split construction, statistics pruning, the
+dictionary-code remap, type mapping — is this connector.
+"""
 
 from __future__ import annotations
 
@@ -17,11 +38,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from presto_tpu.connectors.base import SplitSource
 from presto_tpu.connectors.tpch import HostTable, _slice_rows
 from presto_tpu.data.column import StringDict
 from presto_tpu.types import (
     BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TIMESTAMP,
-    TINYINT, VARCHAR, DecimalType, Type,
+    TINYINT, VARCHAR, ArrayType, DecimalType, MapType, RowType, Type,
 )
 
 
@@ -50,6 +72,16 @@ def _arrow_to_type(t) -> Type:
         return DecimalType(t.precision, t.scale)
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return VARCHAR
+    if pa.types.is_dictionary(t):
+        return _arrow_to_type(t.value_type)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return ArrayType(_arrow_to_type(t.value_type))
+    if pa.types.is_map(t):
+        return MapType(_arrow_to_type(t.key_type),
+                       _arrow_to_type(t.item_type))
+    if pa.types.is_struct(t):
+        return RowType(tuple(f.name for f in t),
+                       tuple(_arrow_to_type(f.type) for f in t))
     raise NotImplementedError(f"arrow type {t}")
 
 
@@ -58,6 +90,13 @@ def _type_to_arrow(t: Type):
 
     if isinstance(t, DecimalType):
         return pa.decimal128(t.precision, t.scale)
+    if isinstance(t, ArrayType):
+        return pa.list_(_type_to_arrow(t.element))
+    if isinstance(t, MapType):
+        return pa.map_(_type_to_arrow(t.key), _type_to_arrow(t.value))
+    if isinstance(t, RowType):
+        return pa.struct([pa.field(n, _type_to_arrow(ft))
+                          for n, ft in zip(t.field_names, t.field_types)])
     return {
         "boolean": pa.bool_(), "tinyint": pa.int8(),
         "smallint": pa.int16(), "integer": pa.int32(),
@@ -68,61 +107,178 @@ def _type_to_arrow(t: Type):
     }[t.name]
 
 
-def read_parquet_table(path: str, name: str) -> HostTable:
-    """One Parquet file -> HostTable (whole-file; splits are row slices
-    of it so string codes share one file-wide dictionary)."""
-    import pyarrow.parquet as pq
+def _decode_column(col, t: Type):
+    """One arrow ChunkedArray -> (values ndarray, nulls ndarray,
+    StringDict|None). The engine's storage forms (codes into a sorted
+    dictionary, unscaled decimal ints, epoch integers)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
 
-    at = pq.read_table(path)
-    arrays: Dict[str, np.ndarray] = {}
-    dicts: Dict[str, StringDict] = {}
-    nulls: Dict[str, np.ndarray] = {}
-    types: Dict[str, Type] = {}
-    n = at.num_rows
-    for field in at.schema:
-        col = at.column(field.name).combine_chunks()
-        t = _arrow_to_type(field.type)
-        types[field.name] = t
-        mask = np.asarray(col.is_null())
-        nulls[field.name] = mask
-        if t.is_string:
-            vals = col.to_pylist()
-            d, codes = StringDict.build(
-                ["" if v is None else v for v in vals])
-            arrays[field.name] = codes
-            dicts[field.name] = d
-        elif t.is_decimal:
-            vals = col.to_pylist()
-            arrays[field.name] = np.asarray(
-                [0 if v is None else int(v.scaleb(t.scale))
-                 for v in vals], dtype=np.int64)
-        elif t.name == "timestamp":
-            import pyarrow as pa
-            us = col.cast(pa.timestamp("us")).cast(pa.int64())
-            arrays[field.name] = np.where(
-                mask, 0, np.asarray(us.to_pandas(), dtype=np.int64))
-        else:
-            np_vals = col.to_pandas().to_numpy()
-            if np_vals.dtype == object or np_vals.dtype.kind in "fmM":
-                if t.name == "date":
-                    np_vals = np.asarray(
-                        col.cast("int32").to_pandas(), dtype=np.int32)
-                elif t.is_floating:
-                    np_vals = np.asarray(np_vals, dtype=t.dtype)
-                else:
-                    np_vals = np.asarray(
-                        [0 if v is None else v
-                         for v in col.to_pylist()], dtype=t.dtype)
-            arrays[field.name] = np.where(
-                mask, t.dtype.type(0), np_vals.astype(t.dtype)) \
-                if np_vals.dtype != t.dtype else np.where(
-                    mask, t.dtype.type(0), np_vals)
-    return HostTable(name, n, arrays, types, dicts, nulls)
+    col = col.combine_chunks()
+    mask = np.asarray(col.is_null())
+    if t.is_string:
+        # dictionary-page path: decode keeps (indices, dictionary);
+        # remap the file dictionary onto the engine's sorted dictionary
+        # with one vectorized take
+        if not pa.types.is_dictionary(col.type):
+            col = pc.dictionary_encode(col)
+        dict_words = col.dictionary.to_pylist()
+        indices = np.asarray(col.indices.fill_null(0),
+                             dtype=np.int32)
+        d, remap = StringDict.build(
+            ["" if w is None else w for w in dict_words] or [""])
+        codes = np.asarray(remap, dtype=np.int32)[
+            np.clip(indices, 0, max(len(dict_words) - 1, 0))]
+        return codes, mask, d
+    if t.is_decimal:
+        vals = col.to_pylist()
+        if t.uses_int128:
+            arr = np.empty(len(vals), object)
+            arr[:] = [0 if v is None else int(v.scaleb(t.scale))
+                      for v in vals]
+            return arr, mask, None
+        return np.asarray(
+            [0 if v is None else int(v.scaleb(t.scale)) for v in vals],
+            dtype=np.int64), mask, None
+    if t.name == "timestamp":
+        us = col.cast(pa.timestamp("us")).cast(pa.int64())
+        return np.asarray(us.fill_null(0), dtype=np.int64), mask, None
+    if t.name == "date":
+        return np.asarray(col.cast(pa.date32()).cast(pa.int32())
+                          .fill_null(0), dtype=np.int32), mask, None
+    if t.name in ("array", "map", "row"):
+        arr = np.empty(len(col), object)
+        arr[:] = col.to_pylist()
+        return arr, mask, None
+    if t.name == "boolean":
+        return np.asarray(col.fill_null(False), dtype=bool), mask, None
+    return (np.asarray(col.fill_null(0)).astype(t.dtype), mask, None)
+
+
+class _LazyArrays(dict):
+    """Column name -> ndarray, loaded from the parquet column chunks on
+    first access (projection pushdown: `page(columns=[...])` only ever
+    touches the requested names)."""
+
+    def __init__(self, loader):
+        super().__init__()
+        self._loader = loader
+
+    def __missing__(self, key):
+        vals, nulls, d = self._loader(key)
+        self[key] = vals
+        return vals
+
+
+class ParquetTable(HostTable):
+    """Lazily-loading HostTable over one or more parquet files.
+    `files` shares already-open ParquetFile handles (split/prune
+    derivatives must not re-open and re-parse every file's metadata)."""
+
+    def __init__(self, name: str, paths: List[str],
+                 row_groups: Optional[List[Tuple[int, int]]] = None,
+                 files=None):
+        import pyarrow.parquet as pq
+
+        self.paths = paths
+        self._files = (files if files is not None
+                       else [pq.ParquetFile(p) for p in paths])
+        # (file index, row group index) units — the split currency
+        self.units = (row_groups if row_groups is not None
+                      else [(fi, g) for fi, f in enumerate(self._files)
+                            for g in range(f.metadata.num_row_groups)])
+        schema = self._files[0].schema_arrow
+        types = {f.name: _arrow_to_type(f.type) for f in schema}
+        n = sum(self._files[fi].metadata.row_group(g).num_rows
+                for fi, g in self.units)
+        self._dicts: Dict[str, StringDict] = {}
+        self._nulls: Dict[str, np.ndarray] = {}
+        super().__init__(name, n, _LazyArrays(self._load_column), types,
+                         self._dicts, self._nulls)
+
+    # -- lazy column load (projection pushdown) -------------------------
+    def _load_column(self, col: str):
+        import pyarrow as pa
+
+        t = self.types[col]
+        chunks = []
+        for fi, g in self.units:
+            chunks.append(self._files[fi].read_row_group(
+                g, columns=[col]).column(0))
+        merged = pa.chunked_array([c for ch in chunks
+                                   for c in ch.chunks]) \
+            if chunks else pa.chunked_array([], type=pa.int64())
+        vals, nulls, d = _decode_column(merged, t)
+        if d is not None:
+            self._dicts[col] = d
+        self._nulls[col] = nulls
+        return vals, nulls, d
+
+    def null_mask(self, c: str):
+        if c not in self._nulls:
+            _ = self.arrays[c]          # triggers the lazy load
+        m = self._nulls.get(c)
+        return m[:self.num_rows] if m is not None else None
+
+    # -- row-group statistics (predicate pushdown support) --------------
+    def column_minmax(self, col: str):
+        """(min, max) from row-group metadata WITHOUT reading data;
+        None when any unit lacks statistics. Reference:
+        TupleDomainParquetPredicate over ColumnChunkMetaData stats."""
+        los, his = [], []
+        idx = {c: i for i, c in
+               enumerate(self._files[0].schema_arrow.names)}
+        if col not in idx:
+            return None
+        for fi, g in self.units:
+            meta = self._files[fi].metadata.row_group(g)
+            st = meta.column(idx[col]).statistics
+            if st is None or not st.has_min_max:
+                return None
+            los.append(st.min)
+            his.append(st.max)
+        if not los:
+            return None
+        return min(los), max(his)
+
+    def prune_units(self, col: str, lo, hi) -> "ParquetTable":
+        """Row groups whose [min, max] cannot intersect [lo, hi] drop
+        out of the split list (the reader's row-group skip)."""
+        idx = {c: i for i, c in
+               enumerate(self._files[0].schema_arrow.names)}
+        if col not in idx:
+            return self
+        kept = []
+        for fi, g in self.units:
+            st = self._files[fi].metadata.row_group(g).column(
+                idx[col]).statistics
+            if st is None or not st.has_min_max:
+                kept.append((fi, g))
+                continue
+            if st.max < lo or st.min > hi:
+                continue
+            kept.append((fi, g))
+        if len(kept) == len(self.units):
+            return self
+        return ParquetTable(self.name, self.paths, kept,
+                            files=self._files)
+
+
+def read_parquet_table(path: str, name: str) -> ParquetTable:
+    """One parquet file (or a directory of them) -> lazy table."""
+    if os.path.isdir(path):
+        paths = sorted(os.path.join(path, f) for f in os.listdir(path)
+                       if f.endswith(".parquet"))
+        if not paths:
+            raise FileNotFoundError(f"no parquet files under {path}")
+        return ParquetTable(name, paths)
+    return ParquetTable(name, [path])
 
 
 def write_parquet_table(path: str, rows: List[tuple],
-                        schema: Sequence[Tuple[str, Type]]):
-    """Engine result rows (to_pylist shape) -> one Parquet file."""
+                        schema: Sequence[Tuple[str, Type]],
+                        row_group_size: Optional[int] = None):
+    """Engine result rows (to_pylist shape) -> one parquet file."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -133,35 +289,103 @@ def write_parquet_table(path: str, rows: List[tuple],
         if isinstance(t, DecimalType):
             from decimal import Decimal
             vals = [None if v is None else
-                    Decimal(str(round(v, t.scale))) for v in vals]
+                    (v if isinstance(v, Decimal)
+                     else Decimal(str(round(v, t.scale)))) for v in vals]
+        if t.name == "date":
+            import datetime
+            epoch = datetime.date(1970, 1, 1)
+            vals = [None if v is None else
+                    (v if isinstance(v, datetime.date)
+                     else epoch + datetime.timedelta(days=int(v)))
+                    for v in vals]
         fields.append(pa.field(name, _type_to_arrow(t)))
         cols.append(pa.array(vals, type=_type_to_arrow(t)))
     pq.write_table(pa.Table.from_arrays(cols, schema=pa.schema(fields)),
-                   path)
+                   path, row_group_size=row_group_size)
 
 
-from presto_tpu.connectors.base import SplitSource
+def write_host_table(table: HostTable, path: str,
+                     row_group_size: Optional[int] = None) -> None:
+    """Vectorized HostTable -> parquet (no per-row python): numeric
+    arrays pass straight through; string columns become arrow
+    DictionaryArrays from their codes (dictionary pages on disk)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = table.num_rows
+    fields, cols = [], []
+    for c in table.column_names():
+        t = table.types[c]
+        mask = table.null_mask(c)
+        if t.is_string:
+            codes = np.asarray(table.arrays[c][:n], dtype=np.int32)
+            words = list(table.dicts[c].words)
+            arr = pa.DictionaryArray.from_arrays(
+                pa.array(codes, type=pa.int32(),
+                         mask=None if mask is None else mask),
+                pa.array(words or [""], type=pa.string()))
+            fields.append(pa.field(c, arr.type))
+        elif t.is_decimal and not t.uses_int128:
+            from decimal import Decimal
+            vals = [Decimal(int(v)).scaleb(-t.scale)
+                    for v in np.asarray(table.arrays[c][:n])]
+            arr = pa.array(vals, type=pa.decimal128(t.precision, t.scale),
+                           mask=None if mask is None else mask)
+            fields.append(pa.field(c, arr.type))
+        elif t.name == "date":
+            arr = pa.array(np.asarray(table.arrays[c][:n],
+                                      dtype=np.int32),
+                           type=pa.date32(),
+                           mask=None if mask is None else mask)
+            fields.append(pa.field(c, arr.type))
+        else:
+            arr = pa.array(np.asarray(table.arrays[c][:n]),
+                           mask=None if mask is None else mask)
+            fields.append(pa.field(c, arr.type))
+        cols.append(arr)
+    pq.write_table(
+        pa.Table.from_arrays(cols, schema=pa.schema(fields)), path,
+        row_group_size=row_group_size)
+
+
+def materialize_connector(conn, directory: str, tables: List[str],
+                          row_group_size: Optional[int] = None) -> None:
+    """Serialize a connector's tables into a parquet directory catalog
+    (the fixture -> lakehouse bridge the scan bench uses)."""
+    os.makedirs(directory, exist_ok=True)
+    for t in tables:
+        out = os.path.join(directory, f"{t}.parquet")
+        if not os.path.exists(out):
+            write_host_table(conn.table(t), out,
+                             row_group_size=row_group_size)
 
 
 class ParquetConnector(SplitSource):
     NAME = "parquet"
-    """Directory-of-files catalog: `<dir>/<table>.parquet`. Same surface
-    as the generated-fixture connectors; an optional fallback serves
-    other names (multi-catalog facade, as connectors/memory.py)."""
+    """Directory catalog: `<dir>/<table>.parquet` (single file) or
+    `<dir>/<table>/` (multi-file, Hive-style). Splits are row-group
+    ranges; an optional fallback serves other names (multi-catalog
+    facade, as connectors/memory.py)."""
 
     def __init__(self, directory: str, fallback=None):
         self.directory = directory
         self.fallback = fallback
-        self._cache: Dict[str, HostTable] = {}
+        self._cache: Dict[str, ParquetTable] = {}
 
-    def _path(self, table: str) -> str:
-        return os.path.join(self.directory, f"{table}.parquet")
+    def _path(self, table: str) -> Optional[str]:
+        p = os.path.join(self.directory, f"{table}.parquet")
+        if os.path.exists(p):
+            return p
+        d = os.path.join(self.directory, table)
+        if os.path.isdir(d):
+            return d
+        return None
 
-    def _load(self, table: str) -> Optional[HostTable]:
+    def _load(self, table: str) -> Optional[ParquetTable]:
         if table in self._cache:
             return self._cache[table]
         p = self._path(table)
-        if not os.path.exists(p):
+        if p is None:
             return None
         t = read_parquet_table(p, table)
         self._cache[table] = t
@@ -192,12 +416,20 @@ class ParquetConnector(SplitSource):
             raise KeyError(f"unknown table {name}")
         if num_parts == 1:
             return full
+        # split by ROW-GROUP ranges when the file layout allows it —
+        # a split then reads only its own column chunks — falling back
+        # to row slices when there are fewer groups than parts
+        if len(full.units) >= num_parts:
+            lo, hi = _slice_rows(len(full.units), part, num_parts)
+            return ParquetTable(name, full.paths, full.units[lo:hi],
+                                files=full._files)
         lo, hi = _slice_rows(full.num_rows, part, num_parts)
-        arrays = {c: a[lo:hi] for c, a in full.arrays.items()}
-        nulls = ({c: m[lo:hi] for c, m in full.nulls.items()}
-                 if full.nulls is not None else None)
+        arrays = {c: full.arrays[c][lo:hi] for c in full.column_names()}
+        nulls = {c: full.null_mask(c)[lo:hi]
+                 for c in full.column_names()
+                 if full.null_mask(c) is not None}
         return HostTable(name, hi - lo, arrays, full.types, full.dicts,
-                         nulls)
+                         nulls or None)
 
     def invalidate(self, table: Optional[str] = None):
         if table is None:
