@@ -137,6 +137,9 @@ class Executor:
         import time
         budget = self.session["query_max_execution_time"]
         self._deadline = (time.time() + budget) if budget else None
+        # stats maps are per query (islands accumulate into them)
+        self.last_node_rows = {}
+        self._node_map = {}
         plan = self._resolve_subqueries(plan)
         plan = self._prepare(plan)
         if isinstance(plan, TableWriterNode):
@@ -199,8 +202,6 @@ class Executor:
         mode = self.session["execution_mode"]
         if mode == "fused" or getattr(self, "_force_fused", False):
             return False
-        if self.session["collect_stats"]:
-            return False          # stats need whole-plan node-id order
         found = [0]
 
         def walk(n):
@@ -222,11 +223,19 @@ class Executor:
         split-node subtrees replaced by PageInputNode slots. Cached by
         node identity (plans are reused across executions)."""
         cache = self.__dict__.setdefault("_island_cache", {})
+        if len(cache) > 256:
+            # bound the id-keyed memo (engines that re-plan per
+            # execution would otherwise leak whole plan trees);
+            # re-splitting is cheap and capacity ids are base-free
+            cache.clear()
+            self.__dict__.get("_island_alias", {}).clear()
         hit = cache.get(id(plan))
         if hit is not None:
-            return hit[0], hit[1]
+            return hit[0], hit[1], hit[3]
         children: List[PlanNode] = []
         child_slots: Dict[int, int] = {}
+
+        alias = self.__dict__.setdefault("_island_alias", {})
 
         def rec(n: PlanNode, is_root: bool) -> PlanNode:
             if n is None:
@@ -244,33 +253,63 @@ class Executor:
             if not kids:
                 return n
             if isinstance(n, JoinNode):
-                return dataclasses.replace(
+                m = dataclasses.replace(
                     n, probe=rec(n.probe, False),
                     build=rec(n.build, False))
-            if isinstance(n, UnionAllNode):
-                return dataclasses.replace(
+            elif isinstance(n, UnionAllNode):
+                m = dataclasses.replace(
                     n, sources=tuple(rec(s, False) for s in n.sources))
-            return dataclasses.replace(n, source=rec(kids[0], False))
+            else:
+                m = dataclasses.replace(n, source=rec(kids[0], False))
+            # copy -> original identity, so EXPLAIN ANALYZE can project
+            # per-island stats back onto the user-facing plan tree
+            alias[id(m)] = id(n)
+            return m
 
         mini = rec(plan, True)
-        cache[id(plan)] = (mini, children, plan)   # keep plan alive
-        return mini, children
+        # stable per-island stats-id base: islands build in a
+        # deterministic traversal order, so len(cache) is reproducible
+        base = (len(cache) + 1) * 1_000_000
+        cache[id(plan)] = (mini, children, plan, base)  # keep plan alive
+        return mini, children, base
 
     def _execute_islands(self, plan: PlanNode) -> Page:
         run_memo: Dict[int, Page] = {}
+        profile = self.session["collect_stats"]
+        self.last_island_profile: List[dict] = []
 
         def run(node: PlanNode) -> Page:
             if id(node) in run_memo:
                 return run_memo[id(node)]
             self._check_deadline()
-            mini, children = self._island_of(node)
+            mini, children, base = self._island_of(node)
             pages = [run(c) for c in children]
             self._island_inputs = pages
-            out = self._execute_fused(mini)
+            self._stats_base = base
+            if profile:
+                # per-island wall time (block per island only under
+                # EXPLAIN ANALYZE — the serialization would otherwise
+                # cost async dispatch overlap): this is the join-plan
+                # profile the fused mode could never produce
+                import time as _t
+                t0 = _t.perf_counter()
+                out = self._execute_fused(mini)
+                jax.block_until_ready(out)   # Page is a pytree
+                self.last_island_profile.append({
+                    "root": type(node).__name__.replace("Node", ""),
+                    "seconds": _t.perf_counter() - t0,
+                    "rows": int(out.num_rows),
+                    "memory_bytes": self.last_memory_estimate,
+                })
+            else:
+                out = self._execute_fused(mini)
             run_memo[id(node)] = out
             return out
 
-        return run(plan)
+        try:
+            return run(plan)
+        finally:
+            self._stats_base = 0
 
     def _execute_tree(self, plan: PlanNode) -> Page:
         if self._use_islands(plan):
@@ -296,8 +335,36 @@ class Executor:
 
     def _plan_fingerprint(self, plan) -> str:
         import hashlib
-        # salt with the connector identity/scale: the same plan over
-        # SF0.01 and SF1 converges to different capacities
+        # salt with the connector identity/scale AND the scanned
+        # tables' row counts: the same plan over SF0.01 and SF1 — or
+        # over two different MemoryConnector datasets (sf=None) —
+        # converges to different capacities
+        sizes = []
+        try:
+            for t in sorted({n.table for n in self._walk_scans(plan)}):
+                sizes.append((t, self.connector.table(t).num_rows))
+        except Exception:   # noqa: BLE001 — salt is best-effort
+            pass
+        salt = (type(self.connector).__name__,
+                getattr(self.connector, "sf", None), tuple(sizes))
+        return hashlib.sha1(
+            (repr(salt) + repr(plan)).encode()).hexdigest()[:24]
+
+    @staticmethod
+    def _walk_scans(plan):
+        out = []
+
+        def rec(n):
+            if isinstance(n, TableScanNode):
+                out.append(n)
+            for c in n.children():
+                if c is not None:
+                    rec(c)
+        rec(plan)
+        return out
+
+    def _plan_fingerprint_legacy(self, plan) -> str:
+        import hashlib
         salt = (type(self.connector).__name__,
                 getattr(self.connector, "sf", None))
         return hashlib.sha1(
@@ -312,7 +379,12 @@ class Executor:
         try:
             with open(path) as f:
                 data = json.load(f)
-            raw = data.get(self._plan_fingerprint(plan), {})
+            raw = data.get(self._plan_fingerprint(plan))
+            if raw is None:
+                # migrate entries learned under the pre-row-count salt
+                # (losing them would re-pay overflow-retry recompiles
+                # through the remote TPU compile service)
+                raw = data.get(self._plan_fingerprint_legacy(plan), {})
             return {int(k): int(v) for k, v in raw.items()}
         except Exception:   # noqa: BLE001 — cache is best-effort
             return {}
@@ -340,6 +412,12 @@ class Executor:
             if data.get(key) == entry:
                 return
             data[key] = entry
+            if len(data) > 512:
+                # bound the cache file: evict oldest-inserted entries
+                # (insertion order == json order) — stale fingerprints
+                # only cost a re-learn, never wrong results
+                for k in list(data)[:len(data) - 512]:
+                    data.pop(k, None)
             tmp = f"{path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(data, f)
@@ -393,8 +471,9 @@ class Executor:
                 _E.raise_for_mask(int(needed[len(watch)]))
                 if stats_box:
                     stats = needed[len(watch) + 1:]
-                    self.last_node_rows = {
-                        nid: int(r) for nid, r in zip(stats_box, stats)}
+                    self.last_node_rows.update(
+                        {nid: int(r)
+                         for nid, r in zip(stats_box, stats)})
                 self._save_caps(plan, caps)
                 return out
         raise RuntimeError("capacity retry loop did not converge")
@@ -511,6 +590,12 @@ class Executor:
         scans: List[ScanSpec] = []
         watch: List[int] = []
         counter = [0]
+        # CAPACITY ids must be identical on every lowering of the same
+        # (mini) plan — they key the persisted caps cache, and a base
+        # offset would orphan learned TPU capacities across re-plans.
+        # STATS ids additionally carry the island's base so row counts
+        # from different islands of one query never collide.
+        base = getattr(self, "_stats_base", 0)
 
         def node_id(_n) -> int:
             counter[0] += 1
@@ -525,13 +610,16 @@ class Executor:
         mem_bytes = [0]
         collect_stats = bool(self.session["collect_stats"])
         _node_rows: List = []
-        self._node_map = {}
+        if base == 0:
+            self._node_map = {}
+        # island mode (base > 0): maps ACCUMULATE across the query's
+        # islands; execute() resets them per query
 
         def build(node: PlanNode):
             key = id(node)
             if key in memo:
                 return memo[key]
-            nid_stats = counter[0] + 1       # id build_inner will assign
+            nid_stats = base + counter[0] + 1  # id build_inner assigns
             fn, cap = build_inner(node)
             mem_bytes[0] += cap * _row_bytes(node.output_types)
             self._node_map[nid_stats] = (node, cap)

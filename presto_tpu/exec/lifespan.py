@@ -332,10 +332,22 @@ class BatchedRunner:
                 t = connector.table(driving, part=b,
                                     num_parts=num_batches)
                 if t.num_rows:
-                    sv = t.arrays[col][:t.num_rows]
-                    if empty or sv.min() > hi or sv.max() < lo:
+                    if empty:
                         skipped += 1
                         continue
+                    # metadata min/max first (parquet row-group stats:
+                    # prunes the lifespan WITHOUT reading the column)
+                    mm = (t.column_minmax(col)
+                          if hasattr(t, "column_minmax") else None)
+                    if mm is not None:
+                        if mm[0] > hi or mm[1] < lo:
+                            skipped += 1
+                            continue
+                    else:
+                        sv = t.arrays[col][:t.num_rows]
+                        if sv.min() > hi or sv.max() < lo:
+                            skipped += 1
+                            continue
             ex.set_splits({driving: [(b, num_batches)]})
             p = ex.execute(self.partial_plan)
             if self.spill:

@@ -74,6 +74,13 @@ class SplitExecutor(Executor):
                 cols.append(NestedColumn.from_pylist(
                     vals, t0.types[c], s.capacity))
                 continue
+            if t0.types[c].is_string and len(tables) > 1:
+                # materialize FIRST: lazy tables (parquet) only build
+                # their dictionary on column access, so comparing dicts
+                # before the load sees None==None and would skip the
+                # remap
+                for t in tables:
+                    _ = t.arrays[c]
             if t0.types[c].is_string and len(tables) > 1 and any(
                     t.dicts.get(c) is not tables[0].dicts.get(c)
                     for t in tables[1:]):

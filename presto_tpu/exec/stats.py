@@ -43,9 +43,17 @@ def _detail(node) -> str:
 
 def render_analyzed(plan, node_map: Dict[int, tuple],
                     node_rows: Dict[int, int], wall_s: float,
-                    memory_bytes: int) -> str:
-    """Annotate the plan tree with executed row counts + footprints."""
-    by_identity = {id(n): (nid, cap) for nid, (n, cap) in node_map.items()}
+                    memory_bytes: int, alias: Dict[int, int] = None,
+                    island_profile=None) -> str:
+    """Annotate the plan tree with executed row counts + footprints.
+    `alias` maps island-copy node identities back to the user-facing
+    plan's nodes (island mode rebuilds subtrees with
+    dataclasses.replace); `island_profile` carries per-island wall
+    times — the per-operator profile fused execution cannot have."""
+    alias = alias or {}
+    by_identity = {}
+    for nid, (n, cap) in node_map.items():
+        by_identity[alias.get(id(n), id(n))] = (nid, cap)
     lines = []
 
     def walk(node, depth):
@@ -66,6 +74,14 @@ def render_analyzed(plan, node_map: Dict[int, tuple],
                 walk(c, depth + 1)
 
     walk(plan, 0)
+    if island_profile:
+        lines.append("-- island profile (one XLA program per heavy "
+                     "operator):")
+        for i, p in enumerate(island_profile):
+            lines.append(
+                f"   island {i}: {p['root']}  "
+                f"{p['seconds'] * 1000:.1f} ms  rows={p['rows']}  "
+                f"~{p['memory_bytes'] // (1 << 20)} MiB")
     lines.append(f"-- wall {wall_s * 1000:.1f} ms, "
                  f"plan footprint ~{memory_bytes // (1 << 20)} MiB")
     return "\n".join(lines)
@@ -83,10 +99,15 @@ def explain_analyze(engine, sql: str) -> str:
     compiled, ex._compiled = ex._compiled, {}
     try:
         t0 = time.perf_counter()
+        ex.last_node_rows = {}
+        ex._node_map = {}
         ex._execute_tree(plan)
         wall = time.perf_counter() - t0
-        return render_analyzed(plan, ex._node_map, ex.last_node_rows,
-                               wall, ex.last_memory_estimate)
+        return render_analyzed(
+            plan, ex._node_map, ex.last_node_rows, wall,
+            ex.last_memory_estimate,
+            alias=getattr(ex, "_island_alias", None),
+            island_profile=getattr(ex, "last_island_profile", None))
     finally:
         ex.session.values["collect_stats"] = old
         ex._compiled = compiled

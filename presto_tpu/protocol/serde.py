@@ -35,6 +35,12 @@ import numpy as np
 COMPRESSED = 1
 ENCRYPTED = 2
 CHECKSUMMED = 4
+#: codec id carried in the marker byte's spare high bits (engine
+#: extension; 0 = unmarked legacy frame -> magic-byte sniffing)
+_CODEC_SHIFT = 4
+_CODEC_BITS = {"zlib": 1 << _CODEC_SHIFT, "gzip": 2 << _CODEC_SHIFT,
+               "lz4": 3 << _CODEC_SHIFT}
+_CODEC_BY_ID = {1: "zlib", 2: "gzip", 3: "lz4"}
 
 
 @dataclasses.dataclass
@@ -342,6 +348,11 @@ def encode_serialized_page(blocks: List[WireBlock],
         if comp is not None and len(comp) < uncompressed:
             payload = comp             # keep raw when incompressible
             markers |= COMPRESSED
+            # codec id in the marker byte's spare bits (above
+            # COMPRESSED/ENCRYPTED/CHECKSUMMED) so the consumer decodes
+            # deterministically instead of sniffing magic bytes — an
+            # LZ4 block can begin with zlib's 0x78
+            markers |= _CODEC_BITS[compression]
     elif compression not in (None, "none", "zlib", "gzip", "lz4"):
         raise ValueError(f"unsupported exchange compression "
                          f"{compression!r}")
@@ -373,11 +384,22 @@ def _compress(payload: bytes, codec: str):
     return out
 
 
-def _decompress(payload: bytes, uncompressed: int) -> bytes:
-    """Codec auto-detection on the pull side (the consumer does not see
-    the producer's session): zlib/gzip by their magic bytes, LZ4 block
-    as the fallback — every path is validated against the frame's
-    declared uncompressed size afterwards."""
+def _decompress(payload: bytes, uncompressed: int,
+                codec: Optional[str] = None) -> bytes:
+    """Deterministic decode when the frame's marker bits name the codec;
+    magic-byte sniffing (zlib/gzip by magic, LZ4 block fallback) only
+    for unmarked legacy frames — every path is validated against the
+    frame's declared uncompressed size afterwards."""
+    if codec == "zlib":
+        return zlib.decompress(payload)
+    if codec == "gzip":
+        return zlib.decompress(payload, 31)
+    if codec == "lz4":
+        from presto_tpu import native
+        out = native.lz4_decompress(payload, uncompressed)
+        if out is None:
+            raise ValueError("lz4 frame but no native codec library")
+        return out
     if len(payload) >= 2 and payload[0] == 0x78:
         try:
             return zlib.decompress(payload)
@@ -410,7 +432,8 @@ def decode_serialized_page(data: bytes, offset: int = 0
         if want != checksum:
             raise ValueError(f"page checksum mismatch: {want} != {checksum}")
     if markers & COMPRESSED:
-        payload = _decompress(payload, uncompressed)
+        codec = _CODEC_BY_ID.get((markers >> _CODEC_SHIFT) & 0x3)
+        payload = _decompress(payload, uncompressed, codec)
         if len(payload) != uncompressed:
             raise ValueError(
                 f"decompressed size {len(payload)} != declared "

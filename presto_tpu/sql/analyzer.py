@@ -1932,6 +1932,24 @@ class Planner:
                                 _dec.Decimal(str(dv)), out_t.scale)
                         elif d.type.is_decimal:
                             default = float(dv)
+                        if out_t.is_integer:
+                            # a fractional (or non-numeric) default
+                            # would silently truncate/crash against an
+                            # integer arg column (the reference coerces
+                            # via a common super type or rejects at
+                            # analysis)
+                            try:
+                                as_dec = _dec.Decimal(str(dv))
+                                lossless = (as_dec.is_finite() and
+                                            as_dec ==
+                                            as_dec.to_integral_value())
+                            except _dec.InvalidOperation:
+                                lossless = False
+                            if not lossless:
+                                raise AnalysisError(
+                                    f"{kind}() default {dv!r} does not "
+                                    f"convert losslessly to {out_t}")
+                            default = int(as_dec)
             elif kind in _WINDOW_VALUE:
                 field = channel(fn.args[0])
                 out_t = ext_fields[field].type
