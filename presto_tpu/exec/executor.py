@@ -274,42 +274,103 @@ class Executor:
         return mini, children, base
 
     def _execute_islands(self, plan: PlanNode) -> Page:
-        run_memo: Dict[int, Page] = {}
+        """Optimistically dispatch the WHOLE island chain without
+        syncing any island's counters, then resolve them all once: K
+        islands cost one results-wait instead of K device round trips
+        (on the remote-TPU tunnel each sync is a full network round
+        trip — this is the per-island dispatch overhead the round-4
+        profile flagged). If any island's capacities grew (first
+        execution of a novel plan; learned caps persist), the chain
+        re-runs with the grown capacities."""
         profile = self.session["collect_stats"]
         self.last_island_profile: List[dict] = []
 
-        def run(node: PlanNode) -> Page:
-            if id(node) in run_memo:
-                return run_memo[id(node)]
-            self._check_deadline()
-            mini, children, base = self._island_of(node)
-            pages = [run(c) for c in children]
-            self._island_inputs = pages
-            self._stats_base = base
-            if profile:
-                # per-island wall time (block per island only under
-                # EXPLAIN ANALYZE — the serialization would otherwise
-                # cost async dispatch overlap): this is the join-plan
-                # profile the fused mode could never produce
-                import time as _t
-                t0 = _t.perf_counter()
-                out = self._execute_fused(mini)
-                jax.block_until_ready(out)   # Page is a pytree
-                self.last_island_profile.append({
-                    "root": type(node).__name__.replace("Node", ""),
-                    "seconds": _t.perf_counter() - t0,
-                    "rows": int(out.num_rows),
-                    "memory_bytes": self.last_memory_estimate,
-                })
-            else:
-                out = self._execute_fused(mini)
-            run_memo[id(node)] = out
-            return out
+        for _round in range(8):
+            run_memo: Dict[int, Page] = {}
+            pendings: List[dict] = []
 
-        try:
-            return run(plan)
-        finally:
-            self._stats_base = 0
+            def run(node: PlanNode) -> Page:
+                if id(node) in run_memo:
+                    return run_memo[id(node)]
+                self._check_deadline()
+                mini, children, base = self._island_of(node)
+                pages = [run(c) for c in children]
+                self._island_inputs = pages
+                self._stats_base = base
+                if profile:
+                    # EXPLAIN ANALYZE: block per island for true wall
+                    # times — the per-operator profile fused execution
+                    # cannot produce (profiling trades away the async
+                    # overlap, production runs keep it)
+                    import time as _t
+                    t0 = _t.perf_counter()
+                    out = self._execute_fused(mini)
+                    jax.block_until_ready(out)   # Page is a pytree
+                    self.last_island_profile.append({
+                        "root": type(node).__name__.replace("Node", ""),
+                        "seconds": _t.perf_counter() - t0,
+                        "rows": int(out.num_rows),
+                        "memory_bytes": self.last_memory_estimate,
+                    })
+                else:
+                    out, pending = self._dispatch_fused(mini)
+                    pendings.append(pending)
+                run_memo[id(node)] = out
+                return out
+
+            try:
+                result = run(plan)
+            finally:
+                self._stats_base = 0
+            if profile:
+                return result
+            resolved = self._await_counters(pendings)
+            # growth first across ALL islands: a truncated upstream
+            # island feeds garbage downstream, so downstream's deferred
+            # error lanes must not raise until a clean converged round
+            grew = False
+            for p, arr in zip(pendings, resolved):
+                if self._grow_caps(p, arr):
+                    grew = True
+            if not grew:
+                for p, arr in zip(pendings, resolved):
+                    self._finish_counters(p, arr)
+                return result
+            if self.memory_pool is not None:
+                # the failed round's buffers are unwound on re-run —
+                # release its reservations so retries never double-count
+                for p in pendings:
+                    self.memory_pool.free(self.pool_query_id,
+                                          p["pool_prev"])
+        raise RuntimeError("island capacity retry did not converge")
+
+    def _await_counters(self, pendings):
+        """Deadline-aware single wait for the whole island chain's
+        counters: the sync runs on a helper thread while the query's
+        time budget stays enforced (the chain dispatches in
+        milliseconds, so this wait is where the compute time actually
+        passes)."""
+        import numpy as _np
+        if getattr(self, "_deadline", None) is None:
+            return [_np.asarray(p["needed"]) for p in pendings]
+        import threading
+        box = {}
+        done = threading.Event()
+
+        def waiter():
+            try:
+                box["v"] = [_np.asarray(p["needed"]) for p in pendings]
+            except BaseException as e:   # noqa: BLE001 — re-raised below
+                box["e"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        while not done.wait(0.5):
+            self._check_deadline()
+        if "e" in box:
+            raise box["e"]
+        return box["v"]
 
     def _execute_tree(self, plan: PlanNode) -> Page:
         if self._use_islands(plan):
@@ -425,56 +486,88 @@ class Executor:
         except Exception:   # noqa: BLE001 — cache is best-effort
             pass
 
-    def _execute_fused(self, plan: PlanNode) -> Page:
-        # Learned capacities persist per plan: overflow retries and
-        # merge-join duplicate fallbacks are paid once, not per execution.
+    def _dispatch_fused(self, plan: PlanNode, pool_prev: int = 0):
+        """Lower + dispatch ONE program without syncing its counters.
+        Returns (out_page, pending) where `pending` resolves later via
+        `_resolve_counters` — island execution defers every island's
+        sync to the end of the chain, so K islands cost ONE wait for
+        results instead of K tunnel round-trips."""
         caps: Dict = self._learned.setdefault(plan, None)
         if caps is None:
             caps = self._learned[plan] = self._load_caps(plan)
+        # _lower is cheap (no tracing) and fills `caps` with its chosen
+        # capacities, which completes the compilation cache key.
+        fn, scans, watch = self._lower(plan, caps)
+        if self.memory_pool is not None:
+            # admission control: swap the PREVIOUS attempt's
+            # reservation for this one (capacity-grow retries must
+            # not double-count); islands of one query accumulate —
+            # their pages stay device-resident
+            self.memory_pool.free(self.pool_query_id, pool_prev)
+            self.memory_pool.reserve(self.pool_query_id,
+                                     self.last_memory_estimate)
+            pool_prev = self.last_memory_estimate
+        key = (plan, tuple(sorted(caps.items(), key=repr)),
+               bool(self.session["collect_stats"]))
+        entry = self._compiled.get(key)
+        if entry is None:
+            # stats_box is filled at this entry's first execution
+            # (trace time fixes the node-id order for its lifetime).
+            entry = (jax.jit(self._wrap(fn)), scans, watch, [])
+            self._compiled[key] = entry
+        fn, scans, watch, stats_box = entry
+        pages = [self._fetch(s) for s in scans]
+        self._stats_ids = []
+        out, needed = fn(pages)
+        if self._stats_ids and not stats_box:
+            stats_box.extend(self._stats_ids)
+        pending = {"plan": plan, "caps": caps, "watch": watch,
+                   "needed": needed, "stats_box": stats_box,
+                   "pool_prev": pool_prev}
+        return out, pending
+
+    def _grow_caps(self, pending, needed) -> bool:
+        """Apply observed capacity needs; True = re-run required."""
+        caps = pending["caps"]
+        grew = False
+        for nid, need in zip(pending["watch"], needed):
+            need = int(need)
+            if need > caps[nid]:
+                caps[nid] = bucket_capacity(need)
+                grew = True
+        return grew
+
+    def _finish_counters(self, pending, needed) -> None:
+        """Converged program: raise checked-arithmetic errors, record
+        stats, persist the learned capacities."""
+        from presto_tpu.expr import errors as _E
+        watch = pending["watch"]
+        _E.raise_for_mask(int(needed[len(watch)]))
+        stats_box = pending["stats_box"]
+        if stats_box:
+            stats = needed[len(watch) + 1:]
+            self.last_node_rows.update(
+                {nid: int(r) for nid, r in zip(stats_box, stats)})
+        self._save_caps(pending["plan"], pending["caps"])
+
+    def _resolve_counters(self, pending) -> bool:
+        """Sync + resolve one dispatched program (the single-program
+        path): returns True when a re-run is required."""
+        import numpy as _np
+        needed = _np.asarray(pending["needed"])   # the sync point
+        if self._grow_caps(pending, needed):
+            return True
+        self._finish_counters(pending, needed)
+        return False
+
+    def _execute_fused(self, plan: PlanNode) -> Page:
+        # Learned capacities persist per plan: overflow retries and
+        # merge-join duplicate fallbacks are paid once, not per execution.
         pool_prev = 0                 # this plan's live reservation
         for _attempt in range(8):
-            # _lower is cheap (no tracing) and fills `caps` with its chosen
-            # capacities, which completes the compilation cache key.
-            fn, scans, watch = self._lower(plan, caps)
-            if self.memory_pool is not None:
-                # admission control: swap the PREVIOUS attempt's
-                # reservation for this one (capacity-grow retries must
-                # not double-count); islands of one query accumulate —
-                # their pages stay device-resident
-                self.memory_pool.free(self.pool_query_id, pool_prev)
-                self.memory_pool.reserve(self.pool_query_id,
-                                         self.last_memory_estimate)
-                pool_prev = self.last_memory_estimate
-            key = (plan, tuple(sorted(caps.items(), key=repr)),
-                   bool(self.session["collect_stats"]))
-            entry = self._compiled.get(key)
-            if entry is None:
-                # stats_box is filled at this entry's first execution
-                # (trace time fixes the node-id order for its lifetime).
-                entry = (jax.jit(self._wrap(fn)), scans, watch, [])
-                self._compiled[key] = entry
-            fn, scans, watch, stats_box = entry
-            pages = [self._fetch(s) for s in scans]
-            self._stats_ids = []
-            out, needed = fn(pages)
-            if self._stats_ids and not stats_box:
-                stats_box.extend(self._stats_ids)
-            needed = __import__("numpy").asarray(needed)   # single sync
-            grew = False
-            for nid, need in zip(watch, needed):
-                need = int(need)
-                if need > caps[nid]:
-                    caps[nid] = bucket_capacity(need)
-                    grew = True
-            if not grew:
-                from presto_tpu.expr import errors as _E
-                _E.raise_for_mask(int(needed[len(watch)]))
-                if stats_box:
-                    stats = needed[len(watch) + 1:]
-                    self.last_node_rows.update(
-                        {nid: int(r)
-                         for nid, r in zip(stats_box, stats)})
-                self._save_caps(plan, caps)
+            out, pending = self._dispatch_fused(plan, pool_prev)
+            pool_prev = pending["pool_prev"]
+            if not self._resolve_counters(pending):
                 return out
         raise RuntimeError("capacity retry loop did not converge")
 
