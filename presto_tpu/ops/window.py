@@ -16,8 +16,8 @@ with frames:
   - default  : RANGE UNBOUNDED PRECEDING..CURRENT ROW (peer-aware) with
                ORDER BY, whole partition without (SQL default)
   - ROWS     : any BETWEEN of UNBOUNDED/N PRECEDING/CURRENT/N FOLLOWING
-               (min/max: one side must be unbounded — a both-bounded
-               sliding min has no prefix-scan form; cleanly rejected)
+               (min/max over a both-bounded frame uses a sparse-table
+               range-extreme: log2(n) doubling levels, 2 gathers/row)
   - RANGE    : UNBOUNDED/CURRENT bounds (value-offset RANGE rejected)
 """
 
@@ -327,9 +327,54 @@ def window_page(page: Page, partition_fields: Sequence[int],
                     run = jnp.flip(rrun)
                     w = jnp.take(run, loc, mode="clip")
                 else:
-                    raise NotImplementedError(
-                        f"{kind} over a frame bounded on both sides "
-                        "(no prefix-scan form; use an unbounded side)")
+                    # both-bounded frame: sparse-table range extreme
+                    # (the RMQ construction) — doubling levels built
+                    # once, every row's [lo, hi] answered with two
+                    # gathers at its level. Queries never cross
+                    # partitions (frame bounds are intra-partition and
+                    # each lookup spans <= frame length). The frame's
+                    # STATIC offsets bound the longest query, so only
+                    # log2(max frame length) levels exist — not
+                    # log2(cap).
+                    f = spec.frame
+                    max_ln = int(cap)
+                    if f is not None and f.start_n is not None \
+                            and f.end_n is not None:
+                        span = 0
+                        span += (int(f.start_n)
+                                 if f.start_type == "preceding"
+                                 else -int(f.start_n))
+                        span += (int(f.end_n)
+                                 if f.end_type == "following"
+                                 else -int(f.end_n))
+                        max_ln = max(span + 1, 1)
+                    elif f is not None and (
+                            f.start_type == "current"
+                            or f.end_type == "current"):
+                        n_side = f.end_n if f.start_type == "current" \
+                            else f.start_n
+                        if n_side is not None:
+                            max_ln = int(n_side) + 1
+                    max_ln = min(max_ln, int(cap))
+                    L = max(int(max_ln - 1).bit_length(), 1)
+                    levels = [masked]
+                    for j in range(1, L + 1):
+                        prev = levels[-1]
+                        off = 1 << (j - 1)
+                        shifted = jnp.concatenate(
+                            [prev[off:],
+                             jnp.full((off,), ident, prev.dtype)])
+                        levels.append(binop(prev, shifted))
+                    table = jnp.stack(levels)          # [L+1, cap]
+                    ln = jnp.maximum(hi - lo + 1, 1)
+                    k = jnp.zeros_like(ln)
+                    for j in range(1, L + 1):
+                        k = k + (ln >= (1 << j)).astype(ln.dtype)
+                    pow_k = jnp.left_shift(
+                        jnp.asarray(1, ln.dtype), k)
+                    left = table[k, loc]
+                    right = table[k, clipi(hi - pow_k + 1)]
+                    w = binop(left, right)
                 wn = n == 0
                 w = jnp.where(wn, ident, w)
         else:

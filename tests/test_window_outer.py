@@ -206,8 +206,23 @@ def test_window_frames_vs_sqlite(engine, oracle, sql):  # noqa: F811
     check(engine, oracle, sql)
 
 
-def test_bounded_minmax_frame_rejected(engine):
-    with pytest.raises(Exception, match="bounded|NotImplemented"):
-        engine.execute_sql(
-            "select min(n_nationkey) over (order by n_nationkey "
-            "rows between 2 preceding and 2 following) from nation")
+BOUNDED_MINMAX_QUERIES = [
+    # both-bounded sliding min/max: sparse-table range extremes
+    "select n_regionkey, min(n_nationkey) over (partition by n_regionkey "
+    "order by n_name rows between 2 preceding and 2 following) "
+    "from nation",
+    "select max(s_acctbal) over (order by s_suppkey "
+    "rows between 3 preceding and 1 following) from supplier",
+    "select min(s_acctbal) over (partition by s_nationkey "
+    "order by s_suppkey rows between 1 preceding and 4 following) "
+    "from supplier",
+    "select max(o_totalprice) over (order by o_orderkey "
+    "rows between 5 preceding and 2 preceding) from orders",
+    "select min(c_acctbal) over (partition by c_nationkey order by "
+    "c_custkey rows between 2 following and 7 following) from customer",
+]
+
+
+@pytest.mark.parametrize("sql", BOUNDED_MINMAX_QUERIES)
+def test_bounded_minmax_frames_vs_sqlite(engine, oracle, sql):  # noqa: F811
+    check(engine, oracle, sql)
