@@ -1,0 +1,427 @@
+"""Iterative rule-based optimizer with a memo table.
+
+Reference role: presto-main-base/.../sql/planner/iterative/
+IterativeOptimizer.java + Memo.java and the rule library under
+sql/planner/iterative/rule/ — a fixpoint driver that applies local
+rewrite rules until no rule fires, with structural memoization so
+equivalent subtrees are explored once. The hand-written planner passes
+(pushdown, pruning, join ordering, decorrelation) cover the TPC shapes;
+this engine generalizes them for arbitrary SQL the way the reference
+does: every simplification is a small independent rule, and the driver
+owns termination.
+
+TPU relevance: fewer/tighter plan nodes means fewer lowered ops and
+smaller XLA programs — constant folding and filter/project fusion
+happen BEFORE tracing, so the compiler never sees the dead work.
+
+Design notes vs the reference:
+- the Memo here is a hash-consing table (structural repr -> canonical
+  node) plus a per-instance explored set, not a group-reference DAG:
+  plans are immutable dataclasses, so "replace group binding" is just
+  rebuilding the spine, and equal subtrees collapse to one instance;
+- rules return None for "no match" exactly like Rule.Result.empty().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from presto_tpu.expr.nodes import (
+    Call, Form, InputRef, Literal, RowExpression, SpecialForm,
+)
+from presto_tpu.plan import nodes as P
+from presto_tpu.types import BOOLEAN
+
+
+# --------------------------------------------------------------- helpers
+def _replace_source(node: P.PlanNode, new_source: P.PlanNode):
+    return dataclasses.replace(node, source=new_source)
+
+
+def _substitute(e: RowExpression,
+                bindings: Tuple[RowExpression, ...]) -> RowExpression:
+    """Rewrite InputRefs through a projection's expressions (the
+    inline-projection substitution every push-through rule needs)."""
+    if isinstance(e, InputRef):
+        return bindings[e.field]
+    if isinstance(e, Call):
+        return dataclasses.replace(
+            e, args=tuple(_substitute(a, bindings) for a in e.args))
+    if isinstance(e, SpecialForm):
+        return dataclasses.replace(
+            e, args=tuple(_substitute(a, bindings) for a in e.args))
+    return e
+
+
+def _expr_size(e: RowExpression) -> int:
+    return 1 + sum(_expr_size(c) for c in e.children())
+
+
+def _refs(e: RowExpression, out: Dict[int, int]) -> Dict[int, int]:
+    if isinstance(e, InputRef):
+        out[e.field] = out.get(e.field, 0) + 1
+    for c in e.children():
+        _refs(c, out)
+    return out
+
+
+_TRUE = Literal(True, BOOLEAN)
+
+
+def _is_literal(e, value=None) -> bool:
+    return isinstance(e, Literal) and (value is None or e.value == value)
+
+
+# ----------------------------------------------------------------- rules
+class Rule:
+    """pattern: the PlanNode subclass this rule inspects (Rule.getPattern
+    role); apply returns the replacement or None (Result.empty)."""
+
+    pattern: type = P.PlanNode
+    name: str = "rule"
+
+    def apply(self, node: P.PlanNode) -> Optional[P.PlanNode]:
+        raise NotImplementedError
+
+
+class EliminateIdentityProject(Rule):
+    """Project emitting exactly its input (RemoveRedundantIdentityProjections)."""
+
+    pattern = P.ProjectNode
+    name = "eliminate_identity_project"
+
+    def apply(self, node):
+        src = node.source
+        if (len(node.expressions) == len(src.output_types)
+                and node.output_names == src.output_names
+                and all(isinstance(e, InputRef) and e.field == i
+                        for i, e in enumerate(node.expressions))):
+            return src
+        return None
+
+
+class MergeFilters(Rule):
+    """Filter(Filter(s, p1), p2) -> Filter(s, p2 AND p1)
+    (MergeFilters.java)."""
+
+    pattern = P.FilterNode
+    name = "merge_filters"
+
+    def apply(self, node):
+        if not isinstance(node.source, P.FilterNode):
+            return None
+        inner = node.source
+        combined = SpecialForm(Form.AND,
+                               (inner.predicate, node.predicate), BOOLEAN)
+        return P.FilterNode(node.output_names, node.output_types,
+                            source=inner.source, predicate=combined)
+
+
+class RemoveTrivialFilter(Rule):
+    """TRUE predicate -> drop the filter; FALSE/NULL -> empty values
+    (RemoveTrivialFilters.java)."""
+
+    pattern = P.FilterNode
+    name = "remove_trivial_filter"
+
+    def apply(self, node):
+        p = node.predicate
+        if _is_literal(p, True):
+            return node.source
+        if isinstance(p, Literal) and (p.value is None or
+                                       p.value is False):
+            return P.ValuesNode(node.output_names, node.output_types,
+                                rows=())
+        return None
+
+
+class MergeProjects(Rule):
+    """Project(Project(s, inner), outer) -> Project(s, outer o inner)
+    (InlineProjections.java), guarded against expression blow-up when a
+    non-trivial inner expression is referenced more than once."""
+
+    pattern = P.ProjectNode
+    name = "merge_projects"
+
+    def apply(self, node):
+        if not isinstance(node.source, P.ProjectNode):
+            return None
+        inner = node.source
+        counts: Dict[int, int] = {}
+        for e in node.expressions:
+            _refs(e, counts)
+        for f, n in counts.items():
+            if n > 1 and not isinstance(
+                    inner.expressions[f], (InputRef, Literal)):
+                return None
+        merged = tuple(_substitute(e, inner.expressions)
+                       for e in node.expressions)
+        return P.ProjectNode(node.output_names, node.output_types,
+                             source=inner.source, expressions=merged)
+
+
+class PushFilterThroughProject(Rule):
+    """Filter(Project(s, es), p) -> Project(Filter(s, p[es]), es)
+    (PushDownFilterThroughProject role): lets the filter keep sinking
+    toward the scan the hand-written pushdown pass feeds on."""
+
+    pattern = P.FilterNode
+    name = "push_filter_through_project"
+
+    def apply(self, node):
+        if not isinstance(node.source, P.ProjectNode):
+            return None
+        proj = node.source
+        pred = _substitute(node.predicate, proj.expressions)
+        if _expr_size(pred) > 4 * _expr_size(node.predicate) + 8:
+            return None                 # substitution blow-up guard
+        filtered = P.FilterNode(proj.source.output_names,
+                                proj.source.output_types,
+                                source=proj.source, predicate=pred)
+        return dataclasses.replace(proj, source=filtered)
+
+
+class PushLimitThroughProject(Rule):
+    """Limit(Project) -> Project(Limit) (PushLimitThroughProject.java)."""
+
+    pattern = P.LimitNode
+    name = "push_limit_through_project"
+
+    def apply(self, node):
+        if not isinstance(node.source, P.ProjectNode):
+            return None
+        proj = node.source
+        limited = P.LimitNode(proj.source.output_names,
+                              proj.source.output_types,
+                              source=proj.source, count=node.count)
+        return dataclasses.replace(proj, source=limited)
+
+
+class MergeLimits(Rule):
+    """Limit(Limit(s, a), b) -> Limit(s, min(a, b)) (MergeLimits.java)."""
+
+    pattern = P.LimitNode
+    name = "merge_limits"
+
+    def apply(self, node):
+        if not isinstance(node.source, P.LimitNode):
+            return None
+        inner = node.source
+        return P.LimitNode(node.output_names, node.output_types,
+                           source=inner.source,
+                           count=min(node.count, inner.count))
+
+
+class SortLimitToTopN(Rule):
+    """Limit(Sort) -> TopN (MergeLimitWithSort.java) — the shape the
+    TPU top_n kernel wants (bounded output, single pass)."""
+
+    pattern = P.LimitNode
+    name = "sort_limit_to_topn"
+
+    def apply(self, node):
+        if not isinstance(node.source, P.SortNode):
+            return None
+        s = node.source
+        return P.TopNNode(node.output_names, node.output_types,
+                          source=s.source, keys=s.keys, count=node.count)
+
+
+class EvaluateConstantExpressions(Rule):
+    """Fold literal-only scalar subexpressions inside Filter predicates
+    (SimplifyExpressions.java's constant folding, on the safe subset:
+    comparisons, boolean forms, integer add/subtract/multiply within
+    int64, negation). Folding happens BEFORE tracing, so XLA never
+    compiles the dead branches."""
+
+    pattern = P.FilterNode
+    name = "fold_constants"
+
+    _CMP = {"eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+            "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+            "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b}
+    _ARITH = {"add": lambda a, b: a + b,
+              "subtract": lambda a, b: a - b,
+              "multiply": lambda a, b: a * b}
+
+    def _fold(self, e: RowExpression) -> RowExpression:
+        if isinstance(e, Call):
+            args = tuple(self._fold(a) for a in e.args)
+            e = dataclasses.replace(e, args=args)
+            if all(isinstance(a, Literal) for a in args):
+                vals = [a.value for a in args]
+                if any(v is None for v in vals):
+                    return e           # NULL semantics stay runtime
+                if e.name in self._CMP and len(vals) == 2 \
+                        and not any(isinstance(v, str) for v in vals):
+                    return Literal(bool(self._CMP[e.name](*vals)),
+                                   BOOLEAN)
+                if e.name in self._ARITH and len(vals) == 2 and all(
+                        isinstance(v, int) and not isinstance(v, bool)
+                        for v in vals):
+                    r = self._ARITH[e.name](*vals)
+                    if -(2 ** 63) <= r < 2 ** 63:
+                        return Literal(r, e.type)
+                if e.name == "not" and isinstance(vals[0], bool):
+                    return Literal(not vals[0], BOOLEAN)
+            return e
+        if isinstance(e, SpecialForm):
+            args = tuple(self._fold(a) for a in e.args)
+            e = dataclasses.replace(e, args=args)
+            if e.form == Form.AND:
+                if any(_is_literal(a, False) for a in args):
+                    return Literal(False, BOOLEAN)
+                live = tuple(a for a in args if not _is_literal(a, True))
+                if not live:
+                    return _TRUE
+                if len(live) == 1:
+                    return live[0]
+                if len(live) != len(args):
+                    return dataclasses.replace(e, args=live)
+            if e.form == Form.OR:
+                if any(_is_literal(a, True) for a in args):
+                    return Literal(True, BOOLEAN)
+                live = tuple(a for a in args
+                             if not _is_literal(a, False))
+                if not live:
+                    return Literal(False, BOOLEAN)
+                if len(live) == 1:
+                    return live[0]
+                if len(live) != len(args):
+                    return dataclasses.replace(e, args=live)
+            return e
+        return e
+
+    def apply(self, node):
+        folded = self._fold(node.predicate)
+        if folded is node.predicate or folded == node.predicate:
+            return None
+        return dataclasses.replace(node, predicate=folded)
+
+
+class RemoveLimitOverValues(Rule):
+    """Limit over inline VALUES evaluates at plan time
+    (EvaluateZeroLimit + the values-local slice)."""
+
+    pattern = P.LimitNode
+    name = "limit_over_values"
+
+    def apply(self, node):
+        if node.count == 0:
+            return P.ValuesNode(node.output_names, node.output_types,
+                                rows=())
+        if isinstance(node.source, P.ValuesNode) \
+                and len(node.source.rows) > node.count:
+            return P.ValuesNode(node.output_names, node.output_types,
+                                rows=node.source.rows[:node.count])
+        return None
+
+
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    EvaluateConstantExpressions(),
+    RemoveTrivialFilter(),
+    MergeFilters(),
+    PushFilterThroughProject(),
+    MergeProjects(),
+    EliminateIdentityProject(),
+    MergeLimits(),
+    RemoveLimitOverValues(),
+    SortLimitToTopN(),
+    PushLimitThroughProject(),
+)
+
+
+# ---------------------------------------------------------------- driver
+class Memo:
+    """Hash-consing table: structurally equal subtrees collapse to one
+    canonical instance (Memo.java's group sharing, expressed over
+    immutable dataclasses), and each canonical node is explored once
+    per optimization run."""
+
+    def __init__(self):
+        self._canon: Dict[str, P.PlanNode] = {}
+        self.explored: set = set()
+
+    def canonical(self, node: P.PlanNode) -> P.PlanNode:
+        key = repr(node)
+        got = self._canon.get(key)
+        if got is None:
+            self._canon[key] = node
+            return node
+        return got
+
+
+class IterativeOptimizer:
+    """Bottom-up fixpoint driver (IterativeOptimizer.java): rewrite
+    children first, try every matching rule at each node, restart at a
+    node whenever a rule fires, stop at a global fixpoint or the
+    iteration budget. `trace` records (rule, node) firings for EXPLAIN
+    and tests."""
+
+    def __init__(self, rules: Tuple[Rule, ...] = DEFAULT_RULES,
+                 max_iterations: int = 10_000):
+        self.rules = rules
+        self.max_iterations = max_iterations
+
+    def optimize(self, plan: P.PlanNode,
+                 trace: Optional[List[Tuple[str, str]]] = None
+                 ) -> P.PlanNode:
+        memo = Memo()
+        budget = [self.max_iterations]
+        by_pattern: Dict[type, List[Rule]] = {}
+        for r in self.rules:
+            by_pattern.setdefault(r.pattern, []).append(r)
+
+        def rules_for(node):
+            out = []
+            for klass, rs in by_pattern.items():
+                if isinstance(node, klass):
+                    out.extend(rs)
+            return out
+
+        def rewrite(node: P.PlanNode) -> P.PlanNode:
+            if node is None:
+                return None
+            node = memo.canonical(node)
+            if id(node) in memo.explored:
+                return node
+            # children first (ExploreGroup recursion)
+            kids = node.children()
+            if kids:
+                new_kids = tuple(rewrite(c) for c in kids)
+                if any(a is not b for a, b in zip(kids, new_kids)):
+                    if isinstance(node, P.JoinNode):
+                        node = dataclasses.replace(
+                            node, probe=new_kids[0], build=new_kids[1])
+                    elif isinstance(node, P.UnionAllNode):
+                        node = dataclasses.replace(node,
+                                                   sources=new_kids)
+                    else:
+                        node = _replace_source(node, new_kids[0])
+                    node = memo.canonical(node)
+            progress = True
+            while progress and budget[0] > 0:
+                progress = False
+                for rule in rules_for(node):
+                    budget[0] -= 1
+                    replacement = rule.apply(node)
+                    if replacement is None:
+                        continue
+                    if trace is not None:
+                        trace.append(
+                            (rule.name,
+                             type(replacement).__name__))
+                    # a fired rule exposes new matches above AND below:
+                    # re-descend into the replacement
+                    node = rewrite(memo.canonical(replacement))
+                    progress = True
+                    break
+            memo.explored.add(id(node))
+            return node
+
+        return rewrite(plan)
+
+
+#: process-default optimizer (rule set is stateless)
+DEFAULT_OPTIMIZER = IterativeOptimizer()

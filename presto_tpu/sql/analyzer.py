@@ -330,8 +330,17 @@ class Planner:
     # ================================================================ FROM
     def plan_query(self, q: ast.Select) -> PlanNode:
         rp = self._plan_select(q)
-        return OutputNode(tuple(f.name for f in rp.fields),
+        plan = OutputNode(tuple(f.name for f in rp.fields),
                           tuple(f.type for f in rp.fields), rp.node)
+        # iterative rule engine over the planned tree (reference:
+        # sql/planner/iterative/IterativeOptimizer.java driving the rule
+        # library to fixpoint after the structural planning passes);
+        # PRESTO_TPU_NO_ITERATIVE=1 opts out for debugging
+        import os as _os
+        if not _os.environ.get("PRESTO_TPU_NO_ITERATIVE"):
+            from presto_tpu.plan.iterative import DEFAULT_OPTIMIZER
+            plan = DEFAULT_OPTIMIZER.optimize(plan)
+        return plan
 
     def _plan_select(self, q: ast.Select) -> RelationPlan:
         if q.ctes:
@@ -2426,6 +2435,9 @@ class Planner:
         pf = _plugins.get_function(name)
         if pf is not None:
             return Call(name, args, pf.return_type)
+        rf = _plugins.get_remote_function(name)
+        if rf is not None:
+            return Call(name, args, rf.return_type)
         raise AnalysisError(f"unknown function {name}")
 
 

@@ -1,0 +1,123 @@
+"""Iterative rule engine + memo (round-5; reference:
+sql/planner/iterative/IterativeOptimizer.java + Memo.java and the rule
+library): rules fire to fixpoint, plans simplify structurally, and
+results never change."""
+
+import pytest
+
+from presto_tpu.connectors import MemoryConnector, TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.expr.nodes import Call, InputRef, Literal, SpecialForm
+from presto_tpu.plan import nodes as P
+from presto_tpu.plan.iterative import (
+    DEFAULT_RULES, IterativeOptimizer, Memo,
+)
+from presto_tpu.types import BIGINT, BOOLEAN
+
+
+def _scan():
+    return P.TableScanNode(("a", "b"), (BIGINT, BIGINT),
+                           table="t", columns=("a", "b"))
+
+
+def _opt(plan, trace=None):
+    return IterativeOptimizer(DEFAULT_RULES).optimize(plan, trace=trace)
+
+
+def test_merge_filters_and_fold_constants():
+    s = _scan()
+    p1 = Call("gt", (InputRef(0, BIGINT), Literal(2, BIGINT)), BOOLEAN)
+    true_pred = Call("eq", (Literal(3, BIGINT),
+                            Call("add", (Literal(1, BIGINT),
+                                         Literal(2, BIGINT)), BIGINT)),
+                     BOOLEAN)
+    plan = P.FilterNode(s.output_names, s.output_types,
+                        source=P.FilterNode(s.output_names,
+                                            s.output_types,
+                                            source=s, predicate=p1),
+                        predicate=true_pred)
+    trace = []
+    out = _opt(plan, trace)
+    # 3 = 1+2 folds to TRUE, the trivial filter drops, one filter stays
+    assert isinstance(out, P.FilterNode) and out.source is not plan
+    assert isinstance(out.source, P.TableScanNode)
+    assert out.predicate == p1
+    assert any(r == "fold_constants" for r, _ in trace)
+
+
+def test_false_filter_becomes_empty_values():
+    s = _scan()
+    plan = P.FilterNode(s.output_names, s.output_types, source=s,
+                        predicate=Literal(False, BOOLEAN))
+    out = _opt(plan)
+    assert isinstance(out, P.ValuesNode) and out.rows == ()
+
+
+def test_sort_limit_fuses_to_topn_through_project():
+    from presto_tpu.plan.nodes import SortKey
+    s = _scan()
+    srt = P.SortNode(s.output_names, s.output_types, source=s,
+                     keys=(SortKey(0, True),))
+    proj = P.ProjectNode(("a",), (BIGINT,), source=srt,
+                         expressions=(InputRef(0, BIGINT),))
+    plan = P.LimitNode(("a",), (BIGINT,), source=proj, count=5)
+    out = _opt(plan)
+    # limit pushes through the projection and fuses with the sort
+    assert isinstance(out, P.ProjectNode)
+    assert isinstance(out.source, P.TopNNode)
+    assert out.source.count == 5
+
+
+def test_identity_project_eliminated_and_projects_merge():
+    s = _scan()
+    ident = P.ProjectNode(s.output_names, s.output_types, source=s,
+                          expressions=(InputRef(0, BIGINT),
+                                       InputRef(1, BIGINT)))
+    outer = P.ProjectNode(("x",), (BIGINT,), source=ident,
+                          expressions=(
+                              Call("add", (InputRef(0, BIGINT),
+                                           InputRef(1, BIGINT)),
+                                   BIGINT),))
+    out = _opt(outer)
+    assert isinstance(out, P.ProjectNode)
+    assert isinstance(out.source, P.TableScanNode)
+
+
+def test_memo_hash_conses_equal_subtrees():
+    m = Memo()
+    a = _scan()
+    b = _scan()
+    assert a is not b
+    assert m.canonical(a) is m.canonical(b)
+
+
+def test_fixpoint_terminates_on_deep_stacks():
+    s = _scan()
+    plan = s
+    for i in range(60):
+        plan = P.LimitNode(s.output_names, s.output_types,
+                           source=plan, count=100 - i)
+    out = _opt(plan)
+    assert isinstance(out, P.LimitNode)
+    assert isinstance(out.source, P.TableScanNode)
+    assert out.count == 41          # min of the stack
+
+
+@pytest.mark.parametrize("sql", [
+    "select n_name from nation where n_regionkey = 1 and 1 = 1",
+    "select n_name, n_regionkey + 0 from nation where 2 > 1 "
+    "order by n_name limit 3",
+    "select count(*) from lineitem where l_quantity < 10 and 5 = 2 + 3",
+    "select * from region where 1 = 2",
+])
+def test_results_unchanged_with_optimizer(sql):
+    import os
+    eng_on = LocalEngine(TpchConnector(0.01))
+    got = eng_on.execute_sql(sql)
+    os.environ["PRESTO_TPU_NO_ITERATIVE"] = "1"
+    try:
+        eng_off = LocalEngine(TpchConnector(0.01))
+        exp = eng_off.execute_sql(sql)
+    finally:
+        del os.environ["PRESTO_TPU_NO_ITERATIVE"]
+    assert got == exp
