@@ -1,0 +1,191 @@
+"""Mergeable t-digest quantile sketches, reference wire layout.
+
+Reference role: presto-main-base/.../tdigest/TDigest.java — the
+mergeable quantile sketch behind approx_percentile's cross-engine
+partial states (this engine's in-fragment approx_percentile stays the
+exact sorted-run quantile, which dominates on-device; this module is
+the interchange form so partials can cross an engine boundary).
+
+Algorithm: Dunning's merging t-digest (public design): incoming values
+buffer, and compression merge-sorts buffered values with existing
+centroids, closing a centroid whenever the k-scale budget
+k(q) = (delta / (2 pi)) * asin(2q - 1) advances by one unit — small
+centroids at the distribution tails, big ones in the middle, which is
+what bounds relative quantile error at the extremes.
+
+Wire layout (little-endian, matching TDigest.java serialize()):
+    byte    version (1)
+    byte    value type (0 = double)
+    double  min, max, sum, compression, totalWeight
+    int     activeCentroids
+    double[activeCentroids] weights
+    double[activeCentroids] means
+Version-0 frames (no `sum` field) deserialize too.
+"""
+
+import math
+import struct
+from typing import List, Optional, Tuple
+
+_BUFFER = 5
+
+
+class TDigest:
+    def __init__(self, compression: float = 100.0):
+        if compression < 10:
+            compression = 10.0
+        self.compression = float(compression)
+        self.min = math.inf
+        self.max = -math.inf
+        self.sum = 0.0
+        self.total_weight = 0.0
+        self._centroids: List[Tuple[float, float]] = []  # (mean, weight)
+        self._buffer: List[Tuple[float, float]] = []
+
+    # ------------------------------------------------------------ build
+    def add(self, value: float, weight: float = 1.0) -> None:
+        if math.isnan(value):
+            raise ValueError("cannot add NaN to t-digest")
+        self._buffer.append((float(value), float(weight)))
+        self.sum += value * weight
+        self.total_weight += weight
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if len(self._buffer) >= _BUFFER * self.compression:
+            self._compress()
+
+    def merge(self, other: "TDigest") -> "TDigest":
+        other._compress()
+        for mean, w in other._centroids:
+            self._buffer.append((mean, w))
+        self.sum += other.sum
+        self.total_weight += other.total_weight
+        if other.total_weight:
+            self.min = min(self.min, other.min)
+            self.max = max(self.max, other.max)
+        self._compress()
+        return self
+
+    def _k(self, q: float) -> float:
+        q = min(max(q, 0.0), 1.0)
+        return self.compression / (2 * math.pi) * math.asin(2 * q - 1)
+
+    def _compress(self) -> None:
+        if not self._buffer:
+            return
+        pts = sorted(self._centroids + self._buffer)
+        self._buffer = []
+        total = sum(w for _m, w in pts)
+        out: List[Tuple[float, float]] = []
+        cur_m, cur_w = pts[0]
+        seen = 0.0
+        k_lo = self._k(0.0)
+        for mean, w in pts[1:]:
+            q_next = (seen + cur_w + w) / total
+            if self._k(q_next) - k_lo <= 1.0:
+                # merge into the open centroid (weighted mean)
+                cur_m = (cur_m * cur_w + mean * w) / (cur_w + w)
+                cur_w += w
+            else:
+                out.append((cur_m, cur_w))
+                seen += cur_w
+                k_lo = self._k(seen / total)
+                cur_m, cur_w = mean, w
+        out.append((cur_m, cur_w))
+        self._centroids = out
+
+    # ------------------------------------------------------------ query
+    def quantile(self, q: float) -> Optional[float]:
+        """value_at_quantile semantics: interpolated between centroid
+        means, clamped by the exact observed min/max."""
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"quantile must be in [0, 1]: {q}")
+        self._compress()
+        if not self._centroids:
+            return None
+        if q <= 0:
+            return self.min
+        if q >= 1:
+            return self.max
+        target = q * self.total_weight
+        seen = 0.0
+        prev_mean, prev_mid = self.min, 0.0
+        for mean, w in self._centroids:
+            # a heavy centroid owns its interior: a target inside its
+            # mass (beyond the half-unit shared with each neighbor)
+            # returns the mean exactly (Dunning's singleton rule, which
+            # keeps e.g. a 97-weight centroid's median at its mean)
+            if w > 1 and seen + 0.5 <= target <= seen + w - 0.5:
+                return mean
+            mid = seen + w / 2.0
+            if target < mid:
+                if mid == prev_mid:
+                    return mean
+                f = (target - prev_mid) / (mid - prev_mid)
+                return prev_mean + f * (mean - prev_mean)
+            prev_mean, prev_mid = mean, mid
+            seen += w
+        f_last = self._centroids[-1]
+        span = self.total_weight - prev_mid
+        if span <= 0:
+            return f_last[0]
+        f = (target - prev_mid) / span
+        return prev_mean + f * (self.max - prev_mean)
+
+    def centroid_count(self) -> int:
+        self._compress()
+        return len(self._centroids)
+
+    # -------------------------------------------------------------- wire
+    def serialize(self) -> bytes:
+        self._compress()
+        out = bytearray()
+        out += struct.pack("<bb", 1, 0)
+        out += struct.pack("<ddddd", self.min, self.max, self.sum,
+                           self.compression, self.total_weight)
+        out += struct.pack("<i", len(self._centroids))
+        for _m, w in self._centroids:
+            out += struct.pack("<d", w)
+        for m, _w in self._centroids:
+            out += struct.pack("<d", m)
+        return bytes(out)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "TDigest":
+        version, vtype = struct.unpack_from("<bb", data, 0)
+        if version not in (0, 1):
+            raise ValueError(f"bad t-digest version {version}")
+        if vtype != 0:
+            raise ValueError(f"unsupported t-digest value type {vtype}")
+        off = 2
+        mn, mx = struct.unpack_from("<dd", data, off)
+        off += 16
+        s = 0.0
+        if version == 1:
+            (s,) = struct.unpack_from("<d", data, off)
+            off += 8
+        comp, total = struct.unpack_from("<dd", data, off)
+        off += 16
+        (n,) = struct.unpack_from("<i", data, off)
+        off += 4
+        weights = struct.unpack_from(f"<{n}d", data, off)
+        off += 8 * n
+        means = struct.unpack_from(f"<{n}d", data, off)
+        d = TDigest(max(comp, 10.0))
+        d.min, d.max, d.sum, d.total_weight = mn, mx, s, total
+        d._centroids = [(m, w) for m, w in zip(means, weights)]
+        for m, w in d._centroids:
+            if math.isnan(m) or w <= 0:
+                raise ValueError("corrupt t-digest frame")
+        return d
+
+
+def merge_serialized(frames) -> bytes:
+    """merge_tdigest aggregation role: fold serialized partials."""
+    frames = list(frames)
+    if not frames:
+        raise ValueError("nothing to merge")
+    acc = TDigest.deserialize(frames[0])
+    for f in frames[1:]:
+        acc.merge(TDigest.deserialize(f))
+    return acc.serialize()
