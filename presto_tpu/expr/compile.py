@@ -1108,4 +1108,85 @@ def _call(e: Call, page: Page, ev) -> Column:
         for c in cols:
             nulls = nulls | c.nulls     # NULL propagates
         return Column(jnp.asarray(v), nulls, e.type)
+    rf = _plugins.get_remote_function(name)
+    if rf is not None:
+        cols = [ev(a, page) for a in e.args]
+        return _remote_function_call(rf, cols, e.type, page)
     raise NotImplementedError(f"function {name}")
+
+
+def _remote_function_call(rf, cols, rt: Type, page: Page) -> Column:
+    """Evaluate a sidecar-served scalar function (reference:
+    RemoteFunctionRegisterer + RemoteProjectOperator): the compiled
+    program calls the host through jax.pure_callback at run time, the
+    host POSTs the page's argument values as JSON to the function's
+    REST endpoint and feeds the response back into the program — shapes
+    stay static, the call site stays inside the fragment."""
+    import jax
+
+    cap = page.capacity
+    out_dtype = rt.dtype
+    dictionaries = [c.dictionary for c in cols]
+    # decimals travel as LOGICAL values (unscaled ints would be wrong
+    # by 10^scale on the sidecar side — same default as
+    # ScalarFunction.descale_decimals)
+    scales = [c.type.scale if c.type.is_decimal else None for c in cols]
+    sentinel = rt.null_sentinel()
+    if rt.is_decimal:
+        raise NotImplementedError(
+            f"remote function {rf.name!r}: DECIMAL return types are "
+            "not supported (no exact wire form); return DOUBLE")
+
+    def host(num_rows, *flat):
+        import json as _json
+        import urllib.request
+        n = int(num_rows)
+        values, nullcols = [], []
+        for i in range(0, len(flat), 2):
+            arr, nl = flat[i][:n], flat[i + 1][:n]
+            d = dictionaries[i // 2]
+            sc = scales[i // 2]
+            if d is not None:
+                words = d.words
+                col_vals = [None if nl[j] else words[int(arr[j])]
+                            for j in range(n)]
+            elif sc is not None:
+                col_vals = [None if nl[j]
+                            else arr[j].item() / (10 ** sc)
+                            for j in range(n)]
+            else:
+                col_vals = [None if nl[j] else arr[j].item()
+                            for j in range(n)]
+            values.append(col_vals)
+            nullcols.append([bool(x) for x in nl])
+        body = _json.dumps({"function": rf.name, "values": values,
+                            "nulls": nullcols}).encode()
+        req = urllib.request.Request(
+            rf.url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     # marks the request EXTERNAL: the internal-auth
+                     # opener must not attach the cluster JWT to a
+                     # sidecar outside the trust boundary
+                     "X-Presto-External": "true"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            doc = _json.loads(resp.read())
+        rv = doc["values"]
+        rn = doc.get("nulls") or [v is None for v in rv]
+        out = np.full(cap, sentinel, dtype=out_dtype)
+        out_nulls = np.ones(cap, dtype=bool)
+        for j in range(n):
+            out_nulls[j] = bool(rn[j])
+            if not out_nulls[j]:
+                out[j] = rv[j]
+        return out, out_nulls
+
+    flat = []
+    for c in cols:
+        flat.append(c.values)
+        flat.append(c.nulls)
+    vals, nulls = jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap,), out_dtype),
+         jax.ShapeDtypeStruct((cap,), jnp.bool_)),
+        page.num_rows, *flat)
+    return Column(vals, nulls, rt)

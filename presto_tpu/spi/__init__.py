@@ -46,6 +46,23 @@ class ScalarFunction:
 
 
 @dataclasses.dataclass(frozen=True)
+class RemoteFunction:
+    """A scalar function served by an EXTERNAL process (reference:
+    presto-native-execution/presto_cpp/main/RemoteFunctionRegisterer.cpp
+    registering sidecar-served functions, and RemoteProjectOperator
+    evaluating projections out-of-process). Here the transport is REST
+    JSON: the engine POSTs {function, values[][], nulls[][]} for the
+    page's rows and reads {values[], nulls[]} back. Evaluation happens
+    through jax.pure_callback, so the call site still lives INSIDE the
+    compiled fragment program (the XLA program calls out to the host at
+    run time — shapes stay static). String returns are not supported
+    (result dictionaries cannot be built at trace time)."""
+    name: str
+    return_type: Type
+    url: str
+
+
+@dataclasses.dataclass(frozen=True)
 class ConnectorFactory:
     """Reference: spi/connector/ConnectorFactory — `create(config)`
     returns a connector serving a catalog."""
@@ -97,6 +114,9 @@ class Plugin:
         """Each factory: () -> SystemAccessControl."""
         return ()
 
+    def get_remote_functions(self) -> Sequence["RemoteFunction"]:
+        return ()
+
 
 class PluginManager:
     """Engine-side registries (reference: presto-main
@@ -106,6 +126,7 @@ class PluginManager:
     def __init__(self):
         self._lock = threading.Lock()
         self.functions: Dict[str, ScalarFunction] = {}
+        self.remote_functions: Dict[str, RemoteFunction] = {}
         self.connector_factories: Dict[str, ConnectorFactory] = {}
         self.catalogs: Dict[str, object] = {}
         self.access_controls: List[SystemAccessControl] = []
@@ -118,6 +139,12 @@ class PluginManager:
             self.loaded_plugins.append(plugin)
             for f in plugin.get_functions():
                 self.functions[f.name.lower()] = f
+            for rf in plugin.get_remote_functions():
+                if rf.return_type.is_string:
+                    raise ValueError(
+                        f"remote function {rf.name!r}: string return "
+                        "types are not supported")
+                self.remote_functions[rf.name.lower()] = rf
             for cf in plugin.get_connector_factories():
                 self.connector_factories[cf.name] = cf
             for ac_factory in \
@@ -169,6 +196,9 @@ class PluginManager:
 
     def get_function(self, name: str) -> Optional[ScalarFunction]:
         return self.functions.get(name.lower())
+
+    def get_remote_function(self, name: str) -> Optional[RemoteFunction]:
+        return self.remote_functions.get(name.lower())
 
     def check_can_select(self, user: str, table: str) -> None:
         for ac in list(self.access_controls):

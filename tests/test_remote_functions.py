@@ -1,0 +1,136 @@
+"""Remote (sidecar-served) scalar functions (round-5; reference:
+presto-native-execution/presto_cpp/main/RemoteFunctionRegisterer.cpp +
+RemoteProjectOperator): functions registered with a REST endpoint
+evaluate inside compiled fragments via a host callback."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from presto_tpu.connectors import MemoryConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.spi import Plugin, PluginManager, RemoteFunction
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+
+class _FnHandler(BaseHTTPRequestHandler):
+    calls = []
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        doc = json.loads(self.rfile.read(n))
+        type(self).calls.append(doc)
+        fn = doc["function"]
+        vals = doc["values"]
+        nulls = doc["nulls"]
+        out, out_nulls = [], []
+        for i in range(len(vals[0])):
+            if any(nc[i] for nc in nulls):
+                out.append(None)
+                out_nulls.append(True)
+                continue
+            if fn == "tax":
+                out.append(round(vals[0][i] * 1.1, 6))
+            elif fn == "str_len_sq":       # string arg, bigint result
+                out.append(len(vals[0][i]) ** 2)
+            else:
+                out.append(vals[0][i] + vals[1][i])
+            out_nulls.append(False)
+        body = json.dumps({"values": out, "nulls": out_nulls}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    srv = HTTPServer(("127.0.0.1", 0), _FnHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}/v1/function"
+    srv.shutdown()
+
+
+@pytest.fixture()
+def engine(sidecar):
+    import presto_tpu.spi as spi
+
+    class P(Plugin):
+        def get_remote_functions(self):
+            return [
+                RemoteFunction("tax", DOUBLE, sidecar),
+                RemoteFunction("str_len_sq", BIGINT, sidecar),
+                RemoteFunction("rsum", BIGINT, sidecar),
+            ]
+
+    old = spi.manager
+    spi.manager = PluginManager()
+    spi.manager.install(P())
+    conn = MemoryConnector()
+    conn.create("t", [("k", BIGINT), ("price", DOUBLE), ("s", VARCHAR)])
+    conn.append_rows("t", [(1, 10.0, "ab"), (2, None, "xyz"),
+                           (3, 20.0, None)])
+    try:
+        yield LocalEngine(conn)
+    finally:
+        spi.manager.shutdown()
+        spi.manager = old
+
+
+def test_remote_scalar_in_projection(engine):
+    got = engine.execute_sql("select k, tax(price) from t order by k")
+    assert got == [(1, 11.0), (2, None), (3, 22.0)]
+
+
+def test_remote_scalar_string_arg(engine):
+    got = engine.execute_sql(
+        "select k, str_len_sq(s) from t order by k")
+    assert got == [(1, 4), (2, 9), (3, None)]
+
+
+def test_remote_scalar_two_args_in_filter(engine):
+    got = engine.execute_sql(
+        "select k from t where rsum(k, k) > 3 order by k")
+    assert got == [(2,), (3,)]
+
+
+def test_string_return_rejected(sidecar):
+    mgr = PluginManager()
+
+    class P(Plugin):
+        def get_remote_functions(self):
+            return [RemoteFunction("bad", VARCHAR, sidecar)]
+
+    with pytest.raises(ValueError, match="string return"):
+        mgr.install(P())
+
+
+def test_remote_scalar_decimal_arg_descaled(sidecar):
+    """DECIMAL args reach the sidecar as LOGICAL values, not unscaled
+    ints (the descale_decimals default local UDFs get)."""
+    import presto_tpu.spi as spi
+    from presto_tpu.types import DecimalType
+
+    class P(Plugin):
+        def get_remote_functions(self):
+            return [RemoteFunction("tax", DOUBLE, sidecar)]
+
+    old = spi.manager
+    spi.manager = PluginManager()
+    spi.manager.install(P())
+    conn = MemoryConnector()
+    conn.create("t", [("p", DecimalType(10, 2))])
+    from decimal import Decimal
+    conn.append_rows("t", [(Decimal("100.50"),)])
+    try:
+        got = LocalEngine(conn).execute_sql("select tax(p) from t")
+        assert got == [(pytest.approx(110.55),)]
+    finally:
+        spi.manager.shutdown()
+        spi.manager = old
