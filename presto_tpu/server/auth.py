@@ -1,0 +1,140 @@
+"""Internal-communication JWT authentication.
+
+Reference: presto-internal-communication/.../InternalAuthenticationManager.java
+— when a shared secret is configured, every intra-cluster HTTP request
+carries an HS256 JWT in `X-Presto-Internal-Bearer`: key =
+SHA256(shared secret), subject = the sender's node id, 5-minute expiry.
+Workers reject internal endpoints without a valid token.
+
+The JWT encode/verify here is a from-scratch minimal HS256
+implementation (header.payload.signature, base64url, HMAC-SHA256) —
+no external JWT dependency exists in this image.
+"""
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+from typing import Optional
+
+PRESTO_INTERNAL_BEARER = "X-Presto-Internal-Bearer"
+_EXPIRY_S = 300            # reference: now + 5 minutes
+_REFRESH_S = 60            # regenerate when this close to expiry
+
+
+class AuthenticationError(RuntimeError):
+    pass
+
+
+def _b64url(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+class InternalAuthenticator:
+    """Signs and verifies internal-request JWTs for one node."""
+
+    def __init__(self, shared_secret: str, node_id: str = "tpu-node"):
+        self._key = hashlib.sha256(shared_secret.encode()).digest()
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._cached: Optional[str] = None
+        self._cached_exp = 0.0
+
+    # ------------------------------------------------------------- sign
+    def generate_jwt(self) -> str:
+        now = time.time()
+        with self._lock:
+            if self._cached and now < self._cached_exp - _REFRESH_S:
+                return self._cached
+            header = _b64url(json.dumps(
+                {"alg": "HS256", "typ": "JWT"},
+                separators=(",", ":")).encode())
+            exp = int(now + _EXPIRY_S)
+            payload = _b64url(json.dumps(
+                {"sub": self.node_id, "exp": exp},
+                separators=(",", ":")).encode())
+            signing_input = header + b"." + payload
+            sig = _b64url(hmac.new(self._key, signing_input,
+                                   hashlib.sha256).digest())
+            self._cached = (signing_input + b"." + sig).decode()
+            self._cached_exp = exp
+            return self._cached
+
+    def headers(self) -> dict:
+        return {PRESTO_INTERNAL_BEARER: self.generate_jwt()}
+
+    # ----------------------------------------------------------- verify
+    def authenticate(self, token: str) -> str:
+        """Returns the sender's node id or raises AuthenticationError
+        (bad structure, bad signature, or expired)."""
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthenticationError("malformed internal bearer token")
+        signing_input = (parts[0] + "." + parts[1]).encode()
+        want = _b64url(hmac.new(self._key, signing_input,
+                                hashlib.sha256).digest()).decode()
+        if not hmac.compare_digest(want, parts[2]):
+            raise AuthenticationError("invalid internal bearer signature")
+        try:
+            header = json.loads(_b64url_decode(parts[0]))
+            payload = json.loads(_b64url_decode(parts[1]))
+        except (ValueError, TypeError) as e:
+            raise AuthenticationError(f"bad token payload: {e}") from e
+        if header.get("alg") != "HS256":
+            raise AuthenticationError(
+                f"unsupported JWT alg {header.get('alg')!r}")
+        if float(payload.get("exp", 0)) < time.time():
+            raise AuthenticationError("internal bearer token expired")
+        return str(payload.get("sub", ""))
+
+
+#: process-wide client-side authenticator (None = auth disabled). The
+#: coordinator/worker startup configures it; a urllib opener handler
+#: then signs EVERY outbound /v1/* request in this process (announcer
+#: PUTs, task POSTs, status polls, exchange pulls) — the reference
+#: installs the equivalent as an HttpClient request filter.
+_CLIENT: Optional[InternalAuthenticator] = None
+_OPENER_INSTALLED = [False]
+
+
+import urllib.request as _urllib_request
+
+
+class _InternalAuthHandler(_urllib_request.BaseHandler):
+    """urllib handler signing internal requests (http_request hook)."""
+
+    handler_order = 100
+
+    def http_request(self, req):
+        # requests marked X-Presto-External cross a trust boundary
+        # (remote-function sidecars): never leak the cluster JWT there
+        if (_CLIENT is not None and "/v1/" in req.full_url
+                and not req.has_header("X-presto-external")):
+            req.add_unredirected_header(PRESTO_INTERNAL_BEARER,
+                                        _CLIENT.generate_jwt())
+        return req
+
+    https_request = http_request
+
+
+def configure(shared_secret: Optional[str],
+              node_id: str = "tpu-node") -> None:
+    global _CLIENT
+    _CLIENT = (InternalAuthenticator(shared_secret, node_id)
+               if shared_secret else None)
+    if _CLIENT is not None and not _OPENER_INSTALLED[0]:
+        import urllib.request
+        opener = urllib.request.build_opener(_InternalAuthHandler())
+        urllib.request.install_opener(opener)
+        _OPENER_INSTALLED[0] = True
+
+
+def internal_headers() -> dict:
+    return _CLIENT.headers() if _CLIENT is not None else {}

@@ -152,9 +152,18 @@ class TpuCluster:
 
     def __init__(self, connector, n_workers: int = 2,
                  session_properties: Optional[Dict[str, str]] = None,
-                 resource_groups=None, history=None, discovery=None):
+                 resource_groups=None, history=None, discovery=None,
+                 shared_secret: Optional[str] = None):
         from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
+
+        # internal-communication JWT (InternalCommunicationConfig
+        # sharedSecret + internalJwtEnabled): the coordinator signs its
+        # requests; workers enforce
+        self.shared_secret = shared_secret
+        if shared_secret:
+            from presto_tpu.server.auth import configure
+            configure(shared_secret, "tpu-coordinator")
 
         self.connector = connector
         self.planner = Planner(connector)
@@ -171,7 +180,8 @@ class TpuCluster:
         # alongside the statically started ones.
         self.discovery = discovery
         self.workers: List[TpuWorkerServer] = [
-            TpuWorkerServer(connector, node_id=f"tpu-worker-{i}").start()
+            TpuWorkerServer(connector, node_id=f"tpu-worker-{i}",
+                            shared_secret=shared_secret).start()
             for i in range(n_workers)]
         self.all_worker_uris = [f"http://127.0.0.1:{w.port}"
                                 for w in self.workers]

@@ -80,6 +80,27 @@ class _Handler(BaseHTTPRequestHandler):
     def tm(self) -> TpuTaskManager:
         return self.server.task_manager
 
+    def _authorized(self) -> bool:
+        """Internal JWT gate (InternalAuthenticationManager.java:
+        authenticateInternalRequest) — applies to every route when a
+        shared secret is configured."""
+        auth = getattr(self.server, "authenticator", None)
+        if auth is None:
+            return True
+        from presto_tpu.server.auth import (
+            AuthenticationError, PRESTO_INTERNAL_BEARER,
+        )
+        token = self.headers.get(PRESTO_INTERNAL_BEARER)
+        if not token:
+            self._json(401, {"error": "missing internal bearer token"})
+            return False
+        try:
+            auth.authenticate(token)
+            return True
+        except AuthenticationError as e:
+            self._json(401, {"error": str(e)})
+            return False
+
     def _json(self, code: int, obj, headers=None):
         # binary transport negotiation (reference:
         # InternalCommunicationConfig.java:174 isBinaryTransportEnabled):
@@ -123,6 +144,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- POST
     def do_POST(self):
+        if not self._authorized():
+            return
         path = self.path.split("?")[0]
         m = _BATCH.match(path)
         if m:
@@ -143,6 +166,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -------------------------------------------------------------- GET
     def do_GET(self):
+        if not self._authorized():
+            return
         path = self.path.split("?")[0]
         m = _ACK.match(path)
         if m:
@@ -260,6 +285,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ----------------------------------------------------------- DELETE
     def do_DELETE(self):
+        if not self._authorized():
+            return
         path = self.path.split("?")[0]
         m = _REMOTE_SOURCE.match(path)
         if m:
@@ -286,12 +313,25 @@ class TpuWorkerServer:
 
     def __init__(self, connector, host: str = "127.0.0.1", port: int = 0,
                  coordinator_uri: Optional[str] = None,
-                 node_id: str = "tpu-worker-0"):
+                 node_id: str = "tpu-worker-0",
+                 shared_secret: Optional[str] = None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         base = f"http://{host}:{self.port}"
         self.task_manager = TpuTaskManager(connector, base_uri=base)
         self.httpd.task_manager = self.task_manager
+        # internal JWT auth (InternalAuthenticationManager role): with a
+        # shared secret every /v1/* request must carry a valid
+        # X-Presto-Internal-Bearer token; this node also SENDS signed
+        # requests (announcements, exchange pulls)
+        self.httpd.authenticator = None
+        if shared_secret:
+            from presto_tpu.server.auth import (
+                InternalAuthenticator, configure,
+            )
+            self.httpd.authenticator = InternalAuthenticator(
+                shared_secret, node_id)
+            configure(shared_secret, node_id)
         self.thread = threading.Thread(target=self.httpd.serve_forever,
                                        daemon=True)
         self.announcer = None
