@@ -55,13 +55,81 @@ class ClientBuffer:
             self.base += drop
 
 
+class MaterializedClientBuffer(ClientBuffer):
+    """Batch-mode buffer (reference: presto-spark's materialized
+    shuffle, presto_cpp ShuffleWrite.cpp): frames persist to a DISK
+    file as produced and every token stays replayable from 0 — the
+    property that makes stage-level retry sound (a replacement consumer
+    re-pulls the full stream; RAM holds only the offset index).
+    acknowledge() advances the window but never discards."""
+
+    def __init__(self):
+        super().__init__()
+        import tempfile
+        self._file = tempfile.NamedTemporaryFile(
+            prefix="presto_tpu_shuffle_", delete=False)
+        self._index: List[Tuple[int, int]] = []   # (offset, length)
+        self._flock = threading.Lock()
+        self._closed = False
+
+    def add(self, frame: bytes):
+        with self._flock:
+            if self._closed:
+                return                  # aborted task still emitting
+            off = self._file.tell()
+            self._file.write(frame)
+            self._file.flush()
+            self._index.append((off, len(frame)))
+        self.pages.append(None)          # token bookkeeping only
+
+    def get(self, token: int, max_bytes: int):
+        out: List[bytes] = []
+        size = 0
+        t = max(token, 0)
+        with self._flock:
+            if self._closed:
+                return [], t, True
+            while t < len(self._index):
+                off, ln = self._index[t]
+                if out and size + ln > max_bytes:
+                    break
+                self._file.seek(off)
+                out.append(self._file.read(ln))
+                size += ln
+                t += 1
+        complete = self.no_more_pages and t >= len(self._index)
+        return out, t, complete
+
+    def acknowledge(self, token: int):
+        self.base = min(max(self.base, token), len(self._index))
+
+    def close(self):
+        import os
+        with self._flock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._file.close()
+                os.unlink(self._file.name)
+            except (OSError, ValueError):
+                pass
+
+
 class OutputBufferManager:
     """All buffers of one task (OutputBuffers.type PARTITIONED etc.)."""
 
-    def __init__(self, buffer_ids: List[str]):
+    def __init__(self, buffer_ids: List[str], materialized: bool = False):
+        cls = MaterializedClientBuffer if materialized else ClientBuffer
         self.buffers: Dict[str, ClientBuffer] = {
-            b: ClientBuffer() for b in buffer_ids}
+            b: cls() for b in buffer_ids}
         self.lock = threading.Lock()
+
+    def close(self):
+        with self.lock:
+            for b in self.buffers.values():
+                if hasattr(b, "close"):
+                    b.close()
 
     def buffer(self, buffer_id: str) -> Optional[ClientBuffer]:
         return self.buffers.get(buffer_id)

@@ -372,11 +372,36 @@ class TpuCluster:
                                  table=target,
                                  column_names=tuple(
                                      c for c, _t in schema))
+        # Scaled writers (reference: execution/scheduler/
+        # ScaledWriterScheduler.java + SystemSessionProperties
+        # scale_writers/writer_min_size): writer-task count scales with
+        # the estimated data volume instead of always using every
+        # worker — small inserts get one writer (no N tiny files /
+        # per-task commit overhead), big ones fan out. The reference
+        # scales at runtime on buffer backlog; with static shapes the
+        # volume is estimable at plan time, so admission picks the
+        # count up front.
+        writer_tasks = None
+        if (self.session_properties.get("scale_writers", "true")
+                .lower() != "false"):
+            try:
+                from presto_tpu.exec.executor import _row_bytes
+                from presto_tpu.plan.stats import estimate_rows
+                est_rows = estimate_rows(plan, conn, self.history)
+                min_size = int(self.session_properties.get(
+                    "writer_min_size", 32 * 1024 * 1024))
+                est_bytes = max(est_rows, 1) * _row_bytes(
+                    plan.output_types)
+                writer_tasks = max(
+                    1, -(-est_bytes // max(min_size, 1)))
+            except Exception:   # noqa: BLE001 — estimate is advisory
+                writer_tasks = None
         try:
             # NON-idempotent: never auto-retried (a partial write on a
             # surviving worker would duplicate rows; reference: streaming
             # INSERT failures fail the query)
-            counts = self._execute_plan_once(writer)
+            counts = self._execute_plan_once(writer,
+                                             writer_tasks=writer_tasks)
         except Exception:
             if is_insert:
                 conn.drop(target, if_exists=True)      # discard the stage
@@ -448,7 +473,9 @@ class TpuCluster:
 
     def _execute_plan_once(self, plan: PlanNode,
                            capture: bool = False,
-                           cancel_event=None) -> List[tuple]:
+                           cancel_event=None,
+                           writer_tasks: Optional[int] = None
+                           ) -> List[tuple]:
         # Uncorrelated scalar subqueries execute through the cluster
         # itself (recursively), not a local engine: distributed partial/
         # final aggregation orders float summation differently, and a
@@ -466,11 +493,13 @@ class TpuCluster:
         return self._run_fragments(frags, list(plan.output_types),
                                    capture=capture,
                                    merge_keys=merge_keys,
-                                   cancel_event=cancel_event)
+                                   cancel_event=cancel_event,
+                                   writer_tasks=writer_tasks)
 
     # ------------------------------------------------------------------
     def _run_fragments(self, frags, out_types,
                        capture: bool = False, merge_keys=None,
+                       writer_tasks: Optional[int] = None,
                        cancel_event=None) -> List[tuple]:
         with self._lock:
             self._query_counter += 1
@@ -509,6 +538,15 @@ class TpuCluster:
 
         def n_tasks(fid: int) -> int:
             spec = specs[fid]
+            if fid == 0 and writer_tasks is not None \
+                    and spec.scan_nodes:
+                # scaled writers: a SOURCE-partitioned (scan-fed)
+                # writer fragment's parallelism follows the estimated
+                # data volume; gathered shapes (SINGLE producers under
+                # the writer) keep the plan-driven count
+                self.last_writer_tasks = max(
+                    1, min(int(writer_tasks), W))
+                return self.last_writer_tasks
             if spec.scan_nodes:
                 return W
             for pfid in spec.remote_nodes.values():
@@ -549,7 +587,14 @@ class TpuCluster:
             self._start_stage(qid, fid, stages, by_id, placement)
             scheduled.add(fid)
 
+        batch_mode = (str(self.session_properties.get(
+            "exchange_materialization_enabled", ""))
+            .strip().lower() == "true")
         try:
+            if batch_mode:
+                return self._run_fragments_batch(
+                    qid, stages, by_id, placement, out_types,
+                    merge_keys, capture, cancel_event)
             schedule(0)
             try:
                 self._await_all(stages, cancel_event=cancel_event)
@@ -571,31 +616,119 @@ class TpuCluster:
         finally:
             self._cleanup(stages)
 
+    def _run_fragments_batch(self, qid, stages, by_id, placement,
+                             out_types, merge_keys, capture,
+                             cancel_event) -> List[tuple]:
+        """Materialized-exchange batch execution (reference:
+        presto-spark-base's stage-by-stage mode over materialized
+        shuffles, ShuffleWrite.cpp): stages run to COMPLETION in
+        producer-first order — their output frames persist on disk and
+        replay from token 0 (MaterializedClientBuffer) — and a stage
+        lost to a worker death re-runs ALONE on the survivors (its
+        consumers have not started, its producers' outputs are still
+        replayable), instead of failing or retrying the whole query."""
+        order: List[int] = []
+        seen = set()
+
+        def topo(fid: int):
+            if fid in seen:
+                return
+            seen.add(fid)
+            for src in by_id[fid].remote_sources:
+                topo(src)
+            order.append(fid)
+
+        topo(0)
+        live_placement = list(placement)
+        for pos, fid in enumerate(order):
+            for _attempt in range(2):
+                try:
+                    if _attempt == 0:
+                        self._start_stage(qid, fid, stages, by_id,
+                                          live_placement)
+                    self._await_all({fid: stages[fid]},
+                                    cancel_event=cancel_event)
+                    break
+                except (ClusterQueryError, OSError):
+                    if cancel_event is not None \
+                            and cancel_event.is_set():
+                        raise
+                    if _attempt:
+                        raise
+                    # a dead worker also takes the materialized outputs
+                    # of COMPLETED upstream tasks it hosted: regenerate
+                    # those first (their survivors return FINISHED
+                    # immediately), then re-post the whole current
+                    # stage so its split bindings see the new producer
+                    # locations
+                    alive = set(self.check_workers())
+                    if not alive:
+                        raise
+                    recovered = False
+                    for up in order[:pos]:
+                        if self._reschedule_stage(qid, up, stages,
+                                                  by_id):
+                            recovered = True
+                            self._await_all({up: stages[up]},
+                                            cancel_event=cancel_event)
+                    if self._reschedule_stage(qid, fid, stages, by_id,
+                                              force_all=recovered):
+                        recovered = True
+                    if not recovered:
+                        raise
+                    live_placement = [w for w in live_placement
+                                      if w in alive] or live_placement
+        if capture:
+            self._capture_task_infos(stages)
+        return self._collect_root(stages[0], out_types, merge_keys)
+
     def _recover_dead_tasks(self, qid: str, stages: Dict[int, _Stage],
                             by_id) -> bool:
-        """Reschedule tasks stranded on dead workers onto survivors.
-        Only safe when every stage's output is still pullable, i.e. the
-        single-fragment shape (consumers re-pull from token 0 of the
-        replacement task); multi-stage plans fall back to the
-        whole-query retry. Returns True if recovery was performed."""
+        """Streaming-mode task recovery: only safe when every stage's
+        output is still pullable, i.e. the single-fragment shape
+        (consumers re-pull from token 0 of the replacement task);
+        multi-stage streaming plans fall back to the whole-query
+        retry. Returns True if recovery was performed."""
         if len(stages) != 1:
             return False
+        return self._reschedule_stage(qid, 0, stages, by_id)
+
+    def _reschedule_stage(self, qid: str, fid: int,
+                          stages: Dict[int, _Stage], by_id,
+                          force_all: bool = False) -> bool:
+        """Re-post fragment `fid`'s tasks stranded on dead workers to
+        survivors with bumped attempt ids (deterministic split
+        assignment -> exactly the lost work re-runs). `force_all`
+        re-posts EVERY task — needed when upstream producers moved and
+        surviving tasks' remote splits still point at the old
+        locations (batch-mode recovery)."""
         alive = set(self.check_workers())
         if not alive:
             return False
-        stage = stages[0]
+        stage = stages[fid]
         survivors = sorted(alive)
         recovered = False
         for t, uri in enumerate(list(stage.task_uris)):
             worker = uri.split("/v1/task/")[0]
-            if worker in alive:
+            if worker in alive and not force_all:
                 continue
             attempt = int(stage.task_ids[t].rsplit(".", 1)[1]) + 1
-            new_worker = survivors[t % len(survivors)]
+            new_worker = (worker if worker in alive
+                          else survivors[t % len(survivors)])
             task_id, new_uri = self._post_stage_task(
-                qid, 0, stages, by_id, new_worker, t, attempt)
+                qid, fid, stages, by_id, new_worker, t, attempt)
             stage.task_ids[t] = task_id
             stage.task_uris[t] = new_uri
+            stage.recovered_tasks += 1
+            recovered = True
+        # a scheduling-time death can leave the stage partially posted:
+        # place the never-created tasks on survivors
+        for t in range(len(stage.task_uris), stage.n_tasks):
+            task_id, new_uri = self._post_stage_task(
+                qid, fid, stages, by_id, survivors[t % len(survivors)],
+                t, attempt=1)
+            stage.task_ids.append(task_id)
+            stage.task_uris.append(new_uri)
             stage.recovered_tasks += 1
             recovered = True
         self.last_recovered_tasks = stage.recovered_tasks

@@ -297,8 +297,15 @@ class TpuTaskManager:
         # updates still bind their splits.
         with task.update_lock:
             if req.outputIds is not None and task.buffers is None:
+                # batch/materialized execution (presto-spark shuffle
+                # role): output frames persist to disk and stay
+                # replayable from token 0, enabling stage-level retry
+                mat = bool(req.session is not None and str((
+                    req.session.systemProperties or {}).get(
+                    "exchange_materialization_enabled", ""))
+                    .strip().lower() == "true")
                 task.buffers = OutputBufferManager(
-                    sorted(req.outputIds.buffers))
+                    sorted(req.outputIds.buffers), materialized=mat)
             if req.session is not None and req.session.systemProperties:
                 task.session_properties.update(req.session.systemProperties)
             if req.fragment is not None and task.fragment is None:
@@ -737,6 +744,8 @@ class TpuTaskManager:
             return t.info(self.base_uri)
         if task.state in ("PLANNED", "RUNNING"):
             task.set_state("ABORTED")
+        if task.buffers is not None:
+            task.buffers.close()     # materialized shuffle files
         return task.info(self.base_uri)
 
     @staticmethod

@@ -110,7 +110,7 @@ def test_failed_insert_leaves_table_unchanged(cluster):
 
     real = c._execute_plan_once
 
-    def partial_then_fail(plan, capture=False):
+    def partial_then_fail(plan, capture=False, **kw):
         # simulate tasks that wrote part of their rows before a failure
         stage = plan.table
         assert stage != "t3", "INSERT must write to a staging table"
@@ -155,3 +155,39 @@ def test_boolean_literals():
         [(True, False, False)]
     assert eng.execute_sql(
         "SELECT count(*) FROM nation WHERE true") == [(25,)]
+
+
+def test_scaled_writers_single_task_for_small_insert():
+    """Reference: ScaledWriterScheduler + scale_writers/writer_min_size —
+    a small INSERT gets ONE writer task (volume below writer_min_size),
+    a forced-low threshold fans out to every worker."""
+    from presto_tpu.connectors import MemoryConnector, TpchConnector
+    from presto_tpu.server.cluster import TpuCluster
+    from presto_tpu.types import BIGINT, DOUBLE
+
+    mem = MemoryConnector(fallback=TpchConnector(0.01))
+    mem.create("sink", [("k", BIGINT), ("v", DOUBLE)])
+    c = TpuCluster(mem, n_workers=3)
+    try:
+        got = c.execute_sql(
+            "insert into sink select o_orderkey, o_totalprice "
+            "from orders where o_orderkey < 100")
+        n_small = got[0][0]
+        assert mem.table("sink").num_rows == n_small
+        # tiny volume -> 1 writer task in the root stage
+        assert c.last_writer_tasks == 1
+    finally:
+        c.stop()
+
+    mem2 = MemoryConnector(fallback=TpchConnector(0.01))
+    mem2.create("sink", [("k", BIGINT), ("v", DOUBLE)])
+    c2 = TpuCluster(mem2, n_workers=3,
+                    session_properties={"writer_min_size": "64"})
+    try:
+        c2.execute_sql(
+            "insert into sink select o_orderkey, o_totalprice "
+            "from orders")
+        assert c2.last_writer_tasks == 3     # scaled out to all workers
+        assert mem2.table("sink").num_rows == 15000
+    finally:
+        c2.stop()
