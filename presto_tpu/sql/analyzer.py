@@ -95,6 +95,17 @@ _SCALAR_BOOLEAN_FUNCS = {"starts_with", "regexp_like"}
 _SCALAR_DOUBLE_FUNCS = {"power", "cbrt", "log2", "pi", "e"}
 
 
+def _table_function_output_name(r: "ast.TableFunctionRef") -> str:
+    """The single output column's name — ONE definition shared by scope
+    resolution and planning. A surplus alias list is a user error."""
+    if len(r.column_aliases) > 1:
+        raise AnalysisError(
+            f"table function {r.name!r} produces 1 column, "
+            f"{len(r.column_aliases)} aliases given")
+    return r.column_aliases[0] if r.column_aliases \
+        else "sequential_number"
+
+
 def _conjuncts(e: Optional[ast.Expr]) -> List[ast.Expr]:
     if e is None:
         return []
@@ -837,6 +848,9 @@ class Planner:
             return left + self._shallow_rel_fields(r.right)
         if isinstance(r, ast.UnnestRef):
             return self._shallow_unnest_fields(r, [])
+        if isinstance(r, ast.TableFunctionRef):
+            return [Field(_table_function_output_name(r), BIGINT,
+                          r.alias or r.name)]
         raise AnalysisError(f"relation {r}")
 
     def _shallow_unnest_fields(self, u: ast.UnnestRef,
@@ -1030,7 +1044,51 @@ class Planner:
         return RelationPlan(node, out_fields,
                             max(left.est_rows * 4.0, 1.0))
 
+    def _table_function_rows(self, r: "ast.TableFunctionRef"):
+        """(column_name, type, rows) for a literal-argument table
+        function (reference: LeafTableFunctionOperator feeding the
+        registered table function's split source). `sequence` is the
+        built-in generator."""
+        if r.name != "sequence":
+            raise AnalysisError(f"unknown table function {r.name!r}")
+        if not 2 <= len(r.args) <= 3:
+            raise AnalysisError("sequence(start, stop[, step])")
+        vals = []
+        for a in r.args:
+            e = self.analyze(a, ())
+            if isinstance(e, Call) and e.name == "negate" \
+                    and isinstance(e.args[0], Literal):
+                e = Literal(-e.args[0].value, e.args[0].type)
+            if not isinstance(e, Literal) or not isinstance(e.value, int):
+                raise AnalysisError(
+                    "sequence() arguments must be integer literals")
+            vals.append(int(e.value))
+        start, stop = vals[0], vals[1]
+        step = vals[2] if len(vals) == 3 else (1 if stop >= start else -1)
+        if step == 0:
+            raise AnalysisError("sequence() step must not be zero")
+        if (stop - start) * step < 0:
+            # Presto: sequence stop must be reachable in the step's
+            # direction — a typo'd sign errors, never an empty result
+            raise AnalysisError(
+                f"sequence() stop {stop} is not reachable from "
+                f"{start} with step {step}")
+        count = max(0, (stop - start) // step + 1)
+        if count > 1_000_000:
+            raise AnalysisError(
+                f"sequence() would produce {count} rows (cap 1000000)")
+        name = _table_function_output_name(r)
+        rows = tuple((start + i * step,) for i in range(count))
+        return name, BIGINT, rows
+
     def _plan_relation(self, r: ast.Relation, q: ast.Select) -> RelationPlan:
+        if isinstance(r, ast.TableFunctionRef):
+            from presto_tpu.plan.nodes import ValuesNode
+            cname, ctype, rows = self._table_function_rows(r)
+            alias = r.alias or r.name
+            node = ValuesNode((cname,), (ctype,), rows=rows)
+            return RelationPlan(node, (Field(cname, ctype, alias),),
+                                max(len(rows), 1.0))
         if isinstance(r, ast.UnnestRef):
             return self._plan_unnest(None, r)
         if isinstance(r, ast.TableRef):

@@ -188,6 +188,19 @@ class SubqueryRef:
 
 
 @dataclasses.dataclass(frozen=True)
+class TableFunctionRef:
+    """TABLE(fn(arg, ...)) [AS alias (c1, ...)] — reference:
+    sql/tree table-function invocation planned to
+    LeafTableFunctionOperator; this engine evaluates literal-argument
+    generator functions (sequence) at analysis time into inline
+    values."""
+    name: str
+    args: Tuple["Expr", ...]
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class UnnestRef:
     """UNNEST(expr, ...) [WITH ORDINALITY] [AS alias (c1, c2, ...)] —
     reference: sql/tree/Unnest.java. In a join, the arguments may

@@ -483,6 +483,20 @@ class Parser:
                 on = self.expr()
             rel = ast.Join(kind, rel, right, on)
 
+    def _alias_with_columns(self):
+        """[AS] alias [(c1, c2, ...)] — the UNNEST / table-function
+        relation alias form."""
+        alias, col_aliases = None, ()
+        if self.accept_kw("as") or self.peek().kind == "ident":
+            alias = self.ident_text()
+            if self.accept("op", "("):
+                cols = [self.ident_text()]
+                while self.accept("op", ","):
+                    cols.append(self.ident_text())
+                self.expect("op", ")")
+                col_aliases = tuple(cols)
+        return alias, col_aliases
+
     def relation_primary(self) -> ast.Relation:
         if self.accept("op", "("):
             q = self.query()
@@ -509,17 +523,27 @@ class Parser:
                     self.next()
                     self.next()
                     with_ord = True
-            alias, col_aliases = None, ()
-            if self.accept_kw("as") or self.peek().kind == "ident":
-                alias = self.ident_text()
-                if self.accept("op", "("):
-                    cols = [self.ident_text()]
-                    while self.accept("op", ","):
-                        cols.append(self.ident_text())
-                    self.expect("op", ")")
-                    col_aliases = tuple(cols)
+            alias, col_aliases = self._alias_with_columns()
             return ast.UnnestRef(tuple(exprs), alias, col_aliases,
                                  with_ord)
+        if self.peek().kind == "ident" and self.peek().text == "table" \
+                and self.peek(1).kind == "op" \
+                and self.peek(1).text == "(":
+            # TABLE(fn(args)) — table-function invocation
+            self.next()
+            self.next()
+            fn = self.ident_text()
+            self.expect("op", "(")
+            args = []
+            if not self.accept("op", ")"):
+                args.append(self.expr())
+                while self.accept("op", ","):
+                    args.append(self.expr())
+                self.expect("op", ")")
+            self.expect("op", ")")
+            alias, col_aliases = self._alias_with_columns()
+            return ast.TableFunctionRef(fn, tuple(args), alias,
+                                        col_aliases)
         name = self.ident_text()
         alias = None
         if self.accept_kw("as"):
