@@ -176,8 +176,11 @@ DS_DEFAULT = [3, 7, 19, 42, 43, 52, 55, 96, 98, 27]
 
 
 def _ds_qids():
-    spec = os.environ.get("BENCH_DS_QUERIES", "")
-    if not spec:
+    # a small scan/agg-shaped DS lane runs by DEFAULT so every round's
+    # artifact carries a TPC-DS number; "default" widens to 10 queries,
+    # "none" disables
+    spec = os.environ.get("BENCH_DS_QUERIES", "3,42,52")
+    if not spec or spec == "none":
         return []
     if spec == "default":
         return list(DS_DEFAULT)
@@ -445,10 +448,12 @@ def _main_orchestrator(sf, qids) -> None:
             sys.stderr.write(tail + "\n")
 
     # parquet scan lane (VERDICT r4 #5): same TPC-H queries, data read
-    # from parquet files instead of the generator
-    pq_spec = os.environ.get("BENCH_PARQUET_QUERIES", "")
-    for qid in ([int(q) for q in pq_spec.split(",") if q]
-                if pq_spec else []):
+    # from parquet files instead of the generator (q6 by default so the
+    # lakehouse scan path gets a number; "none" disables)
+    pq_spec = os.environ.get("BENCH_PARQUET_QUERIES", "6")
+    for qid in ([int(q) for q in pq_spec.split(",")
+                 if q and q != "none"]
+                if pq_spec and pq_spec != "none" else []):
         if wedged is not None:
             detail[f"pq_q{qid:02d}"] = {"error": f"infra: {wedged}"}
             continue

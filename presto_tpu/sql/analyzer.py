@@ -1059,7 +1059,11 @@ class Planner:
             if isinstance(e, Call) and e.name == "negate" \
                     and isinstance(e.args[0], Literal):
                 e = Literal(-e.args[0].value, e.args[0].type)
-            if not isinstance(e, Literal) or not isinstance(e.value, int):
+            # type check, not just value shape: DECIMAL literals store
+            # the UNSCALED int and booleans are ints to isinstance
+            if not isinstance(e, Literal) \
+                    or not getattr(e.type, "is_integer", False) \
+                    or isinstance(e.value, bool):
                 raise AnalysisError(
                     "sequence() arguments must be integer literals")
             vals.append(int(e.value))

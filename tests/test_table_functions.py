@@ -56,3 +56,10 @@ def test_sequence_sign_mismatch_and_alias_surplus(engine):
     with pytest.raises(AnalysisError, match="aliases"):
         engine.execute_sql(
             "select * from table(sequence(1, 3)) as t(a, b)")
+
+
+def test_sequence_rejects_non_integer_literals(engine):
+    with pytest.raises(AnalysisError, match="integer literals"):
+        engine.execute_sql("select * from table(sequence(0.5, 2.5))")
+    with pytest.raises(AnalysisError, match="integer literals"):
+        engine.execute_sql("select * from table(sequence(true, false))")
