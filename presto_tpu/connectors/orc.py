@@ -1,0 +1,206 @@
+"""ORC connector — the second lakehouse file format.
+
+Reference role: presto-orc (the ORC->Page reader feeding Hive scans,
+presto-orc/.../OrcReader.java) + presto-hive's directory layout. Same
+TPU-first shape as the parquet connector (connectors/parquet.py):
+columns decode lazily per stripe (projection pushdown), a table is one
+file or a directory of files, and the split unit is (file, stripe) —
+ORC's natural row-group analog. Decode is pyarrow.orc (the role the
+reference delegates to its own ORC decoder); the lazy projection,
+split construction, dictionary remap and type mapping are this
+connector. pyarrow's ORC API exposes no per-stripe column statistics,
+so there is no metadata min/max pruning here (the parquet path has it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors.base import SplitSource
+from presto_tpu.connectors.parquet import (
+    LazyFileTable, _LazyArrays, _arrow_to_type, _decode_column,
+    _type_to_arrow,
+)
+from presto_tpu.connectors.tpch import HostTable, _slice_rows
+from presto_tpu.data.column import StringDict
+from presto_tpu.types import Type
+
+
+class OrcTable(LazyFileTable):
+    """Lazily-loading HostTable over one or more ORC files; units are
+    (file index, stripe index). Row counts come from file metadata
+    (ORCFile.nrows) for whole files; slices get per-stripe lengths
+    computed ONCE on the parent and passed down — never re-read."""
+
+    def __init__(self, name: str, paths: List[str],
+                 stripes: Optional[List[Tuple[int, int]]] = None,
+                 files=None, stripe_rows=None):
+        import pyarrow.orc as orc
+
+        self.paths = paths
+        self._files = (files if files is not None
+                       else [orc.ORCFile(p) for p in paths])
+        self.units = (stripes if stripes is not None
+                      else [(fi, s) for fi, f in enumerate(self._files)
+                            for s in range(f.nstripes)])
+        self._stripe_rows = stripe_rows
+        schema = self._files[0].schema
+        types = {f.name: _arrow_to_type(f.type) for f in schema}
+        if stripes is None:
+            n = sum(f.nrows for f in self._files)
+        else:
+            n = sum(self.stripe_lengths()[u] for u in self.units)
+        self._dicts: Dict[str, StringDict] = {}
+        self._nulls: Dict[str, np.ndarray] = {}
+        super().__init__(name, n, _LazyArrays(self._load_column),
+                         types, self._dicts, self._nulls)
+
+    def stripe_lengths(self) -> Dict[Tuple[int, int], int]:
+        """(file, stripe) -> row count, computed once per table family
+        (pyarrow exposes no per-stripe metadata; reading one narrow
+        column per stripe is the cheapest measure and is shared with
+        every slice via the `stripe_rows=` handoff)."""
+        if self._stripe_rows is None:
+            first_col = self._files[0].schema[0].name
+            self._stripe_rows = {
+                (fi, s): len(self._files[fi].read_stripe(
+                    s, columns=[first_col]))
+                for fi, f in enumerate(self._files)
+                for s in range(f.nstripes)}
+        return self._stripe_rows
+
+    def _load_column(self, col: str):
+        import pyarrow as pa
+
+        t = self.types[col]
+        chunks = []
+        for fi, s in self.units:
+            batch = self._files[fi].read_stripe(s, columns=[col])
+            chunks.append(batch.column(0))
+        merged = pa.chunked_array(chunks) if chunks \
+            else pa.chunked_array([], type=pa.int64())
+        vals, nulls, d = _decode_column(merged, t)
+        if d is not None:
+            self._dicts[col] = d
+        self._nulls[col] = nulls
+        return vals, nulls, d
+
+
+def read_orc_table(path: str, name: str) -> OrcTable:
+    if os.path.isdir(path):
+        paths = sorted(os.path.join(path, f) for f in os.listdir(path)
+                       if f.endswith(".orc"))
+        if not paths:
+            raise FileNotFoundError(f"no orc files under {path}")
+        return OrcTable(name, paths)
+    return OrcTable(name, [path])
+
+
+def write_orc_table(path: str, rows: List[tuple], schema,
+                    stripe_size: Optional[int] = None) -> None:
+    """Engine result rows -> one ORC file (write side for round trips;
+    reference role: OrcWriter)."""
+    import pyarrow as pa
+    import pyarrow.orc as orc
+
+    cols, fields = [], []
+    for i, (name, t) in enumerate(schema):
+        vals = [r[i] for r in rows]
+        if t.is_decimal:
+            from decimal import Decimal
+            vals = [None if v is None else
+                    (v if isinstance(v, Decimal)
+                     else Decimal(str(round(v, t.scale))))
+                    for v in vals]
+        if t.name == "date":
+            import datetime
+            epoch = datetime.date(1970, 1, 1)
+            vals = [None if v is None else
+                    (v if isinstance(v, datetime.date)
+                     else epoch + datetime.timedelta(days=int(v)))
+                    for v in vals]
+        fields.append(pa.field(name, _type_to_arrow(t)))
+        cols.append(pa.array(vals, type=_type_to_arrow(t)))
+    kw = {}
+    if stripe_size:
+        kw["stripe_size"] = stripe_size
+    orc.write_table(pa.Table.from_arrays(cols,
+                                         schema=pa.schema(fields)),
+                    path, **kw)
+
+
+class OrcConnector(SplitSource):
+    NAME = "orc"
+    """Directory catalog: `<dir>/<table>.orc` or `<dir>/<table>/`
+    (multi-file). Splits are stripe ranges."""
+
+    def __init__(self, directory: str, fallback=None):
+        self.directory = directory
+        self.fallback = fallback
+        self._cache: Dict[str, OrcTable] = {}
+
+    def _path(self, table: str) -> Optional[str]:
+        p = os.path.join(self.directory, f"{table}.orc")
+        if os.path.exists(p):
+            return p
+        d = os.path.join(self.directory, table)
+        if os.path.isdir(d):
+            return d
+        return None
+
+    def _load(self, table: str) -> Optional[OrcTable]:
+        if table in self._cache:
+            return self._cache[table]
+        p = self._path(table)
+        if p is None:
+            return None
+        t = read_orc_table(p, table)
+        self._cache[table] = t
+        return t
+
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        t = self._load(table)
+        if t is None:
+            if self.fallback is not None:
+                return self.fallback.schema(table)
+            raise KeyError(f"unknown table {table}")
+        return [(c, t.types[c]) for c in t.column_names()]
+
+    def row_count(self, table: str) -> int:
+        t = self._load(table)
+        if t is None:
+            if self.fallback is not None:
+                return self.fallback.row_count(table)
+            raise KeyError(f"unknown table {table}")
+        return t.num_rows
+
+    def table(self, name: str, part: int = 0, num_parts: int = 1
+              ) -> HostTable:
+        full = self._load(name)
+        if full is None:
+            if self.fallback is not None:
+                return self.fallback.table(name, part, num_parts)
+            raise KeyError(f"unknown table {name}")
+        if num_parts == 1:
+            return full
+        if len(full.units) >= num_parts:
+            lo, hi = _slice_rows(len(full.units), part, num_parts)
+            return OrcTable(name, full.paths, full.units[lo:hi],
+                            files=full._files,
+                            stripe_rows=full.stripe_lengths())
+        lo, hi = _slice_rows(full.num_rows, part, num_parts)
+        arrays = {c: full.arrays[c][lo:hi] for c in full.column_names()}
+        nulls = {c: full.null_mask(c)[lo:hi]
+                 for c in full.column_names()
+                 if full.null_mask(c) is not None}
+        return HostTable(name, hi - lo, arrays, full.types, full.dicts,
+                         nulls or None)
+
+    def invalidate(self, table: Optional[str] = None):
+        if table is None:
+            self._cache.clear()
+        else:
+            self._cache.pop(table, None)

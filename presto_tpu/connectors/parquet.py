@@ -156,9 +156,10 @@ def _decode_column(col, t: Type):
 
 
 class _LazyArrays(dict):
-    """Column name -> ndarray, loaded from the parquet column chunks on
+    """Column name -> ndarray, loaded from the file's column chunks on
     first access (projection pushdown: `page(columns=[...])` only ever
-    touches the requested names)."""
+    touches the requested names). Shared by every lazy file-format
+    table (parquet, orc)."""
 
     def __init__(self, loader):
         super().__init__()
@@ -170,7 +171,18 @@ class _LazyArrays(dict):
         return vals
 
 
-class ParquetTable(HostTable):
+class LazyFileTable(HostTable):
+    """Base for lazily-loading file-format tables: the null-mask cache
+    rides the same lazy column load."""
+
+    def null_mask(self, c: str):
+        if c not in self._nulls:
+            _ = self.arrays[c]          # triggers the lazy load
+        m = self._nulls.get(c)
+        return m[:self.num_rows] if m is not None else None
+
+
+class ParquetTable(LazyFileTable):
     """Lazily-loading HostTable over one or more parquet files.
     `files` shares already-open ParquetFile handles (split/prune
     derivatives must not re-open and re-parse every file's metadata)."""
@@ -213,12 +225,6 @@ class ParquetTable(HostTable):
             self._dicts[col] = d
         self._nulls[col] = nulls
         return vals, nulls, d
-
-    def null_mask(self, c: str):
-        if c not in self._nulls:
-            _ = self.arrays[c]          # triggers the lazy load
-        m = self._nulls.get(c)
-        return m[:self.num_rows] if m is not None else None
 
     # -- row-group statistics (predicate pushdown support) --------------
     def column_minmax(self, col: str):

@@ -914,24 +914,39 @@ class TpuCluster:
 
     def _merge_root(self, root: _Stage, out_types,
                     merge_keys) -> List[tuple]:
-        """K-way merge of per-task SORTED page streams (the ordered
-        merge exchange: operator/MergeOperator.java semantics at the
-        coordinator's root ExchangeClient). Streams decode page by page,
-        so the in-flight window is one page per task — never the whole
-        result per node."""
-        import heapq
+        """Ordered-merge exchange at the coordinator
+        (operator/MergeOperator.java semantics at the root
+        ExchangeClient). The per-task streams drain CONCURRENTLY
+        (network overlap across workers) and the K pre-sorted runs
+        merge in ONE Timsort pass — its run detection + galloping
+        merges the runs at C speed with ~n log k comparisons, replacing
+        the per-row python heap that was the round-4 throughput
+        ceiling."""
+        from concurrent.futures import ThreadPoolExecutor
 
         from presto_tpu.server.task_manager import TpuTaskManager
 
-        def row_iter(uri):
+        failed = threading.Event()
+
+        def drain(uri):
             stream = PageStream(
                 uri, buffer_id="0",
                 max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES)
-            while not stream.complete:
-                data = stream.fetch()
-                for p in decode_pages(data, out_types):
-                    yield from p.to_pylist()
-            stream.close()
+            rows: List[tuple] = []
+            try:
+                while not stream.complete:
+                    if failed.is_set():
+                        raise ClusterQueryError(
+                            "sibling stream failed; aborting merge")
+                    data = stream.fetch()
+                    for p in decode_pages(data, out_types):
+                        rows.extend(p.to_pylist())
+            except BaseException:
+                failed.set()            # fail fast across all drains
+                raise
+            finally:
+                stream.close()
+            return rows
 
         class _Key:
             """SQL sort-order comparison over python row values (null
@@ -962,8 +977,14 @@ class TpuCluster:
                     return (a < b) == k.ascending
                 return False
 
-        return list(heapq.merge(*[row_iter(u) for u in root.task_uris],
-                                key=_Key))
+        with ThreadPoolExecutor(
+                max_workers=min(len(root.task_uris), 16)) as pool:
+            runs = list(pool.map(drain, root.task_uris))
+        rows: List[tuple] = []
+        for r in runs:
+            rows.extend(r)
+        rows.sort(key=_Key)     # K sorted runs: galloping merges
+        return rows
 
     def _cleanup(self, stages: Dict[int, _Stage]):
         for stage in stages.values():

@@ -1,0 +1,91 @@
+"""ORC lakehouse scan path (round-5; reference: presto-orc
+OrcReader.java + the Hive directory/split model): lazy projection,
+(file, stripe) splits, dictionary strings, TPC-H from ORC files."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.connectors.orc import (
+    OrcConnector, OrcTable, write_orc_table,
+)
+from presto_tpu.exec import LocalEngine
+
+SF = 0.01
+TABLES = ["region", "nation", "supplier", "customer", "part",
+          "partsupp", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_orc"))
+    src = TpchConnector(SF)
+    eng = LocalEngine(src)
+    for t in TABLES:
+        schema = src.schema(t)
+        cols = ", ".join(c for c, _t in schema)
+        rows = eng.execute_sql(f"select {cols} from {t}")
+        if t == "lineitem":
+            os.mkdir(os.path.join(d, t))
+            half = (len(rows) + 1) // 2
+            for i in range(2):
+                write_orc_table(
+                    os.path.join(d, t, f"part-{i}.orc"),
+                    rows[i * half:(i + 1) * half], schema,
+                    stripe_size=1 << 20)
+        else:
+            write_orc_table(os.path.join(d, f"{t}.orc"), rows, schema)
+    return d
+
+
+@pytest.fixture(scope="module")
+def orc_engine(tpch_dir):
+    return LocalEngine(OrcConnector(tpch_dir))
+
+
+@pytest.mark.parametrize("qid", [1, 3, 6, 12])
+def test_tpch_from_orc_files(orc_engine, qid):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpch_queries import QUERIES
+
+    gen = LocalEngine(TpchConnector(SF))
+    got = orc_engine.execute_sql(QUERIES[qid])
+    exp = gen.execute_sql(QUERIES[qid])
+    assert len(got) == len(exp), qid
+    for g, e in zip(got, exp):
+        for a, b in zip(g, e):
+            if isinstance(a, float) and isinstance(b, float):
+                assert a == pytest.approx(b, rel=1e-9)
+            else:
+                assert a == b, (qid, g, e)
+
+
+def test_lazy_projection(tpch_dir):
+    conn = OrcConnector(tpch_dir)
+    t = conn.table("customer")
+    assert isinstance(t, OrcTable)
+    t.page(columns=["c_custkey"])
+    assert "c_custkey" in t.arrays.keys()
+    assert "c_comment" not in t.arrays.keys()
+
+
+def test_multifile_stripe_splits(tpch_dir):
+    conn = OrcConnector(tpch_dir)
+    full = conn.table("lineitem")
+    assert len(full.paths) == 2
+    total = 0
+    keys = []
+    n_parts = min(4, len(full.units))
+    for p in range(n_parts):
+        t = conn.table("lineitem", part=p, num_parts=n_parts)
+        total += t.num_rows
+        keys.extend(np.asarray(
+            t.arrays["l_orderkey"][:t.num_rows]).tolist())
+    assert total == full.num_rows
+    import collections
+    whole = collections.Counter(np.asarray(
+        full.arrays["l_orderkey"][:full.num_rows]).tolist())
+    assert collections.Counter(keys) == whole
