@@ -37,8 +37,18 @@ def _local_engine(spec: str):
         MemoryConnector, TpcdsConnector, TpchConnector,
     )
     from presto_tpu.exec.engine import LocalEngine
-    name, _, scale = spec.partition(":")
-    sf = float(scale or "0.01")
+    name, _, arg = spec.partition(":")
+    if name in ("parquet", "orc"):
+        # lakehouse directory catalogs: --local parquet:/data/dir
+        if not arg:
+            raise SystemExit(f"--local {name}:<directory> needs a path")
+        if name == "parquet":
+            from presto_tpu.connectors.parquet import ParquetConnector
+            return LocalEngine(MemoryConnector(
+                fallback=ParquetConnector(arg)))
+        from presto_tpu.connectors.orc import OrcConnector
+        return LocalEngine(MemoryConnector(fallback=OrcConnector(arg)))
+    sf = float(arg or "0.01")
     conn = {"tpch": TpchConnector, "tpcds": TpcdsConnector}.get(name)
     if conn is None:
         raise SystemExit(f"unknown local connector {name!r}")

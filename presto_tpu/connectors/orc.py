@@ -19,12 +19,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from presto_tpu.connectors.base import SplitSource
 from presto_tpu.connectors.parquet import (
-    LazyFileTable, _LazyArrays, _arrow_to_type, _decode_column,
-    _type_to_arrow,
+    FileCatalogConnector, LazyFileTable, _LazyArrays, _arrow_to_type,
+    _decode_column,
 )
-from presto_tpu.connectors.tpch import HostTable, _slice_rows
+from presto_tpu.connectors.tpch import HostTable
 from presto_tpu.data.column import StringDict
 from presto_tpu.types import Type
 
@@ -102,105 +101,24 @@ def read_orc_table(path: str, name: str) -> OrcTable:
 def write_orc_table(path: str, rows: List[tuple], schema,
                     stripe_size: Optional[int] = None) -> None:
     """Engine result rows -> one ORC file (write side for round trips;
-    reference role: OrcWriter)."""
-    import pyarrow as pa
+    reference role: OrcWriter). Value coercion is the shared
+    rows_to_arrow_table."""
     import pyarrow.orc as orc
 
-    cols, fields = [], []
-    for i, (name, t) in enumerate(schema):
-        vals = [r[i] for r in rows]
-        if t.is_decimal:
-            from decimal import Decimal
-            vals = [None if v is None else
-                    (v if isinstance(v, Decimal)
-                     else Decimal(str(round(v, t.scale))))
-                    for v in vals]
-        if t.name == "date":
-            import datetime
-            epoch = datetime.date(1970, 1, 1)
-            vals = [None if v is None else
-                    (v if isinstance(v, datetime.date)
-                     else epoch + datetime.timedelta(days=int(v)))
-                    for v in vals]
-        fields.append(pa.field(name, _type_to_arrow(t)))
-        cols.append(pa.array(vals, type=_type_to_arrow(t)))
+    from presto_tpu.connectors.parquet import rows_to_arrow_table
     kw = {}
     if stripe_size:
         kw["stripe_size"] = stripe_size
-    orc.write_table(pa.Table.from_arrays(cols,
-                                         schema=pa.schema(fields)),
-                    path, **kw)
+    orc.write_table(rows_to_arrow_table(rows, schema), path, **kw)
 
 
-class OrcConnector(SplitSource):
+class OrcConnector(FileCatalogConnector):
     NAME = "orc"
-    """Directory catalog: `<dir>/<table>.orc` or `<dir>/<table>/`
-    (multi-file). Splits are stripe ranges."""
+    EXT = "orc"
 
-    def __init__(self, directory: str, fallback=None):
-        self.directory = directory
-        self.fallback = fallback
-        self._cache: Dict[str, OrcTable] = {}
+    def _open(self, path: str, name: str) -> OrcTable:
+        return read_orc_table(path, name)
 
-    def _path(self, table: str) -> Optional[str]:
-        p = os.path.join(self.directory, f"{table}.orc")
-        if os.path.exists(p):
-            return p
-        d = os.path.join(self.directory, table)
-        if os.path.isdir(d):
-            return d
-        return None
-
-    def _load(self, table: str) -> Optional[OrcTable]:
-        if table in self._cache:
-            return self._cache[table]
-        p = self._path(table)
-        if p is None:
-            return None
-        t = read_orc_table(p, table)
-        self._cache[table] = t
-        return t
-
-    def schema(self, table: str) -> List[Tuple[str, Type]]:
-        t = self._load(table)
-        if t is None:
-            if self.fallback is not None:
-                return self.fallback.schema(table)
-            raise KeyError(f"unknown table {table}")
-        return [(c, t.types[c]) for c in t.column_names()]
-
-    def row_count(self, table: str) -> int:
-        t = self._load(table)
-        if t is None:
-            if self.fallback is not None:
-                return self.fallback.row_count(table)
-            raise KeyError(f"unknown table {table}")
-        return t.num_rows
-
-    def table(self, name: str, part: int = 0, num_parts: int = 1
-              ) -> HostTable:
-        full = self._load(name)
-        if full is None:
-            if self.fallback is not None:
-                return self.fallback.table(name, part, num_parts)
-            raise KeyError(f"unknown table {name}")
-        if num_parts == 1:
-            return full
-        if len(full.units) >= num_parts:
-            lo, hi = _slice_rows(len(full.units), part, num_parts)
-            return OrcTable(name, full.paths, full.units[lo:hi],
-                            files=full._files,
-                            stripe_rows=full.stripe_lengths())
-        lo, hi = _slice_rows(full.num_rows, part, num_parts)
-        arrays = {c: full.arrays[c][lo:hi] for c in full.column_names()}
-        nulls = {c: full.null_mask(c)[lo:hi]
-                 for c in full.column_names()
-                 if full.null_mask(c) is not None}
-        return HostTable(name, hi - lo, arrays, full.types, full.dicts,
-                         nulls or None)
-
-    def invalidate(self, table: Optional[str] = None):
-        if table is None:
-            self._cache.clear()
-        else:
-            self._cache.pop(table, None)
+    def _slice(self, full, name: str, units) -> OrcTable:
+        return OrcTable(name, full.paths, units, files=full._files,
+                        stripe_rows=full.stripe_lengths())

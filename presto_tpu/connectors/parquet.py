@@ -281,12 +281,12 @@ def read_parquet_table(path: str, name: str) -> ParquetTable:
     return ParquetTable(name, [path])
 
 
-def write_parquet_table(path: str, rows: List[tuple],
-                        schema: Sequence[Tuple[str, Type]],
-                        row_group_size: Optional[int] = None):
-    """Engine result rows (to_pylist shape) -> one parquet file."""
+def rows_to_arrow_table(rows: List[tuple],
+                        schema: Sequence[Tuple[str, Type]]):
+    """Engine result rows (to_pylist shape) -> pa.Table with engine
+    value coercion (python Decimals, epoch-day dates) — THE shared
+    write-side conversion for every file format (parquet, orc)."""
     import pyarrow as pa
-    import pyarrow.parquet as pq
 
     cols = []
     fields = []
@@ -306,8 +306,17 @@ def write_parquet_table(path: str, rows: List[tuple],
                     for v in vals]
         fields.append(pa.field(name, _type_to_arrow(t)))
         cols.append(pa.array(vals, type=_type_to_arrow(t)))
-    pq.write_table(pa.Table.from_arrays(cols, schema=pa.schema(fields)),
-                   path, row_group_size=row_group_size)
+    return pa.Table.from_arrays(cols, schema=pa.schema(fields))
+
+
+def write_parquet_table(path: str, rows: List[tuple],
+                        schema: Sequence[Tuple[str, Type]],
+                        row_group_size: Optional[int] = None):
+    """Engine result rows (to_pylist shape) -> one parquet file."""
+    import pyarrow.parquet as pq
+
+    pq.write_table(rows_to_arrow_table(rows, schema), path,
+                   row_group_size=row_group_size)
 
 
 def write_host_table(table: HostTable, path: str,
@@ -366,20 +375,28 @@ def materialize_connector(conn, directory: str, tables: List[str],
                              row_group_size=row_group_size)
 
 
-class ParquetConnector(SplitSource):
-    NAME = "parquet"
-    """Directory catalog: `<dir>/<table>.parquet` (single file) or
-    `<dir>/<table>/` (multi-file, Hive-style). Splits are row-group
-    ranges; an optional fallback serves other names (multi-catalog
-    facade, as connectors/memory.py)."""
+class FileCatalogConnector(SplitSource):
+    """Shared directory-catalog mechanics for file formats:
+    `<dir>/<table>.<ext>` (single file) or `<dir>/<table>/`
+    (multi-file, Hive-style); splits are unit ranges; an optional
+    fallback serves other names (multi-catalog facade). Subclasses
+    supply EXT, `_open(path, name)` and `_slice(full, name, units)`."""
+
+    EXT = ""
 
     def __init__(self, directory: str, fallback=None):
         self.directory = directory
         self.fallback = fallback
-        self._cache: Dict[str, ParquetTable] = {}
+        self._cache: Dict[str, HostTable] = {}
+
+    def _open(self, path: str, name: str) -> HostTable:
+        raise NotImplementedError
+
+    def _slice(self, full, name: str, units) -> HostTable:
+        raise NotImplementedError
 
     def _path(self, table: str) -> Optional[str]:
-        p = os.path.join(self.directory, f"{table}.parquet")
+        p = os.path.join(self.directory, f"{table}.{self.EXT}")
         if os.path.exists(p):
             return p
         d = os.path.join(self.directory, table)
@@ -387,13 +404,13 @@ class ParquetConnector(SplitSource):
             return d
         return None
 
-    def _load(self, table: str) -> Optional[ParquetTable]:
+    def _load(self, table: str):
         if table in self._cache:
             return self._cache[table]
         p = self._path(table)
         if p is None:
             return None
-        t = read_parquet_table(p, table)
+        t = self._open(p, table)
         self._cache[table] = t
         return t
 
@@ -422,13 +439,12 @@ class ParquetConnector(SplitSource):
             raise KeyError(f"unknown table {name}")
         if num_parts == 1:
             return full
-        # split by ROW-GROUP ranges when the file layout allows it —
-        # a split then reads only its own column chunks — falling back
-        # to row slices when there are fewer groups than parts
+        # split by UNIT ranges (row groups / stripes) when the layout
+        # allows it — a split then reads only its own column chunks —
+        # falling back to row slices when there are fewer units
         if len(full.units) >= num_parts:
             lo, hi = _slice_rows(len(full.units), part, num_parts)
-            return ParquetTable(name, full.paths, full.units[lo:hi],
-                                files=full._files)
+            return self._slice(full, name, full.units[lo:hi])
         lo, hi = _slice_rows(full.num_rows, part, num_parts)
         arrays = {c: full.arrays[c][lo:hi] for c in full.column_names()}
         nulls = {c: full.null_mask(c)[lo:hi]
@@ -442,3 +458,15 @@ class ParquetConnector(SplitSource):
             self._cache.clear()
         else:
             self._cache.pop(table, None)
+
+
+class ParquetConnector(FileCatalogConnector):
+    NAME = "parquet"
+    EXT = "parquet"
+
+    def _open(self, path: str, name: str) -> "ParquetTable":
+        return read_parquet_table(path, name)
+
+    def _slice(self, full, name: str, units) -> "ParquetTable":
+        return ParquetTable(name, full.paths, units,
+                            files=full._files)

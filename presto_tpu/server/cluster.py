@@ -927,6 +927,7 @@ class TpuCluster:
         from presto_tpu.server.task_manager import TpuTaskManager
 
         failed = threading.Event()
+        root_cause: List[BaseException] = []
 
         def drain(uri):
             stream = PageStream(
@@ -941,8 +942,10 @@ class TpuCluster:
                     data = stream.fetch()
                     for p in decode_pages(data, out_types):
                         rows.extend(p.to_pylist())
-            except BaseException:
-                failed.set()            # fail fast across all drains
+            except BaseException as e:
+                if not failed.is_set():
+                    root_cause.append(e)   # the REAL failure, not the
+                failed.set()               # siblings' abort placeholder
                 raise
             finally:
                 stream.close()
@@ -977,9 +980,14 @@ class TpuCluster:
                     return (a < b) == k.ascending
                 return False
 
-        with ThreadPoolExecutor(
-                max_workers=min(len(root.task_uris), 16)) as pool:
-            runs = list(pool.map(drain, root.task_uris))
+        try:
+            with ThreadPoolExecutor(
+                    max_workers=min(len(root.task_uris), 16)) as pool:
+                runs = list(pool.map(drain, root.task_uris))
+        except BaseException:
+            if root_cause:
+                raise root_cause[0]
+            raise
         rows: List[tuple] = []
         for r in runs:
             rows.extend(r)
