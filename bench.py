@@ -392,7 +392,10 @@ def _main_orchestrator(sf, qids) -> None:
       instead of burning N x BENCH_QUERY_TIMEOUT;
     - infra failure is always labeled (`infra_error`), never an
       unlabeled 0.0."""
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
+    # a HEALTHY tunnel compiles the trivial probe in seconds; 300 s per
+    # attempt x 5 attempts + growing backoffs spans a ~40-minute window
+    # when wedged while still fitting a bounded driver budget
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
     probe_log = []
     err = _probe_with_retry(probe_attempts, probe_timeout, probe_log)

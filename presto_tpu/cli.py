@@ -42,12 +42,10 @@ def _local_engine(spec: str):
         # lakehouse directory catalogs: --local parquet:/data/dir
         if not arg:
             raise SystemExit(f"--local {name}:<directory> needs a path")
-        if name == "parquet":
-            from presto_tpu.connectors.parquet import ParquetConnector
-            return LocalEngine(MemoryConnector(
-                fallback=ParquetConnector(arg)))
         from presto_tpu.connectors.orc import OrcConnector
-        return LocalEngine(MemoryConnector(fallback=OrcConnector(arg)))
+        from presto_tpu.connectors.parquet import ParquetConnector
+        cls = {"parquet": ParquetConnector, "orc": OrcConnector}[name]
+        return LocalEngine(MemoryConnector(fallback=cls(arg)))
     sf = float(arg or "0.01")
     conn = {"tpch": TpchConnector, "tpcds": TpcdsConnector}.get(name)
     if conn is None:

@@ -21,11 +21,9 @@ import numpy as np
 
 from presto_tpu.connectors.parquet import (
     FileCatalogConnector, LazyFileTable, _LazyArrays, _arrow_to_type,
-    _decode_column,
+    _decode_column, rows_to_arrow_table,
 )
-from presto_tpu.connectors.tpch import HostTable
 from presto_tpu.data.column import StringDict
-from presto_tpu.types import Type
 
 
 class OrcTable(LazyFileTable):
@@ -105,7 +103,6 @@ def write_orc_table(path: str, rows: List[tuple], schema,
     rows_to_arrow_table."""
     import pyarrow.orc as orc
 
-    from presto_tpu.connectors.parquet import rows_to_arrow_table
     kw = {}
     if stripe_size:
         kw["stripe_size"] = stripe_size

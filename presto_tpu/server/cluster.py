@@ -984,7 +984,9 @@ class TpuCluster:
             with ThreadPoolExecutor(
                     max_workers=min(len(root.task_uris), 16)) as pool:
                 runs = list(pool.map(drain, root.task_uris))
-        except BaseException:
+        except (ClusterQueryError, OSError):
+            # surface the FIRST REAL drain failure, not a sibling's
+            # abort placeholder; interrupts pass through untouched
             if root_cause:
                 raise root_cause[0]
             raise
