@@ -122,6 +122,12 @@ class _Query:
         return out
 
 
+def _query_info(q) -> dict:
+    """ONE query-info shape for the list and detail endpoints."""
+    return {"queryId": q.qid, "state": q.state, "query": q.sql,
+            "error": q.error}
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
@@ -153,13 +159,47 @@ class _Handler(BaseHTTPRequestHandler):
             q.done.wait(timeout=1.0)
             return self._json(200, q.results_json(self.server.base,
                                                   int(m.group(2))))
+        if path == "/v1/query":
+            # the query list (QueryResource.getAllQueryInfo role —
+            # the UI's landing data)
+            co = self.server.coordinator
+            return self._json(200, [_query_info(q)
+                                    for q in list(co.queries.values())])
         if path.startswith("/v1/query/"):
             q = self.server.coordinator.queries.get(path.rsplit("/", 1)[-1])
             if q is None:
                 return self._json(404, {"error": "no query"})
-            return self._json(200, {"queryId": q.qid, "state": q.state,
-                                    "query": q.sql,
-                                    "error": q.error})
+            return self._json(200, _query_info(q))
+        if path == "/v1/cluster":
+            # ClusterStatsResource role: the cluster-overview numbers
+            # the reference UI polls (running/queued/finished counts,
+            # worker membership, memory reservation)
+            co = self.server.coordinator
+            qs = list(co.queries.values())
+            queued = sum(1 for q in qs if q.state == "QUEUED")
+            running = sum(1 for q in qs
+                          if not q.done.is_set()
+                          and q.state != "QUEUED")
+            failed = sum(1 for q in qs
+                         if q.done.is_set() and q.error is not None)
+            finished = sum(1 for q in qs
+                           if q.done.is_set() and q.error is None)
+            eng = co.engine
+            workers = list(getattr(eng, "worker_uris", []) or [])
+            mem = 0
+            pool = getattr(eng, "memory_pool", None)
+            if pool is not None:
+                mem = pool.reserved
+            return self._json(200, {
+                "runningQueries": running,
+                "queuedQueries": queued,
+                "finishedQueries": finished,
+                "failedQueries": failed,
+                "trackedQueries": len(qs),
+                "activeWorkers": len(workers),
+                "workers": workers,
+                "reservedMemoryBytes": mem,
+            })
         return self._json(404, {"error": f"no route {path}"})
 
     def do_DELETE(self):

@@ -79,3 +79,37 @@ def test_cli_execute_against_server(server):
         capture_output=True, text=True, timeout=120, cwd="/root/repo")
     assert r.returncode == 0, r.stderr
     assert "AFRICA" in r.stdout and "(5 rows)" in r.stdout
+
+
+def test_cluster_stats_and_query_list_endpoints():
+    """ClusterStatsResource + QueryResource.getAllQueryInfo roles: the
+    coordinator overview the reference UI polls."""
+    import json as _json
+    import urllib.request
+
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.server.cluster import TpuCluster
+    from presto_tpu.server.statement import StatementServer, run_statement
+
+    cluster = TpuCluster(TpchConnector(0.01), n_workers=2)
+    srv = StatementServer(cluster).start()
+    try:
+        _cols, rows = run_statement(srv.base,
+                                    "select count(*) from region")
+        assert rows == [[5]]
+        with urllib.request.urlopen(f"{srv.base}/v1/cluster",
+                                    timeout=10) as resp:
+            stats = _json.loads(resp.read())
+        assert stats["activeWorkers"] == 2
+        assert stats["finishedQueries"] >= 1
+        assert stats["failedQueries"] == 0
+        assert len(stats["workers"]) == 2
+        with urllib.request.urlopen(f"{srv.base}/v1/query",
+                                    timeout=10) as resp:
+            qlist = _json.loads(resp.read())
+        assert any("region" in q["query"] for q in qlist)
+        assert all(q["state"] in ("QUEUED", "RUNNING", "FINISHED",
+                                  "FAILED") for q in qlist)
+    finally:
+        srv.stop()
+        cluster.stop()
