@@ -22,7 +22,7 @@ from __future__ import annotations
 import decimal
 import json
 import time
-import urllib.request
+import uuid
 from typing import Any, List, Optional, Sequence, Tuple
 
 apilevel = "2.0"
@@ -198,20 +198,27 @@ class Cursor:
         self._rows = []
 
     # ---------------------------------------------------------- transport
+    # (protocol/transport.py: retries with backoff + error
+    # classification; every transport failure subclasses OSError)
     def _post(self, sql: str) -> dict:
-        req = urllib.request.Request(
-            f"{self._conn.base}/v1/statement", data=sql.encode(),
-            method="POST", headers={"Content-Type": "text/plain"})
+        from presto_tpu.protocol.transport import get_client
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                return json.loads(resp.read())
+            # per-execute idempotency key: the transport auto-retries
+            # the POST, and the server dedupes on the key so a retry
+            # after a lost response attaches to the in-flight query
+            # instead of re-executing (INSERT/CTAS must not duplicate)
+            return get_client().post(
+                f"{self._conn.base}/v1/statement", sql.encode(),
+                headers={"Content-Type": "text/plain",
+                         "X-Presto-Idempotency-Key": uuid.uuid4().hex},
+                request_class="statement").json()
         except OSError as e:
             raise OperationalError(str(e)) from e
 
     def _get(self, uri: str) -> dict:
+        from presto_tpu.protocol.transport import get_client
         try:
-            with urllib.request.urlopen(uri, timeout=30) as resp:
-                return json.loads(resp.read())
+            return get_client().get_json(uri, request_class="statement")
         except OSError as e:
             raise OperationalError(str(e)) from e
 

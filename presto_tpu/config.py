@@ -115,6 +115,50 @@ PROPERTIES = [
 _BY_NAME = {p.name: p for p in PROPERTIES}
 
 
+@dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Intra-cluster HTTP transport knobs (reference: the reference
+    engine's HttpClientConfig / ExchangeClientConfig — request timeouts,
+    backoff schedule, failure-detector thresholds — one config object
+    instead of per-call-site literals). Per-request-class timeouts and
+    retry counts live here; `protocol/transport.py` builds its policy
+    table from this registry."""
+
+    # per-request-class (timeout seconds, attempts incl. the first try)
+    probe_timeout_s: float = 2.0           # /v1/info liveness probes
+    probe_attempts: int = 1                # a probe IS the retry
+    control_timeout_s: float = 10.0        # ack / abort / delete / info
+    control_attempts: int = 2
+    page_fetch_timeout_s: float = 30.0     # results GETs (long-poll)
+    page_fetch_attempts: int = 5           # ExchangeClient.java:322 role
+    status_poll_timeout_s: float = 30.0    # task status long-polls
+    status_poll_attempts: int = 3
+    task_post_timeout_s: float = 60.0      # TaskUpdateRequest POSTs
+    task_post_attempts: int = 4            # at-least-once update protocol
+    announce_timeout_s: float = 5.0        # discovery announcements
+    announce_attempts: int = 1             # the announcer loop re-tries
+    statement_timeout_s: float = 30.0      # client statement protocol
+    statement_attempts: int = 3
+    remote_function_timeout_s: float = 60.0
+    remote_function_attempts: int = 3
+
+    # exponential backoff + full jitter between retryable failures
+    retry_base_backoff_s: float = 0.05
+    retry_max_backoff_s: float = 2.0
+    # total time a single logical request may spend retrying
+    retry_budget_s: float = 15.0
+
+    # per-worker circuit breaker (HeartbeatFailureDetector role):
+    # consecutive failures to OPEN, then a cooldown before ONE
+    # half-open probe may test whether the worker recovered
+    breaker_failure_threshold: int = 3
+    breaker_cooldown_s: float = 5.0
+
+
+#: process defaults; tests construct their own with tighter windows
+DEFAULT_TRANSPORT = TransportConfig()
+
+
 class Session:
     """One query session: defaults overridden by string-typed properties
     (the wire form). Unknown properties are rejected loudly, like the

@@ -1139,7 +1139,8 @@ def _remote_function_call(rf, cols, rt: Type, page: Page) -> Column:
 
     def host(num_rows, *flat):
         import json as _json
-        import urllib.request
+
+        from presto_tpu.protocol.transport import get_client
         n = int(num_rows)
         values, nullcols = [], []
         for i in range(0, len(flat), 2):
@@ -1161,15 +1162,16 @@ def _remote_function_call(rf, cols, rt: Type, page: Page) -> Column:
             nullcols.append([bool(x) for x in nl])
         body = _json.dumps({"function": rf.name, "values": values,
                             "nulls": nullcols}).encode()
-        req = urllib.request.Request(
-            rf.url, data=body, method="POST",
+        # the sidecar call is a pure function of its inputs, so
+        # transport-level retries cannot change the result
+        doc = get_client().post(
+            rf.url, body,
             headers={"Content-Type": "application/json",
                      # marks the request EXTERNAL: the internal-auth
                      # opener must not attach the cluster JWT to a
                      # sidecar outside the trust boundary
-                     "X-Presto-External": "true"})
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            doc = _json.loads(resp.read())
+                     "X-Presto-External": "true"},
+            request_class="remote_function").json()
         rv = doc["values"]
         rn = doc.get("nulls") or [v is None for v in rv]
         out = np.full(cap, sentinel, dtype=out_dtype)

@@ -5,13 +5,53 @@ presto_cpp/main/PrestoExchangeSource.cpp: sequenced GET
 /v1/task/{id}/results/{buffer}/{token}, acknowledge, DELETE on close; the
 X-Presto-* headers carry token progression and completion. This client is
 synchronous (one upstream at a time per call site); the worker's own
-RemoteSource lowering fans out over upstream locations."""
+RemoteSource lowering fans out over upstream locations.
+
+All HTTP rides `protocol/transport.HttpClient` (retries with backoff,
+error classification, per-worker circuit breakers). On top of that this
+module adds page-protocol-level defenses: a truncated response body
+(connection dropped mid-transfer, or an injected fault) is detected by
+frame validation BEFORE the token is acknowledged, so the same token is
+simply re-fetched — the server re-serves un-acknowledged frames, and a
+replay can neither skip nor duplicate pages."""
 
 from __future__ import annotations
 
-import urllib.error
-import urllib.request
+import struct
 from typing import List, Optional, Tuple
+
+from presto_tpu.protocol.transport import (
+    HttpClient, RetriesExhaustedError, TransportError,
+    WorkerRestartedError, get_client,
+)
+
+_FRAME_HEADER = struct.Struct("<ibiiq")     # serde SerializedPage header
+
+
+def count_frames(data: bytes) -> Optional[int]:
+    """Number of whole SerializedPage frames in `data`, or None if the
+    body ends mid-frame — walks the 21-byte headers without decoding
+    payloads, so a body cut inside a frame (truncation) is caught
+    before any token acknowledge."""
+    off = 0
+    n = len(data)
+    count = 0
+    while off < n:
+        if off + _FRAME_HEADER.size > n:
+            return None
+        size = _FRAME_HEADER.unpack_from(data, off)[3]
+        if size < 0:
+            return None
+        off += _FRAME_HEADER.size + size
+        if off > n:
+            return None
+        count += 1
+    return count
+
+
+def frames_complete(data: bytes) -> bool:
+    """True iff `data` is a whole number of SerializedPage frames."""
+    return count_frames(data) is not None
 
 
 class PageStream:
@@ -20,58 +60,81 @@ class PageStream:
     ExchangeClient.java maxResponseSize / PrestoExchangeSource's
     kMaxBytes) so one pull round never materializes more than a chunk."""
 
+    #: replays of one token on truncated bodies before giving up
+    TRUNCATION_RETRIES = 4
+
     def __init__(self, task_uri: str, buffer_id: str = "0",
                  max_wait: str = "1s",
-                 max_size_bytes: Optional[int] = None):
+                 max_size_bytes: Optional[int] = None,
+                 client: Optional[HttpClient] = None):
         self.base = task_uri.rstrip("/")
         self.buffer_id = buffer_id
         self.max_wait = max_wait
         self.max_size_bytes = max_size_bytes
+        self.client = client or get_client()
         self.token = 0
         self.complete = False
         self.task_instance_id: Optional[str] = None
 
-    #: transient-failure retry schedule (reference: PageBufferClient's
-    #: exponential backoff, ExchangeClient.java:322)
-    RETRIES = 4
-    BACKOFF_BASE_S = 0.1
-
-    def _get(self, url: str) -> Tuple[bytes, dict]:
-        import time as _time
-
+    def _get(self, url: str, validate: bool = False
+             ) -> Tuple[bytes, dict]:
+        """One transport GET; with `validate`, a body that does not
+        parse as complete frames — or whose frame count disagrees with
+        the token advance the server's headers claim — counts as a
+        transient failure and the SAME url (same un-acknowledged token)
+        is fetched again."""
         headers = {"X-Presto-Max-Wait": self.max_wait}
         if self.max_size_bytes is not None:
             headers["X-Presto-Max-Size"] = f"{self.max_size_bytes}B"
         last: Optional[BaseException] = None
-        for attempt in range(self.RETRIES + 1):
-            try:
-                req = urllib.request.Request(url, headers=headers)
-                with urllib.request.urlopen(req, timeout=30) as resp:
-                    return resp.read(), dict(resp.headers)
-            except (urllib.error.URLError, OSError) as e:
-                # token-sequenced GETs are idempotent: the server
-                # re-serves un-acknowledged frames, so a retry after a
-                # dropped response cannot skip or duplicate pages
-                last = e
-                if attempt < self.RETRIES:
-                    _time.sleep(self.BACKOFF_BASE_S * (2 ** attempt))
-        raise last
+        for _attempt in range(self.TRUNCATION_RETRIES + 1):
+            resp = self.client.request(url, headers=headers,
+                                       request_class="page_fetch")
+            if not validate:
+                return resp.body, resp.headers
+            problem = self._body_problem(resp)
+            if problem is None:
+                return resp.body, resp.headers
+            last = TransportError(f"{problem} from {url}")
+        raise RetriesExhaustedError(
+            f"page body from {url} still truncated after "
+            f"{self.TRUNCATION_RETRIES + 1} fetch(es)") from last
+
+    def _body_problem(self, resp) -> Optional[str]:
+        """None if the body is intact, else why it must be re-fetched.
+        Frame-walking alone misses a truncation landing exactly on a
+        frame boundary (the body parses, pages are silently missing),
+        so the frame count is also cross-checked against the token
+        advance the server claims in X-Presto-Page-End-Sequence-Id."""
+        nframes = count_frames(resp.body)
+        if nframes is None:
+            return "truncated page body"
+        end = resp.headers.get("X-Presto-Page-End-Sequence-Id")
+        if end is not None and int(end) - self.token != nframes:
+            return (f"page body carries {nframes} frame(s) but the "
+                    f"token advance claims {int(end) - self.token} "
+                    "(truncated on a frame boundary)")
+        return None
 
     def fetch(self) -> bytes:
         """One round: GET next frames, acknowledge, advance the token."""
         url = f"{self.base}/results/{self.buffer_id}/{self.token}"
-        body, headers = self._get(url)
+        body, headers = self._get(url, validate=True)
         instance = headers.get("X-Presto-Task-Instance-Id")
         if self.task_instance_id is None:
             self.task_instance_id = instance
         elif instance != self.task_instance_id:
-            raise RuntimeError("task instance changed mid-stream "
-                               "(worker restarted)")
+            raise WorkerRestartedError(
+                f"task instance changed mid-stream on {self.base} "
+                "(worker restarted)")
         nxt = int(headers.get("X-Presto-Page-End-Sequence-Id",
                               self.token))
         self.complete = (headers.get("X-Presto-Buffer-Complete",
                                      "false") == "true")
         if nxt > self.token:
+            # token-sequenced GETs are idempotent: the server re-serves
+            # un-acknowledged frames, so everything up to here is safe
+            # to replay; the ack is what advances the server cursor
             self._get(f"{self.base}/results/{self.buffer_id}/{nxt}"
                       f"/acknowledge")
             self.token = nxt
@@ -79,10 +142,8 @@ class PageStream:
 
     def close(self):
         """Release the buffer (reference: abortResults DELETE)."""
-        req = urllib.request.Request(
-            f"{self.base}/results/{self.buffer_id}", method="DELETE")
         try:
-            urllib.request.urlopen(req, timeout=10).read()
+            self.client.delete(f"{self.base}/results/{self.buffer_id}")
         except Exception:            # noqa: BLE001 — abort is best-effort
             pass
 

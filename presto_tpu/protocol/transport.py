@@ -1,0 +1,347 @@
+"""Fault-tolerant intra-cluster HTTP transport — the single RPC
+chokepoint.
+
+Every HTTP byte this engine sends (task POSTs, status long-polls, page
+fetches, liveness probes, announcements, statement-protocol calls,
+remote-function invocations) goes through `HttpClient.request`. The
+reference pairing splits these roles across PageBufferClient's
+exponential backoff (ExchangeClient.java:322), HttpRemoteTask's
+update-failure classification, and HeartbeatFailureDetector's
+continuous re-probing (failureDetector/HeartbeatFailureDetector.java:76);
+here one client provides:
+
+  (a) per-request-class retry policies — exponential backoff with FULL
+      jitter, bounded by both an attempt count and a wall-clock retry
+      budget (config.TransportConfig);
+  (b) error classification — retryable (connection refused/reset,
+      timeouts, torn mid-body reads, 5xx) vs fatal (4xx, protocol
+      violations) vs
+      worker-death (`CircuitOpenError`, `WorkerRestartedError`), all
+      subclassing OSError so the cluster's streaming-mode recovery
+      (`cluster._execute_plan`'s `except (ClusterQueryError, OSError)`)
+      catches them without new plumbing;
+  (c) a per-worker circuit breaker with half-open probing: a host that
+      keeps failing fast-fails callers (no 2s timeout per probe of a
+      dead node), and after a cooldown exactly ONE request is let
+      through to test recovery — the failure detector re-admits
+      restarted workers through this gate instead of banning them
+      forever.
+
+A deterministic `FaultInjector` (testing/faults.py) can be installed on
+any client; its hooks run inside `request` so injected faults exercise
+the real retry/classification/breaker paths.
+
+The module still calls `urllib.request.urlopen` internally — the ONLY
+place in presto_tpu that may (tests/test_rpc_chokepoint.py enforces
+this) — so the internal-JWT opener installed by server/auth.py keeps
+signing every request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json as _json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional
+
+from presto_tpu.config import DEFAULT_TRANSPORT, TransportConfig
+
+log = logging.getLogger("presto_tpu.transport")
+
+
+# --------------------------------------------------------------------------
+# Error taxonomy. All transport failures are OSError subclasses on
+# purpose: the existing recovery ladders (`cluster._execute_plan`,
+# `_run_fragments` task recovery, PageStream callers) already catch
+# `(ClusterQueryError, OSError)`.
+class TransportError(OSError):
+    """Base for every failure the transport layer surfaces."""
+
+
+class RetriesExhaustedError(TransportError):
+    """A retryable failure persisted past the policy's attempt count or
+    retry budget; `__cause__` carries the last underlying error."""
+
+
+class FatalResponseError(TransportError):
+    """A 4xx response: the request itself is wrong (or the resource is
+    gone) — retrying the same bytes cannot succeed."""
+
+    def __init__(self, url: str, status: int, body: bytes = b""):
+        super().__init__(f"HTTP {status} from {url}")
+        self.status = status
+        self.body = body
+
+
+class CircuitOpenError(TransportError):
+    """The target worker's breaker is OPEN (worker-death
+    classification): fail fast instead of burning a timeout."""
+
+
+class WorkerRestartedError(TransportError):
+    """The task instance id changed mid-stream: the worker restarted
+    and its buffers are gone (worker-death classification)."""
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Classify an exception from one attempt. HTTPError must be
+    checked before URLError (it is a subclass)."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500
+    if isinstance(exc, (FatalResponseError, CircuitOpenError,
+                        WorkerRestartedError)):
+        return False
+    # URLError wraps connection refused/reset and DNS failures;
+    # socket.timeout is an OSError; ConnectionError covers
+    # refused/reset/aborted raised directly; HTTPException covers
+    # mid-body disconnects surfacing as IncompleteRead/BadStatusLine
+    # (NOT OSError subclasses) from resp.read()
+    return isinstance(exc, (urllib.error.URLError, TimeoutError,
+                            ConnectionError, OSError,
+                            http.client.HTTPException))
+
+
+# --------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class RequestPolicy:
+    timeout_s: float
+    attempts: int
+
+
+def _build_policies(cfg: TransportConfig) -> Dict[str, RequestPolicy]:
+    return {
+        "probe": RequestPolicy(cfg.probe_timeout_s, cfg.probe_attempts),
+        "control": RequestPolicy(cfg.control_timeout_s,
+                                 cfg.control_attempts),
+        "page_fetch": RequestPolicy(cfg.page_fetch_timeout_s,
+                                    cfg.page_fetch_attempts),
+        "status_poll": RequestPolicy(cfg.status_poll_timeout_s,
+                                     cfg.status_poll_attempts),
+        "task_post": RequestPolicy(cfg.task_post_timeout_s,
+                                   cfg.task_post_attempts),
+        "announce": RequestPolicy(cfg.announce_timeout_s,
+                                  cfg.announce_attempts),
+        "statement": RequestPolicy(cfg.statement_timeout_s,
+                                   cfg.statement_attempts),
+        "remote_function": RequestPolicy(cfg.remote_function_timeout_s,
+                                         cfg.remote_function_attempts),
+    }
+
+
+class CircuitBreaker:
+    """CLOSED -> OPEN after `threshold` consecutive failures; OPEN ->
+    HALF_OPEN after `cooldown_s`, admitting exactly one probe at a
+    time; the probe's outcome decides CLOSED vs back to OPEN."""
+
+    CLOSED, OPEN, HALF_OPEN = "CLOSED", "OPEN", "HALF_OPEN"
+
+    def __init__(self, threshold: int, cooldown_s: float, clock=None):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = cooldown_s
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if self._clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self.state = self.HALF_OPEN
+                self._probing = True
+                return True
+            # HALF_OPEN: one outstanding probe owns the trial
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self.state = self.CLOSED
+            self.failures = 0
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self.failures += 1
+            if self.state == self.HALF_OPEN \
+                    or self.failures >= self.threshold:
+                self.state = self.OPEN
+                self._opened_at = self._clock()
+            self._probing = False
+
+
+class Response:
+    __slots__ = ("status", "body", "headers", "url")
+
+    def __init__(self, url: str, status: int, body: bytes,
+                 headers: dict):
+        self.url = url
+        self.status = status
+        self.body = body
+        self.headers = headers
+
+    def json(self):
+        return _json.loads(self.body)
+
+
+def _host_of(url: str) -> str:
+    return urllib.parse.urlsplit(url).netloc or url
+
+
+class HttpClient:
+    """One fault-tolerant HTTP client; breakers are keyed per host so a
+    coordinator-side instance tracks each worker independently."""
+
+    def __init__(self, config: Optional[TransportConfig] = None,
+                 fault_injector=None, rng: Optional[random.Random] = None,
+                 clock=None, sleep=None):
+        self.config = config or DEFAULT_TRANSPORT
+        self.policies = _build_policies(self.config)
+        self.fault_injector = fault_injector
+        self._rng = rng or random.Random()
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ breakers
+    def breaker(self, url_or_host: str) -> CircuitBreaker:
+        host = _host_of(url_or_host)
+        with self._lock:
+            br = self._breakers.get(host)
+            if br is None:
+                br = CircuitBreaker(self.config.breaker_failure_threshold,
+                                    self.config.breaker_cooldown_s,
+                                    clock=self._clock)
+                self._breakers[host] = br
+            return br
+
+    # ------------------------------------------------------------- request
+    def request(self, url: str, method: str = "GET",
+                body: Optional[bytes] = None,
+                headers: Optional[dict] = None,
+                request_class: str = "control",
+                timeout: Optional[float] = None,
+                attempts: Optional[int] = None) -> Response:
+        """One logical RPC: classify + retry + breaker-account every
+        attempt. Raises FatalResponseError (4xx), CircuitOpenError
+        (breaker OPEN), or RetriesExhaustedError (retryables past the
+        budget)."""
+        policy = self.policies[request_class]
+        timeout = policy.timeout_s if timeout is None else timeout
+        max_attempts = policy.attempts if attempts is None else attempts
+        breaker = self.breaker(url)
+        injector = self.fault_injector
+        deadline = self._clock() + self.config.retry_budget_s
+        # the breaker gates the START of a logical request (fast-fail
+        # instead of burning a timeout on a known-dead worker); within
+        # one request the retry policy governs, so a request whose own
+        # early attempts trip the threshold may still recover
+        if not breaker.allow():
+            raise CircuitOpenError(
+                f"circuit open for {_host_of(url)} ({url})")
+        last: Optional[BaseException] = None
+        for attempt in range(max_attempts):
+            try:
+                if injector is not None:
+                    injector.before_request(url, method)
+                req = urllib.request.Request(
+                    url, data=body, method=method, headers=headers or {})
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    raw = resp.read()
+                    resp_headers = dict(resp.headers)
+                    status = resp.status
+                if injector is not None:
+                    raw = injector.after_response(url, method, raw)
+                breaker.record_success()
+                return Response(url, status, raw, resp_headers)
+            except urllib.error.HTTPError as e:
+                err_body = b""
+                try:
+                    err_body = e.read()
+                except Exception:   # noqa: BLE001 — body is best-effort
+                    pass
+                if e.code < 500:
+                    # the worker answered: it is alive, the REQUEST is
+                    # bad — don't punish the breaker, don't retry
+                    breaker.record_success()
+                    raise FatalResponseError(url, e.code, err_body) \
+                        from e
+                breaker.record_failure()
+                last = e
+            except (urllib.error.URLError, TimeoutError, ConnectionError,
+                    OSError, http.client.HTTPException) as e:
+                # HTTPException: a mid-body disconnect raises
+                # IncompleteRead/BadStatusLine from resp.read(), which
+                # are NOT OSErrors — retry them like any torn connection
+                breaker.record_failure()
+                last = e
+            except BaseException:
+                # unclassified failure: account it so a half-open probe
+                # slot is never leaked, then propagate untouched
+                breaker.record_failure()
+                raise
+            if attempt + 1 >= max_attempts:
+                break
+            backoff = min(self.config.retry_base_backoff_s * (2 ** attempt),
+                          self.config.retry_max_backoff_s)
+            backoff *= self._rng.random()         # full jitter
+            if self._clock() + backoff > deadline:
+                break                             # retry budget exhausted
+            self._sleep(backoff)
+        raise RetriesExhaustedError(
+            f"{method} {url} failed after {max_attempts} attempt(s): "
+            f"{last}") from last
+
+    # --------------------------------------------------------- conveniences
+    def get_json(self, url: str, headers: Optional[dict] = None,
+                 request_class: str = "control",
+                 timeout: Optional[float] = None):
+        return self.request(url, headers=headers,
+                            request_class=request_class,
+                            timeout=timeout).json()
+
+    def post(self, url: str, body: bytes,
+             headers: Optional[dict] = None,
+             request_class: str = "task_post",
+             timeout: Optional[float] = None) -> Response:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        return self.request(url, method="POST", body=body, headers=hdrs,
+                            request_class=request_class, timeout=timeout)
+
+    def delete(self, url: str, request_class: str = "control",
+               timeout: Optional[float] = None) -> Response:
+        return self.request(url, method="DELETE",
+                            request_class=request_class, timeout=timeout)
+
+
+# --------------------------------------------------------------------------
+#: process-wide shared client for call sites that don't own a cluster
+#: (PageStream defaults, DBAPI, statement client, remote functions).
+#: TpuCluster instances build their own so breaker state and fault
+#: injection stay per-cluster.
+_DEFAULT_CLIENT: Optional[HttpClient] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_client() -> HttpClient:
+    global _DEFAULT_CLIENT
+    with _DEFAULT_LOCK:
+        if _DEFAULT_CLIENT is None:
+            _DEFAULT_CLIENT = HttpClient()
+        return _DEFAULT_CLIENT

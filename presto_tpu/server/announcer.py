@@ -8,15 +8,21 @@ turns announcements into the active worker set."""
 from __future__ import annotations
 
 import json
+import logging
 import threading
-import urllib.request
+
+from presto_tpu.protocol.transport import HttpClient, get_client
+
+log = logging.getLogger("presto_tpu.announcer")
 
 
 class Announcer:
     def __init__(self, coordinator_uri: str, self_uri: str, node_id: str,
                  environment: str = "tpu", interval_s: float = 5.0,
-                 connector_ids: str = "tpch,tpcds,memory,parquet"):
+                 connector_ids: str = "tpch,tpcds,memory,parquet",
+                 client: HttpClient = None):
         self.coordinator_uri = coordinator_uri.rstrip("/")
+        self.client = client or get_client()
         self.self_uri = self_uri
         self.node_id = node_id
         self.environment = environment
@@ -47,20 +53,23 @@ class Announcer:
     def announce_once(self) -> bool:
         url = f"{self.coordinator_uri}/v1/announcement/{self.node_id}"
         body = json.dumps(self.payload()).encode()
-        req = urllib.request.Request(
-            url, data=body, method="PUT",
-            headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=5):
-                self.announcements += 1
-                return True
+            self.client.request(
+                url, method="PUT", body=body,
+                headers={"Content-Type": "application/json"},
+                request_class="announce")
+            self.announcements += 1
+            return True
         except Exception as e:               # noqa: BLE001 — keep retrying
             self.last_error = str(e)
             return False
 
     def _loop(self):
         while not self._stop.is_set():
-            self.announce_once()
+            try:
+                self.announce_once()
+            except Exception:   # noqa: BLE001 — the loop must survive
+                log.exception("announcement attempt failed; continuing")
             self._stop.wait(self.interval_s)
 
     def start(self):

@@ -22,19 +22,22 @@ SURVEY.md §5.8)."""
 from __future__ import annotations
 
 import dataclasses
-import json
+import logging
 import threading
 import time
-import urllib.request
 from typing import Dict, List, Optional, Tuple
 
+from presto_tpu.config import TransportConfig
 from presto_tpu.plan.fragment import add_exchanges, create_fragments
 from presto_tpu.plan.nodes import ExchangeNode, Partitioning, PlanNode
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.exchange_client import PageStream, decode_pages
 from presto_tpu.protocol.to_protocol import FragmentSpec, \
     fragment_to_protocol
+from presto_tpu.protocol.transport import HttpClient
 from presto_tpu.server.http import TpuWorkerServer
+
+log = logging.getLogger("presto_tpu.cluster")
 
 
 def _unshare(plan: PlanNode) -> PlanNode:
@@ -153,7 +156,8 @@ class TpuCluster:
     def __init__(self, connector, n_workers: int = 2,
                  session_properties: Optional[Dict[str, str]] = None,
                  resource_groups=None, history=None, discovery=None,
-                 shared_secret: Optional[str] = None):
+                 shared_secret: Optional[str] = None,
+                 transport_config: Optional[TransportConfig] = None):
         from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
 
@@ -186,6 +190,10 @@ class TpuCluster:
         self.all_worker_uris = [f"http://127.0.0.1:{w.port}"
                                 for w in self.workers]
         self.dead: set = set()
+        # this cluster's fault-tolerant RPC chokepoint: per-worker
+        # circuit breakers + per-request-class retry policies; chaos
+        # tests install a FaultInjector on it
+        self.http = HttpClient(config=transport_config)
         self._query_counter = 0
         self._lock = threading.Lock()
         self._plans: Dict[str, PlanNode] = {}
@@ -204,14 +212,20 @@ class TpuCluster:
         failureDetector/HeartbeatFailureDetector.java:76 + the
         discovery-announcement timeout in DiscoveryNodeManager): probe
         /v1/info, mark unreachable workers dead so the scheduler stops
-        placing tasks on them. Returns the live URI list."""
+        placing tasks on them — and RE-ADMIT recovered ones. Dead
+        workers keep being probed through the circuit breaker: while
+        its breaker is OPEN the probe fast-fails without touching the
+        network; once the cooldown elapses the half-open state lets
+        exactly one real probe through, and a restarted worker rejoins
+        the schedulable set instead of staying banned forever.
+        Returns the live URI list."""
         for uri in list(self.all_worker_uris):
-            if uri in self.dead:
-                continue
             try:
-                req = urllib.request.Request(f"{uri}/v1/info")
-                with urllib.request.urlopen(req, timeout=2) as resp:
-                    resp.read()
+                self.http.request(f"{uri}/v1/info",
+                                  request_class="probe")
+                if uri in self.dead:
+                    log.info("worker %s recovered; re-admitting", uri)
+                    self.dead.discard(uri)
             except Exception:     # noqa: BLE001 — any failure = dead node
                 self.dead.add(uri)
         return self.worker_uris
@@ -225,7 +239,11 @@ class TpuCluster:
 
         def loop():
             while not self._hb_stop.wait(interval_s):
-                self.check_workers()
+                try:
+                    self.check_workers()
+                except Exception:   # noqa: BLE001 — prober must survive
+                    log.exception(
+                        "heartbeat probe sweep failed; continuing")
 
         self._hb_thread = threading.Thread(target=loop, daemon=True)
         self._hb_thread.start()
@@ -460,13 +478,19 @@ class TpuCluster:
         try:
             return self._execute_plan_once(plan, capture=capture,
                                            cancel_event=cancel_event)
-        except (ClusterQueryError, OSError):
+        except (ClusterQueryError, OSError) as e:
             if cancel_event is not None and cancel_event.is_set():
                 raise
             before = set(self.worker_uris)
             alive = set(self.check_workers())
             if _retried or alive == before or not alive:
-                raise
+                if isinstance(e, ClusterQueryError):
+                    raise
+                # terminal transport failure: surface the query-level
+                # contract (clean ClusterQueryError, cause chained) —
+                # callers never see raw socket errors
+                raise ClusterQueryError(
+                    f"query failed on transport error: {e}") from e
             return self._execute_plan(plan, _retried=True,
                                       capture=capture,
                                       cancel_event=cancel_event)
@@ -743,8 +767,7 @@ class TpuCluster:
         for fid, stage in stages.items():
             for uri in stage.task_uris:
                 try:
-                    with urllib.request.urlopen(uri, timeout=10) as resp:
-                        infos.append((fid, json.loads(resp.read())))
+                    infos.append((fid, self.http.get_json(uri)))
                 except Exception:    # noqa: BLE001 — stats best-effort
                     pass
         self.last_task_infos = infos
@@ -822,11 +845,11 @@ class TpuCluster:
 
     # ------------------------------------------------------------------
     def _post(self, uri: str, body: bytes) -> dict:
-        req = urllib.request.Request(
-            uri, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            return json.loads(resp.read())
+        # TaskUpdateRequest POSTs are at-least-once by protocol (the
+        # worker dedupes splits by sequenceId), so transport retries of
+        # a dropped response are safe
+        return self.http.post(uri, body,
+                              request_class="task_post").json()
 
     def _await_all(self, stages: Dict[int, _Stage],
                    timeout_s: float = 1800, cancel_event=None):
@@ -858,12 +881,11 @@ class TpuCluster:
                         return            # another task already failed
                     if time.time() > deadline:
                         raise ClusterQueryError(f"timeout on {uri}")
-                    req = urllib.request.Request(
+                    st = self.http.get_json(
                         f"{uri}/status",
                         headers={"X-Presto-Current-State": state,
-                                 "X-Presto-Max-Wait": "1s"})
-                    with urllib.request.urlopen(req, timeout=30) as resp:
-                        st = json.loads(resp.read())
+                                 "X-Presto-Max-Wait": "1s"},
+                        request_class="status_poll")
                     state = st["state"]
                 results[uri] = st
                 if state != "FINISHED":
@@ -907,7 +929,8 @@ class TpuCluster:
             return self._merge_root(root, out_types, merge_keys)
         rows: List[tuple] = []
         for uri in root.task_uris:
-            data = PageStream(uri, buffer_id="0").drain()
+            data = PageStream(uri, buffer_id="0",
+                              client=self.http).drain()
             for p in decode_pages(data, out_types):
                 rows.extend(p.to_pylist())
         return rows
@@ -932,7 +955,8 @@ class TpuCluster:
         def drain(uri):
             stream = PageStream(
                 uri, buffer_id="0",
-                max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES)
+                max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES,
+                client=self.http)
             rows: List[tuple] = []
             try:
                 while not stream.complete:
@@ -1000,7 +1024,6 @@ class TpuCluster:
         for stage in stages.values():
             for uri in stage.task_uris:
                 try:
-                    req = urllib.request.Request(uri, method="DELETE")
-                    urllib.request.urlopen(req, timeout=10).read()
+                    self.http.delete(uri)
                 except Exception:   # noqa: BLE001 — best-effort abort
                     pass

@@ -42,6 +42,12 @@ class _Query:
         self.rows: List[tuple] = []
         self.done = threading.Event()
         self.cancelled = False
+        # final-batch cache: clients auto-retry nextUri GETs, so the
+        # last data batch must survive serving it once — a replayed GET
+        # of the same token re-serves the same rows instead of silently
+        # returning FINISHED with no data
+        self._final_token: Optional[int] = None
+        self._final_batch: List = []
 
     def run(self, engine):
         self.state = "RUNNING"
@@ -107,6 +113,14 @@ class _Query:
         # FINISHED: serve data batches; nextUri until drained
         if self.columns is not None:
             out["columns"] = self.columns
+        if self._final_token is not None:
+            # already drained: the bulk buffer is released, but the
+            # final batch stays cached so a client RETRY of the last
+            # GET (response lost after the server built it) re-serves
+            # the same rows — same-token GETs must be idempotent
+            if token == self._final_token and self._final_batch:
+                out["data"] = self._final_batch
+            return out
         lo = token * _BATCH_ROWS
         hi = lo + _BATCH_ROWS
         batch = self.rows[lo:hi]
@@ -118,6 +132,9 @@ class _Query:
         else:
             # final batch served: release the buffered result (queries
             # stay listed for /v1/query info, rows do not accumulate)
+            # but keep this batch for idempotent replay
+            self._final_token = token
+            self._final_batch = batch
             self.rows = []
         return out
 
@@ -145,7 +162,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(404, {"error": "no route"})
         length = int(self.headers.get("Content-Length", 0))
         sql = self.rfile.read(length).decode()
-        q = self.server.coordinator.submit(sql)
+        q = self.server.coordinator.submit(
+            sql, idempotency_key=self.headers.get(
+                "X-Presto-Idempotency-Key"))
         return self._json(200, q.results_json(self.server.base, 0))
 
     def do_GET(self):
@@ -221,6 +240,13 @@ class StatementServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
         self.engine = engine
         self.queries: Dict[str, _Query] = {}
+        # client idempotency key -> qid: POST /v1/statement is
+        # auto-retried by the transport, and a retry after a LOST
+        # response must attach to the already-running query instead of
+        # re-executing the SQL (an INSERT/CTAS replay would silently
+        # duplicate rows)
+        self._idempotency: Dict[str, str] = {}
+        self._submit_lock = threading.Lock()
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.coordinator = self
         self.port = self.httpd.server_address[1]
@@ -232,17 +258,30 @@ class StatementServer:
     #: completed queries kept for /v1/query info (QueryTracker role)
     MAX_TRACKED = 200
 
-    def submit(self, sql: str) -> _Query:
-        qid = f"{uuid.uuid4().hex[:16]}"
-        q = _Query(qid, sql)
-        self.queries[qid] = q
-        if len(self.queries) > self.MAX_TRACKED:
-            # FIFO-evict finished queries (dict preserves insertion order)
-            for old_id in list(self.queries):
-                if len(self.queries) <= self.MAX_TRACKED:
-                    break
-                if self.queries[old_id].done.is_set():
-                    del self.queries[old_id]
+    def submit(self, sql: str,
+               idempotency_key: Optional[str] = None) -> _Query:
+        with self._submit_lock:
+            if idempotency_key is not None:
+                known = self._idempotency.get(idempotency_key)
+                dup = self.queries.get(known) if known else None
+                if dup is not None:
+                    return dup          # retried POST: do NOT re-execute
+            qid = f"{uuid.uuid4().hex[:16]}"
+            q = _Query(qid, sql)
+            self.queries[qid] = q
+            if idempotency_key is not None:
+                self._idempotency[idempotency_key] = qid
+            if len(self.queries) > self.MAX_TRACKED:
+                # FIFO-evict finished queries (dict preserves insertion
+                # order), and drop idempotency entries with them
+                for old_id in list(self.queries):
+                    if len(self.queries) <= self.MAX_TRACKED:
+                        break
+                    if self.queries[old_id].done.is_set():
+                        del self.queries[old_id]
+                self._idempotency = {
+                    k: v for k, v in self._idempotency.items()
+                    if v in self.queries}
         threading.Thread(target=q.run, args=(self.engine,),
                          daemon=True).start()
         return q
@@ -261,13 +300,19 @@ def run_statement(base_uri: str, sql: str, timeout_s: float = 600):
     POST, then follow nextUri until it disappears; returns
     (columns, rows). Raises on FAILED."""
     import time
-    import urllib.request
 
-    req = urllib.request.Request(
-        f"{base_uri}/v1/statement", data=sql.encode(), method="POST",
-        headers={"Content-Type": "text/plain"})
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        payload = json.loads(resp.read())
+    from presto_tpu.protocol.transport import get_client
+
+    client = get_client()
+    # per-execute idempotency key: the transport auto-retries the POST,
+    # and the server dedupes on the key so a retry after a lost
+    # response attaches to the in-flight query instead of re-running
+    # the SQL (which would duplicate INSERT/CTAS writes)
+    payload = client.post(f"{base_uri}/v1/statement", sql.encode(),
+                          headers={"Content-Type": "text/plain",
+                                   "X-Presto-Idempotency-Key":
+                                   uuid.uuid4().hex},
+                          request_class="statement").json()
     columns, rows = None, []
     deadline = time.time() + timeout_s
     while True:
@@ -281,5 +326,4 @@ def run_statement(base_uri: str, sql: str, timeout_s: float = 600):
             return columns, rows
         if time.time() > deadline:
             raise TimeoutError(f"query {payload.get('id')} timed out")
-        with urllib.request.urlopen(nxt, timeout=30) as resp:
-            payload = json.loads(resp.read())
+        payload = client.get_json(nxt, request_class="statement")

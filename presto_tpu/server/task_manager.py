@@ -269,16 +269,18 @@ class TpuTaskManager:
         self.total_bytes_out = 0      # monotonic (survives task delete)
         self.lifetime_tasks = 0       # monotonic created-task count
         import collections
-        # DELETE-before-create tombstones (bounded FIFO; membership
-        # checks scan — the deque stays tiny in practice)
+        # DELETE-before-create tombstones: the deque keeps bounded FIFO
+        # eviction order, the set makes the hot-path membership check
+        # O(1) (create_or_update runs under self.lock for every POST)
         self.aborted_ids: "collections.deque" = collections.deque()
+        self._aborted_set: set = set()
         self.lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def create_or_update(self, task_id: str,
                          req: S.TaskUpdateRequest) -> S.TaskInfo:
         with self.lock:
-            if task_id in self.aborted_ids:      # deque scan: tiny
+            if task_id in self._aborted_set:     # O(1) tombstone lookup
                 # the task was aborted before it was created — never run
                 # it (reference: TaskManager.cpp:564 out-of-order
                 # delete/create handling)
@@ -734,10 +736,11 @@ class TpuTaskManager:
             # create must observe either the live task or the tombstone,
             # never neither (TaskManager.cpp:564 ordering)
             task = self.tasks.pop(task_id, None)
-            if task is None:
+            if task is None and task_id not in self._aborted_set:
                 self.aborted_ids.append(task_id)
+                self._aborted_set.add(task_id)
                 if len(self.aborted_ids) > self.MAX_TOMBSTONES:
-                    self.aborted_ids.popleft()
+                    self._aborted_set.discard(self.aborted_ids.popleft())
         if task is None:
             t = Task(task_id)
             t.set_state("ABORTED")

@@ -1,0 +1,6 @@
+"""Deterministic fault-injection tooling for chaos testing the
+transport layer (see testing/faults.py)."""
+
+from presto_tpu.testing.faults import FaultInjector, FaultSpec
+
+__all__ = ["FaultInjector", "FaultSpec"]

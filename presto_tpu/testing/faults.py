@@ -1,0 +1,144 @@
+"""Deterministic fault injection for the HTTP transport.
+
+A `FaultInjector` installs into `transport.HttpClient.fault_injector`;
+its hooks run INSIDE `HttpClient.request`, before the real socket call
+(`before_request` — may raise connection-refused / HTTP 500 errors or
+inject latency) and after a successful read (`after_response` — may
+truncate the body), so every injected fault exercises the real retry /
+classification / circuit-breaker machinery rather than a mock of it.
+
+Determinism: each fault decision is a pure function of
+(seed, fault kind, per-host request ordinal) — `random.Random` seeded
+per decision, no shared RNG stream — so a single-threaded request
+sequence replays identically for a given seed, and a multi-threaded one
+keeps per-host schedules stable as long as each host's request order is
+stable. Kill-worker schedules ("refuse every request to host H after
+its Nth") are counter-based and exactly reproducible regardless of
+interleaving.
+
+Reference analogy: the reference pairing proves its RPC resilience with
+failure-injecting test HTTP clients (TestingHttpClient +
+TestHttpRemoteTask's failure scenarios); this is that harness for the
+single transport chokepoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+import urllib.error
+import urllib.parse
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Per-kind fault rates (0..1) and schedules."""
+
+    connection_refused_rate: float = 0.0
+    http_500_rate: float = 0.0
+    latency_rate: float = 0.0
+    latency_s: float = 0.05
+    #: truncate response bodies (applied to page-result GETs only —
+    #: the frame-validation replay path is what's under test)
+    truncate_rate: float = 0.0
+    #: host -> refuse every request after its Nth (worker "killed";
+    #: `revive(host)` clears it, e.g. after a simulated restart)
+    kill_after: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class FaultInjector:
+    """Seeded, installable fault source for one HttpClient."""
+
+    def __init__(self, seed: int = 0, spec: Optional[FaultSpec] = None,
+                 only_hosts: Optional[set] = None, sleep=time.sleep):
+        self.seed = seed
+        self.spec = spec or FaultSpec()
+        #: restrict injection to these netlocs (None = every host)
+        self.only_hosts = only_hosts
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._per_host: Dict[str, int] = {}
+        self._killed: set = set()
+        #: injected-fault counters by kind, for tests to assert the
+        #: schedule actually fired
+        self.injected: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- helpers
+    def _host(self, url: str) -> str:
+        return urllib.parse.urlsplit(url).netloc
+
+    def _ordinal(self, host: str) -> int:
+        with self._lock:
+            n = self._per_host.get(host, 0)
+            self._per_host[host] = n + 1
+            return n
+
+    def _roll(self, kind: str, host: str, ordinal: int) -> float:
+        # decision = pure function of (seed, kind, host, ordinal):
+        # replayable, and independent decisions never share RNG state
+        return random.Random(
+            f"{self.seed}:{kind}:{host}:{ordinal}").random()
+
+    def _count(self, kind: str):
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def revive(self, host_or_url: str):
+        """Clear a kill-after schedule (the worker 'restarted')."""
+        host = self._host(host_or_url) or host_or_url
+        with self._lock:
+            self._killed.discard(host)
+            kills = dict(self.spec.kill_after)
+            kills.pop(host, None)
+            self.spec = dataclasses.replace(self.spec, kill_after=kills)
+
+    # --------------------------------------------------------------- hooks
+    def before_request(self, url: str, method: str):
+        host = self._host(url)
+        if self.only_hosts is not None and host not in self.only_hosts:
+            return
+        ordinal = self._ordinal(host)
+        spec = self.spec
+        kill_at = spec.kill_after.get(host)
+        if host in self._killed or (
+                kill_at is not None and ordinal >= kill_at):
+            with self._lock:
+                self._killed.add(host)
+            self._count("kill")
+            raise ConnectionRefusedError(
+                f"[fault seed={self.seed}] worker {host} killed "
+                f"after request {ordinal}")
+        if spec.latency_rate and self._roll(
+                "latency", host, ordinal) < spec.latency_rate:
+            self._count("latency")
+            self._sleep(spec.latency_s)
+        if spec.connection_refused_rate and self._roll(
+                "refuse", host, ordinal) < spec.connection_refused_rate:
+            self._count("refuse")
+            raise ConnectionRefusedError(
+                f"[fault seed={self.seed}] injected connection refused "
+                f"to {url}")
+        if spec.http_500_rate and self._roll(
+                "http500", host, ordinal) < spec.http_500_rate:
+            self._count("http500")
+            raise urllib.error.HTTPError(
+                url, 500,
+                f"[fault seed={self.seed}] injected server error",
+                hdrs=None, fp=None)
+
+    def after_response(self, url: str, method: str,
+                       body: bytes) -> bytes:
+        host = self._host(url)
+        if self.only_hosts is not None and host not in self.only_hosts:
+            return body
+        spec = self.spec
+        if (spec.truncate_rate and body and "/results/" in url
+                and not url.endswith("/acknowledge")):
+            ordinal = self._per_host.get(host, 0)
+            if self._roll("truncate", host, ordinal) < spec.truncate_rate:
+                self._count("truncate")
+                return body[:max(len(body) // 2, 1)]
+        return body

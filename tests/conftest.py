@@ -27,3 +27,10 @@ jax.config.update("jax_platforms", "cpu")
 
 assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", \
     f"test harness needs 8 CPU devices, got {jax.devices()}"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the smoke tier (-m 'not slow'); heavy XLA "
+        "collective compiles or large scale factors")

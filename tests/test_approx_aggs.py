@@ -74,6 +74,7 @@ def test_approx_percentile_grouped(engine):
         assert abs(v - exp) <= 1e-6 * max(abs(exp), 1.0)
 
 
+@pytest.mark.slow  # minutes of 8-way collective compile on CPU
 def test_approx_distributed():
     """Unsplittable aggregates reshard rows (hash on group keys / single
     gather) instead of partial+final — exercised over the 8-device mesh."""

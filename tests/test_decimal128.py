@@ -186,6 +186,7 @@ def _check_exact(got, exp, counts):
         assert n == counts[f]
 
 
+@pytest.mark.slow  # minutes of 8-way collective compile on CPU
 def test_distributed_decimal128_mesh_exact():
     """Round-4 VERDICT #8: DECIMAL(38) sum/avg distribute — limb-lane
     partial states ride the all-to-all exchange and merge exactly
@@ -211,6 +212,7 @@ def test_distributed_decimal128_cluster_exact():
         c.stop()
 
 
+@pytest.mark.slow  # minutes of 8-way collective compile on CPU
 def test_distributed_decimal128_global_exact():
     """No-GROUP-BY distributed DECIMAL(38): the merge kinds route
     through the direct (one-bin) aggregation path."""

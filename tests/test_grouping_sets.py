@@ -107,6 +107,7 @@ def test_grouping_sets(name, sql, exp_sql, engine, db):
     _check(engine.execute_sql(sql), db.execute(exp_sql).fetchall())
 
 
+@pytest.mark.slow  # minutes of 8-way collective compile on CPU
 def test_grouping_sets_distributed(db):
     """Same semantics through the fragmenter + 8-device mesh (the GroupId
     expansion feeds a partial/final split aggregation over a hash

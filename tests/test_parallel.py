@@ -16,6 +16,11 @@ from presto_tpu.parallel import (
 from presto_tpu.parallel.mesh import AXIS
 from presto_tpu.types import BIGINT, DOUBLE
 
+# Compiling the 8-way collectives on the host CPU backend costs minutes
+# of XLA time per case — slow tier only (the smoke tier covers the same
+# exchanges end-to-end through the multi-worker cluster suites).
+pytestmark = pytest.mark.slow
+
 NDEV = 8
 
 

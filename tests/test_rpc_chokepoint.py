@@ -1,0 +1,41 @@
+"""Guard: protocol/transport.py is the single RPC chokepoint.
+
+Every HTTP request the engine makes must ride transport.HttpClient so
+retry policies, error classification, and per-worker circuit breakers
+apply uniformly (and fault injection sees every RPC). A raw
+`urllib.request.urlopen` anywhere else in presto_tpu/ silently opts
+that call site out of all of it — this test fails the build instead."""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "presto_tpu"
+
+_DIRECT = re.compile(r"urllib\s*\.\s*request\s*\.\s*urlopen")
+_FROM_IMPORT = re.compile(
+    r"from\s+urllib\s*\.\s*request\s+import\s+[^\n]*\burlopen\b")
+
+ALLOWED = {PKG / "protocol" / "transport.py"}
+
+
+def test_urlopen_only_in_transport():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path in ALLOWED:
+            continue
+        text = path.read_text()
+        for pat in (_DIRECT, _FROM_IMPORT):
+            for m in pat.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                offenders.append(f"{path.relative_to(PKG.parent)}:"
+                                 f"{line}: {m.group(0)!r}")
+    assert not offenders, (
+        "direct urlopen outside protocol/transport.py — route these "
+        "through transport.HttpClient:\n" + "\n".join(offenders))
+
+
+def test_transport_itself_still_uses_urlopen():
+    """The allowlist stays honest: if the transport migrates off
+    urllib, update ALLOWED instead of leaving a stale exemption."""
+    text = (PKG / "protocol" / "transport.py").read_text()
+    assert _DIRECT.search(text)

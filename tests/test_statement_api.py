@@ -58,6 +58,56 @@ def test_statement_protocol_shape(server):
     assert info["state"] == "FINISHED"
 
 
+def _post_statement(base, sql, key=None):
+    headers = {"Content-Type": "text/plain"}
+    if key is not None:
+        headers["X-Presto-Idempotency-Key"] = key
+    req = urllib.request.Request(f"{base}/v1/statement",
+                                 data=sql.encode(), method="POST",
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_statement_post_idempotency_key_dedupes(server):
+    """The transport auto-retries POST /v1/statement; a retry carrying
+    the same idempotency key must attach to the in-flight query, not
+    re-execute the SQL (INSERT/CTAS would duplicate rows)."""
+    p1 = _post_statement(server.base, "SELECT 1 AS one", key="same-key")
+    p2 = _post_statement(server.base, "SELECT 1 AS one", key="same-key")
+    assert p1["id"] == p2["id"]         # deduped: one query, one run
+    # distinct keys (distinct logical executes) stay distinct queries
+    p3 = _post_statement(server.base, "SELECT 1 AS one", key="other")
+    assert p3["id"] != p1["id"]
+    # keyless POSTs never dedupe
+    p4 = _post_statement(server.base, "SELECT 1 AS one")
+    p5 = _post_statement(server.base, "SELECT 1 AS one")
+    assert p4["id"] != p5["id"]
+
+
+def test_final_batch_get_is_idempotent():
+    """Clients auto-retry nextUri GETs: if the final batch's response
+    is lost in transit, the replayed same-token GET must re-serve the
+    same rows — not FINISHED with no data (silent row loss)."""
+    from presto_tpu.server.statement import _BATCH_ROWS, _Query
+
+    q = _Query("q1", "select 1")
+    q.state = "FINISHED"
+    q.columns = [{"name": "x", "type": "bigint"}]
+    q.rows = [[i] for i in range(_BATCH_ROWS + 7)]      # two batches
+    base = "http://c:1"
+    first = q.results_json(base, 0)
+    assert len(first["data"]) == _BATCH_ROWS and first["nextUri"]
+    final = q.results_json(base, 1)
+    assert len(final["data"]) == 7 and "nextUri" not in final
+    # replay the final GET (what the client's retry does after a lost
+    # response): same rows, not FINISHED-with-nothing
+    replay = q.results_json(base, 1)
+    assert replay["data"] == final["data"]
+    assert "nextUri" not in replay
+    assert q.rows == []          # bulk buffer still released
+
+
 def test_statement_error_reported(server):
     with pytest.raises(RuntimeError) as ei:
         run_statement(server.base, "SELECT no_such_column FROM lineitem")

@@ -2,6 +2,7 @@
 (the TPC-H suite's strategy applied to the second fixture connector;
 reference: presto-tpcds + benchto tpcds.yaml, SURVEY.md §6)."""
 
+import math
 import sqlite3
 
 import pytest
@@ -46,6 +47,10 @@ def _drop_compile_caches(engine):
 def oracle():
     conn = TpcdsConnector(SF)
     db = sqlite3.connect(":memory:")
+    # sqlite's math functions are a compile-time option (-DSQLITE_ENABLE_MATH
+    # _FUNCTIONS) absent from some builds; the oracle must not depend on it
+    db.create_function(
+        "sqrt", 1, lambda x: None if x is None else math.sqrt(x))
     for t in _TABLES:
         df = table_df(conn, t)
         for col, typ in conn.schema(t):

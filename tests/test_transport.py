@@ -1,0 +1,324 @@
+"""Unit tests for the fault-tolerant HTTP transport
+(protocol/transport.py) and the page-stream defenses built on it.
+
+Covers: retry-with-backoff on retryable failures, 4xx fatal
+classification (no retry), circuit breaker state machine + half-open
+probing, deterministic fault injection (testing/faults.py), PageStream
+truncated-body replay (same token re-fetched, no page skipped or
+duplicated) and the worker-restarted (task-instance-id changed)
+detection path."""
+
+import struct
+import threading
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from presto_tpu.config import TransportConfig
+from presto_tpu.protocol.exchange_client import PageStream, \
+    frames_complete
+from presto_tpu.protocol.transport import (
+    CircuitBreaker, CircuitOpenError, FatalResponseError, HttpClient,
+    RetriesExhaustedError, WorkerRestartedError,
+)
+from presto_tpu.testing import FaultInjector, FaultSpec
+
+FAST = TransportConfig(retry_base_backoff_s=0.001,
+                       retry_max_backoff_s=0.01,
+                       breaker_failure_threshold=2,
+                       breaker_cooldown_s=0.15)
+
+
+def _frame(payload: bytes) -> bytes:
+    """A syntactically complete SerializedPage frame (uncompressed,
+    unchecked markers) — enough for the framing walk, no decode."""
+    return struct.pack("<ibiiq", 1, 0, len(payload), len(payload),
+                       0) + payload
+
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    """Replies from server.script (a list of (status, body) or
+    callables); records every request path in server.requests."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self):
+        self.server.requests.append((self.command, self.path))
+        step = self.server.script[
+            min(len(self.server.requests) - 1,
+                len(self.server.script) - 1)]
+        if callable(step):
+            step = step(self)
+            if step is None:        # the callable wrote the raw reply
+                return
+        status, body, headers = step
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = do_DELETE = _reply
+
+
+@pytest.fixture
+def scripted():
+    servers = []
+
+    def make(script):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedHandler)
+        srv.script = script
+        srv.requests = []
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    yield make
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------------------ client
+def test_retries_5xx_then_succeeds(scripted):
+    srv, base = scripted([(500, b"boom", None), (500, b"boom", None),
+                          (200, b"ok", None)])
+    resp = HttpClient(FAST).request(f"{base}/v1/info",
+                                    request_class="task_post")
+    assert resp.body == b"ok"
+    assert len(srv.requests) == 3
+
+
+def test_4xx_is_fatal_no_retry(scripted):
+    srv, base = scripted([(404, b"no task", None)])
+    with pytest.raises(FatalResponseError) as ei:
+        HttpClient(FAST).request(f"{base}/v1/task/x",
+                                 request_class="task_post")
+    assert ei.value.status == 404
+    assert len(srv.requests) == 1          # never retried
+    # a 4xx proves the host alive: the breaker must stay closed
+    assert HttpClient(FAST).breaker(base).state == CircuitBreaker.CLOSED
+
+
+def test_connection_refused_exhausts_retries():
+    client = HttpClient(FAST)
+    with pytest.raises(RetriesExhaustedError) as ei:
+        client.request("http://127.0.0.1:1/v1/info",
+                       request_class="status_poll")
+    assert isinstance(ei.value, OSError)   # recovery ladders catch OSError
+    assert ei.value.__cause__ is not None
+
+
+def test_probe_class_is_single_attempt(scripted):
+    srv, base = scripted([(500, b"x", None), (200, b"ok", None)])
+    with pytest.raises(RetriesExhaustedError):
+        HttpClient(FAST).request(f"{base}/v1/info",
+                                 request_class="probe")
+    assert len(srv.requests) == 1
+
+
+def test_mid_body_disconnect_is_retried(scripted):
+    """A connection dropped mid-body raises http.client.IncompleteRead
+    (an HTTPException, NOT an OSError) from resp.read(); it must be
+    classified retryable, not escape as a raw exception."""
+    import http.client
+
+    def torn(handler):
+        # advertise 100 bytes, send 5, hang up: resp.read() raises
+        # IncompleteRead on the client
+        handler.send_response(200)
+        handler.send_header("Content-Length", "100")
+        handler.end_headers()
+        handler.wfile.write(b"short")
+        handler.close_connection = True
+
+    srv, base = scripted([torn, (200, b"ok", None)])
+    resp = HttpClient(FAST).request(f"{base}/v1/info",
+                                    request_class="status_poll")
+    assert resp.body == b"ok"
+    assert len(srv.requests) == 2
+    from presto_tpu.protocol.transport import is_retryable
+    assert is_retryable(http.client.IncompleteRead(b"short", 95))
+    assert is_retryable(http.client.BadStatusLine(""))
+
+
+# ----------------------------------------------------------------- breaker
+def test_circuit_breaker_state_machine():
+    now = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0,
+                        clock=lambda: now[0])
+    assert br.allow() and br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    assert br.allow()                       # one failure: still closed
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()                   # cooling down: fast-fail
+    now[0] = 11.0
+    assert br.allow()                       # half-open: one probe admitted
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()                   # ...and only one
+    br.record_failure()                     # probe failed -> OPEN again
+    assert br.state == CircuitBreaker.OPEN
+    now[0] = 22.0
+    assert br.allow()
+    br.record_success()                     # probe succeeded -> CLOSED
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() and br.allow()
+
+
+def test_breaker_opens_then_half_open_readmits(scripted):
+    import time
+
+    client = HttpClient(FAST)
+    with pytest.raises(RetriesExhaustedError):
+        client.request("http://127.0.0.1:1/v1/info",
+                       request_class="status_poll")   # 3 attempts > threshold
+    with pytest.raises(CircuitOpenError):
+        client.request("http://127.0.0.1:1/v1/info",
+                       request_class="probe")          # fast-fail, no socket
+    time.sleep(FAST.breaker_cooldown_s + 0.05)
+    # cooldown elapsed: the half-open probe goes to the network again
+    with pytest.raises(RetriesExhaustedError):
+        client.request("http://127.0.0.1:1/v1/info",
+                       request_class="probe")
+
+
+# ---------------------------------------------------------- fault injector
+def test_fault_injector_deterministic_and_counted():
+    spec = FaultSpec(connection_refused_rate=0.5)
+
+    def schedule(seed):
+        inj = FaultInjector(seed=seed, spec=spec)
+        out = []
+        for _ in range(40):
+            try:
+                inj.before_request("http://w:1/v1/task/t", "GET")
+                out.append(0)
+            except ConnectionRefusedError:
+                out.append(1)
+        return out, inj.injected.get("refuse", 0)
+
+    a, na = schedule(7)
+    b, nb = schedule(7)
+    c, _ = schedule(8)
+    assert a == b and na == nb      # same seed -> identical schedule
+    assert a != c                   # different seed -> different schedule
+    assert 0 < na < 40              # rate actually injects, not always
+
+
+def test_fault_injector_kill_after_and_revive():
+    inj = FaultInjector(seed=0, spec=FaultSpec(
+        kill_after={"w:1": 2}))
+    inj.before_request("http://w:1/v1/info", "GET")
+    inj.before_request("http://w:1/v1/info", "GET")
+    with pytest.raises(ConnectionRefusedError):
+        inj.before_request("http://w:1/v1/info", "GET")
+    with pytest.raises(ConnectionRefusedError):      # stays down
+        inj.before_request("http://w:1/v1/info", "GET")
+    inj.revive("http://w:1")
+    inj.before_request("http://w:1/v1/info", "GET")  # restarted
+    assert inj.injected["kill"] == 2
+
+
+def test_fault_injector_injects_500_through_client(scripted):
+    srv, base = scripted([(200, b"ok", None)])
+    client = HttpClient(FAST, fault_injector=FaultInjector(
+        seed=1, spec=FaultSpec(http_500_rate=1.0)))
+    with pytest.raises(RetriesExhaustedError) as ei:
+        client.request(f"{base}/v1/info", request_class="status_poll")
+    assert isinstance(ei.value.__cause__, urllib.error.HTTPError)
+    assert srv.requests == []       # fault fired before the socket
+
+
+# -------------------------------------------------------------- PageStream
+def _page_headers(end_seq, complete, instance="inst-1"):
+    return {"X-Presto-Task-Instance-Id": instance,
+            "X-Presto-Page-End-Sequence-Id": str(end_seq),
+            "X-Presto-Buffer-Complete": "true" if complete else "false"}
+
+
+def test_pagestream_truncated_body_replays_same_token(scripted):
+    """A body cut mid-frame is detected BEFORE the acknowledge, so the
+    same token is re-fetched and the stream yields exactly the pages
+    the server produced — none skipped, none duplicated."""
+    frame0, frame1 = _frame(b"page-zero"), _frame(b"page-one!")
+
+    def truncated(handler):
+        return 200, frame0[:11], _page_headers(1, False)
+
+    srv, base = scripted([
+        truncated,                                    # GET token 0: cut
+        (200, frame0, _page_headers(1, False)),       # replay token 0
+        (200, b"", _page_headers(1, False)),          # ack 1
+        (200, frame1, _page_headers(2, True)),        # GET token 1
+        (200, b"", _page_headers(2, True)),           # ack 2
+        (200, b"", None),                             # close DELETE
+    ])
+    stream = PageStream(f"{base}/v1/task/t1", buffer_id="0",
+                        client=HttpClient(FAST))
+    assert stream.drain() == frame0 + frame1
+    gets = [p for (m, p) in srv.requests if m == "GET"
+            and "acknowledge" not in p]
+    assert gets == ["/v1/task/t1/results/0/0",
+                    "/v1/task/t1/results/0/0",       # replayed, same token
+                    "/v1/task/t1/results/0/1"]
+    acks = [p for (m, p) in srv.requests if "acknowledge" in p]
+    assert acks == ["/v1/task/t1/results/0/1/acknowledge",
+                    "/v1/task/t1/results/0/2/acknowledge"]
+
+
+def test_pagestream_boundary_truncation_replays_same_token(scripted):
+    """A truncation landing exactly on a frame boundary parses as
+    complete frames, so frame-walking alone would acknowledge past the
+    missing page; the frame count must be cross-checked against the
+    token advance so the same token is re-fetched instead."""
+    frame0, frame1 = _frame(b"page-zero"), _frame(b"page-one!")
+    assert frames_complete(frame0)      # the cut body LOOKS complete
+
+    srv, base = scripted([
+        # GET token 0: server claims 2 pages but the body was cut at
+        # the frame boundary — only frame0 arrived
+        (200, frame0, _page_headers(2, True)),
+        (200, frame0 + frame1, _page_headers(2, True)),   # replay
+        (200, b"", _page_headers(2, True)),               # ack 2
+        (200, b"", None),                                 # close DELETE
+    ])
+    stream = PageStream(f"{base}/v1/task/t1", buffer_id="0",
+                        client=HttpClient(FAST))
+    assert stream.drain() == frame0 + frame1              # nothing lost
+    gets = [p for (m, p) in srv.requests if m == "GET"
+            and "acknowledge" not in p]
+    assert gets == ["/v1/task/t1/results/0/0",
+                    "/v1/task/t1/results/0/0"]            # same token
+    acks = [p for (m, p) in srv.requests if "acknowledge" in p]
+    assert acks == ["/v1/task/t1/results/0/2/acknowledge"]
+
+
+def test_pagestream_instance_change_raises_worker_restarted(scripted):
+    frame = _frame(b"payload")
+    srv, base = scripted([
+        (200, frame, _page_headers(1, False, instance="born-1")),
+        (200, b"", _page_headers(1, False, instance="born-1")),  # ack
+        (200, frame, _page_headers(2, True, instance="born-2")),
+    ])
+    stream = PageStream(f"{base}/v1/task/t1", buffer_id="0",
+                        client=HttpClient(FAST))
+    stream.fetch()
+    with pytest.raises(WorkerRestartedError):
+        stream.fetch()
+    # worker-death classification: recovery ladders catch OSError
+    assert issubclass(WorkerRestartedError, OSError)
+
+
+def test_frames_complete_walks_headers():
+    f = _frame(b"abcdef")
+    assert frames_complete(b"")
+    assert frames_complete(f) and frames_complete(f + f)
+    assert not frames_complete(f[:-1])
+    assert not frames_complete(f + f[:10])
+    assert not frames_complete(f[:5])
