@@ -1,0 +1,20 @@
+"""Worker-side fragment result cache (Presto at Meta, VLDB'23 §4.2).
+
+Three pieces, layered over the existing task protocol without touching
+it:
+
+- `plan/fingerprint.py`: semantic fragment fingerprints — canonical
+  plan hashes invariant to node ids and symbol renaming, combined with
+  connector table versions so stale entries are unaddressable;
+- `cache/result_store.py`: the memory-bounded LRU page store each
+  worker's task manager consults before executing an eligible leaf
+  fragment and populates after;
+- `cache/affinity.py`: coordinator-side cache-affinity placement —
+  rendezvous hashing on the fingerprint, overridden by observed
+  placements, so repeats land on the worker that holds the entry.
+"""
+
+from presto_tpu.cache.affinity import AffinityRouter, rendezvous_pick
+from presto_tpu.cache.result_store import FragmentResultCache
+
+__all__ = ["FragmentResultCache", "AffinityRouter", "rendezvous_pick"]

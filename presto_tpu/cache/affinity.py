@@ -1,0 +1,67 @@
+"""Cache-affinity placement for the coordinator's scheduler.
+
+Two layers, consulted by `TpuCluster._start_stage` for leaf stages:
+
+1. **Observed placement** — the coordinator remembers which worker ran
+   each fingerprint last (that worker now holds the cached entry) and
+   routes repeats there. This is the soft-affinity map of Presto's
+   SimpleNodeSelector with cache affinity enabled.
+2. **Rendezvous (HRW) hash** as the fallback for fingerprints never
+   seen: pick argmax over workers of hash(fingerprint, worker). Unlike
+   modulo placement, membership changes only move the keys owned by
+   the departed/arrived node, so a worker death does not reshuffle
+   every other worker's cache (degrades to misses only where the
+   entry actually lived).
+
+The router never *pins*: a routed-to worker that is dead or missing
+simply falls through to rendezvous over the live set — cache loss
+degrades to recomputation, not failure.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+def rendezvous_pick(key: str, candidates: Sequence[str]) -> str:
+    """Highest-random-weight choice: stable under membership change."""
+    if not candidates:
+        raise ValueError("no candidates")
+    return max(candidates, key=lambda c: hashlib.sha256(
+        f"{key}|{c}".encode()).digest())
+
+
+class AffinityRouter:
+    """fingerprint -> preferred worker, with observed-placement memory."""
+
+    #: bound on remembered placements (coordinator-side; entries past
+    #: this age out FIFO — affinity only, correctness never depends on it)
+    MAX_PLACEMENTS = 65536
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen: Dict[str, str] = {}
+        self._order: List[str] = []
+
+    def record(self, fingerprint: str, worker: str) -> None:
+        with self._lock:
+            if fingerprint not in self._seen:
+                self._order.append(fingerprint)
+                if len(self._order) > self.MAX_PLACEMENTS:
+                    self._seen.pop(self._order.pop(0), None)
+            self._seen[fingerprint] = worker
+
+    def pick(self, fingerprint: str,
+             live_workers: Sequence[str]) -> Optional[str]:
+        """The worker most likely to hold `fingerprint`: the observed
+        holder if it is still live, else the rendezvous owner among the
+        live set; None when no workers are live."""
+        if not live_workers:
+            return None
+        with self._lock:
+            held = self._seen.get(fingerprint)
+        if held is not None and held in live_workers:
+            return held
+        return rendezvous_pick(fingerprint, live_workers)

@@ -110,6 +110,12 @@ PROPERTIES = [
              "(LZ4 block format in the native C++ codec; reference: "
              "exchange_compression_codec, PagesSerdeFactory + "
              "CompressionCodec.java:16)", str.strip, "none"),
+    Property("fragment_result_cache_enabled",
+             "Worker-side fragment result caching for eligible leaf "
+             "fragments, keyed on semantic plan fingerprint + table "
+             "versions + splits (reference: fragment_result_caching_"
+             "enabled, Presto@Meta VLDB'23 worker result cache)",
+             _parse_bool, False),
 ]
 
 _BY_NAME = {p.name: p for p in PROPERTIES}
@@ -157,6 +163,33 @@ class TransportConfig:
 
 #: process defaults; tests construct their own with tighter windows
 DEFAULT_TRANSPORT = TransportConfig()
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Fragment-result-cache knobs (reference: FragmentCacheStats +
+    fragment-result-cache config in the native worker; Presto@Meta
+    VLDB'23 §4.2). One per worker process — the task manager builds its
+    `FragmentResultCache` from this."""
+
+    #: master switch for the worker-side store (the session property
+    #: `fragment_result_cache_enabled` additionally gates per query)
+    enabled: bool = True
+    #: byte budget for cached pages on one worker
+    budget_bytes: int = 256 << 20
+    #: refuse entries larger than this (one giant scan must not wipe
+    #: the whole cache); 0 = budget_bytes
+    max_entry_bytes: int = 32 << 20
+    #: mirror cached bytes into the node MemoryPool so cache residency
+    #: competes with execution reservations
+    account_in_memory_pool: bool = False
+
+    def entry_cap(self) -> int:
+        return self.max_entry_bytes or self.budget_bytes
+
+
+#: process defaults
+DEFAULT_CACHE = CacheConfig()
 
 
 class Session:

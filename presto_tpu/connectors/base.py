@@ -26,3 +26,22 @@ class SplitSource:
         cid = self.connector_id(table)
         return [{"@type": cid, "part": i, "numParts": n_splits}
                 for i in range(n_splits)]
+
+    # ------------------------------------------------------- data versions
+    # Per-table monotonic versions for the fragment result cache
+    # (cache/): every write/INSERT/CTAS/drop bumps the version, which
+    # changes every cache key that references the table, making stale
+    # entries structurally unreachable (no invalidation broadcast to
+    # race). Immutable connectors (tpch) never bump, so their results
+    # cache forever — the correct semantics for generated data.
+
+    def table_version(self, table: str) -> int:
+        return getattr(self, "_table_versions", {}).get(table, 0)
+
+    def bump_table_version(self, table: str) -> int:
+        versions = getattr(self, "_table_versions", None)
+        if versions is None:
+            versions = {}
+            self._table_versions = versions
+        versions[table] = versions.get(table, 0) + 1
+        return versions[table]

@@ -39,6 +39,13 @@ class MemoryConnector(SplitSource):
             return self.fallback.connector_id(table)
         return self.NAME
 
+    def table_version(self, table: str) -> int:
+        # locally-written tables version here; read-through names keep
+        # the fallback's version stream (one facade, one version truth)
+        if table not in self.tables and self.fallback is not None:
+            return self.fallback.table_version(table)
+        return super().table_version(table)
+
     # ------------------------------------------------------------- reads
     def schema(self, table: str) -> List[Tuple[str, Type]]:
         t = self.tables.get(table)
@@ -98,18 +105,23 @@ class MemoryConnector(SplitSource):
             else:
                 arrays[c] = np.zeros(0, t.dtype)
         self.tables[name] = HostTable(name, 0, arrays, types, dicts)
+        self.bump_table_version(name)
 
     def drop(self, name: str, if_exists: bool = False):
         if name not in self.tables and not if_exists:
             raise KeyError(f"unknown table {name}")
-        self.tables.pop(name, None)
+        if self.tables.pop(name, None) is not None:
+            self.bump_table_version(name)
 
     def append_rows(self, name: str, rows: List[tuple]) -> int:
         """Append python rows (strings decoded, decimals as python
         floats — the engine's to_pylist() shape). Reference role:
         ConnectorPageSink.appendPage (MemoryPagesStore.add)."""
         with self._write_lock:
-            return self._append_rows_locked(name, rows)
+            n = self._append_rows_locked(name, rows)
+            if n:
+                self.bump_table_version(name)
+            return n
 
     def move_table_rows(self, src: str, dst: str) -> int:
         """Move every row of `src` into `dst` (identical schemas) by raw
@@ -149,6 +161,8 @@ class MemoryConnector(SplitSource):
                     dst, t.num_rows + n_new, new_arrays, t.types,
                     new_dicts, new_nulls)
             self.tables.pop(src, None)
+            self.bump_table_version(src)
+            self.bump_table_version(dst)
             return n_new
 
     def _append_rows_locked(self, name: str, rows: List[tuple]) -> int:

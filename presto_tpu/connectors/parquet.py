@@ -227,41 +227,95 @@ class ParquetTable(LazyFileTable):
         return vals, nulls, d
 
     # -- row-group statistics (predicate pushdown support) --------------
-    def column_minmax(self, col: str):
-        """(min, max) from row-group metadata WITHOUT reading data;
-        None when any unit lacks statistics. Reference:
-        TupleDomainParquetPredicate over ColumnChunkMetaData stats."""
-        los, his = [], []
-        idx = {c: i for i, c in
-               enumerate(self._files[0].schema_arrow.names)}
-        if col not in idx:
+    def _leaf_index(self, col: str) -> Optional[int]:
+        """Row-group metadata enumerates FLATTENED LEAF columns (a
+        nested column contributes one entry per leaf, e.g.
+        'a.list.element'), so arrow top-level schema positions misalign
+        the moment any earlier column is nested. Map by exact leaf
+        path instead; a name that is not itself a leaf (nested column)
+        has no usable scalar stats -> None."""
+        md = self._files[0].metadata
+        for i in range(md.num_columns):
+            if md.schema.column(i).path == col:
+                return i
+        return None
+
+    def _stat_value(self, v, col: str):
+        """One parquet stat value -> the engine's storage
+        representation for the column's type (epoch days / epoch
+        microseconds / python str / unscaled decimal int), so callers
+        can compare stats against engine values directly."""
+        import datetime
+        import decimal as _dec
+
+        if v is None:
             return None
+        t = self.types.get(col)
+        if t is None:
+            return v
+        if t.name == "date":
+            if isinstance(v, datetime.date) \
+                    and not isinstance(v, datetime.datetime):
+                return (v - datetime.date(1970, 1, 1)).days
+            return int(v)
+        if t.name == "timestamp":
+            if isinstance(v, datetime.datetime):
+                epoch = datetime.datetime(1970, 1, 1,
+                                          tzinfo=v.tzinfo)
+                return int((v - epoch) / datetime.timedelta(
+                    microseconds=1))
+            return int(v)
+        if t.is_string:
+            return v.decode("utf-8", "replace") \
+                if isinstance(v, bytes) else str(v)
+        if t.is_decimal:
+            if isinstance(v, _dec.Decimal):
+                return int(v.scaleb(t.scale))
+            return v
+        return v
+
+    def column_minmax(self, col: str):
+        """(min, max) from row-group metadata WITHOUT reading data, in
+        engine representation; None when the column is nested or any
+        unit lacks statistics. Reference: TupleDomainParquetPredicate
+        over ColumnChunkMetaData stats."""
+        idx = self._leaf_index(col)
+        if idx is None:
+            return None
+        los, his = [], []
         for fi, g in self.units:
             meta = self._files[fi].metadata.row_group(g)
-            st = meta.column(idx[col]).statistics
+            st = meta.column(idx).statistics
             if st is None or not st.has_min_max:
                 return None
-            los.append(st.min)
-            his.append(st.max)
+            los.append(self._stat_value(st.min, col))
+            his.append(self._stat_value(st.max, col))
         if not los:
             return None
         return min(los), max(his)
 
     def prune_units(self, col: str, lo, hi) -> "ParquetTable":
         """Row groups whose [min, max] cannot intersect [lo, hi] drop
-        out of the split list (the reader's row-group skip)."""
-        idx = {c: i for i, c in
-               enumerate(self._files[0].schema_arrow.names)}
-        if col not in idx:
+        out of the split list (the reader's row-group skip). `lo`/`hi`
+        are engine-representation values; stats normalize to match.
+        Unknown/nested columns and incomparable stats keep every unit
+        (pruning is an optimization, never a correctness gate)."""
+        idx = self._leaf_index(col)
+        if idx is None:
             return self
         kept = []
         for fi, g in self.units:
             st = self._files[fi].metadata.row_group(g).column(
-                idx[col]).statistics
+                idx).statistics
             if st is None or not st.has_min_max:
                 kept.append((fi, g))
                 continue
-            if st.max < lo or st.min > hi:
+            try:
+                if self._stat_value(st.max, col) < lo \
+                        or self._stat_value(st.min, col) > hi:
+                    continue
+            except TypeError:
+                kept.append((fi, g))
                 continue
             kept.append((fi, g))
         if len(kept) == len(self.units):
@@ -454,10 +508,21 @@ class FileCatalogConnector(SplitSource):
                          nulls or None)
 
     def invalidate(self, table: Optional[str] = None):
+        """Drop cached handles after files changed on disk — the
+        catalog's write signal, so it also bumps the data versions the
+        fragment result cache keys on."""
         if table is None:
+            for t in list(self._cache):
+                self.bump_table_version(t)
             self._cache.clear()
         else:
             self._cache.pop(table, None)
+            self.bump_table_version(table)
+
+    def table_version(self, table: str) -> int:
+        if self._path(table) is None and self.fallback is not None:
+            return self.fallback.table_version(table)
+        return super().table_version(table)
 
 
 class ParquetConnector(FileCatalogConnector):

@@ -336,18 +336,25 @@ class BatchedRunner:
                         skipped += 1
                         continue
                     # metadata min/max first (parquet row-group stats:
-                    # prunes the lifespan WITHOUT reading the column)
+                    # prunes the lifespan WITHOUT reading the column);
+                    # stats arrive normalized to engine representation,
+                    # but a source that still yields raw logical values
+                    # (dates/timestamps/varchar vs engine ints) must
+                    # fall back to the column scan, never TypeError out
                     mm = (t.column_minmax(col)
                           if hasattr(t, "column_minmax") else None)
+                    pruned = None
                     if mm is not None:
-                        if mm[0] > hi or mm[1] < lo:
-                            skipped += 1
-                            continue
-                    else:
+                        try:
+                            pruned = bool(mm[0] > hi or mm[1] < lo)
+                        except TypeError:
+                            pruned = None
+                    if pruned is None:
                         sv = t.arrays[col][:t.num_rows]
-                        if sv.min() > hi or sv.max() < lo:
-                            skipped += 1
-                            continue
+                        pruned = bool(sv.min() > hi or sv.max() < lo)
+                    if pruned:
+                        skipped += 1
+                        continue
             ex.set_splits({driving: [(b, num_batches)]})
             p = ex.execute(self.partial_plan)
             if self.spill:

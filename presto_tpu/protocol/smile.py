@@ -250,8 +250,11 @@ class _Decoder:
         if 0x01 <= t <= 0x1F:          # short shared value ref
             return self.shared_values[t - 1]
         if 0xEC <= t <= 0xEF:          # long shared value ref (2 bytes)
+            # the 10-bit long form is 0-based (Jackson
+            # SmileParser._handleSharedString) — only the 1-byte short
+            # form above carries the -1 offset
             idx = ((t & 0x03) << 8) | self._byte()
-            return self.shared_values[idx - 1]
+            return self.shared_values[idx]
         if 0xC0 <= t <= 0xDF:          # small int
             return _unzigzag(t - 0xC0)
         if t == 0x24 or t == 0x25:     # 32/64-bit zigzag VInt
@@ -306,9 +309,14 @@ class _Decoder:
         self.pos += n
         if share and self.shared_values_enabled and len(
                 s.encode()) <= 64:
+            # clear-THEN-append at capacity (Jackson's _expandSeenStringValues
+            # reset): the new string must take slot 0 of the fresh
+            # window, matching the encoder's bookkeeping — resetting
+            # after the append would drop it and desynchronize every
+            # later back-reference
+            if len(self.shared_values) >= 1024:
+                self.shared_values = []
             self.shared_values.append(s)
-            if len(self.shared_values) > 1024:
-                self.shared_values = self.shared_values[:0]
         return s
 
     def _read_key(self) -> str:

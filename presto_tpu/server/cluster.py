@@ -111,6 +111,96 @@ def _derange(plan: PlanNode):
     return visit(plan), merge_keys
 
 
+def bounded_merge(batch_sources, key, queue_pages=4):
+    """K-way merge of pre-sorted row-batch streams under a COORDINATOR
+    memory bound (reference: MergeOperator + ExchangeClient's
+    maxBufferedBytes back-pressure). One producer thread per stream
+    decodes batches into a `queue.Queue(maxsize=queue_pages)`; a full
+    queue blocks its producer (and, through the page protocol, stops
+    acknowledging frames), so at most ``k * (queue_pages + 2)`` row
+    batches exist coordinator-side at once instead of every run fully
+    materialized before the merge. The consumer side feeds
+    ``heapq.merge`` — streams stay sorted, output is the total order.
+
+    ``batch_sources`` is a list of zero-arg callables each returning an
+    iterator of row batches (lists of tuples). Returns
+    ``(rows, in_flight_high_water)``. The first real producer failure is
+    re-raised after all producers stop; sibling streams abort instead of
+    draining to completion."""
+    import heapq
+    import queue as _queue
+
+    n = len(batch_sources)
+    if n == 0:
+        return [], 0
+    queues = [_queue.Queue(maxsize=queue_pages) for _ in range(n)]
+    done = [False] * n
+    failed = threading.Event()
+    cause: List[BaseException] = []
+    lock = threading.Lock()
+    in_flight = [0]
+    high_water = [0]
+
+    def produce(i):
+        try:
+            for batch in batch_sources[i]():
+                if not batch:
+                    continue
+                with lock:
+                    in_flight[0] += 1
+                    if in_flight[0] > high_water[0]:
+                        high_water[0] = in_flight[0]
+                while True:
+                    if failed.is_set():
+                        return
+                    try:
+                        queues[i].put(batch, timeout=0.05)
+                        break
+                    except _queue.Full:
+                        continue
+        except BaseException as e:   # noqa: BLE001 — propagated below
+            if not failed.is_set():
+                cause.append(e)      # the REAL failure, not a sibling's
+            failed.set()             # abort placeholder
+        finally:
+            done[i] = True
+
+    def stream(i):
+        while True:
+            try:
+                batch = queues[i].get(timeout=0.05)
+            except _queue.Empty:
+                if failed.is_set():
+                    raise ClusterQueryError(
+                        "merge input stream failed; aborting merge")
+                if done[i] and queues[i].empty():
+                    return
+                continue
+            with lock:
+                in_flight[0] -= 1
+            for row in batch:
+                yield row
+
+    threads = [threading.Thread(target=produce, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    try:
+        rows = list(heapq.merge(*(stream(i) for i in range(n)), key=key))
+    except BaseException:
+        failed.set()                 # release blocked producers
+        for t in threads:
+            t.join(timeout=5)
+        if cause:
+            raise cause[0]
+        raise
+    for t in threads:
+        t.join(timeout=5)
+    if cause:
+        raise cause[0]
+    return rows, high_water[0]
+
+
 @dataclasses.dataclass
 class _Stage:
     spec: FragmentSpec
@@ -157,7 +247,9 @@ class TpuCluster:
                  session_properties: Optional[Dict[str, str]] = None,
                  resource_groups=None, history=None, discovery=None,
                  shared_secret: Optional[str] = None,
-                 transport_config: Optional[TransportConfig] = None):
+                 transport_config: Optional[TransportConfig] = None,
+                 cache_config=None):
+        from presto_tpu.cache import AffinityRouter
         from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
 
@@ -183,10 +275,17 @@ class TpuCluster:
         # workers that announce to `discovery` join the schedulable set
         # alongside the statically started ones.
         self.discovery = discovery
+        self.cache_config = cache_config
         self.workers: List[TpuWorkerServer] = [
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}",
-                            shared_secret=shared_secret).start()
+                            shared_secret=shared_secret,
+                            cache_config=cache_config).start()
             for i in range(n_workers)]
+        # cache-affinity placement memory (reference: the coordinator's
+        # fragment-result-cache-aware NetworkLocationCache / soft
+        # affinity SplitPlacementPolicy): remembers which worker holds a
+        # fragment fingerprint so repeat queries land on the warm cache
+        self.affinity = AffinityRouter()
         self.all_worker_uris = [f"http://127.0.0.1:{w.port}"
                                 for w in self.workers]
         self.dead: set = set()
@@ -467,7 +566,44 @@ class TpuCluster:
                 lines.append(
                     f"  {op_type} [node {nid}]: {total} rows "
                     f"across {ntasks} task(s)")
+        cache_line = self._render_cache_stats(
+            getattr(self, "last_task_infos", []))
+        if cache_line:
+            lines.append(cache_line)
         return "\n".join(lines)
+
+    @staticmethod
+    def _render_cache_stats(infos) -> str:
+        """Roll the workers' fragmentResultCache* runtime metrics up to
+        one EXPLAIN ANALYZE line (reference: FragmentCacheStats surfaced
+        through the native worker's runtime metrics). Per-task snapshots
+        repeat their worker's process-wide counters, so store counters
+        dedupe by worker (latest snapshot wins) while per-task hit flags
+        sum directly."""
+        per_worker: Dict[str, dict] = {}
+        task_hits = 0
+        cached_tasks = 0
+        for _fid, info in infos:
+            rt = (info.get("stats") or {}).get("runtimeStats") or {}
+            if "fragmentResultCacheHitCount" not in rt:
+                continue
+            cached_tasks += 1
+            task_hits += int(
+                (rt.get("fragmentResultCacheHit") or {}).get("sum", 0))
+            uri = str((info.get("taskStatus") or {}).get("self", ""))
+            per_worker[uri.split("/v1/", 1)[0]] = rt
+        if not per_worker:
+            return ""
+
+        def total(name: str) -> int:
+            return sum(int((rt.get(name) or {}).get("sum", 0))
+                       for rt in per_worker.values())
+
+        return (f"Result cache: {task_hits}/{cached_tasks} tasks served "
+                f"from cache; store hits={total('fragmentResultCacheHitCount')} "
+                f"misses={total('fragmentResultCacheMissCount')} "
+                f"evictions={total('fragmentResultCacheEvictionCount')} "
+                f"bytes={total('fragmentResultCacheSizeBytes')}")
 
     def _execute_plan(self, plan: PlanNode, _retried: bool = False,
                       capture: bool = False,
@@ -782,10 +918,31 @@ class TpuCluster:
             node_id: (self.connector.connector_id(table),
                       self.connector.table_splits(table, stage.n_tasks))
             for node_id, table in stage.spec.scan_nodes.items()}
+        # cache-affinity placement: when result caching is on, route each
+        # leaf task to the worker that (per the router's memory) holds
+        # its fragment's cached result; rendezvous hashing places
+        # never-seen fingerprints deterministically so the FIRST and
+        # SECOND execution agree on a worker even with no history
+        affinity_fp = None
+        if stage.spec.scan_nodes and not stage.spec.remote_nodes and \
+                str(self.session_properties.get(
+                    "fragment_result_cache_enabled", "")
+                    ).strip().lower() == "true":
+            from presto_tpu.plan.fingerprint import plan_fingerprint
+            try:
+                affinity_fp = plan_fingerprint(by_id[fid].root)
+            except Exception:   # noqa: BLE001 — affinity is advisory
+                affinity_fp = None
         for t in range(stage.n_tasks):
-            w = t % len(placement)
+            worker = placement[t % len(placement)]
+            if affinity_fp is not None:
+                key = f"{affinity_fp}|t{t}/{stage.n_tasks}"
+                picked = self.affinity.pick(key, placement)
+                if picked is not None:
+                    worker = picked
+                self.affinity.record(key, worker)
             task_id, uri = self._post_stage_task(
-                qid, fid, stages, by_id, placement[w], t, attempt=0)
+                qid, fid, stages, by_id, worker, t, attempt=0)
             stage.task_ids.append(task_id)
             stage.task_uris.append(uri)
 
@@ -935,45 +1092,37 @@ class TpuCluster:
                 rows.extend(p.to_pylist())
         return rows
 
+    #: per-stream cap on decoded-but-unmerged row batches held at the
+    #: coordinator during an ordered-merge collect
+    MERGE_QUEUE_PAGES = 4
+
     def _merge_root(self, root: _Stage, out_types,
                     merge_keys) -> List[tuple]:
         """Ordered-merge exchange at the coordinator
         (operator/MergeOperator.java semantics at the root
         ExchangeClient). The per-task streams drain CONCURRENTLY
-        (network overlap across workers) and the K pre-sorted runs
-        merge in ONE Timsort pass — its run detection + galloping
-        merges the runs at C speed with ~n log k comparisons, replacing
-        the per-row python heap that was the round-4 throughput
-        ceiling."""
-        from concurrent.futures import ThreadPoolExecutor
-
+        (network overlap across workers) but coordinator residency is
+        RE-BOUND: each stream's decoded batches flow through a bounded
+        queue into ``heapq.merge`` instead of fully materializing every
+        run before a Timsort pass — peak memory is
+        ``k * (MERGE_QUEUE_PAGES + 2)`` batches plus the merged output,
+        not the sum of all runs twice over."""
         from presto_tpu.server.task_manager import TpuTaskManager
 
-        failed = threading.Event()
-        root_cause: List[BaseException] = []
-
-        def drain(uri):
-            stream = PageStream(
-                uri, buffer_id="0",
-                max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES,
-                client=self.http)
-            rows: List[tuple] = []
-            try:
-                while not stream.complete:
-                    if failed.is_set():
-                        raise ClusterQueryError(
-                            "sibling stream failed; aborting merge")
-                    data = stream.fetch()
-                    for p in decode_pages(data, out_types):
-                        rows.extend(p.to_pylist())
-            except BaseException as e:
-                if not failed.is_set():
-                    root_cause.append(e)   # the REAL failure, not the
-                failed.set()               # siblings' abort placeholder
-                raise
-            finally:
-                stream.close()
-            return rows
+        def source(uri):
+            def batches():
+                stream = PageStream(
+                    uri, buffer_id="0",
+                    max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES,
+                    client=self.http)
+                try:
+                    while not stream.complete:
+                        data = stream.fetch()
+                        for p in decode_pages(data, out_types):
+                            yield p.to_pylist()
+                finally:
+                    stream.close()
+            return batches
 
         class _Key:
             """SQL sort-order comparison over python row values (null
@@ -1004,20 +1153,11 @@ class TpuCluster:
                     return (a < b) == k.ascending
                 return False
 
-        try:
-            with ThreadPoolExecutor(
-                    max_workers=min(len(root.task_uris), 16)) as pool:
-                runs = list(pool.map(drain, root.task_uris))
-        except (ClusterQueryError, OSError):
-            # surface the FIRST REAL drain failure, not a sibling's
-            # abort placeholder; interrupts pass through untouched
-            if root_cause:
-                raise root_cause[0]
-            raise
-        rows: List[tuple] = []
-        for r in runs:
-            rows.extend(r)
-        rows.sort(key=_Key)     # K sorted runs: galloping merges
+        rows, high = bounded_merge(
+            [source(u) for u in root.task_uris], key=_Key,
+            queue_pages=self.MERGE_QUEUE_PAGES)
+        # observability hook for the bounded-in-flight test
+        self.last_merge_inflight_high = high
         return rows
 
     def _cleanup(self, stages: Dict[int, _Stage]):

@@ -314,11 +314,13 @@ class TpuWorkerServer:
     def __init__(self, connector, host: str = "127.0.0.1", port: int = 0,
                  coordinator_uri: Optional[str] = None,
                  node_id: str = "tpu-worker-0",
-                 shared_secret: Optional[str] = None):
+                 shared_secret: Optional[str] = None,
+                 cache_config=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         base = f"http://{host}:{self.port}"
-        self.task_manager = TpuTaskManager(connector, base_uri=base)
+        self.task_manager = TpuTaskManager(connector, base_uri=base,
+                                           cache_config=cache_config)
         self.httpd.task_manager = self.task_manager
         # internal JWT auth (InternalAuthenticationManager role): with a
         # shared secret every /v1/* request must carry a valid

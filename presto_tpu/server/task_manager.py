@@ -156,6 +156,14 @@ class Task:
         self.output_positions = 0
         self.operator_stats: List[dict] = []   # per-plan-node summaries
         self.total_splits = 0
+        # fragment result cache observability (FragmentCacheStats role):
+        # was THIS task served from cache, plus a worker-store counter
+        # snapshot taken when the task settles
+        self.cache_hit = False
+        self.cache_stats: dict = {}
+        # when set to a list, _emit_output also records the pre-
+        # partitioning pages for the populate step
+        self._cache_pages: Optional[list] = None
 
     def set_state(self, state: str):
         with self.state_change:
@@ -235,7 +243,7 @@ class Task:
             "physicalWrittenDataSizeInBytes": self.bytes_out,
             "fullGcCount": 0,
             "fullGcTimeInMillis": 0,
-            "runtimeStats": {},
+            "runtimeStats": self._runtime_stats(),
             "pipelines": ([{
                 "pipelineId": 0,
                 "firstStartTimeInMillis": int(start * 1000),
@@ -247,6 +255,28 @@ class Task:
                 "operatorSummaries": self.operator_stats,
             }] if self.operator_stats else []),
         }
+
+    def _runtime_stats(self) -> dict:
+        """TaskStats.runtimeStats metrics (RuntimeMetric wire shape).
+        Fragment-result-cache counters surface here so the coordinator
+        can aggregate them into EXPLAIN ANALYZE."""
+        out: dict = {}
+
+        def metric(name: str, v: int):
+            out[name] = {"name": name, "unit": "NONE", "sum": int(v),
+                         "count": 1, "max": int(v), "min": int(v)}
+
+        if self.cache_stats:
+            metric("fragmentResultCacheHitCount",
+                   self.cache_stats.get("hits", 0))
+            metric("fragmentResultCacheMissCount",
+                   self.cache_stats.get("misses", 0))
+            metric("fragmentResultCacheEvictionCount",
+                   self.cache_stats.get("evictions", 0))
+            metric("fragmentResultCacheSizeBytes",
+                   self.cache_stats.get("bytes", 0))
+            metric("fragmentResultCacheHit", 1 if self.cache_hit else 0)
+        return out
 
     def info(self, base_uri: str = "") -> S.TaskInfo:
         return S.TaskInfo(
@@ -262,10 +292,20 @@ class TpuTaskManager:
     so POST returns immediately (long-poll status sees RUNNING ->
     FINISHED, the coordinator's contract)."""
 
-    def __init__(self, connector, base_uri: str = ""):
+    def __init__(self, connector, base_uri: str = "",
+                 cache_config=None):
+        from presto_tpu.cache import FragmentResultCache
+        from presto_tpu.config import DEFAULT_CACHE
+
         self.connector = connector
         self.base_uri = base_uri
         self.tasks: Dict[str, Task] = {}
+        cfg = cache_config if cache_config is not None else DEFAULT_CACHE
+        # worker-side fragment result store (consulted per task only
+        # when the query enables fragment_result_cache_enabled)
+        self.result_cache = (FragmentResultCache(
+            cfg.budget_bytes, cfg.entry_cap())
+            if cfg.enabled else None)
         self.total_bytes_out = 0      # monotonic (survives task delete)
         self.lifetime_tasks = 0       # monotonic created-task count
         import collections
@@ -383,14 +423,41 @@ class TpuTaskManager:
             ex.set_splits(task.splits)
             task.total_splits = sum(len(v) for v in task.splits.values())
             task.start_time = time.time()
-            if not self._run_streaming(task, plan, ex) \
-                    and not self._run_streaming_remote(task, plan, ex):
-                remote = self._pull_remote_inputs(task, plan)
-                ex.set_remote_pages(remote)
-                page = ex.execute(plan)
-                task.output_positions = int(page.num_rows)
-                self._collect_stats(task, ex)
-                self._emit_output(task, page)
+            # fragment result cache consult (Presto@Meta VLDB'23 §4.2):
+            # an eligible leaf fragment whose key was produced before
+            # replays its cached pages through the normal output-buffer
+            # path — the exchange protocol cannot tell the difference
+            cache_key = None
+            caching_query = str(props.get(
+                "fragment_result_cache_enabled", "")) \
+                .strip().lower() == "true"
+            if self.result_cache is not None and caching_query:
+                cache_key = self._cache_key(task, plan)
+            cached = (self.result_cache.get(cache_key)
+                      if cache_key is not None else None)
+            if cached is not None:
+                task.cache_hit = True
+                for page in cached:
+                    task.output_positions += int(page.num_rows)
+                    self._emit_output(task, page)
+            else:
+                if cache_key is not None:
+                    task._cache_pages = []
+                if not self._run_streaming(task, plan, ex) \
+                        and not self._run_streaming_remote(task, plan,
+                                                           ex):
+                    remote = self._pull_remote_inputs(task, plan)
+                    ex.set_remote_pages(remote)
+                    page = ex.execute(plan)
+                    task.output_positions = int(page.num_rows)
+                    self._collect_stats(task, ex)
+                    self._emit_output(task, page)
+                if cache_key is not None:
+                    self.result_cache.put(
+                        cache_key, getattr(task, "_cache_pages", []))
+                    task._cache_pages = None
+            if self.result_cache is not None and caching_query:
+                task.cache_stats = self.result_cache.stats()
             task.end_time = time.time()
             task.cpu_nanos = int(
                 (task.end_time - task.start_time) * 1e9)
@@ -406,6 +473,33 @@ class TpuTaskManager:
             if task.buffers is not None:
                 task.buffers.set_no_more_pages()
             task.set_state("FAILED")
+
+    def _cache_key(self, task: Task, plan) -> Optional[str]:
+        """Cache key for this task's execution, or None when the
+        fragment is ineligible: remote inputs (result depends on
+        upstream task state, not table versions), table writers (side
+        effects must run), or a connector without version tracking."""
+        from presto_tpu.plan.fingerprint import fragment_cache_key
+        from presto_tpu.plan.nodes import TableWriterNode, scan_tables_deep
+
+        if _remote_source_nodes(plan):
+            return None
+
+        def has_writer(n) -> bool:
+            return isinstance(n, TableWriterNode) or any(
+                has_writer(c) for c in n.children())
+
+        if has_writer(plan):
+            return None
+        version_of = getattr(self.connector, "table_version", None)
+        if version_of is None:
+            return None
+        try:
+            versions = [(t, int(version_of(t)))
+                        for t in scan_tables_deep(plan)]
+        except Exception:
+            return None
+        return fragment_cache_key(plan, versions, task.splits)
 
     def _run_streaming(self, task: Task, plan, ex: SplitExecutor) -> bool:
         """Leaf-fragment streaming: execute one driving-scan lifespan at a
@@ -650,6 +744,11 @@ class TpuTaskManager:
         fragment's PartitioningScheme (producer side of the exchange:
         PartitionedOutputOperator.java:57 hash split,
         BroadcastOutputBuffer replication, TaskOutputOperator single)."""
+        if task._cache_pages is not None:
+            # record the pre-partitioning page for the cache populate
+            # step (replay re-partitions, so a later consumer-count
+            # change still routes correctly)
+            task._cache_pages.append(page)
         codec = (task.session_properties or {}).get(
             "exchange_compression_codec")
         if codec in (None, "", "none"):

@@ -16,8 +16,10 @@ Wire layout (all little-endian, airlift Slice convention):
       byte    baseline                    (min bucket value)
       byte[m/2] deltas                    4-bit (value - baseline) per
                                           bucket; bucket i lives in
-                                          deltas[i>>1], even i = low
-                                          nibble, odd i = high nibble
+                                          deltas[i>>1], even i = HIGH
+                                          nibble, odd i = low nibble
+                                          (airlift DenseHll
+                                          shiftForBucket)
       short   overflowEntries             count of buckets whose delta
                                           exceeds 15
       short[overflowEntries] overflowBucket indexes
@@ -29,8 +31,14 @@ Wire layout (all little-endian, airlift Slice convention):
       short   numberOfEntries
       int[numberOfEntries] entries        sorted; each entry packs the
                                           top 26 bits of the 64-bit
-                                          hash and the bucket value in
-                                          the low 6 bits
+                                          hash and, in the low 6 bits,
+                                          the number of leading zeros
+                                          AFTER that 26-bit prefix
+                                          (airlift SparseHll: value
+                                          computed at
+                                          EXTENDED_PREFIX_BITS, so
+                                          promotion to any p can
+                                          reconstruct the register)
 
 Hashing: Murmur3 x64 128's first word (airlift Murmur3Hash128.hash64,
 seed 0) over the value's 8-byte two's-complement (BIGINT) or UTF-8
@@ -190,7 +198,9 @@ class DenseHll:
         deltas_full = self.registers.astype(np.int32) - baseline
         overflow_idx = np.nonzero(deltas_full > MAX_DELTA)[0]
         nibbles = np.minimum(deltas_full, MAX_DELTA).astype(np.uint8)
-        packed = (nibbles[0::2] | (nibbles[1::2] << 4)).astype(np.uint8)
+        # even buckets take the HIGH nibble (airlift shiftForBucket:
+        # shift = ((~bucket) & 1) << 2)
+        packed = ((nibbles[0::2] << 4) | nibbles[1::2]).astype(np.uint8)
         out = bytearray()
         out += struct.pack("<BBB", TAG_DENSE_V2, self.p, baseline)
         out += packed.tobytes()
@@ -212,8 +222,8 @@ class DenseHll:
                                offset=off)
         off += m // 2
         regs = np.zeros(m, dtype=np.int32)
-        regs[0::2] = packed & 0xF
-        regs[1::2] = packed >> 4
+        regs[0::2] = packed >> 4
+        regs[1::2] = packed & 0xF
         (n_over,) = struct.unpack_from("<H", data, off)
         off += 2
         buckets = struct.unpack_from(f"<{n_over}H", data, off)
@@ -227,8 +237,11 @@ class DenseHll:
 
 class SparseHll:
     """Sparse entry list + airlift SPARSE_V2 serialization. Entries
-    keep the top 26 bits of the hash plus the 6-bit bucket value, so a
-    sparse sketch can promote to dense at any p <= 26 - VALUE_BITS."""
+    keep the top 26 bits of the hash plus, in the low 6 bits, the
+    number of leading zeros AFTER that prefix (airlift SparseHll's
+    value at EXTENDED_PREFIX_BITS) — so a sparse sketch can promote to
+    dense at any p <= 26 - VALUE_BITS by reconstructing the register
+    value from prefix bits below p plus the stored zero count."""
 
     ENTRY_HASH_BITS = 26
 
@@ -239,8 +252,13 @@ class SparseHll:
     def insert_hash(self, h: int) -> None:
         h &= _M64
         prefix = h >> (64 - self.ENTRY_HASH_BITS)
-        _idx, val = _index_and_value(h, self.p)
-        self.entries.add((prefix << VALUE_BITS) | val)
+        # zeros after the 26-bit prefix, with airlift's implicit guard
+        # bit: an all-zero suffix counts 64 - 26 = 38 zeros (fits 6
+        # bits), NOT the value at this sketch's own p
+        rest = (h << self.ENTRY_HASH_BITS) & _M64
+        zeros = (64 - rest.bit_length()) if rest \
+            else (64 - self.ENTRY_HASH_BITS)
+        self.entries.add((prefix << VALUE_BITS) | zeros)
 
     def add_long(self, v: int) -> None:
         self.insert_hash(murmur3_hash64_long(v))
@@ -249,11 +267,23 @@ class SparseHll:
         self.insert_hash(murmur3_hash64_bytes(b))
 
     def to_dense(self) -> DenseHll:
+        """Promote by reconstructing each register value at p from the
+        entry (airlift SparseHll.toDense decodeBucketValue): the
+        (26 - p) prefix bits below the bucket index lead the suffix;
+        only when they are all zero does the stored zero count extend
+        the run."""
         d = DenseHll(self.p)
+        low_bits = self.ENTRY_HASH_BITS - self.p
         for e in self.entries:
             prefix = e >> VALUE_BITS
-            val = e & ((1 << VALUE_BITS) - 1)
-            idx = prefix >> (self.ENTRY_HASH_BITS - self.p)
+            zeros = e & ((1 << VALUE_BITS) - 1)
+            idx = prefix >> low_bits
+            low = prefix & ((1 << low_bits) - 1)
+            if low:
+                val = low_bits - low.bit_length() + 1
+            else:
+                val = low_bits + zeros + 1
+            val = min(val, (1 << VALUE_BITS) - 1)
             if val > d.registers[idx]:
                 d.registers[idx] = val
         return d

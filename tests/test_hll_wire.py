@@ -121,3 +121,50 @@ def test_string_hashing():
     for i in range(2000):
         h.add_bytes(f"customer#{i:09d}".encode())
     assert abs(h.cardinality() - 2000) / 2000 < 0.1
+
+
+def test_dense_v2_nibble_packing_byte_vector():
+    """Airlift DENSE_V2 places EVEN buckets in the HIGH nibble
+    (shiftForBucket = ((~bucket) & 1) << 2) — an exact byte vector, not
+    just a self-consistent round trip."""
+    h = DenseHll(4)                       # 16 buckets -> 8 packed bytes
+    h.registers[0] = 5
+    h.registers[1] = 2
+    h.registers[14] = 9
+    data = h.serialize()
+    assert data[:3] == bytes([TAG_DENSE_V2, 4, 0])   # tag, p, baseline
+    assert data[3] == 0x52, "bucket 0 high nibble, bucket 1 low nibble"
+    assert data[4:10] == b"\x00" * 6
+    assert data[10] == 0x90, "bucket 14 (even) in the high nibble"
+    assert data[11:13] == struct.pack("<H", 0)       # no overflows
+    back = DenseHll.deserialize(data)
+    assert back.registers[0] == 5 and back.registers[1] == 2 \
+        and back.registers[14] == 9
+
+
+def test_sparse_v2_zeros_after_prefix_byte_vector():
+    """SPARSE_V2 entries = 26-bit hash prefix << 6 | number of leading
+    zeros AFTER the prefix (airlift's guard-bit semantics: an all-zero
+    38-bit suffix stores 38, independent of this sketch's own p)."""
+    s = SparseHll(11)
+    prefix_a, prefix_b = 0x155_5555, 0x0AB_CDEF
+    # suffix = 1 << 30 -> 38-bit suffix has 37 - 30 = 7 leading zeros
+    s.insert_hash((prefix_a << 38) | (1 << 30))
+    # all-zero suffix -> the guarded maximum of 64 - 26 = 38 zeros
+    s.insert_hash(prefix_b << 38)
+    entry_a = (prefix_a << 6) | 7
+    entry_b = (prefix_b << 6) | 38
+    assert s.entries == {entry_a, entry_b}
+    data = s.serialize()
+    assert data[:4] == struct.pack("<BBH", TAG_SPARSE_V2, 11, 2)
+    assert data[4:12] == struct.pack("<II", *sorted((entry_a, entry_b)))
+    back = SparseHll.deserialize(data)
+    assert back.entries == s.entries
+    # promotion reconstructs the register run from prefix-low bits +
+    # stored zeros: prefix_b's low 15 bits (26-11) are nonzero here, so
+    # its register value comes from those bits alone
+    d = s.to_dense()
+    low_bits = SparseHll.ENTRY_HASH_BITS - 11
+    low_b = prefix_b & ((1 << low_bits) - 1)
+    assert d.registers[prefix_b >> low_bits] == \
+        low_bits - low_b.bit_length() + 1

@@ -123,3 +123,45 @@ def test_decoder_handles_shared_values():
 ])
 def test_biginteger_roundtrip(v):
     assert smile.loads(smile.dumps(v)) == v
+
+
+def _ascii_tok(s: str) -> bytes:
+    """Tiny-ASCII value token (0x40 + len-1) followed by the bytes."""
+    b = s.encode("ascii")
+    assert 1 <= len(b) <= 32
+    return bytes([0x40 + len(b) - 1]) + b
+
+
+def test_long_shared_value_ref_is_zero_based():
+    """Jackson's 2-byte shared-string ref (0xEC-0xEF) indexes the seen
+    window 0-based; only the 1-byte short form (0x01-0x1F) carries the
+    -1 offset. A decoder applying -1 to the long form returns the wrong
+    string for every ref >= 31."""
+    strings = [f"s{i:02d}" for i in range(40)]
+    doc = bytearray(b":)\n" + bytes([0x03]))      # shared values enabled
+    doc += b"\xF8"                                # array start
+    for s in strings:
+        doc += _ascii_tok(s)
+    doc += bytes([0x01])                          # short ref -> index 0
+    doc += bytes([0xEC, 0x00])                    # long ref, index 0
+    doc += bytes([0xEC, 0x27])                    # long ref, index 39
+    doc += b"\xF9"                                # array end
+    got = smile.loads(bytes(doc))
+    assert got == strings + [strings[0], strings[0], strings[39]]
+
+
+def test_shared_value_window_resets_clear_then_append():
+    """At 1024 seen strings the window clears and the NEW string takes
+    slot 0 (Jackson's _expandSeenStringValues) — a reset that dropped
+    the triggering string would desynchronize every later ref."""
+    strings = [f"v{i:04d}" for i in range(1025)]
+    doc = bytearray(b":)\n" + bytes([0x03]))
+    doc += b"\xF8"
+    for s in strings:
+        doc += _ascii_tok(s)
+    # string #1025 ("v1024") occupies slot 0 of the fresh window
+    doc += bytes([0x01])                          # short ref -> index 0
+    doc += bytes([0xEC, 0x00])                    # long ref -> index 0
+    doc += b"\xF9"
+    got = smile.loads(bytes(doc))
+    assert got == strings + [strings[1024], strings[1024]]
