@@ -23,7 +23,11 @@ passes the compiled fragment makes, not FLOPs.
 Env knobs: BENCH_SF (default 1.0), BENCH_RUNS (5), BENCH_WARMUP (2),
 BENCH_QUERIES (comma list or "all", the default), BENCH_FRAG_QUERIES
 (comma list run lifespan-batched instead, default none),
-BENCH_QUERY_TIMEOUT (s, default 2400).
+BENCH_QUERY_TIMEOUT (s, default 2400). Device-probe budget:
+BENCH_PROBE_ATTEMPTS (2) x BENCH_PROBE_TIMEOUT (120 s) capped at
+BENCH_PROBE_BUDGET (300 s) total; if the accelerator never answers,
+the suite falls back to JAX_PLATFORMS=cpu so the final JSON line is
+always emitted (labeled cpu_fallback).
 
 TPC-DS lane (reference:
 presto-benchto-benchmarks/.../benchmarks/presto/tpcds.yaml): set
@@ -323,13 +327,24 @@ def _probe_device(timeout_s: float) -> Optional[str]:
 def _probe_with_retry(attempts, timeout_s, log) -> Optional[str]:
     """Probe up to `attempts` times with growing sleeps between failures
     (the tunnel wedges transiently: round-4's single 600 s probe turned
-    an infra blip into a 0.0 artifact). Returns None when healthy, else
-    the last error; every attempt is recorded in `log`."""
+    an infra blip into a 0.0 artifact). The WHOLE retry loop — probes
+    plus sleeps — is bounded by BENCH_PROBE_BUDGET seconds (default
+    300): a wedged tunnel gets a fair retry window but can never hold
+    the report hostage for tens of minutes. Returns None when healthy,
+    else the last error; every attempt is recorded in `log`."""
     backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "60"))
+    budget_s = float(os.environ.get("BENCH_PROBE_BUDGET", "300"))
+    deadline = time.perf_counter() + budget_s
     err = None
     for i in range(max(1, attempts)):
+        remaining = deadline - time.perf_counter()
+        if i > 0 and remaining <= 1.0:
+            log.append(f"attempt {i + 1}: skipped (probe budget "
+                       f"{budget_s:.0f}s exhausted)")
+            print(f"# device probe {log[-1]}", file=sys.stderr)
+            break
         t0 = time.perf_counter()
-        err = _probe_device(timeout_s)
+        err = _probe_device(min(timeout_s, max(remaining, 1.0)))
         dt = time.perf_counter() - t0
         log.append(f"attempt {i + 1}: "
                    + ("ok" if err is None else err) + f" ({dt:.0f}s)")
@@ -337,10 +352,12 @@ def _probe_with_retry(attempts, timeout_s, log) -> Optional[str]:
         if err is None:
             return None
         if i + 1 < attempts:
-            sleep_s = min(backoff * (2 ** i), 480.0)
-            print(f"# device probe: sleeping {sleep_s:.0f}s before retry",
-                  file=sys.stderr)
-            time.sleep(sleep_s)
+            sleep_s = min(backoff * (2 ** i), 480.0,
+                          max(deadline - time.perf_counter(), 0.0))
+            if sleep_s > 0:
+                print(f"# device probe: sleeping {sleep_s:.0f}s "
+                      "before retry", file=sys.stderr)
+                time.sleep(sleep_s)
     return err
 
 
@@ -391,21 +408,38 @@ def _main_orchestrator(sf, qids) -> None:
       wedged mid-run the remaining queries are labeled infra errors
       instead of burning N x BENCH_QUERY_TIMEOUT;
     - infra failure is always labeled (`infra_error`), never an
-      unlabeled 0.0."""
-    # a HEALTHY tunnel compiles the trivial probe in seconds; 300 s per
-    # attempt x 5 attempts + growing backoffs spans a ~40-minute window
-    # when wedged while still fitting a bounded driver budget
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
-    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
+      unlabeled 0.0;
+    - if the accelerator never comes up within the probe budget, the
+      suite FALLS BACK to JAX_PLATFORMS=cpu (labeled `cpu_fallback`) so
+      the run still produces a functional-correctness artifact instead
+      of an empty infra_error line."""
+    # a HEALTHY tunnel compiles the trivial probe in seconds; 2 attempts
+    # x 120 s inside a 300 s total budget (BENCH_PROBE_BUDGET) rides out
+    # a transient blip without wedging the driver for ~40 minutes the
+    # way the old 5 x 300 s schedule did
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
     probe_log = []
+    fallback_reason = None
     err = _probe_with_retry(probe_attempts, probe_timeout, probe_log)
+    if err is not None and os.environ.get("BENCH_PLATFORM") != "cpu":
+        # accelerator wedged: rerun the suite on the host CPU so the
+        # final JSON line always lands (perf numbers are then labeled,
+        # not comparable to accelerator runs)
+        fallback_reason = err
+        print("# device probe failed; falling back to "
+              "BENCH_PLATFORM=cpu", file=sys.stderr)
+        os.environ["BENCH_PLATFORM"] = "cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        err = _probe_with_retry(1, min(probe_timeout, 120.0), probe_log)
     if err is not None:
         print(json.dumps({
             "metric": f"tpch_infra_error_sf{sf:g}_rows_per_sec",
             "value": 0.0, "unit": "rows/s", "vs_baseline": 0.0,
             "detail": {"infra_error": err, "probe_log": probe_log,
-                       "note": "accelerator tunnel unhealthy; no engine "
-                               "perf claim can be made this run"},
+                       "note": "accelerator tunnel unhealthy and cpu "
+                               "fallback probe failed; no engine perf "
+                               "claim can be made this run"},
         }))
         return
 
@@ -469,10 +503,15 @@ def _main_orchestrator(sf, qids) -> None:
     if wedged is not None:
         detail["infra_error"] = wedged
         detail["probe_log"] = probe_log
+    if fallback_reason is not None:
+        detail["platform"] = "cpu_fallback"
+        detail["fallback_reason"] = fallback_reason
+        detail["probe_log"] = probe_log
 
     head_name, head = _headline(detail)
+    lane = "tpch_cpu_fallback" if fallback_reason is not None else "tpch"
     print(json.dumps({
-        "metric": f"tpch_{head_name}_sf{sf:g}_rows_per_sec",
+        "metric": f"{lane}_{head_name}_sf{sf:g}_rows_per_sec",
         "value": head["rows_per_sec"],
         "unit": "rows/s",
         "vs_baseline": head["vs_baseline"],

@@ -20,6 +20,20 @@ import threading
 from typing import List, Optional
 
 from presto_tpu.data.column import Page
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+
+# registry mirrors of the per-cache counters below — process-wide
+# (one worker process owns one cache, so no instance label needed)
+_M_HITS = _counter("presto_tpu_result_cache_hits_total",
+                   "Fragment-result-cache lookups served from cache")
+_M_MISSES = _counter("presto_tpu_result_cache_misses_total",
+                     "Fragment-result-cache lookups that missed")
+_M_EVICTIONS = _counter("presto_tpu_result_cache_evictions_total",
+                        "LRU entries evicted to admit new results")
+_M_BYTES = _gauge("presto_tpu_result_cache_bytes",
+                  "Bytes currently held by the fragment result cache")
+_M_ENTRIES = _gauge("presto_tpu_result_cache_entries",
+                    "Entries currently in the fragment result cache")
 
 
 def page_bytes(page: Page) -> int:
@@ -69,9 +83,11 @@ class FragmentResultCache:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
+                _M_MISSES.inc()
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            _M_HITS.inc()
             return list(entry[0])
 
     def contains(self, key: str) -> bool:
@@ -93,6 +109,7 @@ class FragmentResultCache:
                 _, (_, evicted_bytes) = self._entries.popitem(last=False)
                 self._release(evicted_bytes)
                 self.evictions += 1
+                _M_EVICTIONS.inc()
             if self._pool is not None:
                 try:
                     self._pool.reserve(self._pool_qid, nbytes)
@@ -102,10 +119,13 @@ class FragmentResultCache:
                     return False
             self._entries[key] = (list(pages), nbytes)
             self.bytes += nbytes
+            _M_BYTES.set(self.bytes)
+            _M_ENTRIES.set(len(self._entries))
             return True
 
     def _release(self, nbytes: int) -> None:
         self.bytes -= nbytes
+        _M_BYTES.set(self.bytes)
         if self._pool is not None:
             self._pool.free(self._pool_qid, nbytes)
 
@@ -114,6 +134,7 @@ class FragmentResultCache:
             for _, nbytes in self._entries.values():
                 self._release(nbytes)
             self._entries.clear()
+            _M_ENTRIES.set(0)
 
     def __len__(self) -> int:
         with self._lock:

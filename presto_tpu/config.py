@@ -192,6 +192,43 @@ class CacheConfig:
 DEFAULT_CACHE = CacheConfig()
 
 
+@dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (reference: the Prometheus exporter config in
+    the native worker + the coordinator's tracing/event-listener
+    enablement). One per process; `obs/metrics.py` instruments and the
+    cluster's trace sampling consult it."""
+
+    #: master switch for metric collection (endpoints still respond,
+    #: counters simply stay at their last value when off)
+    metrics_enabled: bool = True
+    #: master switch for span recording / trace propagation
+    tracing_enabled: bool = True
+    #: fraction of cluster queries that carry a trace (1.0 = all);
+    #: unsampled queries send no X-Presto-Trace header, so workers open
+    #: no spans for them
+    trace_sample_rate: float = 1.0
+    #: per-trace span cap forwarded to utils/tracing.Tracer — beyond it
+    #: spans are counted as dropped instead of accumulating
+    max_spans_per_trace: int = 2048
+    #: wall-time histogram buckets (seconds)
+    time_buckets_s: tuple = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0,
+                             30.0, 120.0)
+    #: row-count histogram buckets
+    rows_buckets: tuple = (1.0, 100.0, 10_000.0, 100_000.0,
+                           1_000_000.0, 10_000_000.0, 100_000_000.0)
+
+    def sampled(self, rng_value: float) -> bool:
+        """Decide sampling from a caller-supplied uniform [0,1) draw
+        (kept injectable for deterministic tests)."""
+        return self.tracing_enabled \
+            and rng_value < self.trace_sample_rate
+
+
+#: process defaults
+DEFAULT_OBS = ObsConfig()
+
+
 class Session:
     """One query session: defaults overridden by string-typed properties
     (the wire form). Unknown properties are rejected loudly, like the

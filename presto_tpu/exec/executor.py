@@ -29,6 +29,10 @@ from presto_tpu.expr.compile import compile_expr
 from presto_tpu.expr.nodes import (
     Call, InputRef, Literal, RowExpression, SpecialForm,
 )
+from presto_tpu.obs.metrics import (
+    DEFAULT_ROWS_BUCKETS, DEFAULT_TIME_BUCKETS_S,
+    histogram as _obs_histogram,
+)
 from presto_tpu.ops.aggregate import grouped_aggregate
 from presto_tpu.ops.join import hash_join, merge_join
 from presto_tpu.ops.sort import limit_page, sort_page, top_n
@@ -39,6 +43,19 @@ from presto_tpu.plan.nodes import (
     MarkDistinctNode, TableWriterNode, UnionAllNode, UnnestNode,
     ValuesNode, WindowNode,
 )
+
+# per-operator execution histograms (OperatorStats role, scrapeable):
+# wall seconds only exist on the profiled (collect_stats) island path —
+# fused production dispatch deliberately has no per-operator sync —
+# while output-row observations come from every converged program
+_M_OP_WALL = _obs_histogram(
+    "presto_tpu_operator_wall_seconds",
+    "Per-operator island wall time (profiled executions)",
+    ("operator",), buckets=DEFAULT_TIME_BUCKETS_S)
+_M_OP_ROWS = _obs_histogram(
+    "presto_tpu_operator_rows",
+    "Per-operator output rows per execution", ("operator",),
+    buckets=DEFAULT_ROWS_BUCKETS)
 
 
 @dataclasses.dataclass
@@ -306,12 +323,17 @@ class Executor:
                     t0 = _t.perf_counter()
                     out = self._execute_fused(mini)
                     jax.block_until_ready(out)   # Page is a pytree
-                    self.last_island_profile.append({
+                    entry = {
                         "root": type(node).__name__.replace("Node", ""),
                         "seconds": _t.perf_counter() - t0,
                         "rows": int(out.num_rows),
                         "memory_bytes": self.last_memory_estimate,
-                    })
+                    }
+                    self.last_island_profile.append(entry)
+                    _M_OP_WALL.observe(entry["seconds"],
+                                       operator=entry["root"])
+                    _M_OP_ROWS.observe(entry["rows"],
+                                       operator=entry["root"])
                 else:
                     out, pending = self._dispatch_fused(mini)
                     pendings.append(pending)
@@ -546,8 +568,13 @@ class Executor:
         stats_box = pending["stats_box"]
         if stats_box:
             stats = needed[len(watch) + 1:]
-            self.last_node_rows.update(
-                {nid: int(r) for nid, r in zip(stats_box, stats)})
+            node_map = getattr(self, "_node_map", {}) or {}
+            for nid, r in zip(stats_box, stats):
+                self.last_node_rows[nid] = int(r)
+                entry = node_map.get(nid)
+                op = (type(entry[0]).__name__.replace("Node", "")
+                      if entry else "?")
+                _M_OP_ROWS.observe(int(r), operator=op)
         self._save_caps(pending["plan"], pending["caps"])
 
     def _resolve_counters(self, pending) -> bool:

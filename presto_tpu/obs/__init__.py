@@ -1,0 +1,7 @@
+from presto_tpu.obs.metrics import (
+    REGISTRY, Counter, Gauge, Histogram, MetricsRegistry, counter, gauge,
+    histogram, render_prometheus,
+)
+
+__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "counter", "gauge", "histogram", "render_prometheus"]

@@ -20,10 +20,21 @@ from __future__ import annotations
 import struct
 from typing import List, Optional, Tuple
 
+from presto_tpu.obs.metrics import counter as _counter
 from presto_tpu.protocol.transport import (
     HttpClient, RetriesExhaustedError, TransportError,
     WorkerRestartedError, get_client,
 )
+
+_M_FETCHES = _counter("presto_tpu_exchange_fetches_total",
+                      "Exchange fetch rounds (one sequenced GET each)")
+_M_PAGES = _counter("presto_tpu_exchange_pages_total",
+                    "SerializedPage frames pulled over the exchange")
+_M_BYTES = _counter("presto_tpu_exchange_bytes_total",
+                    "Wire bytes pulled over the exchange")
+_M_TRUNCATED = _counter(
+    "presto_tpu_exchange_truncated_bodies_total",
+    "Page-fetch bodies rejected by frame validation and re-fetched")
 
 _FRAME_HEADER = struct.Struct("<ibiiq")     # serde SerializedPage header
 
@@ -95,6 +106,7 @@ class PageStream:
             problem = self._body_problem(resp)
             if problem is None:
                 return resp.body, resp.headers
+            _M_TRUNCATED.inc()
             last = TransportError(f"{problem} from {url}")
         raise RetriesExhaustedError(
             f"page body from {url} still truncated after "
@@ -120,6 +132,9 @@ class PageStream:
         """One round: GET next frames, acknowledge, advance the token."""
         url = f"{self.base}/results/{self.buffer_id}/{self.token}"
         body, headers = self._get(url, validate=True)
+        _M_FETCHES.inc()
+        _M_BYTES.inc(len(body))
+        _M_PAGES.inc(count_frames(body) or 0)
         instance = headers.get("X-Presto-Task-Instance-Id")
         if self.task_instance_id is None:
             self.task_instance_id = instance

@@ -52,8 +52,51 @@ import urllib.request
 from typing import Dict, Optional
 
 from presto_tpu.config import DEFAULT_TRANSPORT, TransportConfig
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+from presto_tpu.utils.tracing import TRACE_HEADER, current_trace
 
 log = logging.getLogger("presto_tpu.transport")
+
+# ------------------------------------------------------------------ metrics
+# Registered once at import; labeled per target host so a scrape shows
+# which worker a coordinator is struggling to reach.
+_M_RETRIES = _counter(
+    "presto_tpu_transport_retries_total",
+    "Retry attempts performed after a retryable transport failure",
+    ("host",))
+_M_TIMEOUTS = _counter(
+    "presto_tpu_transport_timeouts_total",
+    "Transport attempts that failed with a timeout", ("host",))
+_M_FATAL = _counter(
+    "presto_tpu_transport_fatal_responses_total",
+    "4xx responses (request classified fatal, never retried)",
+    ("host",))
+_M_EXHAUSTED = _counter(
+    "presto_tpu_transport_retries_exhausted_total",
+    "Logical requests that failed after exhausting their retry policy",
+    ("host",))
+_M_BREAKER_REJECTS = _counter(
+    "presto_tpu_transport_breaker_rejections_total",
+    "Requests fast-failed because the host's circuit breaker was OPEN",
+    ("host",))
+_M_BREAKER_TRANSITIONS = _counter(
+    "presto_tpu_transport_breaker_transitions_total",
+    "Circuit-breaker state transitions", ("host", "to_state"))
+_M_BREAKER_STATE = _gauge(
+    "presto_tpu_transport_breaker_state",
+    "Current breaker state per host: 0=CLOSED 1=HALF_OPEN 2=OPEN",
+    ("host",))
+
+_STATE_CODE = {"CLOSED": 0, "HALF_OPEN": 1, "OPEN": 2}
+
+
+def _is_timeout(exc: BaseException) -> bool:
+    if isinstance(exc, TimeoutError):
+        return True
+    if isinstance(exc, urllib.error.URLError):
+        return isinstance(exc.reason, TimeoutError) \
+            or "timed out" in str(exc.reason)
+    return False
 
 
 # --------------------------------------------------------------------------
@@ -142,7 +185,8 @@ class CircuitBreaker:
 
     CLOSED, OPEN, HALF_OPEN = "CLOSED", "OPEN", "HALF_OPEN"
 
-    def __init__(self, threshold: int, cooldown_s: float, clock=None):
+    def __init__(self, threshold: int, cooldown_s: float, clock=None,
+                 host: str = ""):
         self.threshold = max(int(threshold), 1)
         self.cooldown_s = cooldown_s
         self._clock = clock or time.monotonic
@@ -151,6 +195,18 @@ class CircuitBreaker:
         self.failures = 0
         self._opened_at = 0.0
         self._probing = False
+        self.host = host
+
+    def _transition(self, new_state: str):
+        """State change under self._lock; mirrors into the registry
+        (real transitions only — a success in CLOSED is not one)."""
+        if new_state == self.state:
+            return
+        self.state = new_state
+        if self.host:
+            _M_BREAKER_TRANSITIONS.inc(host=self.host,
+                                       to_state=new_state)
+            _M_BREAKER_STATE.set(_STATE_CODE[new_state], host=self.host)
 
     def allow(self) -> bool:
         with self._lock:
@@ -159,7 +215,7 @@ class CircuitBreaker:
             if self.state == self.OPEN:
                 if self._clock() - self._opened_at < self.cooldown_s:
                     return False
-                self.state = self.HALF_OPEN
+                self._transition(self.HALF_OPEN)
                 self._probing = True
                 return True
             # HALF_OPEN: one outstanding probe owns the trial
@@ -170,7 +226,7 @@ class CircuitBreaker:
 
     def record_success(self):
         with self._lock:
-            self.state = self.CLOSED
+            self._transition(self.CLOSED)
             self.failures = 0
             self._probing = False
 
@@ -179,7 +235,7 @@ class CircuitBreaker:
             self.failures += 1
             if self.state == self.HALF_OPEN \
                     or self.failures >= self.threshold:
-                self.state = self.OPEN
+                self._transition(self.OPEN)
                 self._opened_at = self._clock()
             self._probing = False
 
@@ -226,7 +282,7 @@ class HttpClient:
             if br is None:
                 br = CircuitBreaker(self.config.breaker_failure_threshold,
                                     self.config.breaker_cooldown_s,
-                                    clock=self._clock)
+                                    clock=self._clock, host=host)
                 self._breakers[host] = br
             return br
 
@@ -244,23 +300,32 @@ class HttpClient:
         policy = self.policies[request_class]
         timeout = policy.timeout_s if timeout is None else timeout
         max_attempts = policy.attempts if attempts is None else attempts
+        host = _host_of(url)
         breaker = self.breaker(url)
         injector = self.fault_injector
         deadline = self._clock() + self.config.retry_budget_s
+        hdrs = dict(headers or {})
+        # distributed tracing: every RPC issued inside a trace_scope
+        # carries the query's trace context to the worker — the single
+        # propagation point, because this method is the RPC chokepoint
+        ctx = current_trace()
+        if ctx is not None and TRACE_HEADER not in hdrs:
+            hdrs[TRACE_HEADER] = ctx.header_value()
         # the breaker gates the START of a logical request (fast-fail
         # instead of burning a timeout on a known-dead worker); within
         # one request the retry policy governs, so a request whose own
         # early attempts trip the threshold may still recover
         if not breaker.allow():
+            _M_BREAKER_REJECTS.inc(host=host)
             raise CircuitOpenError(
-                f"circuit open for {_host_of(url)} ({url})")
+                f"circuit open for {host} ({url})")
         last: Optional[BaseException] = None
         for attempt in range(max_attempts):
             try:
                 if injector is not None:
                     injector.before_request(url, method)
                 req = urllib.request.Request(
-                    url, data=body, method=method, headers=headers or {})
+                    url, data=body, method=method, headers=hdrs)
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     raw = resp.read()
                     resp_headers = dict(resp.headers)
@@ -279,6 +344,7 @@ class HttpClient:
                     # the worker answered: it is alive, the REQUEST is
                     # bad — don't punish the breaker, don't retry
                     breaker.record_success()
+                    _M_FATAL.inc(host=host)
                     raise FatalResponseError(url, e.code, err_body) \
                         from e
                 breaker.record_failure()
@@ -289,6 +355,8 @@ class HttpClient:
                 # IncompleteRead/BadStatusLine from resp.read(), which
                 # are NOT OSErrors — retry them like any torn connection
                 breaker.record_failure()
+                if _is_timeout(e):
+                    _M_TIMEOUTS.inc(host=host)
                 last = e
             except BaseException:
                 # unclassified failure: account it so a half-open probe
@@ -302,7 +370,9 @@ class HttpClient:
             backoff *= self._rng.random()         # full jitter
             if self._clock() + backoff > deadline:
                 break                             # retry budget exhausted
+            _M_RETRIES.inc(host=host)
             self._sleep(backoff)
+        _M_EXHAUSTED.inc(host=host)
         raise RetriesExhaustedError(
             f"{method} {url} failed after {max_attempts} attempt(s): "
             f"{last}") from last

@@ -11,6 +11,18 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+
+_M_PAGES_ADDED = _counter(
+    "presto_tpu_output_buffer_pages_added_total",
+    "Frames enqueued into task output buffers")
+_M_DEPTH_HIGH = _gauge(
+    "presto_tpu_output_buffer_depth_high_water",
+    "Max unacknowledged frames ever queued in one client buffer")
+_M_BYTES_HIGH = _gauge(
+    "presto_tpu_output_buffer_bytes_high_water",
+    "Max unacknowledged bytes ever queued in one client buffer")
+
 
 class ClientBuffer:
     """One destination's page queue with token bookkeeping. Acknowledged
@@ -22,6 +34,7 @@ class ClientBuffer:
         self.base = 0                    # token of pages[0]
         self.no_more_pages = False
         self.aborted = False
+        self.queued_bytes = 0            # bytes in the unacked window
 
     @property
     def end_token(self) -> int:
@@ -29,6 +42,7 @@ class ClientBuffer:
 
     def add(self, frame: bytes):
         self.pages.append(frame)
+        self.queued_bytes += len(frame)
 
     def get(self, token: int, max_bytes: int
             ) -> Tuple[List[bytes], int, bool]:
@@ -51,6 +65,7 @@ class ClientBuffer:
     def acknowledge(self, token: int):
         if token > self.base:
             drop = min(token, self.end_token) - self.base
+            self.queued_bytes -= sum(len(f) for f in self.pages[:drop])
             del self.pages[:drop]
             self.base += drop
 
@@ -81,6 +96,7 @@ class MaterializedClientBuffer(ClientBuffer):
             self._file.flush()
             self._index.append((off, len(frame)))
         self.pages.append(None)          # token bookkeeping only
+        self.queued_bytes += len(frame)  # cumulative: nothing discards
 
     def get(self, token: int, max_bytes: int):
         out: List[bytes] = []
@@ -136,7 +152,11 @@ class OutputBufferManager:
 
     def add_page(self, buffer_id: str, frame: bytes):
         with self.lock:
-            self.buffers[buffer_id].add(frame)
+            b = self.buffers[buffer_id]
+            b.add(frame)
+            _M_PAGES_ADDED.inc()
+            _M_DEPTH_HIGH.set_max(len(b.pages))
+            _M_BYTES_HIGH.set_max(b.queued_bytes)
 
     def set_no_more_pages(self):
         with self.lock:
@@ -149,3 +169,4 @@ class OutputBufferManager:
             if b is not None:
                 b.aborted = True
                 b.pages = []
+                b.queued_bytes = 0

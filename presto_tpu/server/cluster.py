@@ -23,12 +23,15 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from presto_tpu.config import TransportConfig
+from presto_tpu.config import DEFAULT_OBS, TransportConfig
+from presto_tpu.obs.metrics import gauge as _obs_gauge
 from presto_tpu.plan.fragment import add_exchanges, create_fragments
+from presto_tpu.utils.tracing import TRACER, trace_scope
 from presto_tpu.plan.nodes import ExchangeNode, Partitioning, PlanNode
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.exchange_client import PageStream, decode_pages
@@ -38,6 +41,10 @@ from presto_tpu.protocol.transport import HttpClient
 from presto_tpu.server.http import TpuWorkerServer
 
 log = logging.getLogger("presto_tpu.cluster")
+
+_M_MERGE_HIGH = _obs_gauge(
+    "presto_tpu_merge_inflight_high_water",
+    "Max in-flight row batches during bounded k-way root merges")
 
 
 def _unshare(plan: PlanNode) -> PlanNode:
@@ -570,7 +577,33 @@ class TpuCluster:
             getattr(self, "last_task_infos", []))
         if cache_line:
             lines.append(cache_line)
+        trace = self.render_trace()
+        if trace:
+            lines.append(
+                f"Trace {getattr(self, 'last_trace_id', '')}:")
+            lines.extend("  " + ln for ln in trace.splitlines())
         return "\n".join(lines)
+
+    # ---------------------------------------------------------- tracing
+    def _scrape_worker_traces(self, trace_id: str) -> None:
+        """GET /v1/trace/{id} from every worker and stitch the spans
+        into the coordinator tracer (span_id dedupe makes this a no-op
+        for in-process workers, which share the process tracer)."""
+        for uri in self.worker_uris:
+            try:
+                doc = self.http.get_json(f"{uri}/v1/trace/{trace_id}",
+                                         request_class="control")
+                TRACER.merge_remote(trace_id, doc)
+            except Exception:   # noqa: BLE001 — tracing is best-effort
+                log.debug("trace scrape failed for %s", uri,
+                          exc_info=True)
+
+    def render_trace(self, query_id: Optional[str] = None) -> str:
+        """One cross-node timeline for `query_id` (default: the most
+        recent sampled query) — coordinator and worker spans under the
+        same query trace id, sorted by start time."""
+        qid = query_id or getattr(self, "last_trace_id", None)
+        return TRACER.render(qid) if qid else ""
 
     @staticmethod
     def _render_cache_stats(infos) -> str:
@@ -750,31 +783,50 @@ class TpuCluster:
         batch_mode = (str(self.session_properties.get(
             "exchange_materialization_enabled", ""))
             .strip().lower() == "true")
-        try:
-            if batch_mode:
-                return self._run_fragments_batch(
-                    qid, stages, by_id, placement, out_types,
-                    merge_keys, capture, cancel_event)
-            schedule(0)
+
+        def run_query() -> List[tuple]:
             try:
-                self._await_all(stages, cancel_event=cancel_event)
-            except (ClusterQueryError, OSError):
-                if cancel_event is not None and cancel_event.is_set():
-                    raise
-                # task-level recovery (reference: scheduler/group
-                # recoverable grouped execution,
-                # SystemSessionProperties recoverable_grouped_execution):
-                # for a single-stage query, re-run ONLY the tasks that
-                # lived on dead workers — their split assignment is
-                # deterministic, so exactly the lost lifespans re-run
-                if not self._recover_dead_tasks(qid, stages, by_id):
-                    raise
-                self._await_all(stages, cancel_event=cancel_event)
-            if capture:
-                self._capture_task_infos(stages)
-            return self._collect_root(stages[0], out_types, merge_keys)
-        finally:
-            self._cleanup(stages)
+                if batch_mode:
+                    return self._run_fragments_batch(
+                        qid, stages, by_id, placement, out_types,
+                        merge_keys, capture, cancel_event)
+                schedule(0)
+                try:
+                    self._await_all(stages, cancel_event=cancel_event)
+                except (ClusterQueryError, OSError):
+                    if cancel_event is not None \
+                            and cancel_event.is_set():
+                        raise
+                    # task-level recovery (reference: scheduler/group
+                    # recoverable grouped execution,
+                    # SystemSessionProperties
+                    # recoverable_grouped_execution): for a single-stage
+                    # query, re-run ONLY the tasks that lived on dead
+                    # workers — their split assignment is deterministic,
+                    # so exactly the lost lifespans re-run
+                    if not self._recover_dead_tasks(qid, stages, by_id):
+                        raise
+                    self._await_all(stages, cancel_event=cancel_event)
+                if capture:
+                    self._capture_task_infos(stages)
+                return self._collect_root(stages[0], out_types,
+                                          merge_keys)
+            finally:
+                self._cleanup(stages)
+
+        if not DEFAULT_OBS.sampled(random.random()):
+            return run_query()
+        # sampled query: the coordinator opens the root span, the
+        # trace_scope makes every RPC this scheduling thread issues
+        # carry X-Presto-Trace, and worker span dumps are scraped back
+        # at query end into one stitched timeline
+        self.last_trace_id = qid
+        with TRACER.span(qid, "query", worker="coordinator",
+                         fragments=len(frags)) as root:
+            with trace_scope(qid, root.span_id):
+                rows = run_query()
+        self._scrape_worker_traces(qid)
+        return rows
 
     def _run_fragments_batch(self, qid, stages, by_id, placement,
                              out_types, merge_keys, capture,
@@ -1158,6 +1210,7 @@ class TpuCluster:
             queue_pages=self.MERGE_QUEUE_PAGES)
         # observability hook for the bounded-in-flight test
         self.last_merge_inflight_high = high
+        _M_MERGE_HIGH.set_max(high)
         return rows
 
     def _cleanup(self, stages: Dict[int, _Stage]):

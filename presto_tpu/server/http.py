@@ -29,8 +29,18 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from presto_tpu.obs.metrics import gauge as _gauge, render_prometheus
 from presto_tpu.protocol import structs as S
 from presto_tpu.server.task_manager import TpuTaskManager
+from presto_tpu.utils.tracing import (
+    TRACE_HEADER, TRACER, parse_trace_header,
+)
+
+_M_UPTIME = _gauge("presto_tpu_uptime_seconds",
+                   "Seconds since this server process started serving")
+
+#: Prometheus exposition content type (text format 0.0.4)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 _TASK = re.compile(r"^/v1/task/([^/?]+)$")
 _STATUS = re.compile(r"^/v1/task/([^/?]+)/status$")
@@ -40,6 +50,7 @@ _ABORT = re.compile(r"^/v1/task/([^/?]+)/results/([^/]+)$")
 _BATCH = re.compile(r"^/v1/task/([^/?]+)/batch$")
 _REMOTE_SOURCE = re.compile(
     r"^/v1/task/([^/?]+)/remote-source/([^/?]+)$")
+_TRACE = re.compile(r"^/v1/trace/([^/?]+)$")
 
 _SERVER_START = time.time()
 
@@ -147,6 +158,7 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             return
         path = self.path.split("?")[0]
+        trace_ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
         m = _BATCH.match(path)
         if m:
             # /v1/task/{id}/batch (TaskResource.cpp:115-180): unwrap the
@@ -155,12 +167,14 @@ class _Handler(BaseHTTPRequestHandler):
             breq = S.BatchTaskUpdateRequest.from_json(
                 self._read_body_doc())
             info = self.tm.create_or_update(m.group(1),
-                                            breq.taskUpdateRequest)
+                                            breq.taskUpdateRequest,
+                                            trace_ctx=trace_ctx)
             return self._json(200, S.TaskInfo.to_json(info))
         m = _TASK.match(path)
         if m:
             req = S.TaskUpdateRequest.from_json(self._read_body_doc())
-            info = self.tm.create_or_update(m.group(1), req)
+            info = self.tm.create_or_update(m.group(1), req,
+                                            trace_ctx=trace_ctx)
             return self._json(200, S.TaskInfo.to_json(info))
         self._json(404, {"error": f"no route {self.path}"})
 
@@ -206,46 +220,43 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/info/state":
             return self._json(200, "ACTIVE")
         if path == "/v1/status":
+            # NodeStatus role (PrestoServer.cpp /v1/status): JSON node
+            # snapshot — identity, role, uptime, task counts, heap-proxy
+            # byte gauges
             tasks = self.tm.tasks
             return self._json(200, {
-                "nodeId": "tpu-worker-0", "environment": "tpu",
+                "nodeId": self.tm.node_id, "environment": "tpu",
+                "role": "worker",
                 "uptime": f"{time.time() - _SERVER_START:.2f}s",
+                "uptimeSeconds": time.time() - _SERVER_START,
                 "externalAddress": "127.0.0.1",
                 "internalAddress": "127.0.0.1",
                 "taskCount": len(tasks),
+                "tasksCreated": self.tm.lifetime_tasks,
                 "memoryInfo": {"availableProcessors": 1},
                 "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
                 "heapUsed": self.tm.memory_bytes(),
                 "heapAvailable": 16 << 30, "nonHeapUsed": 0})
-        if path == "/v1/info/metrics":
-            # Prometheus text exposition (reference:
-            # presto_cpp/main/runtime-metrics/PrometheusStatsReporter.cpp,
-            # registered at PrestoServer.cpp:562).
-            tasks = list(self.tm.tasks.values())
-            by_state: dict = {}
-            for t in tasks:
-                by_state[t.state] = by_state.get(t.state, 0) + 1
-            lines = [
-                "# TYPE presto_tpu_tasks gauge",
-                f"presto_tpu_tasks {len(tasks)}",
-                "# TYPE presto_tpu_task_bytes_out counter",
-                f"presto_tpu_task_bytes_out {self.tm.total_bytes_out}",
-                "# TYPE presto_tpu_uptime_seconds counter",
-                f"presto_tpu_uptime_seconds "
-                f"{time.time() - _SERVER_START:.1f}",
-                "# TYPE presto_tpu_tasks_by_state gauge",
-            ]
-            for state, n in sorted(by_state.items()):
-                lines.append(
-                    f'presto_tpu_tasks_by_state{{state="{state}"}} {n}')
-            body = ("\n".join(lines) + "\n").encode()
+        if path in ("/v1/metrics", "/v1/info/metrics"):
+            # Prometheus text exposition of the process-global registry
+            # (reference: presto_cpp/main/runtime-metrics/
+            # PrometheusStatsReporter.cpp, registered at
+            # PrestoServer.cpp:562). /v1/info/metrics is the legacy
+            # alias; scrape-time gauges refresh first.
+            self.tm.record_gauges()
+            _M_UPTIME.set(time.time() - _SERVER_START)
+            body = render_prometheus().encode()
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4")
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
             return
+        m = _TRACE.match(path)
+        if m:
+            # worker span dump the coordinator scrapes at query end to
+            # stitch the cross-node timeline
+            return self._json(200, TRACER.to_json(m.group(1)))
         if path == "/v1/memory":
             return self._json(200, {
                 "pools": {"general": {
@@ -320,7 +331,8 @@ class TpuWorkerServer:
         self.port = self.httpd.server_address[1]
         base = f"http://{host}:{self.port}"
         self.task_manager = TpuTaskManager(connector, base_uri=base,
-                                           cache_config=cache_config)
+                                           cache_config=cache_config,
+                                           node_id=node_id)
         self.httpd.task_manager = self.task_manager
         # internal JWT auth (InternalAuthenticationManager role): with a
         # shared secret every /v1/* request must carry a valid

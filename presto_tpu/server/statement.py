@@ -17,13 +17,29 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time as _time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from presto_tpu.obs.metrics import (
+    counter as _counter, gauge as _gauge, render_prometheus,
+)
+from presto_tpu.utils.tracing import TRACER
+
 _EXECUTING = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
 _QUEUED = re.compile(r"^/v1/statement/queued/([^/]+)/(\d+)$")
 _CANCEL = re.compile(r"^/v1/statement/executing/([^/]+)$")
+_TRACE = re.compile(r"^/v1/trace/([^/]+)$")
+
+_M_QUERIES = _counter("presto_tpu_coordinator_queries_total",
+                      "Queries submitted to the coordinator, by outcome",
+                      ("state",))
+_M_COORD_UPTIME = _gauge(
+    "presto_tpu_coordinator_uptime_seconds",
+    "Seconds since this coordinator process started serving")
+
+_COORD_START = _time.time()
 
 _BATCH_ROWS = 4096
 
@@ -92,6 +108,7 @@ class _Query:
                 self.state = "FAILED"
                 self.error = "Query was canceled by the user"
                 self.rows = []
+            _M_QUERIES.inc(state=self.state)
             self.done.set()
 
     def results_json(self, base: str, token: int) -> dict:
@@ -189,6 +206,42 @@ class _Handler(BaseHTTPRequestHandler):
             if q is None:
                 return self._json(404, {"error": "no query"})
             return self._json(200, _query_info(q))
+        if path == "/v1/metrics":
+            # same process-global registry the workers render — on the
+            # coordinator a scrape additionally shows transport/breaker
+            # counters for every worker host it talks to
+            _M_COORD_UPTIME.set(_time.time() - _COORD_START)
+            body = render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path == "/v1/status":
+            # coordinator NodeStatus: uptime, role, query counts, and
+            # the engine memory pool as the heap proxy
+            co = self.server.coordinator
+            qs = list(co.queries.values())
+            eng = co.engine
+            pool = getattr(eng, "memory_pool", None)
+            return self._json(200, {
+                "nodeId": "tpu-coordinator", "role": "coordinator",
+                "environment": "tpu",
+                "uptime": f"{_time.time() - _COORD_START:.2f}s",
+                "uptimeSeconds": _time.time() - _COORD_START,
+                "queryCount": len(qs),
+                "runningQueries": sum(
+                    1 for q in qs if not q.done.is_set()),
+                "taskCount": 0,
+                "heapUsed": pool.reserved if pool is not None else 0,
+                "heapAvailable": 16 << 30, "nonHeapUsed": 0})
+        m = _TRACE.match(path)
+        if m:
+            # stitched cross-node span dump for one query id (worker
+            # spans appear here after the cluster scraped them)
+            return self._json(200, TRACER.to_json(m.group(1)))
         if path == "/v1/cluster":
             # ClusterStatsResource role: the cluster-overview numbers
             # the reference UI polls (running/queued/finished counts,

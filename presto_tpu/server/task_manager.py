@@ -21,12 +21,39 @@ import numpy as np
 
 from presto_tpu.data.column import Page, concat_pages_host, select_page_host
 from presto_tpu.exec.split_executor import SplitExecutor
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
 from presto_tpu.plan.nodes import RemoteSourceNode
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.serde import (
     encode_serialized_page, page_to_wire_blocks,
 )
 from presto_tpu.server.buffers import OutputBufferManager
+from presto_tpu.utils.tracing import TRACER, TraceContext, trace_scope
+
+_M_TASKS_CREATED = _counter("presto_tpu_tasks_created_total",
+                            "Tasks ever created on this worker")
+_M_TASK_TRANSITIONS = _counter(
+    "presto_tpu_task_state_transitions_total",
+    "Task state transitions by destination state", ("state",))
+_M_TASKS_BY_STATE = _gauge(
+    "presto_tpu_worker_tasks",
+    "Live tasks currently held by the task manager, by state",
+    ("state",))
+_M_PENDING_SPLITS = _gauge(
+    "presto_tpu_worker_pending_splits",
+    "Splits received but not yet bound to a scan across live tasks")
+_M_OUTPUT_BYTES = _gauge(
+    "presto_tpu_worker_output_bytes",
+    "Bytes currently buffered in live tasks' output buffers")
+_M_TASKS_LIVE = _gauge("presto_tpu_tasks",
+                       "Live tasks currently held by the task manager")
+_M_LIFETIME_BYTES = _gauge(
+    "presto_tpu_task_bytes_out",
+    "Lifetime bytes emitted into output buffers (survives task delete)")
+
+#: task states the by-state gauge always reports (zeros included, so a
+#: scrape sees a stable series set)
+_TASK_STATES = ("PLANNED", "RUNNING", "FINISHED", "FAILED", "ABORTED")
 
 
 
@@ -164,12 +191,17 @@ class Task:
         # when set to a list, _emit_output also records the pre-
         # partitioning pages for the populate step
         self._cache_pages: Optional[list] = None
+        # propagated X-Presto-Trace context (query trace id + the
+        # coordinator-side parent span) — None when the query is
+        # unsampled or the coordinator predates tracing
+        self.trace_ctx: Optional[TraceContext] = None
 
     def set_state(self, state: str):
         with self.state_change:
             self.state = state
             self.version += 1
             self.state_change.notify_all()
+        _M_TASK_TRANSITIONS.inc(state=state)
 
     # ---- protocol views -------------------------------------------------
     def status(self, base_uri: str = "") -> S.TaskStatus:
@@ -293,12 +325,13 @@ class TpuTaskManager:
     FINISHED, the coordinator's contract)."""
 
     def __init__(self, connector, base_uri: str = "",
-                 cache_config=None):
+                 cache_config=None, node_id: str = "tpu-worker-0"):
         from presto_tpu.cache import FragmentResultCache
         from presto_tpu.config import DEFAULT_CACHE
 
         self.connector = connector
         self.base_uri = base_uri
+        self.node_id = node_id
         self.tasks: Dict[str, Task] = {}
         cfg = cache_config if cache_config is not None else DEFAULT_CACHE
         # worker-side fragment result store (consulted per task only
@@ -318,7 +351,9 @@ class TpuTaskManager:
 
     # ------------------------------------------------------------------
     def create_or_update(self, task_id: str,
-                         req: S.TaskUpdateRequest) -> S.TaskInfo:
+                         req: S.TaskUpdateRequest,
+                         trace_ctx: Optional[TraceContext] = None
+                         ) -> S.TaskInfo:
         with self.lock:
             if task_id in self._aborted_set:     # O(1) tombstone lookup
                 # the task was aborted before it was created — never run
@@ -332,6 +367,13 @@ class TpuTaskManager:
                 task = Task(task_id)
                 self.tasks[task_id] = task
                 self.lifetime_tasks += 1
+                _M_TASKS_CREATED.inc()
+        if trace_ctx is not None and task.trace_ctx is None:
+            task.trace_ctx = trace_ctx
+            TRACER.record(trace_ctx.trace_id, "task_create",
+                          time.time(), end=time.time(),
+                          parent_id=trace_ctx.parent_span_id,
+                          worker=self.node_id, task=task_id)
         # The update protocol is at-least-once and concurrent (coordinator
         # retries race the original POST): apply the whole update under
         # the task's lock, dedupe splits by sequenceId, and resolve split
@@ -400,6 +442,20 @@ class TpuTaskManager:
 
     # ------------------------------------------------------------------
     def _run(self, task: Task):
+        ctx = task.trace_ctx
+        if ctx is None:
+            return self._run_inner(task)
+        # worker-side span under the propagated context: this thread is
+        # where the fragment actually executes, so scope + span both
+        # live here; the coordinator scrapes them back at query end
+        with trace_scope(ctx.trace_id, ctx.parent_span_id):
+            with TRACER.span(ctx.trace_id, "task_run",
+                             worker=self.node_id,
+                             task=task.task_id) as sp:
+                self._run_inner(task)
+                sp.attributes["state"] = task.state
+
+    def _run_inner(self, task: Task):
         try:
             from presto_tpu.config import PROPERTIES, Session
             from presto_tpu.protocol.validator import translate_validated
@@ -680,6 +736,22 @@ class TpuTaskManager:
             })
         task.raw_input_positions = raw_in
         task.operator_stats = summaries
+        # per-operator worker spans from the island profile: wall times
+        # are real, placement is a sequential reconstruction from the
+        # task start (islands execute in dependency order)
+        ctx = task.trace_ctx
+        profile = getattr(ex, "last_island_profile", None) or []
+        if ctx is not None and profile:
+            cursor = task.start_time or time.time()
+            for entry in profile:
+                secs = float(entry.get("seconds", 0.0) or 0.0)
+                TRACER.record(
+                    ctx.trace_id, f"op:{entry.get('root', '?')}",
+                    cursor, end=cursor + secs,
+                    parent_id=ctx.parent_span_id,
+                    worker=self.node_id, task=task.task_id,
+                    rows=int(entry.get("rows", 0) or 0))
+                cursor += secs
 
     #: Each GET to an upstream buffer returns at most this many bytes
     #: (client-side backpressure; reference: ExchangeClient's
@@ -874,3 +946,24 @@ class TpuTaskManager:
 
     def memory_bytes(self) -> int:
         return sum(t.bytes_out for t in self.tasks.values())
+
+    def record_gauges(self) -> None:
+        """Refresh scrape-time gauges (tasks by state, queue depths).
+        Called from the /v1/metrics handler: gauges describe NOW, so
+        computing them at scrape time beats updating on every
+        transition (tasks don't know their manager)."""
+        with self.lock:
+            tasks = list(self.tasks.values())
+        counts = {s: 0 for s in _TASK_STATES}
+        pending = 0
+        out_bytes = 0
+        for t in tasks:
+            counts[t.state] = counts.get(t.state, 0) + 1
+            pending += len(t.pending_splits)
+            out_bytes += t.bytes_out
+        for state, n in counts.items():
+            _M_TASKS_BY_STATE.set(n, state=state)
+        _M_PENDING_SPLITS.set(pending)
+        _M_OUTPUT_BYTES.set(out_bytes)
+        _M_TASKS_LIVE.set(len(tasks))
+        _M_LIFETIME_BYTES.set(self.total_bytes_out)

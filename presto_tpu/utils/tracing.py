@@ -9,6 +9,11 @@ Reference roles:
     SplitCompletedEvent -> eventlistener/EventListenerManager.java +
     event/QueryMonitor.java, SURVEY.md §5.5): registered listeners get
     lifecycle events with timing/stats payloads.
+  - TelemetryTracingImpl's context propagation: the coordinator stamps
+    every worker RPC with an `X-Presto-Trace: <trace_id>;<span_id>`
+    header; workers open their spans under the propagated trace id and
+    the coordinator stitches worker span dumps (GET /v1/trace/{id})
+    back into one cross-node timeline.
 
 Engines call `tracer.span(...)` around phases (plan/lower/execute) and
 `emit_query_event(...)` at lifecycle edges; listeners are plain
@@ -17,10 +22,18 @@ callables (the plugin surface collapsed to its functional core)."""
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger("presto_tpu.tracing")
+
+#: wire header carrying "<trace_id>;<parent_span_id>" on every
+#: coordinator -> worker RPC (PrestoHeaders-style custom header)
+TRACE_HEADER = "X-Presto-Trace"
 
 
 @dataclasses.dataclass
@@ -29,44 +42,196 @@ class Span:
     start: float
     end: Optional[float] = None
     attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: process-unique id — remote-span stitching dedupes on it
+    span_id: str = ""
+    #: parent span id (propagated cross-node via X-Presto-Trace)
+    parent_id: str = ""
+
+    def __post_init__(self):
+        if not self.span_id:
+            self.span_id = uuid.uuid4().hex[:16]
 
     @property
     def duration_s(self) -> Optional[float]:
         return None if self.end is None else self.end - self.start
 
+    def to_json(self) -> dict:
+        return {"name": self.name, "start": self.start, "end": self.end,
+                "spanId": self.span_id, "parentId": self.parent_id,
+                "attributes": dict(self.attributes)}
+
+    @staticmethod
+    def from_json(doc: dict) -> "Span":
+        return Span(name=doc.get("name", "?"),
+                    start=float(doc.get("start", 0.0)),
+                    end=(None if doc.get("end") is None
+                         else float(doc["end"])),
+                    attributes=dict(doc.get("attributes") or {}),
+                    span_id=str(doc.get("spanId") or ""),
+                    parent_id=str(doc.get("parentId") or ""))
+
+
+# --------------------------------------------------------------------------
+# Trace-context propagation. The ACTIVE context is thread-local: the
+# scheduler thread sets it for one query, and `transport.HttpClient`
+# stamps every outgoing RPC on that thread with the header. (Watcher /
+# puller helper threads deliberately do not inherit it — control-plane
+# polls are not part of the query timeline.)
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    parent_span_id: str = ""
+
+    def header_value(self) -> str:
+        return f"{self.trace_id};{self.parent_span_id}"
+
+
+_ACTIVE = threading.local()
+
+
+def current_trace() -> Optional[TraceContext]:
+    return getattr(_ACTIVE, "ctx", None)
+
+
+@contextmanager
+def trace_scope(trace_id: str, parent_span_id: str = ""):
+    """Install a TraceContext for the current thread; outgoing RPCs via
+    transport.HttpClient carry it as X-Presto-Trace until exit."""
+    prev = getattr(_ACTIVE, "ctx", None)
+    _ACTIVE.ctx = TraceContext(trace_id, parent_span_id)
+    try:
+        yield _ACTIVE.ctx
+    finally:
+        _ACTIVE.ctx = prev
+
+
+def parse_trace_header(value: Optional[str]) -> Optional[TraceContext]:
+    """'<trace_id>;<parent_span_id>' -> TraceContext (None on absent or
+    malformed input — tracing is never a reason to fail an RPC)."""
+    if not value:
+        return None
+    parts = value.split(";", 1)
+    trace_id = parts[0].strip()
+    if not trace_id:
+        return None
+    parent = parts[1].strip() if len(parts) > 1 else ""
+    return TraceContext(trace_id, parent)
+
 
 class Tracer:
-    """Per-process tracer: spans grouped by trace id (query id). Bounded:
-    only the most recent `max_traces` query traces are retained (the
-    reference's QueryTracker similarly caps finished-query history)."""
+    """Per-process tracer: spans grouped by trace id (query id). Bounded
+    two ways: only the most recent `max_traces` query traces are
+    retained (the reference's QueryTracker similarly caps
+    finished-query history), and within one trace at most
+    `max_spans_per_trace` spans are recorded — beyond that spans still
+    time their bodies but are counted as dropped instead of growing the
+    list without bound (a long-running query with per-chunk spans must
+    not eat the heap)."""
 
-    def __init__(self, max_traces: int = 256):
+    def __init__(self, max_traces: int = 256,
+                 max_spans_per_trace: int = 2048):
         self._lock = threading.Lock()
         self.max_traces = max_traces
+        self.max_spans_per_trace = max_spans_per_trace
         self.spans: Dict[str, List[Span]] = {}
+        #: trace id -> spans dropped by the per-trace cap
+        self.dropped: Dict[str, int] = {}
+
+    def _store(self, trace_id: str, s: Span) -> bool:
+        """Append under the caps; False when the span was dropped."""
+        with self._lock:
+            lst = self.spans.setdefault(trace_id, [])
+            if len(lst) >= self.max_spans_per_trace:
+                self.dropped[trace_id] = \
+                    self.dropped.get(trace_id, 0) + 1
+                kept = False
+            else:
+                lst.append(s)
+                kept = True
+            while len(self.spans) > self.max_traces:
+                evicted = next(iter(self.spans))   # oldest insert
+                self.spans.pop(evicted)
+                self.dropped.pop(evicted, None)
+        if not kept:
+            from presto_tpu.obs.metrics import counter
+            counter("presto_tpu_tracer_dropped_spans_total",
+                    "Spans dropped by the per-trace span cap").inc()
+        return kept
 
     @contextmanager
     def span(self, trace_id: str, name: str, **attributes):
-        s = Span(name, time.time(), attributes=dict(attributes))
-        with self._lock:
-            self.spans.setdefault(trace_id, []).append(s)
-            while len(self.spans) > self.max_traces:
-                self.spans.pop(next(iter(self.spans)))   # oldest insert
+        ctx = current_trace()
+        parent = ctx.parent_span_id \
+            if ctx is not None and ctx.trace_id == trace_id else ""
+        s = Span(name, time.time(), attributes=dict(attributes),
+                 parent_id=parent)
+        self._store(trace_id, s)
         try:
             yield s
         finally:
             s.end = time.time()
 
+    def record(self, trace_id: str, name: str, start: float,
+               end: Optional[float] = None, parent_id: str = "",
+               **attributes) -> Span:
+        """Record an already-timed span (worker-side per-operator spans
+        whose wall times come from the executor's profile)."""
+        s = Span(name, start, end=end, attributes=dict(attributes),
+                 parent_id=parent_id)
+        self._store(trace_id, s)
+        return s
+
     def get(self, trace_id: str) -> List[Span]:
         with self._lock:
             return list(self.spans.get(trace_id, []))
 
+    def dropped_spans(self, trace_id: str) -> int:
+        with self._lock:
+            return self.dropped.get(trace_id, 0)
+
+    # ---- cross-node stitching -------------------------------------------
+    def to_json(self, trace_id: str) -> dict:
+        """Wire dump for GET /v1/trace/{trace_id}."""
+        return {"traceId": trace_id,
+                "spans": [s.to_json() for s in self.get(trace_id)],
+                "droppedSpans": self.dropped_spans(trace_id)}
+
+    def merge_remote(self, trace_id: str, doc: dict) -> int:
+        """Stitch a worker's span dump into this tracer's trace.
+        Dedupes by span_id, so re-scrapes — and the in-process cluster,
+        where workers share this very tracer — never duplicate spans.
+        Returns the number of spans added."""
+        have = {s.span_id for s in self.get(trace_id)}
+        added = 0
+        for sdoc in doc.get("spans", []):
+            s = Span.from_json(sdoc)
+            if s.span_id in have:
+                continue
+            if not self._store(trace_id, s):
+                break
+            have.add(s.span_id)
+            added += 1
+        return added
+
     def render(self, trace_id: str) -> str:
+        """One cross-node timeline: spans sorted by start, offsets
+        relative to the earliest span, worker column from the `worker`
+        attribute (coordinator spans carry none)."""
+        spans = sorted(self.get(trace_id), key=lambda s: s.start)
+        if not spans:
+            return ""
+        t0 = spans[0].start
         out = []
-        for s in self.get(trace_id):
+        for s in spans:
             d = f"{s.duration_s * 1000:.1f}ms" if s.end else "…"
-            attrs = " ".join(f"{k}={v}" for k, v in s.attributes.items())
-            out.append(f"{s.name:<24} {d:>10} {attrs}")
+            attrs = dict(s.attributes)
+            worker = str(attrs.pop("worker", "coordinator"))
+            rest = " ".join(f"{k}={v}" for k, v in attrs.items())
+            out.append(f"+{(s.start - t0) * 1000:8.1f}ms "
+                       f"{worker:<16} {s.name:<24} {d:>10} {rest}")
+        ndrop = self.dropped_spans(trace_id)
+        if ndrop:
+            out.append(f"… {ndrop} span(s) dropped by the per-trace cap")
         return "\n".join(out)
 
 
@@ -86,6 +251,7 @@ class EventListenerManager:
     def __init__(self):
         self._listeners: List[Callable[[QueryEvent], None]] = []
         self._lock = threading.Lock()
+        self._logged_failures: set = set()
 
     def register(self, listener: Callable[[QueryEvent], None]):
         with self._lock:
@@ -103,7 +269,21 @@ class EventListenerManager:
             try:
                 cb(event)
             except Exception:   # noqa: BLE001 — listeners must not kill queries
-                pass
+                # ...but they must not fail INVISIBLY either: count every
+                # swallow in the registry and log each failing listener
+                # once (not once per event — a broken listener on a busy
+                # cluster would flood the log)
+                from presto_tpu.obs.metrics import counter
+                counter("presto_tpu_event_listener_errors_total",
+                        "Exceptions swallowed from event listeners"
+                        ).inc()
+                key = id(cb)
+                if key not in self._logged_failures:
+                    self._logged_failures.add(key)
+                    log.exception(
+                        "event listener %r raised on %s event "
+                        "(logged once; further failures only counted)",
+                        getattr(cb, "__name__", cb), event.kind)
 
 
 # process-wide defaults (the Guice-singleton analog)

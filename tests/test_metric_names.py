@@ -1,0 +1,70 @@
+"""Guard: every metric registered anywhere in presto_tpu/ has a valid,
+unique Prometheus name.
+
+Like test_rpc_chokepoint.py this is a static scan of the source tree:
+an invalid name would corrupt the /v1/metrics exposition page at scrape
+time, and the same name registered from two modules either aliases two
+unrelated meanings onto one series or (on a kind/label mismatch) raises
+at import. Both fail the build here instead."""
+
+import collections
+import pathlib
+import re
+
+from presto_tpu.obs.metrics import METRIC_NAME_RE
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "presto_tpu"
+
+#: registration call with a literal first argument — matches the bare
+#: helpers (counter/gauge/histogram), the aliased imports (_counter,
+#: _obs_gauge, ...) and registry methods (REGISTRY.counter)
+_CALL = re.compile(
+    r"\b[A-Za-z_.]*(?:counter|gauge|histogram)\s*\(\s*[\"']"
+    r"([^\"']+)[\"']")
+
+#: the registry module itself: class definitions and docstring examples,
+#: not registrations
+EXCLUDED = {PKG / "obs" / "metrics.py"}
+
+
+def _registrations():
+    sites = collections.defaultdict(list)
+    for path in sorted(PKG.rglob("*.py")):
+        if path in EXCLUDED:
+            continue
+        text = path.read_text()
+        for m in _CALL.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            sites[m.group(1)].append(
+                f"{path.relative_to(PKG.parent)}:{line}")
+    return sites
+
+
+def test_metric_names_valid():
+    sites = _registrations()
+    assert sites, "static scan found no metric registrations at all"
+    bad = {name: where for name, where in sites.items()
+           if not METRIC_NAME_RE.match(name)}
+    assert not bad, f"invalid Prometheus metric names: {bad}"
+
+
+def test_metric_names_registered_once():
+    dupes = {name: where for name, where in _registrations().items()
+             if len(where) > 1}
+    assert not dupes, (
+        "metric name registered from more than one call site — move "
+        f"it to one module-level registration: {dupes}")
+
+
+def test_runtime_registry_matches_grammar():
+    """Importing the serving stack must leave only grammar-clean names
+    in the process-global registry (labels validated at registration)."""
+    import presto_tpu.exec.executor           # noqa: F401
+    import presto_tpu.server.cluster          # noqa: F401
+    import presto_tpu.server.statement        # noqa: F401
+    from presto_tpu.obs.metrics import REGISTRY
+
+    names = REGISTRY.names()
+    assert names
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
