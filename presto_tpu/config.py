@@ -116,6 +116,13 @@ PROPERTIES = [
              "versions + splits (reference: fragment_result_caching_"
              "enabled, Presto@Meta VLDB'23 worker result cache)",
              _parse_bool, False),
+    Property("retry_policy",
+             "Mid-query fault handling: NONE (a worker death fails the "
+             "query, whole-query retry only) | TASK (task outputs spool "
+             "to disaggregated storage and only the lost tasks re-plan "
+             "onto survivors as attempt N+1; reference: retry-policy "
+             "TASK, Presto@Meta VLDB'23 §3 / Project Tardigrade)",
+             lambda s: s.strip().upper(), "NONE"),
 ]
 
 _BY_NAME = {p.name: p for p in PROPERTIES}
@@ -227,6 +234,33 @@ class ObsConfig:
 
 #: process defaults
 DEFAULT_OBS = ObsConfig()
+
+
+@dataclasses.dataclass(frozen=True)
+class SpoolConfig:
+    """Spooled-exchange knobs (reference: the exchange-manager /
+    exchange.base-directories config behind Presto's TASK retry policy —
+    Presto@Meta VLDB'23 §3, Trino Project Tardigrade). One per process;
+    `spool/store.SpoolStore` is built from this. The shared `base_dir`
+    plays the role of disaggregated storage: every node of a cluster
+    must see the same directory."""
+
+    #: master switch for the worker-side spool store (the session
+    #: property `retry_policy=TASK` additionally gates per query)
+    enabled: bool = False
+    #: shared spool root; None = the store creates its own temp root
+    base_dir: Optional[str] = None
+    #: SerializedPage frame compression for spooled pages
+    codec: str = "lz4"
+    #: sweep committed/partial spools left by dead processes when a
+    #: store opens over an existing base_dir
+    sweep_on_start: bool = True
+    #: only sweep orphans older than this many seconds (0 = any age)
+    orphan_ttl_s: float = 0.0
+
+
+#: process defaults — off: spooling costs a disk write per output page
+DEFAULT_SPOOL = SpoolConfig()
 
 
 class Session:

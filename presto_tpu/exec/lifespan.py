@@ -324,6 +324,26 @@ class BatchedRunner:
         if self.spill and self.spill_dir:
             from presto_tpu.exec.spill import FileSpiller
             spiller = FileSpiller(self.spill_dir)
+        try:
+            merged = self._run_batches(stats, spiller)
+        finally:
+            # a query failing mid-spill must not leak run files (or, for
+            # a spiller-owned tempdir, the directory itself)
+            if spiller is not None:
+                spiller.close()
+        k = len(self.agg.group_fields)
+        out_cap = bucket_capacity(max(int(merged.num_rows), 256))
+        page, _groups = grouped_aggregate(merged, tuple(range(k)),
+                                          tuple(self.final_specs),
+                                          out_cap)
+        page = Page(page.columns, page.num_rows, self.agg.output_names)
+        return self._finish_above(page)
+
+    def _run_batches(self, stats, spiller) -> Page:
+        """Per-lifespan partial aggregation, spilled partials included;
+        returns the concatenated partial page (spill files still live)."""
+        connector, ex = self.connector, self.ex
+        driving, num_batches = self.driving, self.num_batches
         skipped = 0
         partials: List[Page] = []
         for b in range(num_batches):
@@ -375,16 +395,9 @@ class BatchedRunner:
         if stats is not None and spiller is not None:
             stats.update(spilled_bytes=spiller.total_spilled_bytes,
                          spill_files=len(spiller.handles))
-        merged = _concat_pages(partials, spiller)
-        if spiller is not None:
-            spiller.close()
-        k = len(self.agg.group_fields)
-        out_cap = bucket_capacity(max(int(merged.num_rows), 256))
-        page, _groups = grouped_aggregate(merged, tuple(range(k)),
-                                          tuple(self.final_specs),
-                                          out_cap)
-        page = Page(page.columns, page.num_rows, self.agg.output_names)
+        return _concat_pages(partials, spiller)
 
+    def _finish_above(self, page: Page) -> Page:
         # Interpret the small chain above the aggregation.
         from presto_tpu.data.column import compact
         from presto_tpu.expr.compile import compile_expr

@@ -62,8 +62,17 @@ class FileSpiller:
             compression=self.codec)
         path = os.path.join(self.directory,
                             f"run_{len(self.handles)}_{uuid.uuid4().hex[:8]}")
-        with open(path, "wb") as f:
-            f.write(frame)
+        try:
+            with open(path, "wb") as f:
+                f.write(frame)
+        except OSError:
+            # a partial run file is unreadable garbage — it must not
+            # outlive the failure (close() only knows recorded handles)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
         h = SpillHandle(path, int(page.num_rows),
                         [c.type for c in page.columns],
                         tuple(page.names), len(frame))
@@ -86,6 +95,10 @@ class FileSpiller:
         yield from self.read(handle).to_pylist()
 
     def close(self):
+        """Idempotent teardown. An OWNED directory is removed whole
+        (strays from a mid-spill crash included); a caller-supplied
+        directory only loses the files this spiller recorded — never
+        the caller's other contents."""
         for h in self.handles:
             try:
                 os.unlink(h.path)
@@ -93,10 +106,17 @@ class FileSpiller:
                 pass
         self.handles = []
         if self._own_dir:
-            try:
-                os.rmdir(self.directory)
-            except OSError:
-                pass
+            import shutil
+            shutil.rmtree(self.directory, ignore_errors=True)
+
+    # context-manager form: `with FileSpiller(...) as sp:` guarantees
+    # close on every exit path (the FileSingleStreamSpiller closeable
+    # contract)
+    def __enter__(self) -> "FileSpiller":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def merge_sorted_rows(iters: Sequence[Iterator[tuple]], keys

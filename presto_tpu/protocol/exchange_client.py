@@ -77,7 +77,8 @@ class PageStream:
     def __init__(self, task_uri: str, buffer_id: str = "0",
                  max_wait: str = "1s",
                  max_size_bytes: Optional[int] = None,
-                 client: Optional[HttpClient] = None):
+                 client: Optional[HttpClient] = None,
+                 spool=None):
         self.base = task_uri.rstrip("/")
         self.buffer_id = buffer_id
         self.max_wait = max_wait
@@ -86,6 +87,11 @@ class PageStream:
         self.token = 0
         self.complete = False
         self.task_instance_id: Optional[str] = None
+        # spooled-exchange fallback (retry_policy=TASK): when the
+        # producer's HTTP location dies mid-stream, remaining frames
+        # come straight from its committed spool (spool/store.SpoolStore)
+        self.spool = spool
+        self._committed = None           # CommittedTaskSpool once entered
 
     def _get(self, url: str, validate: bool = False
              ) -> Tuple[bytes, dict]:
@@ -129,9 +135,20 @@ class PageStream:
         return None
 
     def fetch(self) -> bytes:
-        """One round: GET next frames, acknowledge, advance the token."""
+        """One round: GET next frames, acknowledge, advance the token.
+        With a spool store attached, a dead producer location falls
+        back to its committed spool AT THE CURRENT TOKEN — frames
+        acknowledged over HTTP are never re-served, frames not yet
+        acknowledged come from the spool exactly once."""
+        if self._committed is not None:
+            return self._fetch_spool()
         url = f"{self.base}/results/{self.buffer_id}/{self.token}"
-        body, headers = self._get(url, validate=True)
+        try:
+            body, headers = self._get(url, validate=True)
+        except OSError:
+            if self._enter_spool():
+                return self._fetch_spool()
+            raise
         _M_FETCHES.inc()
         _M_BYTES.inc(len(body))
         _M_PAGES.inc(count_frames(body) or 0)
@@ -139,6 +156,10 @@ class PageStream:
         if self.task_instance_id is None:
             self.task_instance_id = instance
         elif instance != self.task_instance_id:
+            # a restarted worker serves a DIFFERENT task instance — the
+            # committed spool (if any) is the only consistent source
+            if self._enter_spool():
+                return self._fetch_spool()
             raise WorkerRestartedError(
                 f"task instance changed mid-stream on {self.base} "
                 "(worker restarted)")
@@ -149,14 +170,60 @@ class PageStream:
         if nxt > self.token:
             # token-sequenced GETs are idempotent: the server re-serves
             # un-acknowledged frames, so everything up to here is safe
-            # to replay; the ack is what advances the server cursor
-            self._get(f"{self.base}/results/{self.buffer_id}/{nxt}"
-                      f"/acknowledge")
+            # to replay; the ack is what advances the server cursor.
+            # The token advances BEFORE the ack round-trip — a worker
+            # dying between body and ack must not make the spool
+            # fallback replay frames this consumer already holds.
             self.token = nxt
+            try:
+                self._get(f"{self.base}/results/{self.buffer_id}/{nxt}"
+                          f"/acknowledge")
+            except OSError:
+                if self.spool is None:
+                    raise
+                # spool mode: the committed spool needs no ack cursor
         return body
 
+    def _enter_spool(self) -> bool:
+        """Switch this stream onto the producer's committed spool (any
+        attempt), validating the part file against its manifest — a
+        truncated or corrupt spool raises SpoolIntegrityError instead
+        of silently under-serving. False when no spool store is
+        attached or nothing committed (caller re-raises the transport
+        error)."""
+        if self.spool is None:
+            return False
+        committed = self.spool.find_committed_for_location(self.base)
+        if committed is None:
+            return False
+        from presto_tpu.spool.store import record_fallback_read
+        record_fallback_read()
+        self._committed = committed
+        return True
+
+    def _fetch_spool(self) -> bytes:
+        frames = self._committed.frames(self.buffer_id,
+                                        start=self.token)
+        out, size = [], 0
+        cap = self.max_size_bytes or (16 << 20)
+        for f in frames:
+            if out and size + len(f) > cap:
+                break
+            out.append(f)
+            size += len(f)
+        _M_FETCHES.inc()
+        _M_BYTES.inc(size)
+        _M_PAGES.inc(len(out))
+        self.token += len(out)
+        self.complete = (self.token
+                         >= self._committed.frame_count(self.buffer_id))
+        return b"".join(out)
+
     def close(self):
-        """Release the buffer (reference: abortResults DELETE)."""
+        """Release the buffer (reference: abortResults DELETE); a
+        spool-served stream has no live buffer to release."""
+        if self._committed is not None:
+            return
         try:
             self.client.delete(f"{self.base}/results/{self.buffer_id}")
         except Exception:            # noqa: BLE001 — abort is best-effort

@@ -1069,6 +1069,41 @@ TASK_STATES = ("PLANNED", "RUNNING", "FINISHED", "CANCELED", "ABORTED",
                "FAILED")
 
 
+@dataclasses.dataclass(frozen=True)
+class TaskId:
+    """Structured task id (reference: execution/TaskId.java —
+    queryId.stageId.stageExecutionId.taskId.attemptNumber). The attempt
+    number is what makes stage-level retry addressable: a recovery
+    re-post of the same (query, stage, index) work unit carries
+    attempt N+1, and spool lookups match on everything BUT the attempt
+    so a replacement consumer finds any committed attempt's output."""
+
+    query_id: str
+    stage_id: int
+    stage_execution_id: int = 0
+    task_index: int = 0
+    attempt: int = 0
+
+    @classmethod
+    def parse(cls, s: str) -> "TaskId":
+        parts = s.rsplit(".", 4)
+        if len(parts) != 5 or not parts[0]:
+            raise ValueError(f"malformed task id {s!r}")
+        try:
+            return cls(parts[0], int(parts[1]), int(parts[2]),
+                       int(parts[3]), int(parts[4]))
+        except ValueError:
+            raise ValueError(f"malformed task id {s!r}") from None
+
+    def __str__(self) -> str:
+        return (f"{self.query_id}.{self.stage_id}."
+                f"{self.stage_execution_id}.{self.task_index}."
+                f"{self.attempt}")
+
+    def with_attempt(self, attempt: int) -> "TaskId":
+        return dataclasses.replace(self, attempt=attempt)
+
+
 @dataclasses.dataclass
 class TaskStatus(Struct):
     taskInstanceIdLeastSignificantBits: int = 0

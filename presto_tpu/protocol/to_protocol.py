@@ -129,6 +129,16 @@ def _ordering(keys: Tuple[SortKey, ...],
     return S.OrderingScheme(orderings)
 
 
+def remote_split_payload(location: str, buffer_id) -> dict:
+    """connectorSplit payload of a RemoteSplit (reference:
+    presto-main-base/.../split/RemoteSplit.java — an upstream task's
+    result location + the consumer's buffer id). One builder so the
+    scheduler and the spool-recovery re-pointing produce identical
+    wire shapes."""
+    return {"@type": "$remote", "location": location,
+            "bufferId": str(buffer_id)}
+
+
 @dataclasses.dataclass
 class FragmentSpec:
     """A protocol fragment plus the scheduling metadata the cluster needs

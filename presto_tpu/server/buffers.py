@@ -4,7 +4,10 @@ Reference roles: PartitionedOutputBuffer / ClientBuffer
 (presto-main-base/.../execution/buffer/PartitionedOutputBuffer.java:44,
 buffer/ClientBuffer.java) — per-destination queues of SerializedPages,
 consumed by sequenced GET .../results/{buffer}/{token} with acknowledge
-semantics (at-least-once; tokens make re-reads idempotent)."""
+semantics (at-least-once; tokens make re-reads idempotent).
+
+All disk-backed variants write through `spool/files.FrameFile` — the
+single task-output file path guarded by tests/test_spool_chokepoint.py."""
 
 from __future__ import annotations
 
@@ -12,6 +15,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+from presto_tpu.spool.files import FrameFile
 
 _M_PAGES_ADDED = _counter(
     "presto_tpu_output_buffer_pages_added_total",
@@ -70,75 +74,79 @@ class ClientBuffer:
             self.base += drop
 
 
-class MaterializedClientBuffer(ClientBuffer):
-    """Batch-mode buffer (reference: presto-spark's materialized
-    shuffle, presto_cpp ShuffleWrite.cpp): frames persist to a DISK
-    file as produced and every token stays replayable from 0 — the
+class FileBackedClientBuffer(ClientBuffer):
+    """Shared disk-backed buffer machinery: frames persist to a
+    FrameFile as produced and every token stays replayable from 0 — the
     property that makes stage-level retry sound (a replacement consumer
     re-pulls the full stream; RAM holds only the offset index).
     acknowledge() advances the window but never discards."""
 
-    def __init__(self):
+    def __init__(self, file: Optional[FrameFile] = None,
+                 owns_file: bool = True):
         super().__init__()
-        import tempfile
-        self._file = tempfile.NamedTemporaryFile(
-            prefix="presto_tpu_shuffle_", delete=False)
-        self._index: List[Tuple[int, int]] = []   # (offset, length)
-        self._flock = threading.Lock()
+        self._file = file if file is not None else FrameFile()
+        self._owns_file = owns_file
         self._closed = False
 
     def add(self, frame: bytes):
-        with self._flock:
-            if self._closed:
-                return                  # aborted task still emitting
-            off = self._file.tell()
-            self._file.write(frame)
-            self._file.flush()
-            self._index.append((off, len(frame)))
+        if self._closed:
+            return                       # aborted task still emitting
+        if not self._file.append(frame):
+            return
         self.pages.append(None)          # token bookkeeping only
         self.queued_bytes += len(frame)  # cumulative: nothing discards
 
     def get(self, token: int, max_bytes: int):
-        out: List[bytes] = []
-        size = 0
-        t = max(token, 0)
-        with self._flock:
-            if self._closed:
-                return [], t, True
-            while t < len(self._index):
-                off, ln = self._index[t]
-                if out and size + ln > max_bytes:
-                    break
-                self._file.seek(off)
-                out.append(self._file.read(ln))
-                size += ln
-                t += 1
-        complete = self.no_more_pages and t >= len(self._index)
+        if self._closed:
+            return [], max(token, 0), True
+        out, t = self._file.read_range(token, max_bytes)
+        complete = self.no_more_pages and t >= self._file.frame_count
         return out, t, complete
 
     def acknowledge(self, token: int):
-        self.base = min(max(self.base, token), len(self._index))
+        self.base = min(max(self.base, token), self._file.frame_count)
 
     def close(self):
-        import os
-        with self._flock:
-            if self._closed:
-                return
-            self._closed = True
-            try:
-                self._file.close()
-                os.unlink(self._file.name)
-            except (OSError, ValueError):
-                pass
+        if self._closed:
+            return
+        self._closed = True
+        if self._owns_file:
+            self._file.close(unlink=True)
+
+
+class MaterializedClientBuffer(FileBackedClientBuffer):
+    """Batch-mode buffer (reference: presto-spark's materialized
+    shuffle, presto_cpp ShuffleWrite.cpp): owns a shuffle temp file,
+    unlinked when the task is deleted."""
+
+
+class SpooledClientBuffer(FileBackedClientBuffer):
+    """retry_policy=TASK buffer: the FrameFile IS the spool part file
+    (no tee, no double write). The TaskSpoolWriter owns the bytes —
+    commit publishes them via atomic rename (open handles stay valid,
+    so live pulls keep working), and the store's GC reclaims them;
+    close() here only stops further reads through this buffer."""
+
+    def __init__(self, file: FrameFile):
+        super().__init__(file=file, owns_file=False)
 
 
 class OutputBufferManager:
-    """All buffers of one task (OutputBuffers.type PARTITIONED etc.)."""
+    """All buffers of one task (OutputBuffers.type PARTITIONED etc.).
 
-    def __init__(self, buffer_ids: List[str], materialized: bool = False):
-        cls = MaterializedClientBuffer if materialized else ClientBuffer
-        self.buffers: Dict[str, ClientBuffer] = {
-            b: cls() for b in buffer_ids}
+    `spool_writer` (a spool/store.TaskSpoolWriter) switches every buffer
+    to SpooledClientBuffer backed by that writer's part files."""
+
+    def __init__(self, buffer_ids: List[str], materialized: bool = False,
+                 spool_writer=None):
+        self.spool_writer = spool_writer
+        if spool_writer is not None:
+            self.buffers: Dict[str, ClientBuffer] = {
+                b: SpooledClientBuffer(spool_writer.part(b))
+                for b in buffer_ids}
+        else:
+            cls = MaterializedClientBuffer if materialized else ClientBuffer
+            self.buffers = {b: cls() for b in buffer_ids}
         self.lock = threading.Lock()
 
     def close(self):
@@ -146,6 +154,8 @@ class OutputBufferManager:
             for b in self.buffers.values():
                 if hasattr(b, "close"):
                     b.close()
+            if self.spool_writer is not None:
+                self.spool_writer.close()
 
     def buffer(self, buffer_id: str) -> Optional[ClientBuffer]:
         return self.buffers.get(buffer_id)

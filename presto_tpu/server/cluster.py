@@ -36,7 +36,7 @@ from presto_tpu.plan.nodes import ExchangeNode, Partitioning, PlanNode
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.exchange_client import PageStream, decode_pages
 from presto_tpu.protocol.to_protocol import FragmentSpec, \
-    fragment_to_protocol
+    fragment_to_protocol, remote_split_payload
 from presto_tpu.protocol.transport import HttpClient
 from presto_tpu.server.http import TpuWorkerServer
 
@@ -222,6 +222,12 @@ class _Stage:
     # task-level recovery re-posts the SAME lifespans elsewhere
     scan_splits: Dict = dataclasses.field(default_factory=dict)
     recovered_tasks: int = 0
+    # retry_policy=TASK bookkeeping: task indices whose COMMITTED spool
+    # absorbed a dead worker (never re-executed, never re-polled), and
+    # the committed attempt's task id consumers should read
+    spool_done: set = dataclasses.field(default_factory=set)
+    spool_task_ids: Dict[int, str] = dataclasses.field(
+        default_factory=dict)
 
 
 class ClusterQueryError(RuntimeError):
@@ -255,8 +261,11 @@ class TpuCluster:
                  resource_groups=None, history=None, discovery=None,
                  shared_secret: Optional[str] = None,
                  transport_config: Optional[TransportConfig] = None,
-                 cache_config=None):
+                 cache_config=None, spool_config=None):
+        import dataclasses as _dc
+
         from presto_tpu.cache import AffinityRouter
+        from presto_tpu.config import DEFAULT_SPOOL
         from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
 
@@ -283,10 +292,27 @@ class TpuCluster:
         # alongside the statically started ones.
         self.discovery = discovery
         self.cache_config = cache_config
+        # spooled exchange (retry_policy=TASK): the coordinator opens
+        # the shared spool base FIRST (sweeping orphans when attaching
+        # to an existing base), then hands every worker a config
+        # pointing at the SAME directory — the local-FS stand-in for
+        # disaggregated storage (Presto@Meta VLDB'23 §3)
+        scfg = spool_config if spool_config is not None else DEFAULT_SPOOL
+        task_retry = str(self.session_properties.get(
+            "retry_policy", "")).strip().upper() == "TASK"
+        self.spool = None
+        self.spool_config = scfg
+        if scfg.enabled or task_retry:
+            from presto_tpu.spool.store import SpoolStore
+            self.spool = SpoolStore(_dc.replace(scfg, enabled=True))
+            self.spool_config = _dc.replace(
+                scfg, enabled=True, base_dir=self.spool.base_dir,
+                sweep_on_start=False)
         self.workers: List[TpuWorkerServer] = [
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}",
                             shared_secret=shared_secret,
-                            cache_config=cache_config).start()
+                            cache_config=cache_config,
+                            spool_config=self.spool_config).start()
             for i in range(n_workers)]
         # cache-affinity placement memory (reference: the coordinator's
         # fragment-result-cache-aware NetworkLocationCache / soft
@@ -361,6 +387,16 @@ class TpuCluster:
             hb.set()
         for w in self.workers:
             w.stop()
+        if self.spool is not None:
+            self.spool.close()
+
+    def _task_retry(self) -> bool:
+        """Is stage-level recovery (retry_policy=TASK) active for this
+        cluster's queries? Requires the spool store — without spooled
+        outputs there is nothing sound to recover from."""
+        return self.spool is not None and str(
+            self.session_properties.get("retry_policy", "")
+        ).strip().upper() == "TASK"
 
     # ------------------------------------------------------------------
     def plan_sql(self, sql: str) -> PlanNode:
@@ -577,6 +613,14 @@ class TpuCluster:
             getattr(self, "last_task_infos", []))
         if cache_line:
             lines.append(cache_line)
+        spool = getattr(self, "last_spool_stats", None)
+        if spool is not None:
+            lines.append(
+                f"Spool: commits={spool['commits']} "
+                f"bytes={spool['bytes_written']} "
+                f"recoveries={spool['recoveries']} "
+                f"fallback_reads={spool['fallback_reads']} "
+                f"gc={spool['gc']}")
         trace = self.render_trace()
         if trace:
             lines.append(
@@ -784,35 +828,91 @@ class TpuCluster:
             "exchange_materialization_enabled", ""))
             .strip().lower() == "true")
 
+        #: bound on spool-recovery rounds per query — each round needs a
+        #: fresh worker death to do anything, so this never limits a
+        #: single-fault run; it stops a flapping cluster from spinning
+        MAX_RECOVERY_ROUNDS = 5
+
+        self.last_recovery_events = []
+        spool_before = None
+        if self.spool is not None:
+            from presto_tpu.spool.store import spool_counters
+            spool_before = spool_counters()
+
         def run_query() -> List[tuple]:
             try:
                 if batch_mode:
                     return self._run_fragments_batch(
                         qid, stages, by_id, placement, out_types,
                         merge_keys, capture, cancel_event)
-                schedule(0)
-                try:
-                    self._await_all(stages, cancel_event=cancel_event)
-                except (ClusterQueryError, OSError):
-                    if cancel_event is not None \
-                            and cancel_event.is_set():
-                        raise
-                    # task-level recovery (reference: scheduler/group
-                    # recoverable grouped execution,
-                    # SystemSessionProperties
-                    # recoverable_grouped_execution): for a single-stage
-                    # query, re-run ONLY the tasks that lived on dead
-                    # workers — their split assignment is deterministic,
-                    # so exactly the lost lifespans re-run
-                    if not self._recover_dead_tasks(qid, stages, by_id):
-                        raise
-                    self._await_all(stages, cancel_event=cancel_event)
+                if self._task_retry():
+                    # stage-level recoverable execution (retry_policy=
+                    # TASK, Presto@Meta VLDB'23 §3): each failed await
+                    # absorbs dead tasks from their committed spools /
+                    # re-plans only the lost ones onto survivors, then
+                    # awaits again — completed stages never re-run.
+                    # Scheduling lives INSIDE the loop: a worker dying
+                    # mid-schedule leaves partially-posted stages, and
+                    # _recover_spooled's tail pass places the
+                    # never-created tasks on survivors — it must never
+                    # escape to the whole-query-retry path.
+                    rounds = 0
+                    need_schedule = True
+                    while True:
+                        try:
+                            if need_schedule:
+                                schedule(0)
+                                need_schedule = False
+                            self._await_all(stages,
+                                            cancel_event=cancel_event)
+                            break
+                        except (ClusterQueryError, OSError):
+                            # recovery finishes any partial scheduling
+                            # itself; re-running schedule() would
+                            # double-post the already-created tasks
+                            need_schedule = False
+                            if cancel_event is not None \
+                                    and cancel_event.is_set():
+                                raise
+                            if rounds >= MAX_RECOVERY_ROUNDS \
+                                    or not self._recover_spooled(
+                                        qid, stages, by_id):
+                                raise
+                            rounds += 1
+                else:
+                    schedule(0)
+                    try:
+                        self._await_all(stages,
+                                        cancel_event=cancel_event)
+                    except (ClusterQueryError, OSError):
+                        if cancel_event is not None \
+                                and cancel_event.is_set():
+                            raise
+                        # task-level recovery (reference: scheduler/
+                        # group recoverable grouped execution,
+                        # SystemSessionProperties
+                        # recoverable_grouped_execution): for a
+                        # single-stage query, re-run ONLY the tasks that
+                        # lived on dead workers — their split assignment
+                        # is deterministic, so exactly the lost
+                        # lifespans re-run
+                        if not self._recover_dead_tasks(qid, stages,
+                                                        by_id):
+                            raise
+                        self._await_all(stages,
+                                        cancel_event=cancel_event)
                 if capture:
                     self._capture_task_infos(stages)
                 return self._collect_root(stages[0], out_types,
                                           merge_keys)
             finally:
-                self._cleanup(stages)
+                self._cleanup(stages, qid)
+                if spool_before is not None:
+                    from presto_tpu.spool.store import spool_counters
+                    after = spool_counters()
+                    self.last_spool_stats = {
+                        k: after[k] - spool_before[k]
+                        for k in after}
 
         if not DEFAULT_OBS.sampled(random.random()):
             return run_query()
@@ -905,6 +1005,112 @@ class TpuCluster:
             return False
         return self._reschedule_stage(qid, 0, stages, by_id)
 
+    def _recover_spooled(self, qid: str, stages: Dict[int, _Stage],
+                         by_id) -> bool:
+        """retry_policy=TASK recovery round (reference: Presto@Meta
+        VLDB'23 §3 — spooled intermediate results make individual task
+        retry sound). Producer-first over the stage DAG:
+
+          - a dead worker's task whose spool COMMITTED is absorbed: the
+            work is done, its output lives in disaggregated storage;
+            consumers read it there (direct spool fallback, or any live
+            worker's HTTP spool serving). It is never re-executed.
+          - a dead worker's task with NO committed spool lost its work:
+            re-plan exactly that task onto a survivor as attempt N+1
+            (deterministic split assignment re-reads the same
+            lifespans).
+          - a live task that FAILED (typically its pull from the dead
+            producer exhausted before the spool committed) re-plans the
+            same way — its replacement's remote splits point at the
+            producers' CURRENT locations.
+
+        Returns True when anything changed (the caller awaits again);
+        False means this error is not recoverable here."""
+        from presto_tpu.spool.store import record_recovery
+
+        alive = set(self.check_workers())
+        if not alive:
+            return False
+        survivors = sorted(alive)
+        order: List[int] = []
+        seen: set = set()
+
+        def topo(fid: int):
+            if fid in seen:
+                return
+            seen.add(fid)
+            for src in by_id[fid].remote_sources:
+                topo(src)
+            order.append(fid)
+
+        for fid in stages:
+            topo(fid)
+        changed = False
+        for fid in order:
+            stage = stages[fid]
+            for t, uri in enumerate(list(stage.task_uris)):
+                if t in stage.spool_done:
+                    continue
+                worker = uri.split("/v1/task/")[0]
+                if worker not in alive:
+                    committed = self.spool.find_committed_for_task(
+                        stage.task_ids[t])
+                    if committed is not None:
+                        stage.spool_done.add(t)
+                        stage.spool_task_ids[t] = committed.task_id
+                        record_recovery("absorb")
+                        self.last_recovery_events.append(
+                            ("spool", fid, t))
+                        log.info("task %s absorbed from committed "
+                                 "spool %s", stage.task_ids[t],
+                                 committed.path)
+                        changed = True
+                        continue
+                    new_worker = survivors[t % len(survivors)]
+                else:
+                    # live worker: only a FAILED task needs re-planning
+                    # (RUNNING consumers of a dead producer recover by
+                    # themselves through the spool fallback)
+                    try:
+                        st = self.http.get_json(
+                            f"{uri}/status",
+                            headers={"X-Presto-Max-Wait": "0s"},
+                            request_class="status_poll")
+                    except OSError:
+                        continue      # transient; next round retries
+                    if st.get("state") != "FAILED":
+                        continue
+                    try:
+                        self.http.delete(uri)
+                    except Exception:   # noqa: BLE001 — best effort
+                        pass
+                    new_worker = worker
+                attempt = int(stage.task_ids[t].rsplit(".", 1)[1]) + 1
+                task_id, new_uri = self._post_stage_task(
+                    qid, fid, stages, by_id, new_worker, t, attempt)
+                stage.task_ids[t] = task_id
+                stage.task_uris[t] = new_uri
+                stage.recovered_tasks += 1
+                record_recovery("retask")
+                self.last_recovery_events.append(("retask", fid, t))
+                log.info("task re-planned as %s on %s", task_id,
+                         new_worker)
+                changed = True
+            # a scheduling-time death can leave the stage partially
+            # posted: place the never-created tasks on survivors
+            for t in range(len(stage.task_uris), stage.n_tasks):
+                task_id, new_uri = self._post_stage_task(
+                    qid, fid, stages, by_id,
+                    survivors[t % len(survivors)], t, attempt=1)
+                stage.task_ids.append(task_id)
+                stage.task_uris.append(new_uri)
+                stage.recovered_tasks += 1
+                record_recovery("retask")
+                self.last_recovery_events.append(("retask", fid, t))
+                changed = True
+            self.last_recovered_tasks = stage.recovered_tasks
+        return changed
+
     def _reschedule_stage(self, qid: str, fid: int,
                           stages: Dict[int, _Stage], by_id,
                           force_all: bool = False) -> bool:
@@ -964,12 +1170,7 @@ class TpuCluster:
     def _start_stage(self, qid: str, fid: int, stages: Dict[int, _Stage],
                      by_id, placement: List[str]):
         stage = stages[fid]
-        # connector-provided splits, one list per scan node (reference:
-        # ConnectorSplitManager; split t goes to task t)
-        stage.scan_splits = {
-            node_id: (self.connector.connector_id(table),
-                      self.connector.table_splits(table, stage.n_tasks))
-            for node_id, table in stage.spec.scan_nodes.items()}
+        self._ensure_scan_splits(stage)
         # cache-affinity placement: when result caching is on, route each
         # leaf task to the worker that (per the router's memory) holds
         # its fragment's cached result; rendezvous hashing places
@@ -998,6 +1199,38 @@ class TpuCluster:
             stage.task_ids.append(task_id)
             stage.task_uris.append(uri)
 
+    def _ensure_scan_splits(self, stage: _Stage):
+        """Bind connector splits (one list per scan node, split t to
+        task t; reference: ConnectorSplitManager). Lazy so that EVERY
+        post path computes them: a worker death during scheduling can
+        leave a stage with no tasks posted, and recovery then creates
+        its tasks without ever passing through _start_stage — a task
+        posted without scan sources would fall back to scanning the
+        whole table (SplitExecutor._fetch), duplicating rows once per
+        task. Split assignment is a pure function of (fragment,
+        n_tasks), so first-caller-wins is deterministic."""
+        if stage.scan_splits or not stage.spec.scan_nodes:
+            return
+        stage.scan_splits = {
+            node_id: (self.connector.connector_id(table),
+                      self.connector.table_splits(table, stage.n_tasks))
+            for node_id, table in stage.spec.scan_nodes.items()}
+
+    def _producer_location(self, producer: _Stage, i: int,
+                           uri: str) -> str:
+        """Result location of producer task `i` as a consumer should
+        see it NOW: normally the live task's URI; for a spool-absorbed
+        task, a LIVE worker's URI with the COMMITTED attempt's task id
+        — any worker sharing the spool base serves a committed spool
+        over the same GET .../results/... protocol, so replacement
+        consumers never dial the dead host."""
+        if i not in producer.spool_done:
+            return uri
+        live = self.worker_uris
+        host = (live[i % len(live)] if live
+                else uri.split("/v1/task/")[0])
+        return f"{host}/v1/task/{producer.spool_task_ids[i]}"
+
     def _post_stage_task(self, qid: str, fid: int, stages, by_id,
                          worker_uri: str, t: int, attempt: int):
         """POST task index `t` of fragment `fid` to one worker. The
@@ -1007,6 +1240,7 @@ class TpuCluster:
         execution; attempt is the Presto task-id attempt field)."""
         stage = stages[fid]
         spec = stage.spec
+        self._ensure_scan_splits(stage)
         task_id = f"{qid}.{fid}.0.{t}.{attempt}"
         uri = f"{worker_uri}/v1/task/{task_id}"
         sources: List[S.TaskSource] = []
@@ -1027,14 +1261,14 @@ class TpuCluster:
             buffer_id = (str(off) if part == Partitioning.SINGLE
                          else str(off + t))
             splits = []
-            for u in producer.task_uris:
+            for i, u in enumerate(producer.task_uris):
                 splits.append(S.ScheduledSplit(
                     sequenceId=seq, planNodeId=node_id,
                     split=S.Split(connectorId="$remote",
-                                  connectorSplit={
-                                      "@type": "$remote",
-                                      "location": u,
-                                      "bufferId": buffer_id})))
+                                  connectorSplit=remote_split_payload(
+                                      self._producer_location(
+                                          producer, i, u),
+                                      buffer_id))))
                 seq += 1
             sources.append(S.TaskSource(planNodeId=node_id,
                                         splits=splits,
@@ -1075,7 +1309,11 @@ class TpuCluster:
         if budget > 0:
             timeout_s = min(timeout_s, budget)
         deadline = time.time() + timeout_s
-        uris = [u for st in stages.values() for u in st.task_uris]
+        # spool-absorbed tasks are DONE by definition (their committed
+        # output is the result) — never poll their dead location
+        uris = [u for st in stages.values()
+                for i, u in enumerate(st.task_uris)
+                if i not in st.spool_done]
         results: Dict[str, Optional[dict]] = {}
         errs: Dict[str, BaseException] = {}
         wake = threading.Event()          # first failure OR all done
@@ -1137,9 +1375,10 @@ class TpuCluster:
         if merge_keys:
             return self._merge_root(root, out_types, merge_keys)
         rows: List[tuple] = []
-        for uri in root.task_uris:
-            data = PageStream(uri, buffer_id="0",
-                              client=self.http).drain()
+        for i, uri in enumerate(root.task_uris):
+            data = PageStream(self._producer_location(root, i, uri),
+                              buffer_id="0", client=self.http,
+                              spool=self.spool).drain()
             for p in decode_pages(data, out_types):
                 rows.extend(p.to_pylist())
         return rows
@@ -1166,7 +1405,7 @@ class TpuCluster:
                 stream = PageStream(
                     uri, buffer_id="0",
                     max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES,
-                    client=self.http)
+                    client=self.http, spool=self.spool)
                 try:
                     while not stream.complete:
                         data = stream.fetch()
@@ -1206,17 +1445,24 @@ class TpuCluster:
                 return False
 
         rows, high = bounded_merge(
-            [source(u) for u in root.task_uris], key=_Key,
+            [source(self._producer_location(root, i, u))
+             for i, u in enumerate(root.task_uris)], key=_Key,
             queue_pages=self.MERGE_QUEUE_PAGES)
         # observability hook for the bounded-in-flight test
         self.last_merge_inflight_high = high
         _M_MERGE_HIGH.set_max(high)
         return rows
 
-    def _cleanup(self, stages: Dict[int, _Stage]):
+    def _cleanup(self, stages: Dict[int, _Stage], qid: str = ""):
         for stage in stages.values():
-            for uri in stage.task_uris:
+            for i, uri in enumerate(stage.task_uris):
+                if i in stage.spool_done:
+                    continue       # nothing live behind a spooled task
                 try:
                     self.http.delete(uri)
                 except Exception:   # noqa: BLE001 — best-effort abort
                     pass
+        # end-of-query spool retention: the query's whole spool tree
+        # goes away with the query (success or failure)
+        if self.spool is not None and qid:
+            self.spool.gc_query(qid)

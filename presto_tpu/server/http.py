@@ -187,6 +187,11 @@ class _Handler(BaseHTTPRequestHandler):
         if m:
             task = self.tm.get(m.group(1))
             if task is None or task.buffers is None:
+                # a committed spool needs no ack bookkeeping (every
+                # token stays replayable) — 200 no-op keeps consumers
+                # of spool-served streams on the normal protocol path
+                if self._spool_for(m.group(1)) is not None:
+                    return self._bytes(200, b"")
                 return self._json(404, {"error": "no task"})
             buf = task.buffers.buffer(m.group(2))
             if buf is not None:
@@ -268,9 +273,48 @@ class _Handler(BaseHTTPRequestHandler):
                     "queryMemoryRevocableReservations": {}}}})
         self._json(404, {"error": f"no route {path}"})
 
+    def _spool_for(self, task_id: str):
+        """Committed spool for a task no longer (or never) held live by
+        this worker — ANY worker sharing the spool base can serve it."""
+        spool = getattr(self.tm, "spool", None)
+        if spool is None:
+            return None
+        return spool.find_committed_for_task(task_id)
+
+    def _spool_results(self, committed, buffer_id: str, token: str):
+        """Serve GET .../results/... from a committed spool: the same
+        headers and chunking as live buffers, tokens are frame indices
+        from 0, instance id comes from the manifest (so a consumer that
+        already pulled frames from the live task sees a CONSISTENT
+        stream, not a WorkerRestartedError)."""
+        from presto_tpu.spool.store import record_fallback_read
+        max_bytes = _parse_size(self.headers.get("X-Presto-Max-Size"),
+                                16 << 20)
+        tok = int(token)
+        frames = committed.frames(buffer_id, start=tok)
+        out, size = [], 0
+        for f in frames:
+            if out and size + len(f) > max_bytes:
+                break
+            out.append(f)
+            size += len(f)
+        nxt = tok + len(out)
+        complete = nxt >= committed.frame_count(buffer_id)
+        record_fallback_read()
+        headers = {
+            "X-Presto-Task-Instance-Id": committed.instance_id,
+            "X-Presto-Page-Sequence-Id": str(tok),
+            "X-Presto-Page-End-Sequence-Id": str(nxt),
+            "X-Presto-Buffer-Complete": "true" if complete else "false",
+        }
+        return self._bytes(200, b"".join(out), headers)
+
     def _results(self, task_id: str, buffer_id: str, token: str):
         task = self.tm.get(task_id)
         if task is None or task.buffers is None:
+            committed = self._spool_for(task_id)
+            if committed is not None:
+                return self._spool_results(committed, buffer_id, token)
             return self._json(404, {"error": "no task/buffers"})
         buf = task.buffers.buffer(buffer_id)
         if buf is None:
@@ -326,13 +370,14 @@ class TpuWorkerServer:
                  coordinator_uri: Optional[str] = None,
                  node_id: str = "tpu-worker-0",
                  shared_secret: Optional[str] = None,
-                 cache_config=None):
+                 cache_config=None, spool_config=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         base = f"http://{host}:{self.port}"
         self.task_manager = TpuTaskManager(connector, base_uri=base,
                                            cache_config=cache_config,
-                                           node_id=node_id)
+                                           node_id=node_id,
+                                           spool_config=spool_config)
         self.httpd.task_manager = self.task_manager
         # internal JWT auth (InternalAuthenticationManager role): with a
         # shared secret every /v1/* request must carry a valid
@@ -364,3 +409,4 @@ class TpuWorkerServer:
             self.announcer.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.task_manager.shutdown()

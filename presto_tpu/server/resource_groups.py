@@ -15,6 +15,17 @@ import re
 import threading
 from typing import List, Optional, Tuple
 
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+
+_M_ADMITTED = _counter("presto_tpu_resource_group_admitted_total",
+                       "Queries admitted per resource group", ("group",))
+_M_REJECTED = _counter("presto_tpu_resource_group_rejected_total",
+                       "Queries rejected (queue full / slot timeout) "
+                       "per resource group", ("group",))
+_M_PEAK_QUEUED = _gauge("presto_tpu_resource_group_peak_queued",
+                        "High-water mark of queued queries per "
+                        "resource group", ("group",))
+
 
 class QueryQueueFull(RuntimeError):
     """Reference: QUERY_QUEUE_FULL StandardErrorCode."""
@@ -42,16 +53,20 @@ class ResourceGroup:
         if fast and self._slots.acquire(blocking=False):
             with self._lock:
                 self.stats["admitted"] += 1
+            _M_ADMITTED.inc(group=self.name)
             return _Slot(self)
         with self._lock:
             if self._queued >= self.max_queued:
                 self.stats["rejected"] += 1
+                _M_REJECTED.inc(group=self.name)
                 raise QueryQueueFull(
                     f"group {self.name}: {self._queued} queued "
                     f">= max_queued {self.max_queued}")
             self._queued += 1
             self.stats["peak_queued"] = max(self.stats["peak_queued"],
                                             self._queued)
+            _M_PEAK_QUEUED.set_max(self.stats["peak_queued"],
+                                   group=self.name)
         ok = self._slots.acquire(timeout=timeout_s)
         with self._lock:
             self._queued -= 1
@@ -59,6 +74,10 @@ class ResourceGroup:
                 self.stats["rejected"] += 1
             else:
                 self.stats["admitted"] += 1
+        if ok:
+            _M_ADMITTED.inc(group=self.name)
+        else:
+            _M_REJECTED.inc(group=self.name)
         if not ok:
             raise QueryQueueFull(
                 f"group {self.name}: no slot within {timeout_s}s")

@@ -226,6 +226,7 @@ class _Handler(BaseHTTPRequestHandler):
             qs = list(co.queries.values())
             eng = co.engine
             pool = getattr(eng, "memory_pool", None)
+            rgs = getattr(eng, "resource_groups", None)
             return self._json(200, {
                 "nodeId": "tpu-coordinator", "role": "coordinator",
                 "environment": "tpu",
@@ -236,7 +237,13 @@ class _Handler(BaseHTTPRequestHandler):
                     1 for q in qs if not q.done.is_set()),
                 "taskCount": 0,
                 "heapUsed": pool.reserved if pool is not None else 0,
-                "heapAvailable": 16 << 30, "nonHeapUsed": 0})
+                "heapAvailable": 16 << 30, "nonHeapUsed": 0,
+                # per-group admission stats (reference:
+                # ResourceGroupInfo on the cluster resource) — absent
+                # when the engine has no admission control attached
+                "resourceGroups": (
+                    {name: stats for name, stats in rgs.info()}
+                    if rgs is not None else {})})
         m = _TRACE.match(path)
         if m:
             # stitched cross-node span dump for one query id (worker

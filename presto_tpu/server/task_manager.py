@@ -325,14 +325,24 @@ class TpuTaskManager:
     FINISHED, the coordinator's contract)."""
 
     def __init__(self, connector, base_uri: str = "",
-                 cache_config=None, node_id: str = "tpu-worker-0"):
+                 cache_config=None, node_id: str = "tpu-worker-0",
+                 spool_config=None):
         from presto_tpu.cache import FragmentResultCache
-        from presto_tpu.config import DEFAULT_CACHE
+        from presto_tpu.config import DEFAULT_CACHE, DEFAULT_SPOOL
 
         self.connector = connector
         self.base_uri = base_uri
         self.node_id = node_id
         self.tasks: Dict[str, Task] = {}
+        # spooled-exchange store (retry_policy=TASK): present only when
+        # the process config enables it — per-query gating happens at
+        # buffer-creation time from the session's retry_policy
+        scfg = spool_config if spool_config is not None else DEFAULT_SPOOL
+        if scfg.enabled:
+            from presto_tpu.spool.store import SpoolStore
+            self.spool: Optional["SpoolStore"] = SpoolStore(scfg)
+        else:
+            self.spool = None
         cfg = cache_config if cache_config is not None else DEFAULT_CACHE
         # worker-side fragment result store (consulted per task only
         # when the query enables fragment_result_cache_enabled)
@@ -384,12 +394,23 @@ class TpuTaskManager:
                 # batch/materialized execution (presto-spark shuffle
                 # role): output frames persist to disk and stay
                 # replayable from token 0, enabling stage-level retry
-                mat = bool(req.session is not None and str((
-                    req.session.systemProperties or {}).get(
-                    "exchange_materialization_enabled", ""))
-                    .strip().lower() == "true")
+                props = ((req.session.systemProperties or {})
+                         if req.session is not None else {})
+                mat = str(props.get(
+                    "exchange_materialization_enabled", "")) \
+                    .strip().lower() == "true"
+                writer = None
+                if self.spool is not None and str(props.get(
+                        "retry_policy", "")).strip().upper() == "TASK":
+                    # retry_policy=TASK: the output buffers ARE the
+                    # spool part files; commit happens at FINISHED
+                    try:
+                        writer = self.spool.writer(task_id)
+                    except ValueError:
+                        writer = None    # unit-test style opaque ids
                 task.buffers = OutputBufferManager(
-                    sorted(req.outputIds.buffers), materialized=mat)
+                    sorted(req.outputIds.buffers), materialized=mat,
+                    spool_writer=writer)
             if req.session is not None and req.session.systemProperties:
                 task.session_properties.update(req.session.systemProperties)
             if req.fragment is not None and task.fragment is None:
@@ -518,6 +539,14 @@ class TpuTaskManager:
             task.cpu_nanos = int(
                 (task.end_time - task.start_time) * 1e9)
             task.buffers.set_no_more_pages()
+            # spool commit BEFORE the FINISHED transition: once any
+            # observer can see FINISHED, the spool must already be
+            # atomically published (rename-to-commit), or a consumer
+            # racing the producer's death could find neither the HTTP
+            # buffers nor a committed spool
+            writer = getattr(task.buffers, "spool_writer", None)
+            if writer is not None:
+                writer.commit(str(task.instance_id))
             task.set_state("FINISHED")
         except Exception as e:
             from presto_tpu.protocol.validator import UnsupportedPlanError
@@ -528,6 +557,9 @@ class TpuTaskManager:
                 task.failures.append(traceback.format_exc())
             if task.buffers is not None:
                 task.buffers.set_no_more_pages()
+                writer = getattr(task.buffers, "spool_writer", None)
+                if writer is not None:
+                    writer.discard()   # never publish a failed attempt
             task.set_state("FAILED")
 
     def _cache_key(self, task: Task, plan) -> Optional[str]:
@@ -688,7 +720,8 @@ class TpuTaskManager:
 
         for loc, buf in task.remote_splits[driving.node_id]:
             stream = PageStream(loc, buffer_id=buf,
-                                max_size_bytes=self.REMOTE_CHUNK_BYTES)
+                                max_size_bytes=self.REMOTE_CHUNK_BYTES,
+                                spool=self.spool)
             while not stream.complete:
                 data = stream.fetch()
                 if data:
@@ -784,6 +817,7 @@ class TpuTaskManager:
                     PageStream(
                         location, buffer_id=buffer_id,
                         max_size_bytes=self.REMOTE_CHUNK_BYTES,
+                        spool=self.spool,
                     ).drain_pages(node.output_types, per_src[i].append)
                 except BaseException as e:   # noqa: BLE001 — re-raised
                     errs[i] = e
@@ -921,6 +955,26 @@ class TpuTaskManager:
         if task.buffers is not None:
             task.buffers.close()     # materialized shuffle files
         return task.info(self.base_uri)
+
+    def shutdown(self):
+        """Release every live task's disk-backed output on worker stop.
+        DELETE normally closes buffers task by task, but a worker
+        stopped mid-query (tests, rolling restarts) still holds tasks
+        the coordinator could never reach — without this their
+        materialized-shuffle FrameFiles outlive the process's work."""
+        with self.lock:
+            tasks = list(self.tasks.values())
+            self.tasks.clear()
+        for task in tasks:
+            if task.state in ("PLANNED", "RUNNING"):
+                task.set_state("ABORTED")
+            if task.buffers is not None:
+                try:
+                    task.buffers.close()
+                except OSError:
+                    pass
+        if self.spool is not None:
+            self.spool.close()
 
     @staticmethod
     def _loc_task_id(location: str) -> str:

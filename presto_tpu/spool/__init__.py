@@ -1,0 +1,23 @@
+"""Spooled exchange — disaggregated intermediate-result storage for
+stage-level recoverable execution (Presto@Meta VLDB'23 §3 / Trino
+Project Tardigrade role). See `spool/store.py` for layout + commit
+protocol."""
+
+from presto_tpu.spool.files import FrameFile, frame_slices
+from presto_tpu.spool.store import (
+    SPOOL_DIR_PREFIX,
+    CommittedTaskSpool,
+    SpoolIntegrityError,
+    SpoolStore,
+    TaskSpoolWriter,
+)
+
+__all__ = [
+    "SPOOL_DIR_PREFIX",
+    "CommittedTaskSpool",
+    "FrameFile",
+    "SpoolIntegrityError",
+    "SpoolStore",
+    "TaskSpoolWriter",
+    "frame_slices",
+]

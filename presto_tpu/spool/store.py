@@ -1,0 +1,332 @@
+"""Spool store — disaggregated intermediate-result storage for
+stage-level recoverable execution.
+
+Reference roles: the exchange manager behind Presto's TASK retry policy
+("Presto: A Decade of SQL Analytics at Meta", VLDB'23 §3 fault-tolerant
+execution; the same architecture as Trino's Project Tardigrade) and
+presto-spark's materialized shuffle. Workers persist every finished
+task's per-partition output pages here; a worker death after commit
+costs nothing — consumers and the coordinator read the committed spool
+instead of the dead worker's HTTP buffers.
+
+Layout (one shared base directory = the disaggregated store):
+
+    <base>/<query_id>/<stage>.<task>.<attempt>/
+        manifest.json            frame counts + checksums + instance id
+        part_<bufferId>.bin      concatenated SerializedPage(+LZ4) frames
+
+Commit protocol: a task writes into
+`<base>/<query_id>/.tmp-<stage>.<task>.<attempt>/`; only after every
+part file is flushed and the manifest written does ONE atomic
+`os.rename` move the directory to its committed name — a partially
+written spool is never visible, and readers treat "directory exists
+with a manifest" as the commit marker. Retention: the coordinator
+deletes a query's spool at query end; a store opening over an existing
+base sweeps orphans left by dead processes."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from presto_tpu.obs.metrics import counter as _counter
+from presto_tpu.protocol.structs import TaskId
+from presto_tpu.spool.files import (
+    FrameFile, frame_slices, read_bytes, write_bytes,
+)
+
+#: spool roots (and the chaos-suite stray-dir guard) key off this
+SPOOL_DIR_PREFIX = "presto_tpu_spool_"
+MANIFEST = "manifest.json"
+_TMP_PREFIX = ".tmp-"
+
+_M_SPOOL_BYTES = _counter(
+    "presto_tpu_spool_bytes_written_total",
+    "Bytes of SerializedPage frames written into spool part files")
+_M_SPOOL_COMMITS = _counter(
+    "presto_tpu_spool_commits_total",
+    "Task spools atomically committed (rename-to-commit completed)")
+_M_SPOOL_DISCARDS = _counter(
+    "presto_tpu_spool_discards_total",
+    "Uncommitted task spools discarded (task failed or was aborted)")
+_M_SPOOL_RECOVERIES = _counter(
+    "presto_tpu_spool_task_recoveries_total",
+    "Tasks recovered under retry_policy=TASK: committed spools absorbed "
+    "a dead worker's output, or lost tasks re-planned as attempt N+1")
+_M_SPOOL_FALLBACK_READS = _counter(
+    "presto_tpu_spool_fallback_reads_total",
+    "Exchange pulls that fell back from a dead HTTP location to the "
+    "committed spool")
+_M_SPOOL_GC = _counter(
+    "presto_tpu_spool_gc_total",
+    "Query spool directories deleted by end-of-query retention")
+_M_SPOOL_ORPHANS = _counter(
+    "presto_tpu_spool_orphans_swept_total",
+    "Orphaned spool directories removed by a startup sweep")
+
+
+def spool_counters() -> Dict[str, int]:
+    """Current process-wide spool counter values (EXPLAIN ANALYZE takes
+    a before/after delta around one query)."""
+    return {
+        "bytes_written": int(_M_SPOOL_BYTES.value()),
+        "commits": int(_M_SPOOL_COMMITS.value()),
+        "recoveries": int(_M_SPOOL_RECOVERIES.value()),
+        "fallback_reads": int(_M_SPOOL_FALLBACK_READS.value()),
+        "gc": int(_M_SPOOL_GC.value()),
+    }
+
+
+class SpoolIntegrityError(OSError):
+    """A committed spool failed validation (frame count or checksum
+    disagrees with its manifest) — an OSError so consumers treat it
+    like any other unusable source and recovery machinery engages."""
+
+
+def record_recovery(kind: str = "absorb") -> None:
+    """Count one task recovery; lives here so the metric has exactly
+    one registration site (tests/test_metric_names.py)."""
+    del kind
+    _M_SPOOL_RECOVERIES.inc()
+
+
+def record_fallback_read() -> None:
+    _M_SPOOL_FALLBACK_READS.inc()
+
+
+class TaskSpoolWriter:
+    """Write-side of one task's spool: per-buffer FrameFiles inside the
+    hidden tmp directory, committed by a single atomic rename."""
+
+    def __init__(self, store: "SpoolStore", task_id: str):
+        self.store = store
+        self.task_id = task_id
+        tid = TaskId.parse(task_id)
+        leaf = f"{tid.stage_id}.{tid.task_index}.{tid.attempt}"
+        qdir = os.path.join(store.base_dir, tid.query_id)
+        os.makedirs(qdir, exist_ok=True)
+        self.final_dir = os.path.join(qdir, leaf)
+        self.tmp_dir = os.path.join(qdir, _TMP_PREFIX + leaf)
+        # a leftover tmp dir from a dead prior attempt of the SAME id
+        # is garbage by definition (it never committed)
+        shutil.rmtree(self.tmp_dir, ignore_errors=True)
+        os.makedirs(self.tmp_dir)
+        self.files: Dict[str, FrameFile] = {}
+        self.committed = False
+        self._settled = False
+
+    def part(self, buffer_id: str) -> FrameFile:
+        """The FrameFile holding this buffer's frames (created lazily;
+        server/buffers.SpooledClientBuffer appends through it)."""
+        f = self.files.get(buffer_id)
+        if f is None:
+            f = FrameFile(os.path.join(self.tmp_dir,
+                                       f"part_{buffer_id}.bin"))
+            self.files[buffer_id] = f
+        return f
+
+    def commit(self, instance_id: str) -> Optional[str]:
+        """Manifest + atomic rename; after this the spool is visible to
+        every node sharing the base dir. Open FrameFile handles stay
+        valid across the rename (POSIX), so in-flight live reads keep
+        working. Returns the committed path (None if already settled)."""
+        if self._settled:
+            return self.final_dir if self.committed else None
+        manifest = {
+            "taskId": self.task_id,
+            "instanceId": instance_id,
+            "committedAtMillis": int(time.time() * 1000),
+            "buffers": {
+                b: {"frames": f.frame_count, "bytes": f.bytes,
+                    "crc32": f.crc32}
+                for b, f in self.files.items()},
+        }
+        write_bytes(os.path.join(self.tmp_dir, MANIFEST),
+                    json.dumps(manifest).encode())
+        try:
+            os.rename(self.tmp_dir, self.final_dir)
+        except OSError:
+            # a concurrent duplicate commit (at-least-once task updates)
+            # already published this id — keep the existing spool
+            if not os.path.isdir(self.final_dir):
+                raise
+            shutil.rmtree(self.tmp_dir, ignore_errors=True)
+        self.committed = True
+        self._settled = True
+        _M_SPOOL_COMMITS.inc()
+        _M_SPOOL_BYTES.inc(sum(f.bytes for f in self.files.values()))
+        return self.final_dir
+
+    def discard(self):
+        """Drop an uncommitted spool (task failed/aborted)."""
+        if self._settled:
+            return
+        self._settled = True
+        for f in self.files.values():
+            f.close(unlink=False)
+        shutil.rmtree(self.tmp_dir, ignore_errors=True)
+        _M_SPOOL_DISCARDS.inc()
+
+    def close(self):
+        """Task deleted: committed spools only release handles (the
+        store's GC owns the bytes); uncommitted ones are discarded."""
+        if self.committed:
+            for f in self.files.values():
+                f.close(unlink=False)
+        else:
+            self.discard()
+
+
+class CommittedTaskSpool:
+    """Read-side of one committed task spool. Every read validates the
+    part file against the manifest — frame count AND checksum — so a
+    replay can neither skip nor duplicate pages (a truncated or
+    corrupted spool raises instead of silently under-serving)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        doc = json.loads(read_bytes(os.path.join(path, MANIFEST)))
+        self.task_id: str = doc["taskId"]
+        self.instance_id: str = doc.get("instanceId", "")
+        self.buffers: Dict[str, dict] = doc.get("buffers", {})
+
+    def frame_count(self, buffer_id: str) -> int:
+        return int(self.buffers.get(buffer_id, {}).get("frames", 0))
+
+    def frames(self, buffer_id: str, start: int = 0) -> List[bytes]:
+        """All frames of `buffer_id` from token `start` onward."""
+        meta = self.buffers.get(buffer_id)
+        if meta is None:
+            return []
+        data = read_bytes(os.path.join(self.path,
+                                       f"part_{buffer_id}.bin"))
+        import zlib
+        if zlib.crc32(data) != int(meta.get("crc32", 0)):
+            raise SpoolIntegrityError(
+                f"spool {self.path} part {buffer_id}: checksum mismatch")
+        slices = frame_slices(data)
+        if slices is None or len(slices) != int(meta["frames"]):
+            got = "truncated" if slices is None else len(slices)
+            raise SpoolIntegrityError(
+                f"spool {self.path} part {buffer_id}: {got} frame(s) "
+                f"on disk, manifest claims {meta['frames']}")
+        return [data[o:o + ln] for o, ln in slices[start:]]
+
+
+class SpoolStore:
+    """One node's view of the shared spool base directory."""
+
+    def __init__(self, config=None):
+        from presto_tpu.config import DEFAULT_SPOOL
+        cfg = config if config is not None else DEFAULT_SPOOL
+        self.owns_base = cfg.base_dir is None
+        self.base_dir = cfg.base_dir or tempfile.mkdtemp(
+            prefix=SPOOL_DIR_PREFIX)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.codec = cfg.codec
+        if cfg.sweep_on_start and not self.owns_base:
+            self.sweep_orphans(cfg.orphan_ttl_s)
+
+    # ------------------------------------------------------------- write
+    def writer(self, task_id: str) -> TaskSpoolWriter:
+        return TaskSpoolWriter(self, task_id)
+
+    # -------------------------------------------------------------- read
+    def find_committed(self, query_id: str, stage_id: int,
+                       task_index: int) -> Optional[CommittedTaskSpool]:
+        """The committed spool for (query, stage, task) with the HIGHEST
+        attempt number, or None. Matching ignores the attempt — that is
+        what lets a replacement consumer locate whichever attempt of
+        its producer actually finished."""
+        qdir = os.path.join(self.base_dir, query_id)
+        best: Optional[int] = None
+        best_name = None
+        try:
+            names = os.listdir(qdir)
+        except OSError:
+            return None
+        prefix = f"{stage_id}.{task_index}."
+        for name in names:
+            if name.startswith(_TMP_PREFIX) \
+                    or not name.startswith(prefix):
+                continue
+            try:
+                attempt = int(name[len(prefix):])
+            except ValueError:
+                continue
+            if not os.path.isfile(os.path.join(qdir, name, MANIFEST)):
+                continue
+            if best is None or attempt > best:
+                best, best_name = attempt, name
+        if best_name is None:
+            return None
+        try:
+            return CommittedTaskSpool(os.path.join(qdir, best_name))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def find_committed_for_task(self, task_id: str
+                                ) -> Optional[CommittedTaskSpool]:
+        """Committed spool for the work unit `task_id` names (any
+        attempt), or None for unparseable ids / no spool."""
+        try:
+            tid = TaskId.parse(task_id)
+        except ValueError:
+            return None
+        return self.find_committed(tid.query_id, tid.stage_id,
+                                   tid.task_index)
+
+    def find_committed_for_location(self, location: str
+                                    ) -> Optional[CommittedTaskSpool]:
+        """Committed spool for an HTTP result location
+        (`.../v1/task/<taskId>`), or None."""
+        tail = location.rstrip("/").rsplit("/", 1)[-1]
+        return self.find_committed_for_task(tail)
+
+    # ---------------------------------------------------------- retention
+    def gc_query(self, query_id: str) -> bool:
+        """Delete a finished query's whole spool tree (end-of-query
+        retention; reference: exchange source cleanup when a query
+        reaches a terminal state)."""
+        qdir = os.path.join(self.base_dir, query_id)
+        if not os.path.isdir(qdir):
+            return False
+        shutil.rmtree(qdir, ignore_errors=True)
+        _M_SPOOL_GC.inc()
+        return True
+
+    def sweep_orphans(self, ttl_s: float = 0.0) -> int:
+        """Remove query spool trees left behind by dead processes
+        (startup sweep). `ttl_s` spares trees younger than the cutoff
+        (0 = sweep any age) so a node joining a busy shared base does
+        not eat a live query's spool."""
+        cutoff = time.time() - max(ttl_s, 0.0)
+        swept = 0
+        try:
+            names = os.listdir(self.base_dir)
+        except OSError:
+            return 0
+        for name in names:
+            path = os.path.join(self.base_dir, name)
+            if not os.path.isdir(path):
+                continue
+            try:
+                if os.path.getmtime(path) > cutoff:
+                    continue
+            except OSError:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            swept += 1
+        if swept:
+            _M_SPOOL_ORPHANS.inc(swept)
+        return swept
+
+    def close(self):
+        """Tear down a store whose base dir this process created
+        (tests / per-cluster temp roots); shared bases are left alone."""
+        if self.owns_base:
+            shutil.rmtree(self.base_dir, ignore_errors=True)

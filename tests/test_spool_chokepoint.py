@@ -1,0 +1,65 @@
+"""Guard: spool/ is the single task-output file writer.
+
+Spooled task output carries recovery-critical invariants — atomic
+rename-to-commit, manifest frame counts + checksums, GC by directory
+prefix. Those hold only while every byte of task output that touches
+disk goes through `presto_tpu/spool/` (FrameFile + TaskSpoolWriter). A
+server- or protocol-layer call site opening its own spill/temp file
+would create output the manifest never covers: invisible to recovery,
+invisible to GC, and silently skipped by the spool fallback read path.
+This test fails the build instead (pattern: tests/test_rpc_chokepoint).
+
+Scope is the distributed-execution layers (`server/`, `protocol/`).
+`exec/` keeps its own spill files (exec/spill.py) — those are
+node-local scratch for operators, never served across the exchange, so
+they are NOT task output and not in scope here."""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "presto_tpu"
+
+#: file-writing idioms that would bypass the spool commit protocol
+_PATTERNS = (
+    re.compile(r"""open\s*\([^)\n]*,\s*["'][wax]b?\+?["']"""),
+    re.compile(r"tempfile\s*\.\s*(mkstemp|mkdtemp|NamedTemporaryFile|"
+               r"TemporaryFile|TemporaryDirectory)"),
+    re.compile(r"from\s+tempfile\s+import\b"),
+    re.compile(r"os\s*\.\s*(open|mkstemp)\s*\("),
+)
+
+#: distributed-execution layers where ALL task-output writes must ride
+#: the spool package — no allowlist inside them
+SCOPED = ("server", "protocol")
+
+
+def _offenders(root: pathlib.Path):
+    out = []
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text()
+        for pat in _PATTERNS:
+            for m in pat.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                out.append(f"{path.relative_to(PKG.parent)}:{line}: "
+                           f"{m.group(0)!r}")
+    return out
+
+
+def test_no_file_writes_outside_spool():
+    offenders = []
+    for sub in SCOPED:
+        offenders.extend(_offenders(PKG / sub))
+    assert not offenders, (
+        "file-writing call site in a distributed-execution layer — "
+        "task output must go through presto_tpu/spool "
+        "(TaskSpoolWriter/FrameFile) so commit manifests, checksums "
+        "and GC cover it:\n" + "\n".join(offenders))
+
+
+def test_spool_package_itself_writes_files():
+    """The guard stays honest: the spool package must actually match
+    the patterns it polices — if the writer idiom changes, update
+    _PATTERNS instead of letting the scan go vacuous."""
+    assert _offenders(PKG / "spool"), (
+        "presto_tpu/spool no longer matches the write patterns this "
+        "guard scans for — update _PATTERNS")

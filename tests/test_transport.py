@@ -17,7 +17,7 @@ import pytest
 
 from presto_tpu.config import TransportConfig
 from presto_tpu.protocol.exchange_client import PageStream, \
-    frames_complete
+    count_frames, frames_complete
 from presto_tpu.protocol.transport import (
     CircuitBreaker, CircuitOpenError, FatalResponseError, HttpClient,
     RetriesExhaustedError, WorkerRestartedError,
@@ -322,3 +322,24 @@ def test_frames_complete_walks_headers():
     assert not frames_complete(f[:-1])
     assert not frames_complete(f + f[:10])
     assert not frames_complete(f[:5])
+
+
+def test_count_frames_unit_vectors():
+    header = struct.Struct("<ibiiq")
+    f = _frame(b"abcdef")
+    # empty body: zero frames, NOT a truncation
+    assert count_frames(b"") == 0
+    # exact frame boundaries count exactly
+    assert count_frames(f) == 1
+    assert count_frames(f + f + f) == 3
+    # a body cut exactly at the 21-byte header (payload missing
+    # entirely) is mid-frame
+    assert count_frames(f[:header.size]) is None
+    # negative payload length in the header: corrupt, never walk past
+    neg = struct.pack("<ibiiq", 1, 0, -1, -1, 0) + b"x" * 8
+    assert count_frames(neg) is None
+    # declared payload length overshoots the body end
+    over = struct.pack("<ibiiq", 1, 0, 10_000, 10_000, 0) + b"x" * 16
+    assert count_frames(over) is None
+    # ...even as the trailing frame of an otherwise-complete body
+    assert count_frames(f + over) is None
