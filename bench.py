@@ -25,9 +25,11 @@ BENCH_QUERIES (comma list or "all", the default), BENCH_FRAG_QUERIES
 (comma list run lifespan-batched instead, default none),
 BENCH_QUERY_TIMEOUT (s, default 2400). Device-probe budget:
 BENCH_PROBE_ATTEMPTS (2) x BENCH_PROBE_TIMEOUT (120 s) capped at
-BENCH_PROBE_BUDGET (300 s) total; if the accelerator never answers,
-the suite falls back to JAX_PLATFORMS=cpu so the final JSON line is
-always emitted (labeled cpu_fallback).
+BENCH_PROBE_BUDGET (300 s) total — ONE wall-clock deadline shared by
+every probe the run makes (initial, cpu-fallback, mid-run re-probes),
+covering sleeps as well as probe subprocesses; if the accelerator
+never answers, the suite falls back to JAX_PLATFORMS=cpu so the final
+JSON line is always emitted (labeled cpu_fallback).
 
 TPC-DS lane (reference:
 presto-benchto-benchmarks/.../benchmarks/presto/tpcds.yaml): set
@@ -324,24 +326,62 @@ def _probe_device(timeout_s: float) -> Optional[str]:
     return None
 
 
+#: ONE wall-clock deadline for every probe the whole run makes —
+#: initial, cpu-fallback, and mid-run re-probes all draw down the same
+#: BENCH_PROBE_BUDGET. Per-call deadlines let a run with a wedged
+#: tunnel stack several full budgets plus backoff sleeps (BENCH_r05:
+#: 4 x 300 s probes + 60/120/240/480 s sleeps) past the harness
+#: timeout, so the labeled-infra-error JSON never landed (rc=124,
+#: parsed: null). Lazily armed at the first probe so import costs
+#: nothing against the budget.
+_PROBE_DEADLINE: Optional[float] = None
+
+
+def _probe_deadline() -> float:
+    global _PROBE_DEADLINE
+    if _PROBE_DEADLINE is None:
+        budget_s = float(os.environ.get("BENCH_PROBE_BUDGET", "300"))
+        _PROBE_DEADLINE = time.perf_counter() + budget_s
+    return _PROBE_DEADLINE
+
+
+def _probe_remaining() -> float:
+    return _probe_deadline() - time.perf_counter()
+
+
+def _probe_grant_grace(seconds: float) -> None:
+    """Extend the global probe deadline by a BOUNDED one-off slice (the
+    cpu-fallback probe after the accelerator burned the whole budget) —
+    total probe wall time stays <= budget + grace, never another full
+    budget per call site."""
+    global _PROBE_DEADLINE
+    _PROBE_DEADLINE = max(_probe_deadline(),
+                          time.perf_counter() + seconds)
+
+
 def _probe_with_retry(attempts, timeout_s, log) -> Optional[str]:
     """Probe up to `attempts` times with growing sleeps between failures
     (the tunnel wedges transiently: round-4's single 600 s probe turned
     an infra blip into a 0.0 artifact). The WHOLE retry loop — probes
-    plus sleeps — is bounded by BENCH_PROBE_BUDGET seconds (default
-    300): a wedged tunnel gets a fair retry window but can never hold
-    the report hostage for tens of minutes. Returns None when healthy,
-    else the last error; every attempt is recorded in `log`."""
+    plus sleeps, ACROSS every call this process makes — is bounded by
+    the global BENCH_PROBE_BUDGET deadline (default 300 s): a wedged
+    tunnel gets a fair retry window but can never hold the report
+    hostage for tens of minutes. Returns None when healthy, else the
+    last error; every attempt is recorded in `log`."""
     backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "60"))
     budget_s = float(os.environ.get("BENCH_PROBE_BUDGET", "300"))
-    deadline = time.perf_counter() + budget_s
+    deadline = _probe_deadline()
     err = None
     for i in range(max(1, attempts)):
         remaining = deadline - time.perf_counter()
-        if i > 0 and remaining <= 1.0:
+        if remaining <= 1.0:
             log.append(f"attempt {i + 1}: skipped (probe budget "
                        f"{budget_s:.0f}s exhausted)")
             print(f"# device probe {log[-1]}", file=sys.stderr)
+            # a skipped probe is NOT a healthy probe: without a real
+            # answer inside the budget the device must count as down
+            err = err or (f"device probe budget {budget_s:.0f}s "
+                          "exhausted before a probe could run")
             break
         t0 = time.perf_counter()
         err = _probe_device(min(timeout_s, max(remaining, 1.0)))
@@ -431,6 +471,10 @@ def _main_orchestrator(sf, qids) -> None:
               "BENCH_PLATFORM=cpu", file=sys.stderr)
         os.environ["BENCH_PLATFORM"] = "cpu"
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # the accelerator probes may have spent the whole global
+        # budget; the host-cpu probe gets one bounded grace slice so
+        # the functional-correctness artifact still has a chance
+        _probe_grant_grace(min(probe_timeout, 120.0))
         err = _probe_with_retry(1, min(probe_timeout, 120.0), probe_log)
     if err is not None:
         print(json.dumps({
@@ -462,8 +506,12 @@ def _main_orchestrator(sf, qids) -> None:
             if "error" not in retry:
                 entry = retry
         if "error" in entry and entry["error"].startswith("timeout"):
-            # distinguish "this query is slow/broken" from "tunnel died"
-            quick = _probe_device(min(300.0, probe_timeout))
+            # distinguish "this query is slow/broken" from "tunnel
+            # died"; the quick probe draws on the same global budget —
+            # with it exhausted, a short 5 s sanity probe still runs so
+            # a wedged tunnel is labeled rather than silently retried
+            quick = _probe_device(min(300.0, probe_timeout,
+                                      max(_probe_remaining(), 5.0)))
             if quick is not None:
                 requick = _probe_with_retry(2, probe_timeout, probe_log)
                 if requick is not None:

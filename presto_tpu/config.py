@@ -263,6 +263,36 @@ class SpoolConfig:
 DEFAULT_SPOOL = SpoolConfig()
 
 
+@dataclasses.dataclass(frozen=True)
+class ExchangeConfig:
+    """Concurrent-exchange knobs (reference: ExchangeClientConfig behind
+    operator/ExchangeClient.java — maxBufferedBytes, maxResponseSize,
+    concurrentRequestMultiplier). One per process; every
+    `protocol/exchange.ExchangeClient` is built from this."""
+
+    #: total decoded-chunk bytes (accounted by wire size) the client may
+    #: hold in its in-flight buffer before fetchers park — the true
+    #: backpressure bound (ExchangeClient.java maxBufferedBytes). An
+    #: empty buffer always admits one chunk even if it alone exceeds
+    #: the cap, so the effective bound is
+    #: max(max_buffered_bytes, one chunk) and progress never deadlocks.
+    max_buffered_bytes: int = 32 << 20
+    #: per-GET response cap sent as X-Presto-Max-Size (ExchangeClient's
+    #: maxResponseSize): one pull round never materializes more than
+    #: this per stream
+    max_response_bytes: int = 4 << 20
+    #: simultaneous in-flight GETs across all of a client's streams
+    #: (concurrentRequestMultiplier role); 0 = one per stream,
+    #: unbounded across streams
+    max_concurrent_fetchers: int = 16
+    #: X-Presto-Max-Wait long-poll window per GET
+    max_wait: str = "1s"
+
+
+#: process defaults
+DEFAULT_EXCHANGE = ExchangeConfig()
+
+
 class Session:
     """One query session: defaults overridden by string-typed properties
     (the wire form). Unknown properties are rejected loudly, like the

@@ -230,11 +230,11 @@ class PageStream:
             pass
 
     def drain(self) -> bytes:
-        out = b""
+        chunks = []
         while not self.complete:
-            out += self.fetch()
+            chunks.append(self.fetch())
         self.close()
-        return out
+        return b"".join(chunks)
 
     def drain_pages(self, types, sink) -> None:
         """Bounded-memory drain: decode each fetched chunk into engine

@@ -34,7 +34,9 @@ from presto_tpu.plan.fragment import add_exchanges, create_fragments
 from presto_tpu.utils.tracing import TRACER, trace_scope
 from presto_tpu.plan.nodes import ExchangeNode, Partitioning, PlanNode
 from presto_tpu.protocol import structs as S
-from presto_tpu.protocol.exchange_client import PageStream, decode_pages
+from presto_tpu.protocol.exchange import (
+    ExchangeClient, exchange_counters, stream_pages,
+)
 from presto_tpu.protocol.to_protocol import FragmentSpec, \
     fragment_to_protocol, remote_split_payload
 from presto_tpu.protocol.transport import HttpClient
@@ -261,11 +263,12 @@ class TpuCluster:
                  resource_groups=None, history=None, discovery=None,
                  shared_secret: Optional[str] = None,
                  transport_config: Optional[TransportConfig] = None,
-                 cache_config=None, spool_config=None):
+                 cache_config=None, spool_config=None,
+                 exchange_config=None):
         import dataclasses as _dc
 
         from presto_tpu.cache import AffinityRouter
-        from presto_tpu.config import DEFAULT_SPOOL
+        from presto_tpu.config import DEFAULT_EXCHANGE, DEFAULT_SPOOL
         from presto_tpu.server.resource_groups import ResourceGroupManager
         from presto_tpu.sql.analyzer import Planner
 
@@ -292,6 +295,11 @@ class TpuCluster:
         # alongside the statically started ones.
         self.discovery = discovery
         self.cache_config = cache_config
+        # concurrent-exchange knobs: the coordinator's own root collect
+        # AND every worker's upstream pulls share one config
+        self.exchange_config = (exchange_config
+                                if exchange_config is not None
+                                else DEFAULT_EXCHANGE)
         # spooled exchange (retry_policy=TASK): the coordinator opens
         # the shared spool base FIRST (sweeping orphans when attaching
         # to an existing base), then hands every worker a config
@@ -312,7 +320,8 @@ class TpuCluster:
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}",
                             shared_secret=shared_secret,
                             cache_config=cache_config,
-                            spool_config=self.spool_config).start()
+                            spool_config=self.spool_config,
+                            exchange_config=exchange_config).start()
             for i in range(n_workers)]
         # cache-affinity placement memory (reference: the coordinator's
         # fragment-result-cache-aware NetworkLocationCache / soft
@@ -613,6 +622,14 @@ class TpuCluster:
             getattr(self, "last_task_infos", []))
         if cache_line:
             lines.append(cache_line)
+        ex = getattr(self, "last_exchange_stats", None)
+        if ex is not None:
+            lines.append(
+                f"Exchange: fetches={ex['fetches']} "
+                f"pages={ex['pages']} bytes={ex['bytes']} "
+                f"truncations={ex['truncations']} "
+                f"buffered_bytes_hw={ex['buffered_bytes_high_water']} "
+                f"buffer_depth_hw={ex['buffer_depth_high_water']}")
         spool = getattr(self, "last_spool_stats", None)
         if spool is not None:
             lines.append(
@@ -838,6 +855,10 @@ class TpuCluster:
         if self.spool is not None:
             from presto_tpu.spool.store import spool_counters
             spool_before = spool_counters()
+        # exchange activity this query: counter deltas (process-global
+        # registry, so in-process workers' pulls are included) plus the
+        # absolute high-water gauges
+        exchange_before = exchange_counters()
 
         def run_query() -> List[tuple]:
             try:
@@ -913,6 +934,11 @@ class TpuCluster:
                     self.last_spool_stats = {
                         k: after[k] - spool_before[k]
                         for k in after}
+                ex_after = exchange_counters()
+                self.last_exchange_stats = {
+                    k: (ex_after[k] - exchange_before[k]
+                        if not k.endswith("high_water") else ex_after[k])
+                    for k in ex_after}
 
         if not DEFAULT_OBS.sampled(random.random()):
             return run_query()
@@ -1374,13 +1400,20 @@ class TpuCluster:
                       merge_keys=None) -> List[tuple]:
         if merge_keys:
             return self._merge_root(root, out_types, merge_keys)
+        # concurrent final-result drain: all root tasks' buffers pull in
+        # parallel through the bounded exchange buffer; arrival-order
+        # interleaving is legal here because ordered results always
+        # carry merge_keys (the _merge_root path), and single-task roots
+        # keep exact order (per-stream FIFO)
+        locations = [(self._producer_location(root, i, uri), "0")
+                     for i, uri in enumerate(root.task_uris)]
         rows: List[tuple] = []
-        for i, uri in enumerate(root.task_uris):
-            data = PageStream(self._producer_location(root, i, uri),
-                              buffer_id="0", client=self.http,
-                              spool=self.spool).drain()
-            for p in decode_pages(data, out_types):
-                rows.extend(p.to_pylist())
+        with ExchangeClient(locations, types=list(out_types),
+                            config=self.exchange_config,
+                            client=self.http, spool=self.spool) as xc:
+            for pages in xc:
+                for p in pages:
+                    rows.extend(p.to_pylist())
         return rows
 
     #: per-stream cap on decoded-but-unmerged row batches held at the
@@ -1398,21 +1431,14 @@ class TpuCluster:
         run before a Timsort pass — peak memory is
         ``k * (MERGE_QUEUE_PAGES + 2)`` batches plus the merged output,
         not the sum of all runs twice over."""
-        from presto_tpu.server.task_manager import TpuTaskManager
-
         def source(uri):
             def batches():
-                stream = PageStream(
-                    uri, buffer_id="0",
-                    max_size_bytes=TpuTaskManager.REMOTE_CHUNK_BYTES,
-                    client=self.http, spool=self.spool)
-                try:
-                    while not stream.complete:
-                        data = stream.fetch()
-                        for p in decode_pages(data, out_types):
-                            yield p.to_pylist()
-                finally:
-                    stream.close()
+                for p in stream_pages(
+                        uri, buffer_id="0", types=out_types,
+                        client=self.http, spool=self.spool,
+                        max_size_bytes=self.exchange_config
+                        .max_response_bytes):
+                    yield p.to_pylist()
             return batches
 
         class _Key:

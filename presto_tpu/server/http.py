@@ -370,14 +370,16 @@ class TpuWorkerServer:
                  coordinator_uri: Optional[str] = None,
                  node_id: str = "tpu-worker-0",
                  shared_secret: Optional[str] = None,
-                 cache_config=None, spool_config=None):
+                 cache_config=None, spool_config=None,
+                 exchange_config=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         base = f"http://{host}:{self.port}"
         self.task_manager = TpuTaskManager(connector, base_uri=base,
                                            cache_config=cache_config,
                                            node_id=node_id,
-                                           spool_config=spool_config)
+                                           spool_config=spool_config,
+                                           exchange_config=exchange_config)
         self.httpd.task_manager = self.task_manager
         # internal JWT auth (InternalAuthenticationManager role): with a
         # shared secret every /v1/* request must carry a valid

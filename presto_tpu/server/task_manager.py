@@ -326,13 +326,20 @@ class TpuTaskManager:
 
     def __init__(self, connector, base_uri: str = "",
                  cache_config=None, node_id: str = "tpu-worker-0",
-                 spool_config=None):
+                 spool_config=None, exchange_config=None):
         from presto_tpu.cache import FragmentResultCache
-        from presto_tpu.config import DEFAULT_CACHE, DEFAULT_SPOOL
+        from presto_tpu.config import (
+            DEFAULT_CACHE, DEFAULT_EXCHANGE, DEFAULT_SPOOL,
+        )
 
         self.connector = connector
         self.base_uri = base_uri
         self.node_id = node_id
+        # concurrent-exchange knobs for every upstream pull this worker
+        # makes (protocol/exchange.ExchangeClient)
+        self.exchange_config = (exchange_config
+                                if exchange_config is not None
+                                else DEFAULT_EXCHANGE)
         self.tasks: Dict[str, Task] = {}
         # spooled-exchange store (retry_policy=TASK): present only when
         # the process config enables it — per-query gating happens at
@@ -672,9 +679,7 @@ class TpuTaskManager:
             AggregationNode, FilterNode, OutputNode, ProjectNode,
             RemoteSourceNode, Step,
         )
-        from presto_tpu.protocol.exchange_client import (
-            PageStream, decode_pages,
-        )
+        from presto_tpu.protocol.exchange import ExchangeClient
 
         rs = _remote_source_nodes(plan)
         if not rs:
@@ -718,16 +723,19 @@ class TpuTaskManager:
             self._emit_output(task, out)
             emitted[0] += 1
 
-        for loc, buf in task.remote_splits[driving.node_id]:
-            stream = PageStream(loc, buffer_id=buf,
-                                max_size_bytes=self.REMOTE_CHUNK_BYTES,
-                                spool=self.spool)
-            while not stream.complete:
-                data = stream.fetch()
-                if data:
-                    run_chunk(decode_pages(
-                        data, list(driving.output_types)))
-            stream.close()
+        # concurrent pipelined pull (protocol/exchange.ExchangeClient):
+        # every upstream task is fetched AND decoded by background
+        # threads into the bounded buffer while run_chunk executes, so
+        # the shuffle costs ~max-of-streams instead of ~sum and the
+        # device never idles through a GET; chunks interleave across
+        # upstreams in arrival order (legal here — additivity already
+        # allows any chunking of the driving input)
+        with ExchangeClient(task.remote_splits[driving.node_id],
+                            types=list(driving.output_types),
+                            config=self.exchange_config,
+                            spool=self.spool) as xc:
+            for pages in xc:
+                run_chunk(pages)
         if emitted[0] == 0:
             # no upstream rows at all: run once on an empty chunk so
             # output shape/stats exist (PARTIAL aggs emit zero states)
@@ -799,38 +807,26 @@ class TpuTaskManager:
         RemoteSourceNode (consumer side of the pull protocol —
         ExchangeClient.java:255 semantics; the final materialization is
         what the whole-fragment jit engine consumes). `skip` excludes
-        node ids the caller streams itself (_run_streaming_remote)."""
-        from presto_tpu.protocol.exchange_client import PageStream
+        node ids the caller streams itself (_run_streaming_remote).
+        Pulls ride the concurrent ExchangeClient: producer latencies
+        overlap AND decoded residency is bounded by
+        `ExchangeConfig.max_buffered_bytes` ahead of the consumer
+        (the old thread-per-location drain accumulated every upstream's
+        pages unboundedly before the join)."""
+        from presto_tpu.protocol.exchange import ExchangeClient
 
         out: Dict[str, Page] = {}
         for node in _remote_source_nodes(plan):
             if skip and node.node_id in skip:
                 continue
             splits = task.remote_splits.get(node.node_id, [])
-            # concurrent pulls (reference: ExchangeClient's parallel
-            # PageBufferClients) — producer latencies overlap
-            per_src: List[List[Page]] = [[] for _ in splits]
-            errs: List[Optional[BaseException]] = [None] * len(splits)
-
-            def pull(i, location, buffer_id):
-                try:
-                    PageStream(
-                        location, buffer_id=buffer_id,
-                        max_size_bytes=self.REMOTE_CHUNK_BYTES,
-                        spool=self.spool,
-                    ).drain_pages(node.output_types, per_src[i].append)
-                except BaseException as e:   # noqa: BLE001 — re-raised
-                    errs[i] = e
-            threads = [threading.Thread(target=pull, args=(i, loc, b))
-                       for i, (loc, b) in enumerate(splits)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            for e in errs:
-                if e is not None:
-                    raise e
-            pages = [p for src in per_src for p in src]
+            pages: List[Page] = []
+            if splits:
+                with ExchangeClient(splits,
+                                    types=list(node.output_types),
+                                    config=self.exchange_config,
+                                    spool=self.spool) as xc:
+                    pages = xc.drain_pages()
             if not pages:
                 # no producer emitted rows: empty page of the right shape
                 from presto_tpu.data.column import Column

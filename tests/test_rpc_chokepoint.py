@@ -1,10 +1,20 @@
-"""Guard: protocol/transport.py is the single RPC chokepoint.
+"""Guards: the engine's RPC and exchange chokepoints stay single.
 
-Every HTTP request the engine makes must ride transport.HttpClient so
-retry policies, error classification, and per-worker circuit breakers
-apply uniformly (and fault injection sees every RPC). A raw
-`urllib.request.urlopen` anywhere else in presto_tpu/ silently opts
-that call site out of all of it — this test fails the build instead."""
+1. protocol/transport.py is the single HTTP chokepoint: every request
+   must ride transport.HttpClient so retry policies, error
+   classification, and per-worker circuit breakers apply uniformly
+   (and fault injection sees every RPC). A raw
+   `urllib.request.urlopen` anywhere else in presto_tpu/ silently opts
+   that call site out of all of it — this test fails the build instead.
+
+2. protocol/exchange.py + protocol/exchange_client.py are the only
+   CONSUMERS of `/results/` page GETs: any other code path pulling
+   pages would bypass the bounded exchange buffer (backpressure), the
+   truncation-before-ack validation, and the spool fallback. Two
+   patterns enforce it — client-side results-URL construction
+   (`/results/{` in an f-string) and `PageStream(` construction. The
+   server SIDE of the protocol (route regexes in server/http.py,
+   buffers) never builds a client URL, so it does not match."""
 
 import pathlib
 import re
@@ -16,6 +26,16 @@ _FROM_IMPORT = re.compile(
     r"from\s+urllib\s*\.\s*request\s+import\s+[^\n]*\burlopen\b")
 
 ALLOWED = {PKG / "protocol" / "transport.py"}
+
+#: an f-string literal interpolating into a /results/ path = building a
+#: results GET/DELETE url client-side (the server's route regexes use
+#: groups, not interpolation, and docstrings describing the routes are
+#: not f-strings, so neither matches)
+_RESULTS_URL = re.compile(r"""f["'][^"'\n]*/results/\{""")
+_PAGESTREAM = re.compile(r"\bPageStream\s*\(")
+
+EXCHANGE_ALLOWED = {PKG / "protocol" / "exchange.py",
+                    PKG / "protocol" / "exchange_client.py"}
 
 
 def test_urlopen_only_in_transport():
@@ -39,3 +59,29 @@ def test_transport_itself_still_uses_urlopen():
     urllib, update ALLOWED instead of leaving a stale exemption."""
     text = (PKG / "protocol" / "transport.py").read_text()
     assert _DIRECT.search(text)
+
+
+def test_results_consumers_only_in_exchange_modules():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path in EXCHANGE_ALLOWED:
+            continue
+        text = path.read_text()
+        for pat in (_RESULTS_URL, _PAGESTREAM):
+            for m in pat.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                offenders.append(f"{path.relative_to(PKG.parent)}:"
+                                 f"{line}: {m.group(0)!r}")
+    assert not offenders, (
+        "page-protocol consumption outside protocol/exchange*.py — "
+        "route these through exchange.ExchangeClient/stream_pages so "
+        "the bounded buffer, truncation validation and spool fallback "
+        "apply:\n" + "\n".join(offenders))
+
+
+def test_exchange_client_itself_still_builds_results_urls():
+    """The exchange allowlist stays honest the same way."""
+    text = (PKG / "protocol" / "exchange_client.py").read_text()
+    assert _RESULTS_URL.search(text)
+    assert _PAGESTREAM.search(
+        (PKG / "protocol" / "exchange.py").read_text())
