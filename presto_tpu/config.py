@@ -64,6 +64,11 @@ PROPERTIES = [
     Property("exchange_chunk_factor",
              "Per-peer exchange chunk = factor * capacity / n_devices",
              int, 2),
+    Property("capacity_annealing_enabled",
+             "Shrink learned capacities back toward the observed "
+             "high-water mark after a converged run (costs one recompile "
+             "at the smaller bucket, then every later run executes the "
+             "smaller program)", _parse_bool, True),
     Property("collect_stats",
              "Record per-node output row counts for EXPLAIN ANALYZE",
              _parse_bool, False),

@@ -31,12 +31,34 @@ import jax.numpy as jnp
 
 from presto_tpu.data.column import Page, bucket_capacity
 from presto_tpu.exec.executor import Executor, ScanSpec
+from presto_tpu.obs.metrics import counter as _metric_counter
 from presto_tpu.parallel.mesh import AXIS, run_sharded, stack_pages, \
     unstack_page
-from presto_tpu.parallel.shuffle import all_gather_page, partition_ids, \
-    repartition_page
+from presto_tpu.parallel.shuffle import ExchangeLayout, all_gather_page, \
+    partition_ids, repartition_page
 from presto_tpu.plan.fragment import add_exchanges
 from presto_tpu.plan.nodes import Partitioning, PlanNode, Step
+
+#: ICI exchange observability (the mesh analog of the HTTP "Exchange:"
+#: counters): static wire-buffer bytes and collective launches per
+#: exchange kind, exchange-driven overflow re-lowers, and distinct
+#: fragment programs compiled. All feed /v1/metrics and the "Mesh:"
+#: line in EXPLAIN ANALYZE.
+_M_MESH_BYTES = _metric_counter(
+    "presto_tpu_mesh_exchange_bytes_total",
+    "Static wire-buffer bytes moved by packed ICI collectives",
+    ("kind",))
+_M_MESH_LAUNCHES = _metric_counter(
+    "presto_tpu_mesh_collective_launches_total",
+    "Packed ICI collectives launched (one per distinct lane dtype)",
+    ("kind",))
+_M_MESH_OVERFLOW = _metric_counter(
+    "presto_tpu_mesh_exchange_overflow_retries_total",
+    "Exchange re-lowers forced by per-peer chunk or receive-capacity "
+    "overflow")
+_M_MESH_COMPILES = _metric_counter(
+    "presto_tpu_mesh_fragment_compiles_total",
+    "Distinct fragment programs compiled by the mesh executor")
 
 
 class DistExecutor(Executor):
@@ -54,6 +76,13 @@ class DistExecutor(Executor):
         self.ndev = int(mesh.devices.size)
         # HBO store consulted by add_exchanges at _prepare time
         self.history = history
+        # id(exchange node) -> ExchangeLayout, recorded at trace time by
+        # the packed collectives; _trace_credit marks exchanges whose
+        # first dispatch still owes its metric increment to the trace.
+        self._exchange_layout = {}
+        self._trace_credit = set()
+        # per-query mesh counters behind the EXPLAIN ANALYZE "Mesh:" line
+        self.last_mesh_stats = None
 
     # ---- fragment-by-fragment execution ---------------------------------
     # One XLA program per fragment (not one giant fused program): compile
@@ -61,11 +90,31 @@ class DistExecutor(Executor):
     # and every cut exchange becomes a consumer-side collective over the
     # producer fragment's materialized sharded page (the pull model).
     def execute(self, plan: PlanNode) -> Page:
+        import time
+        budget = self.session["query_max_execution_time"]
+        self._deadline = (time.time() + budget) if budget else None
+        self.last_node_rows = {}
+        self._node_map = {}
         plan = self._resolve_subqueries(plan)
         plan = self._prepare(plan)
+        return self._execute_prepared(plan)
+
+    def _execute_prepared(self, plan: PlanNode) -> Page:
         from presto_tpu.plan.fragment import create_fragments
         frags = create_fragments(plan)
         by_id = {f.fragment_id: f for f in frags}
+        self.last_mesh_stats = {
+            "ndev": self.ndev, "fragments": len(frags),
+            "collectives": 0, "wire_bytes": 0,
+            "overflow_retries": 0, "fragment_compiles": 0}
+        # donation analog for the repartition scratch: a fragment result
+        # is freed as soon as its last consumer converged (the retry
+        # loop re-reads inputs, so true jit donation is unsafe — but a
+        # converged consumer never re-reads its upstream)
+        refs = {}
+        for f in frags:
+            for c in set(f.remote_sources):
+                refs[c] = refs.get(c, 0) + 1
         self._frag_results = {}
         done = set()
 
@@ -74,14 +123,35 @@ class DistExecutor(Executor):
                 return
             for c in by_id[fid].remote_sources:
                 run(c)
+            # stats ids must not collide across fragments: give each
+            # fragment its own id space (the island-mode mechanism)
+            self._stats_base = (fid + 1) << 20
             self._frag_results[fid] = self._execute_tree(by_id[fid].root)
             done.add(fid)
+            for c in set(by_id[fid].remote_sources):
+                refs[c] -= 1
+                if refs[c] == 0 and c != 0:
+                    self._free_page(self._frag_results.pop(c))
 
         try:
             run(0)
             return self._frag_results[0]
         finally:
             self._frag_results = {}
+            self._stats_base = 0
+
+    @staticmethod
+    def _free_page(page: Page) -> None:
+        """Release a dead fragment result's device buffers eagerly
+        instead of waiting for GC (jit outputs — never aliased with
+        connector-cached scan pages, so deletion cannot corrupt them)."""
+        for leaf in jax.tree_util.tree_leaves(page):
+            delete = getattr(leaf, "delete", None)
+            if delete is not None:
+                try:
+                    delete()
+                except Exception:   # noqa: BLE001 — freeing is advisory
+                    pass
 
     def _remote_input(self, node, scans):
         from presto_tpu.exec.executor import RemoteSpec
@@ -167,6 +237,51 @@ class DistExecutor(Executor):
         on0 = jnp.where(jax.lax.axis_index(AXIS) == 0, out.num_rows, 0)
         return Page(out.columns, on0.astype(jnp.int32), out.names)
 
+    # ---- mesh observability --------------------------------------------
+    def _mesh_sink(self, node, kind: str):
+        """Per-dispatch exchange accounting. The packed layout (launch
+        count, wire bytes) is only known at trace time; once recorded it
+        is charged host-side on every later dispatch, and the first
+        dispatch's charge is deferred to its own trace (`_trace_credit`)
+        so retraces after capacity growth never double-count."""
+        key = id(node)
+
+        def sink(layout, key=key, kind=kind):
+            self._exchange_layout[key] = ExchangeLayout(
+                kind, layout.collectives, layout.wire_bytes)
+            if key in self._trace_credit:
+                self._trace_credit.discard(key)
+                self._account_exchange(key)
+        if key in self._exchange_layout:
+            self._account_exchange(key)
+        else:
+            self._trace_credit.add(key)
+        return sink
+
+    def _account_exchange(self, key) -> None:
+        lay = self._exchange_layout[key]
+        _M_MESH_LAUNCHES.inc(lay.collectives, kind=lay.kind)
+        _M_MESH_BYTES.inc(lay.wire_bytes, kind=lay.kind)
+        st = self.last_mesh_stats
+        if st is not None:
+            st["collectives"] += lay.collectives
+            st["wire_bytes"] += lay.wire_bytes
+
+    def _grow_caps(self, pending, needed) -> bool:
+        if self.ndev > 1:
+            caps = pending["caps"]
+            if any(isinstance(k, tuple) and int(n) > caps[k]
+                   for k, n in zip(pending["watch"], needed)):
+                _M_MESH_OVERFLOW.inc()
+                if self.last_mesh_stats is not None:
+                    self.last_mesh_stats["overflow_retries"] += 1
+        return super()._grow_caps(pending, needed)
+
+    def _note_compile(self, plan: PlanNode) -> None:
+        _M_MESH_COMPILES.inc()
+        if self.last_mesh_stats is not None:
+            self.last_mesh_stats["fragment_compiles"] += 1
+
     def _lower_exchange(self, node, nid, src, cap, caps, watch, _needed):
         if self.ndev == 1:
             # exchanges between fragments are identity relabels on one
@@ -178,9 +293,11 @@ class DistExecutor(Executor):
             from presto_tpu.parallel.shuffle import range_partition_ids
             if node.partitioning == Partitioning.HASH:
                 pid_fn = lambda p: partition_ids(p, node.keys, ndev)  # noqa: E731
+                kind = "hash"
             else:
                 pid_fn = lambda p: range_partition_ids(  # noqa: E731
                     p, node.sort_keys[0], ndev)
+                kind = "range"
             out_cap = caps.get((nid, "cap")) or bucket_capacity(2 * cap)
             factor = self.session["exchange_chunk_factor"]
             chunk = caps.get((nid, "chunk")) \
@@ -189,27 +306,34 @@ class DistExecutor(Executor):
             caps[(nid, "chunk")] = chunk
             watch.append((nid, "cap"))
             watch.append((nid, "chunk"))
+            sink = self._mesh_sink(node, kind)
 
-            def repart_fn(pages, node=node, out_cap=out_cap, chunk=chunk):
+            def repart_fn(pages, node=node, out_cap=out_cap, chunk=chunk,
+                          sink=sink):
                 p = src(pages)
                 out, total, max_send = repartition_page(
-                    p, pid_fn(p), ndev, out_cap, chunk)
+                    p, pid_fn(p), ndev, out_cap, chunk,
+                    layout_sink=sink)
                 _needed.append(total)
                 _needed.append(max_send)
                 return Page(out.columns, out.num_rows, node.output_names)
             return repart_fn, out_cap
 
         if node.partitioning == Partitioning.BROADCAST:
-            def bcast_fn(pages, node=node):
+            sink = self._mesh_sink(node, "broadcast")
+
+            def bcast_fn(pages, node=node, sink=sink):
                 p = src(pages)
-                out = all_gather_page(p, ndev)
+                out = all_gather_page(p, ndev, layout_sink=sink)
                 return Page(out.columns, out.num_rows, node.output_names)
             return bcast_fn, ndev * cap
 
         if node.partitioning == Partitioning.SINGLE:
-            def single_fn(pages, node=node):
+            sink = self._mesh_sink(node, "single")
+
+            def single_fn(pages, node=node, sink=sink):
                 p = src(pages)
-                out = all_gather_page(p, ndev)
+                out = all_gather_page(p, ndev, layout_sink=sink)
                 on0 = jnp.where(jax.lax.axis_index(AXIS) == 0,
                                 out.num_rows, 0)
                 return Page(out.columns, on0.astype(jnp.int32),
@@ -217,6 +341,50 @@ class DistExecutor(Executor):
             return single_fn, ndev * cap
 
         raise NotImplementedError(f"exchange {node.partitioning}")
+
+
+class DistSplitExecutor(DistExecutor):
+    """Mesh executor with lifespan splits: the batched driver assigns one
+    (part, num_parts) split of the driving table per lifespan; each mesh
+    device then reads sub-split `part*ndev + d` of `num_parts*ndev`, so a
+    lifespan's working set stays bounded PER DEVICE. This is the
+    composition of exec/lifespan.BatchedRunner's driving-scan streaming
+    with the distributed exchange lowering (grouped execution over
+    lifespans, run on the mesh)."""
+
+    def __init__(self, connector, mesh, session=None, history=None):
+        super().__init__(connector, mesh, session=session,
+                         history=history)
+        self.splits = {}
+
+    def set_splits(self, by_table) -> None:
+        self.splits = by_table
+
+    def _split_tables(self, name):
+        parts = self.splits.get(name)
+        if parts is None:
+            return None
+        (b, n), = parts       # lifespan contract: one split per table
+        return [self.connector.table(name, part=b * self.ndev + d,
+                                     num_parts=n * self.ndev)
+                for d in range(self.ndev)]
+
+    def _scan_rows(self, node) -> int:
+        ts = self._split_tables(node.table)
+        if ts is None:
+            return super()._scan_rows(node)
+        return max(max(t.num_rows for t in ts), 1)
+
+    def _fetch(self, s) -> Page:
+        from presto_tpu.exec.executor import RemoteSpec
+        ts = None
+        if not isinstance(s, RemoteSpec) and hasattr(s, "table"):
+            ts = self._split_tables(s.table)
+        if ts is None:
+            return super()._fetch(s)
+        pages = [t.page(columns=list(s.columns), capacity=s.capacity)
+                 for t in ts]
+        return pages[0] if self.ndev == 1 else stack_pages(pages)
 
 
 class DistEngine:
@@ -239,7 +407,31 @@ class DistEngine:
             self._plans[sql] = self.planner.plan_query(parse_sql(sql))
         return self._plans[sql]
 
+    def explain_sql(self, sql: str) -> str:
+        from presto_tpu.plan.nodes import explain
+        return explain(self.plan_sql(sql))
+
+    def explain_analyze_sql(self, sql: str) -> str:
+        from presto_tpu.exec.stats import explain_analyze
+        return explain_analyze(self, sql)
+
+    @property
+    def session(self):
+        return self.executor.session
+
     def execute_sql(self, sql: str) -> List[tuple]:
+        head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() \
+            else ""
+        if head == "explain":
+            # EXPLAIN [ANALYZE] over the distributed plan — the mesh
+            # analog of LocalEngine's dispatch
+            rest = sql.lstrip()[len("explain"):].lstrip()
+            if rest.lower().startswith("analyze"):
+                text = self.explain_analyze_sql(
+                    rest[len("analyze"):].lstrip())
+            else:
+                text = self.explain_sql(rest)
+            return [(line,) for line in text.splitlines()]
         stacked = self.executor.execute(self.plan_sql(sql))
         rows = self.executor._page_rows(stacked)
         self._record_history()

@@ -161,7 +161,7 @@ class Executor:
         plan = self._prepare(plan)
         if isinstance(plan, TableWriterNode):
             return self._execute_writer(plan)
-        return self._execute_tree(plan)
+        return self._execute_prepared(plan)
 
     def _check_deadline(self):
         import time
@@ -454,6 +454,7 @@ class Executor:
             (repr(salt) + repr(plan)).encode()).hexdigest()[:24]
 
     def _load_caps(self, plan) -> Dict:
+        import ast
         import json
         import os
         path = self._caps_store_path()
@@ -468,7 +469,18 @@ class Executor:
                 # (losing them would re-pay overflow-retry recompiles
                 # through the remote TPU compile service)
                 raw = data.get(self._plan_fingerprint_legacy(plan), {})
-            return {int(k): int(v) for k, v in raw.items()}
+            out = {}
+            for k, v in raw.items():
+                try:
+                    key = int(k)
+                except ValueError:
+                    # exchange capacities are keyed (node_id, "cap"/
+                    # "chunk") — persisted via str(), recovered here
+                    key = ast.literal_eval(k)
+                    if not isinstance(key, tuple):
+                        continue
+                out[key] = int(v)
+            return out
         except Exception:   # noqa: BLE001 — cache is best-effort
             return {}
 
@@ -537,6 +549,7 @@ class Executor:
             # (trace time fixes the node-id order for its lifetime).
             entry = (jax.jit(self._wrap(fn)), scans, watch, [])
             self._compiled[key] = entry
+            self._note_compile(plan)
         fn, scans, watch, stats_box = entry
         pages = [self._fetch(s) for s in scans]
         self._stats_ids = []
@@ -559,12 +572,40 @@ class Executor:
                 grew = True
         return grew
 
+    def _anneal_caps(self, pending, needed) -> None:
+        """Shrink learned capacities back toward the observed need.
+
+        Growth is overflow-driven and monotone, so one oversized first
+        guess (an exchange sized at twice its upstream capacity, a join
+        fanout hint that never materializes) pins every later run to
+        that bucket — and program cost scales with capacity, not rows.
+        Each converged run updates a per-counter peak and re-buckets
+        the cap at peak + 25% headroom; peaks are monotone, so the cap
+        steps down to the true requirement and stays there instead of
+        flip-flopping. An undershoot on later, larger data is always
+        recoverable: every watched counter reports its unclamped need
+        and rides the normal overflow-retry loop."""
+        if not self.session["capacity_annealing_enabled"]:
+            return
+        caps = pending["caps"]
+        peaks = self.__dict__.setdefault("_peak_needs", {}) \
+            .setdefault(pending["plan"], {})
+        for nid, need in zip(pending["watch"], needed):
+            if isinstance(nid, int) and nid < 0:
+                continue    # merge-join duplicate flags, not capacities
+            peak = max(peaks.get(nid, 0), int(need))
+            peaks[nid] = peak
+            tgt = bucket_capacity(max(peak + (peak >> 2), 64))
+            if tgt < caps[nid]:
+                caps[nid] = tgt
+
     def _finish_counters(self, pending, needed) -> None:
         """Converged program: raise checked-arithmetic errors, record
         stats, persist the learned capacities."""
         from presto_tpu.expr import errors as _E
         watch = pending["watch"]
         _E.raise_for_mask(int(needed[len(watch)]))
+        self._anneal_caps(pending, needed)
         stats_box = pending["stats_box"]
         if stats_box:
             stats = needed[len(watch) + 1:]
@@ -601,6 +642,16 @@ class Executor:
     # ---- hooks overridden by the distributed executor ------------------
     def _prepare(self, plan: PlanNode) -> PlanNode:
         return plan
+
+    def _execute_prepared(self, plan: PlanNode) -> Page:
+        """Run an already-prepared plan (the distributed executor splits
+        it into fragments here; EXPLAIN ANALYZE enters through this hook
+        so it measures the real execution shape)."""
+        return self._execute_tree(plan)
+
+    def _note_compile(self, plan: PlanNode) -> None:
+        """A new program was added to the compile cache (mesh executor
+        counts fragment compiles here)."""
 
     def _wrap(self, fn: Callable) -> Callable:
         return fn

@@ -172,14 +172,22 @@ def _dynamic_filter(connector, ex: SplitExecutor, agg_source: PlanNode,
     if join.build.output_types[join.build_keys[0]].is_string:
         return None
     build_page = ex.execute(join.build)
-    key = build_page.columns[join.build_keys[0]]
-    n = int(build_page.num_rows)
-    if n == 0:
+    if getattr(ex, "ndev", 1) > 1:
+        from presto_tpu.parallel.mesh import unstack_page
+        pages = unstack_page(build_page)
+    else:
+        pages = [build_page]
+    parts = []
+    for p in pages:
+        key = p.columns[join.build_keys[0]]
+        n = int(p.num_rows)
+        if n:
+            v = np.asarray(key.values)[:n][~np.asarray(key.nulls)[:n]]
+            if len(v):
+                parts.append(v)
+    if not parts:
         return (col, 0, -1, True)
-    vals, nulls = key.values, key.nulls
-    v = np.asarray(vals)[:n][~np.asarray(nulls)[:n]]
-    if len(v) == 0:
-        return (col, 0, -1, True)
+    v = np.concatenate(parts)
     return (col, v.min(), v.max(), False)
 
 
@@ -275,7 +283,8 @@ class BatchedRunner:
     needs for warm timing, and the worker for repeated tasks."""
 
     def __init__(self, connector, plan: PlanNode, num_batches: int,
-                 memory_limit_bytes: Optional[int] = None, session=None):
+                 memory_limit_bytes: Optional[int] = None, session=None,
+                 mesh=None):
         from presto_tpu.plan.fragment import (
             _UNSPLITTABLE, _partial_agg_layout,
         )
@@ -293,7 +302,13 @@ class BatchedRunner:
             # sketch aggregates have no column-shaped partial state —
             # same rule as the fragmenter's reshard-instead-of-split
             or any(a.kind in _UNSPLITTABLE for a in chain[1].aggs))
-        self.ex = SplitExecutor(connector, session=session)
+        if mesh is not None:
+            # distributed lifespan batching: each lifespan's partial
+            # runs on the device mesh, sub-split per device
+            from presto_tpu.exec.dist_executor import DistSplitExecutor
+            self.ex = DistSplitExecutor(connector, mesh, session=session)
+        else:
+            self.ex = SplitExecutor(connector, session=session)
         self.ex.memory_limit_bytes = memory_limit_bytes
         self.driving = driving
         if not self.batchable:
@@ -315,9 +330,19 @@ class BatchedRunner:
         # (FileSingleStreamSpiller role); empty -> host RAM offload
         self.spill_dir = self.ex.session["spill_path"] or None
 
+    def _host_pages(self, p: Page) -> List[Page]:
+        """A mesh executor returns a stacked sharded page — split it into
+        per-device host pages; single-device pages pass through."""
+        if getattr(self.ex, "ndev", 1) > 1:
+            from presto_tpu.parallel.mesh import unstack_page
+            return unstack_page(p)
+        return [p]
+
     def run(self, stats: Optional[dict] = None) -> Page:
         if not self.batchable:
-            return self.ex.execute(self.plan)
+            out = self.ex.execute(self.plan)
+            pages = self._host_pages(out)
+            return pages[0] if len(pages) == 1 else _concat_pages(pages)
         connector, ex = self.connector, self.ex
         driving, num_batches = self.driving, self.num_batches
         spiller = None
@@ -376,13 +401,13 @@ class BatchedRunner:
                         skipped += 1
                         continue
             ex.set_splits({driving: [(b, num_batches)]})
-            p = ex.execute(self.partial_plan)
-            if self.spill:
-                if spiller is not None:
-                    p = spiller.spill(p)
-                else:
-                    p = _spill_to_host(p)
-            partials.append(p)
+            for p in self._host_pages(ex.execute(self.partial_plan)):
+                if self.spill:
+                    if spiller is not None:
+                        p = spiller.spill(p)
+                    else:
+                        p = _spill_to_host(p)
+                partials.append(p)
         if stats is not None:
             stats.update(batches=num_batches, skipped=skipped)
         if not partials:
@@ -390,7 +415,8 @@ class BatchedRunner:
             # join cannot match, so it yields the correct zero-state
             # partial (global aggregates still emit their count=0 row)
             ex.set_splits({driving: [(0, num_batches)]})
-            partials.append(ex.execute(self.partial_plan))
+            partials.extend(
+                self._host_pages(ex.execute(self.partial_plan)))
 
         if stats is not None and spiller is not None:
             stats.update(spilled_bytes=spiller.total_spilled_bytes,
@@ -424,14 +450,16 @@ class BatchedRunner:
 
 def execute_batched(connector, plan: PlanNode, num_batches: int,
                     memory_limit_bytes: Optional[int] = None,
-                    session=None,
+                    session=None, mesh=None,
                     stats: Optional[dict] = None) -> Page:
     """Execute `plan` streaming the driving scan in `num_batches`
     lifespans. Falls back to single-shot execution when the plan shape
-    does not support batching (no root aggregation). `stats` (if given)
-    records {"batches", "skipped"} — dynamic-filter effectiveness."""
+    does not support batching (no root aggregation). With a `mesh`, each
+    lifespan's partial runs distributed over the device mesh (sub-split
+    per device). `stats` (if given) records {"batches", "skipped"} —
+    dynamic-filter effectiveness."""
     return BatchedRunner(connector, plan, num_batches,
-                         memory_limit_bytes, session).run(stats)
+                         memory_limit_bytes, session, mesh=mesh).run(stats)
 
 
 def execute_bounded(connector, plan: PlanNode,

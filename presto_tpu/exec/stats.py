@@ -44,7 +44,7 @@ def _detail(node) -> str:
 def render_analyzed(plan, node_map: Dict[int, tuple],
                     node_rows: Dict[int, int], wall_s: float,
                     memory_bytes: int, alias: Dict[int, int] = None,
-                    island_profile=None) -> str:
+                    island_profile=None, mesh_stats=None) -> str:
     """Annotate the plan tree with executed row counts + footprints.
     `alias` maps island-copy node identities back to the user-facing
     plan's nodes (island mode rebuilds subtrees with
@@ -82,6 +82,16 @@ def render_analyzed(plan, node_map: Dict[int, tuple],
                 f"   island {i}: {p['root']}  "
                 f"{p['seconds'] * 1000:.1f} ms  rows={p['rows']}  "
                 f"~{p['memory_bytes'] // (1 << 20)} MiB")
+    if mesh_stats:
+        # ICI-mesh analog of the cluster renderer's "Exchange:" line
+        # (server/cluster.py): what the device exchanges actually cost.
+        lines.append(
+            f"Mesh: ndev={mesh_stats['ndev']} "
+            f"fragments={mesh_stats['fragments']} "
+            f"collectives={mesh_stats['collectives']} "
+            f"wire={mesh_stats['wire_bytes'] // 1024} KiB "
+            f"overflow_retries={mesh_stats['overflow_retries']} "
+            f"fragment_compiles={mesh_stats['fragment_compiles']}")
     lines.append(f"-- wall {wall_s * 1000:.1f} ms, "
                  f"plan footprint ~{memory_bytes // (1 << 20)} MiB")
     return "\n".join(lines)
@@ -101,13 +111,16 @@ def explain_analyze(engine, sql: str) -> str:
         t0 = time.perf_counter()
         ex.last_node_rows = {}
         ex._node_map = {}
-        ex._execute_tree(plan)
+        # the hook the distributed executor fragments through, so the
+        # analyzed run measures the real (fragment-wise, mesh) shape
+        ex._execute_prepared(plan)
         wall = time.perf_counter() - t0
         return render_analyzed(
             plan, ex._node_map, ex.last_node_rows, wall,
             ex.last_memory_estimate,
             alias=getattr(ex, "_island_alias", None),
-            island_profile=getattr(ex, "last_island_profile", None))
+            island_profile=getattr(ex, "last_island_profile", None),
+            mesh_stats=getattr(ex, "last_mesh_stats", None))
     finally:
         ex.session.values["collect_stats"] = old
         ex._compiled = compiled

@@ -48,6 +48,70 @@ def partition_ids_cols(cols: Sequence[Column], ndev: int,
     return jnp.where(valid, pid, ndev)
 
 
+class ExchangeLayout:
+    """Host-visible description of one packed exchange, recorded at trace
+    time (shapes/dtypes are static): how many collectives the exchange
+    launches (one per distinct lane dtype) and the static wire-buffer
+    bytes it moves across the mesh per execution. Feeds the mesh metrics
+    (obs) without touching the traced values."""
+
+    __slots__ = ("kind", "collectives", "wire_bytes")
+
+    def __init__(self, kind: str, collectives: int, wire_bytes: int):
+        self.kind = kind
+        self.collectives = collectives
+        self.wire_bytes = wire_bytes
+
+
+def _packed_all_to_all(parts, axis: str, ndev: int, sink=None):
+    """One `lax.all_to_all` per distinct dtype: same-dtype [ndev, w] blocks
+    are concatenated along axis 1, exchanged in a single collective, and
+    sliced back apart. Collapsing the per-lane collectives into per-dtype
+    ones is what keeps the ICI launch count independent of column count.
+    Returns outputs in input order."""
+    groups = {}
+    for i, p in enumerate(parts):
+        groups.setdefault(jnp.dtype(p.dtype), []).append(i)
+    if sink is not None:
+        wire = ndev * sum(int(p.size) * p.dtype.itemsize for p in parts)
+        sink(ExchangeLayout("repartition", len(groups), wire))
+    out = [None] * len(parts)
+    for idxs in groups.values():
+        stacked = (parts[idxs[0]] if len(idxs) == 1 else
+                   jnp.concatenate([parts[i] for i in idxs], axis=1))
+        ex = jax.lax.all_to_all(stacked, axis, split_axis=0, concat_axis=0)
+        off = 0
+        for i in idxs:
+            w = parts[i].shape[1]
+            out[i] = ex[:, off:off + w]
+            off += w
+    return out
+
+
+def _packed_all_gather(parts, axis: str, ndev: int, sink=None):
+    """One `lax.all_gather` per distinct dtype: same-dtype 1-D [w] blocks
+    are concatenated, gathered once into [ndev, sum(w)], and sliced back.
+    Returns [ndev, w] outputs in input order."""
+    groups = {}
+    for i, p in enumerate(parts):
+        groups.setdefault(jnp.dtype(p.dtype), []).append(i)
+    if sink is not None:
+        wire = ndev * ndev * sum(
+            int(p.size) * p.dtype.itemsize for p in parts)
+        sink(ExchangeLayout("broadcast", len(groups), wire))
+    out = [None] * len(parts)
+    for idxs in groups.values():
+        stacked = (parts[idxs[0]] if len(idxs) == 1 else
+                   jnp.concatenate([parts[i] for i in idxs]))
+        g = jax.lax.all_gather(stacked, axis)
+        off = 0
+        for i in idxs:
+            w = parts[i].shape[0]
+            out[i] = g[:, off:off + w]
+            off += w
+    return out
+
+
 def _pack_by_partition(arrs, pid, ndev: int, chunk: int, valid):
     """Scatter rows into per-destination blocks.
 
@@ -77,13 +141,20 @@ def _pack_by_partition(arrs, pid, ndev: int, chunk: int, valid):
 
 def repartition_page(page: Page, pid: jnp.ndarray, ndev: int,
                      out_capacity: int, chunk: Optional[int] = None,
-                     axis: str = AXIS) -> Tuple[Page, jnp.ndarray, jnp.ndarray]:
+                     axis: str = AXIS, layout_sink=None
+                     ) -> Tuple[Page, jnp.ndarray, jnp.ndarray]:
     """All-to-all exchange: each row moves to device pid[row].
 
     Must run inside shard_map over `axis`. Returns
     (local page of received rows with capacity out_capacity,
      needed_recv  — true received total (may exceed out_capacity),
      needed_send  — max rows destined to one peer (may exceed chunk)).
+
+    All lanes of the page ride a single all_to_all per distinct dtype
+    (the per-peer counts travel in the int32 group), so launch count is
+    bounded by the number of dtypes, not the number of columns.
+    `layout_sink`, if given, is called at trace time with the
+    ExchangeLayout describing the packed collectives.
     """
     cap = page.capacity
     if chunk is None:
@@ -100,12 +171,12 @@ def repartition_page(page: Page, pid: jnp.ndarray, ndev: int,
         arrs, pid, ndev, chunk, valid)
 
     # counts[d] = rows we send to d; exchange so recv_counts[j] = rows
-    # device j sent to me.
-    recv_counts = jax.lax.all_to_all(
-        counts.reshape(ndev, 1), axis, split_axis=0, concat_axis=0
-    ).reshape(ndev)
-    recv = [jax.lax.all_to_all(p, axis, split_axis=0, concat_axis=0)
-            for p in packed]
+    # device j sent to me. The [ndev, 1] counts block packs into the
+    # int32 dtype group alongside any int32 column lanes.
+    exchanged = _packed_all_to_all(
+        [counts.reshape(ndev, 1)] + packed, axis, ndev, sink=layout_sink)
+    recv_counts = exchanged[0].reshape(ndev)
+    recv = exchanged[1:]
 
     # Flatten [ndev, chunk] -> [ndev*chunk]; block j's first
     # min(recv_counts[j], chunk) rows are live.
@@ -212,17 +283,33 @@ def range_partition_ids(page: Page, sort_key, ndev: int,
     return jnp.where(valid, pid, ndev)
 
 
-def all_gather_page(page: Page, ndev: int, axis: str = AXIS) -> Page:
+def all_gather_page(page: Page, ndev: int, axis: str = AXIS,
+                    layout_sink=None) -> Page:
     """Replicate all rows of a sharded page onto every device (broadcast
     build side of a join). Output capacity is ndev * local capacity, rows
-    compacted to the front. Must run inside shard_map over `axis`."""
+    compacted to the front. Must run inside shard_map over `axis`.
+
+    Like repartition_page, all lanes travel in one all_gather per
+    distinct dtype; the per-device row counts pack into the int32 group.
+    """
     cap = page.capacity
     flat_cap = ndev * cap
-    nums = jax.lax.all_gather(page.num_rows, axis)        # [ndev]
+
+    arrs = [jnp.reshape(page.num_rows, (1,)).astype(jnp.int32)]
+    lane_counts = []
+    for c in page.columns:
+        lanes = _col_lanes(c)
+        lane_counts.append(len(lanes))
+        arrs.extend(lanes)
+    gathered = _packed_all_gather(arrs, axis, ndev, sink=layout_sink)
+    nums = gathered[0].reshape(ndev)                      # [ndev]
     live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
             < nums[:, None]).reshape(flat_cap)
 
-    flat = [([jax.lax.all_gather(lane, axis).reshape(flat_cap)
-              for lane in _col_lanes(c)], c)
-            for c in page.columns]
+    flat = []
+    pos = 1
+    for c, nl in zip(page.columns, lane_counts):
+        flat.append(([g.reshape(flat_cap)
+                      for g in gathered[pos:pos + nl]], c))
+        pos += nl
     return _compact_flat(flat, live, flat_cap, page.names)

@@ -160,6 +160,14 @@ def add_exchanges(plan: PlanNode, connector=None, session=None,
 
         if isinstance(node, AggregationNode):
             src, prop = visit(node.source)
+            if node.step == Step.PARTIAL:
+                # Already-split partial (distributed lifespan batching
+                # roots its per-lifespan plan at the PARTIAL agg):
+                # partial states are additive, so aggregate
+                # device-locally and let the host-side FINAL merge the
+                # per-device partials — no exchange needed.
+                return (dataclasses.replace(node, source=src),
+                        (Partitioning.SOURCE, ()))
             assert node.step == Step.SINGLE, "re-fragmenting a split agg"
             k = len(node.group_fields)
             if k and hash_satisfied(prop, tuple(node.group_fields),

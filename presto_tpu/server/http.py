@@ -29,6 +29,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.obs.metrics import gauge as _gauge, render_prometheus
 from presto_tpu.protocol import structs as S
 from presto_tpu.server.task_manager import TpuTaskManager

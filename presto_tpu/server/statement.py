@@ -22,6 +22,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.obs.metrics import (
     counter as _counter, gauge as _gauge, render_prometheus,
 )

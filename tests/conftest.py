@@ -21,6 +21,17 @@ if "xla_force_host_platform_device_count" not in flags:
 # re-registers the remote TPU platform and hangs when the tunnel is wedged.
 os.environ["PRESTO_TPU_PLATFORM"] = "cpu"
 
+# Hermetic learned-capacity store: without this, a previous session's
+# grown caps warm-start plans and tests that assert on cold-start
+# behavior (overflow retries, compile counts) become order-dependent.
+# setdefault so a harness that pins its own path wins.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "PRESTO_TPU_CAPS_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="presto_tpu_caps_"),
+                 "caps.json"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

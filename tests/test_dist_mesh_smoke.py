@@ -1,0 +1,82 @@
+"""Tier-1 distributed smoke: a small join+agg runs end-to-end through
+DistEngine on a 2-device mesh on every test run.
+
+The full 22-query distributed suite (test_tpch_full_distributed.py) is
+slow-marked — minutes of 8-way collective compile per query on the CPU
+harness — so before this test a refactor could break the mesh path and
+the smoke tier would stay green. Two devices keep the shard_map compile
+in single-digit seconds while still exercising everything that makes
+the distributed path distributed: sharded scans, a hash-exchange
+co-partitioned join, partial/final aggregation around the exchange,
+packed same-dtype collectives, and the mesh observability surface
+("Mesh:" EXPLAIN ANALYZE line, /v1/metrics counter names).
+"""
+
+import sqlite3
+
+import pytest
+
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.exec.dist_executor import DistEngine
+from presto_tpu.parallel import device_mesh
+from presto_tpu.types import BIGINT, VARCHAR
+
+NDEV = 2
+
+SQL = ("select c.region, count(*), sum(o.amount) "
+       "from orders_t o join customer_t c on o.custkey = c.custkey "
+       "group by c.region order by c.region")
+
+
+def _data():
+    customers = [(i, ["ASIA", "EMEA", "AMER"][i % 3]) for i in range(40)]
+    orders = [(i, (i * 7) % 40, 100 + i) for i in range(500)]
+    return customers, orders
+
+
+@pytest.fixture(scope="module")
+def eng():
+    customers, orders = _data()
+    mem = MemoryConnector()
+    mem.create("customer_t", [("custkey", BIGINT), ("region", VARCHAR)])
+    mem.append_rows("customer_t", customers)
+    mem.create("orders_t", [("okey", BIGINT), ("custkey", BIGINT),
+                            ("amount", BIGINT)])
+    mem.append_rows("orders_t", orders)
+    return DistEngine(mem, device_mesh(NDEV))
+
+
+def test_join_agg_through_dist_engine_matches_oracle(eng):
+    customers, orders = _data()
+    got = eng.execute_sql(SQL)
+
+    db = sqlite3.connect(":memory:")
+    db.execute("create table customer_t (custkey, region)")
+    db.executemany("insert into customer_t values (?, ?)", customers)
+    db.execute("create table orders_t (okey, custkey, amount)")
+    db.executemany("insert into orders_t values (?, ?, ?)", orders)
+    assert got == db.execute(SQL).fetchall()
+
+    stats = eng.executor.last_mesh_stats
+    assert stats["ndev"] == NDEV and stats["fragments"] >= 2
+    assert stats["collectives"] >= 1 and stats["wire_bytes"] > 0
+
+
+def test_explain_analyze_shows_mesh_line(eng):
+    lines = [r[0] for r in eng.execute_sql("explain analyze " + SQL)]
+    mesh = [ln for ln in lines if ln.strip().startswith("Mesh:")]
+    assert len(mesh) == 1, lines
+    assert f"ndev={NDEV}" in mesh[0]
+    assert "collectives=" in mesh[0] and "wire=" in mesh[0]
+
+
+def test_mesh_metrics_registered_and_counting(eng):
+    from presto_tpu.obs.metrics import REGISTRY
+
+    eng.execute_sql(SQL)
+    dump = REGISTRY.render()
+    for name in ("presto_tpu_mesh_exchange_bytes_total",
+                 "presto_tpu_mesh_collective_launches_total",
+                 "presto_tpu_mesh_exchange_overflow_retries_total",
+                 "presto_tpu_mesh_fragment_compiles_total"):
+        assert name in dump, name

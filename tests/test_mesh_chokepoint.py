@@ -1,0 +1,56 @@
+"""Guard: parallel/shuffle.py is the single ICI collective chokepoint.
+
+Every cross-device exchange must ride the page-level helpers
+(`repartition_page` / `all_gather_page`), because that is where the
+packed same-dtype collective layout, the per-peer count lanes, the
+overflow-retry counters, and the ExchangeLayout metric accounting all
+live. A raw `lax.all_to_all` / `lax.all_gather` anywhere else in
+presto_tpu/ silently opts that exchange out of all of it — wire bytes
+vanish from /v1/metrics, skew overflow goes unretried, and the
+one-collective-per-dtype batching stops being true. This test fails
+the build instead (same discipline as tests/test_rpc_chokepoint.py).
+
+Prose mentions of the collectives (module docstrings narrating the
+lowering) are fine: only a real call — `lax.all_to_all(` with the
+paren — or an import of the raw primitive matches.
+"""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "presto_tpu"
+
+#: a real invocation: (jax.)lax.all_to_all( / (jax.)lax.all_gather(
+_CALL = re.compile(r"\blax\s*\.\s*(all_to_all|all_gather)\s*\(")
+#: importing the raw primitive out of jax.lax to call it unqualified
+_FROM_IMPORT = re.compile(
+    r"from\s+jax\s*\.\s*lax\s+import\s+[^\n]*\b(all_to_all|all_gather)\b")
+
+ALLOWED = {PKG / "parallel" / "shuffle.py"}
+
+
+def test_collectives_only_in_shuffle():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path in ALLOWED:
+            continue
+        text = path.read_text()
+        for pat in (_CALL, _FROM_IMPORT):
+            for m in pat.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                offenders.append(f"{path.relative_to(PKG.parent)}:"
+                                 f"{line}: {m.group(0)!r}")
+    assert not offenders, (
+        "raw ICI collective outside parallel/shuffle.py — exchange "
+        "pages via repartition_page/all_gather_page so packed layout, "
+        "overflow retry, and exchange metrics apply:\n"
+        + "\n".join(offenders))
+
+
+def test_shuffle_itself_still_calls_collectives():
+    """The allowlist stays honest: if the shuffle migrates off the lax
+    primitives (e.g. to ragged_all_to_all), update ALLOWED instead of
+    leaving a stale exemption."""
+    text = (PKG / "parallel" / "shuffle.py").read_text()
+    kinds = {m.group(1) for m in _CALL.finditer(text)}
+    assert kinds == {"all_to_all", "all_gather"}
