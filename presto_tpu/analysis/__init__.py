@@ -1,0 +1,65 @@
+"""Engine-aware static analysis + dynamic sanitizers.
+
+`python -m presto_tpu.analysis` runs the full rule set over the
+package and exits nonzero on findings (including unused suppressions).
+See framework.py for the rule/suppression machinery, rules.py for the
+engine rule catalog, locksan.py for the lock-order sanitizer."""
+
+from presto_tpu.analysis.framework import (
+    Finding, Package, Rule, all_rules, analyze, get_rule, register,
+)
+
+__all__ = ["Finding", "Package", "Rule", "all_rules", "analyze",
+           "get_rule", "register", "main"]
+
+
+def main(argv=None) -> int:
+    """CLI entry point (also invoked in-process by the tier-1 test)."""
+    import argparse
+    import json as _json
+    import pathlib
+
+    p = argparse.ArgumentParser(
+        prog="python -m presto_tpu.analysis",
+        description="Run the engine's static-analysis rule set.")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to analyze "
+                        "(default: the installed presto_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name}: {r.description}")
+        return 0
+    if args.rule:
+        rules = [get_rule(name) for name in args.rule]
+
+    if args.paths:
+        files = {}
+        for raw in args.paths:
+            sub = Package.from_path(pathlib.Path(raw))
+            files.update(sub.files)
+        pkg = Package(files)
+    else:
+        pkg = Package.from_path()
+
+    findings = analyze(pkg, rules)
+    if args.as_json:
+        print(_json.dumps({
+            "rules": [r.name for r in rules],
+            "files": len(pkg.files),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"{len(findings)} finding(s) across {len(pkg.files)} "
+              f"file(s), {len(rules)} rule(s)")
+    return 1 if findings else 0

@@ -1,0 +1,5 @@
+import sys
+
+from presto_tpu.analysis import main
+
+sys.exit(main())

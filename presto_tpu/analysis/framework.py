@@ -1,0 +1,274 @@
+"""Engine-aware static analysis framework: rule registry, package
+walker, findings, suppressions.
+
+The engine's architectural invariants (single RPC chokepoint,
+exchange-only page consumption, spool-only task output, shuffle-only
+collectives, thread/lock discipline) used to live in four ad-hoc regex
+tests. This package expresses them as declarative *rules* over one
+shared source index so they compose: `python -m presto_tpu.analysis`
+runs the whole set from the command line (nonzero exit on findings),
+and tests/test_analysis.py runs the same set as a tier-1 gate.
+
+Core objects:
+
+  SourceFile  one parsed file: text, line table, lazy AST
+  Package     the walked file set (a real tree or in-memory sources —
+              the honesty tests plant violations through the latter)
+  Rule        `run(package) -> findings`; registered by name
+  Finding     rule + file:line + message, renderable or JSON
+
+Suppressions: a ``# lint: disable=<rule>[,<rule>...]`` comment at the
+end of a line suppresses findings for those rules on that line; on a
+line of its own it suppresses the following line. Every suppression
+must actually suppress something — unused ones are reported as
+`unused-suppression` findings, so stale exemptions fail the build the
+same way violations do."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: the presto_tpu package root this module ships inside
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation anchored to a file:line."""
+
+    rule: str
+    path: str          # package-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One source file in the index; AST parsed lazily and cached."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath
+        self.text = text
+        self._tree: Optional[ast.Module] = None
+        self._parse_error: Optional[SyntaxError] = None
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> Optional[SyntaxError]:
+        self.tree  # noqa: B018 — force the lazy parse
+        return self._parse_error
+
+    def line_at(self, offset: int) -> int:
+        """1-based line number of a character offset (regex rules)."""
+        return self.text.count("\n", 0, offset) + 1
+
+    def lines(self) -> List[str]:
+        return self.text.splitlines()
+
+
+class Package:
+    """The analyzed file set, keyed by package-relative posix path
+    (e.g. ``presto_tpu/server/http.py``)."""
+
+    def __init__(self, files: Dict[str, SourceFile]):
+        self.files = files
+
+    @classmethod
+    def from_path(cls, root: Optional[pathlib.Path] = None) -> "Package":
+        root = pathlib.Path(root) if root is not None else PKG_ROOT
+        base = root.parent
+        files: Dict[str, SourceFile] = {}
+        paths = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for path in paths:
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(base).as_posix()
+            files[rel] = SourceFile(rel, path.read_text())
+        return cls(files)
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str]) -> "Package":
+        """In-memory package — the honesty tests plant violation
+        snippets here without touching the real tree."""
+        return cls({rel: SourceFile(rel, text)
+                    for rel, text in sources.items()})
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        return self.files.get(relpath)
+
+    def walk(self, prefix: str = "") -> Iterable[SourceFile]:
+        for rel in sorted(self.files):
+            if rel.startswith(prefix):
+                yield self.files[rel]
+
+
+class Rule:
+    """Base rule: subclasses set `name`/`description` and implement
+    `run`. Registration is by module-level `register()` call."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, f: SourceFile, line: int, message: str) -> Finding:
+        return Finding(self.name, f.relpath, line, message)
+
+
+#: name -> rule instance (insertion-ordered: report order is stable)
+_RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if not rule.name:
+        raise ValueError(f"rule {rule!r} has no name")
+    if rule.name in _RULES:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _RULES[rule.name] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    # import for side effect: the engine rule set registers itself
+    from presto_tpu.analysis import rules  # noqa: F401
+    return list(_RULES.values())
+
+
+def get_rule(name: str) -> Rule:
+    all_rules()
+    if name not in _RULES:
+        raise KeyError(
+            f"unknown rule {name!r}; known: {sorted(_RULES)}")
+    return _RULES[name]
+
+
+# ---------------------------------------------------------- suppressions
+@dataclasses.dataclass
+class Suppression:
+    path: str
+    line: int            # the line whose findings it suppresses
+    comment_line: int    # where the comment itself sits (for reporting)
+    rules: frozenset
+    used: bool = False
+
+
+def collect_suppressions(pkg: Package) -> List[Suppression]:
+    out: List[Suppression] = []
+    for f in pkg.walk():
+        for i, line in enumerate(f.lines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            names = frozenset(
+                s.strip() for s in m.group(1).split(",") if s.strip())
+            # a comment-only line shields the NEXT line; a trailing
+            # comment shields its own
+            target = i + 1 if line.strip().startswith("#") else i
+            out.append(Suppression(f.relpath, target, i, names))
+    return out
+
+
+# --------------------------------------------------------------- analyze
+def analyze(pkg: Package,
+            rules: Optional[Sequence[Rule]] = None
+            ) -> List[Finding]:
+    """Run rules over the package, apply suppressions, report unused
+    suppressions and unparseable files. The returned list is the
+    complete verdict: empty == clean."""
+    rules = list(rules) if rules is not None else all_rules()
+    raw: List[Finding] = []
+    for f in pkg.walk():
+        if f.parse_error is not None:
+            raw.append(Finding(
+                "parse-error", f.relpath,
+                f.parse_error.lineno or 1,
+                f"file does not parse: {f.parse_error.msg}"))
+    for rule in rules:
+        raw.extend(rule.run(pkg))
+
+    sups = collect_suppressions(pkg)
+    by_site: Dict[Tuple[str, int], List[Suppression]] = {}
+    for s in sups:
+        by_site.setdefault((s.path, s.line), []).append(s)
+
+    kept: List[Finding] = []
+    for fd in raw:
+        suppressed = False
+        for s in by_site.get((fd.path, fd.line), ()):
+            if fd.rule in s.rules:
+                s.used = True
+                suppressed = True
+        if not suppressed:
+            kept.append(fd)
+    for s in sups:
+        if not s.used:
+            kept.append(Finding(
+                "unused-suppression", s.path, s.comment_line,
+                f"suppression for {', '.join(sorted(s.rules))} never "
+                f"matched a finding — remove it or fix the rule name"))
+    return sorted(kept, key=lambda f: (f.path, f.line, f.rule))
+
+
+# ------------------------------------------------------- shared helpers
+def regex_findings(rule: Rule, pkg: Package,
+                   patterns: Sequence[re.Pattern],
+                   message: str,
+                   allowed: Sequence[str] = (),
+                   prefixes: Sequence[str] = ("presto_tpu/",)
+                   ) -> List[Finding]:
+    """Scan every file under `prefixes` (minus `allowed`) for any of
+    `patterns`; one finding per match, message suffixed with the
+    matched text."""
+    out: List[Finding] = []
+    allowed_set = set(allowed)
+    for f in pkg.walk():
+        if f.relpath in allowed_set:
+            continue
+        if not any(f.relpath.startswith(p) for p in prefixes):
+            continue
+        for pat in patterns:
+            for m in pat.finditer(f.text):
+                out.append(rule.finding(
+                    f, f.line_at(m.start()),
+                    f"{message} (matched {m.group(0)!r})"))
+    return out
+
+
+def honesty_finding(rule: Rule, pkg: Package, relpath: str,
+                    patterns: Sequence[re.Pattern],
+                    what: str) -> List[Finding]:
+    """Allowlist-honesty check: the exempted file must itself still
+    match the policed patterns, else the rule has gone vacuous (the
+    implementation moved and the exemption is stale)."""
+    f = pkg.get(relpath)
+    if f is None:
+        return [Finding(rule.name, relpath, 1,
+                        f"allowlisted file is missing — {what} moved? "
+                        f"update the rule's allowlist")]
+    if not any(p.search(f.text) for p in patterns):
+        return [Finding(rule.name, relpath, 1,
+                        f"allowlist gone vacuous: this file no longer "
+                        f"matches the patterns the rule polices — "
+                        f"{what} moved? update the rule")]
+    return []

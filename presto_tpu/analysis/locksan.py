@@ -1,0 +1,451 @@
+"""Dynamic lock-order sanitizer: TSan-style deadlock-potential
+detection for the whole engine.
+
+The engine is genuinely concurrent — exchange fetcher threads, per-task
+worker threads, heartbeat/announcer loops, breaker state machines — and
+a deadlock needs only two locks acquired in opposite orders by two
+threads that never actually collide in a test run. This module catches
+the *potential*: instrumented Lock/RLock/Condition wrappers record, per
+thread, which locks are held when another is acquired, accumulate those
+observations into one global lock-ORDER graph keyed by allocation site,
+and report any cycle in that graph — the classic ABBA pattern — even
+though no run ever deadlocked.
+
+Two ways to use it:
+
+  - `LockSanitizer()` + `san.lock()/rlock()/condition()` builds
+    instrumented primitives against a private graph (the honesty tests
+    drive a deliberate ABBA fixture through this).
+  - `install()` monkeypatches `threading.Lock/RLock/Condition` so every
+    lock subsequently allocated *from repo code* is instrumented
+    against the process-global sanitizer; tests/conftest.py does this
+    for the whole tier-1 suite and fails the session on any cycle.
+    Locks allocated by stdlib/third-party code pass through raw — the
+    graph stays ours.
+
+While active, every tracked release observes the hold duration into the
+`presto_tpu_lock_hold_seconds` histogram (labeled by lock name) in the
+process metrics registry, so contended locks surface in /v1/metrics
+next to everything else."""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+#: bind the real factories at import time — installation rebinds the
+#: threading module attributes, never these
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+#: hold-duration buckets: spin-length holds up to pathological seconds
+_HOLD_BUCKETS = (0.000_01, 0.000_1, 0.001, 0.01, 0.1, 0.5, 2.0, 10.0)
+
+#: allocation sites never instrumented even inside the repo: the
+#: metrics registry's own locks guard the histogram this module
+#: observes into (instrumenting them would recurse), and this module's
+#: internals must not watch themselves
+_SITE_BLOCKLIST = (os.path.join("obs", "metrics.py"),
+                   os.path.join("analysis", "locksan.py"))
+
+
+class LockOrderError(RuntimeError):
+    """Raised by assert_no_cycles when the order graph has a cycle."""
+
+
+class LockSanitizer:
+    """The order graph + per-thread held-lock accounting."""
+
+    def __init__(self):
+        # raw mutex: the sanitizer must never route through wrappers
+        self._mutex = _thread.allocate_lock()
+        self._tls = threading.local()
+        #: (held_site, acquired_site) -> one example stack pair
+        self._edges: Dict[Tuple[str, str], str] = {}
+        #: sites observed nesting with a *different instance* of the
+        #: same site (diagnostic only: a length-1 site cycle needs two
+        #: threads nesting opposite instances to deadlock)
+        self.same_site_nesting: set = set()
+        self.tracked_locks = 0
+        self._hold_hist = None
+
+    # -------------------------------------------------- wrapper factories
+    def lock(self, name: Optional[str] = None) -> "_SanLock":
+        with self._mutex:
+            self.tracked_locks += 1
+        return _SanLock(self, _REAL_LOCK(), name or _caller_site())
+
+    def rlock(self, name: Optional[str] = None) -> "_SanRLock":
+        with self._mutex:
+            self.tracked_locks += 1
+        return _SanRLock(self, _REAL_RLOCK(), name or _caller_site())
+
+    def condition(self, name: Optional[str] = None,
+                  lock=None) -> threading.Condition:
+        """A real Condition over an instrumented RLock: wait/notify
+        semantics are stdlib's, every acquire/release is accounted."""
+        return _REAL_CONDITION(
+            lock if lock is not None
+            else self.rlock(name or _caller_site()))
+
+    # ------------------------------------------------------- accounting
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def _in_hook(self) -> bool:
+        return getattr(self._tls, "in_hook", False)
+
+    def before_acquire(self, lock: "_SanLock") -> None:
+        if self._in_hook():
+            return
+        held = self._held()
+        for h in held:
+            if h is lock:
+                return               # reentrant — not an ordering fact
+        for h in held:
+            if h.name == lock.name:
+                with self._mutex:
+                    self.same_site_nesting.add(lock.name)
+                continue
+            edge = (h.name, lock.name)
+            if edge not in self._edges:       # racy pre-check is fine
+                example = "".join(traceback.format_stack(
+                    sys._getframe(2), limit=6))
+                with self._mutex:
+                    self._edges.setdefault(edge, example)
+
+    def after_acquire(self, lock: "_SanLock") -> None:
+        if not self._in_hook():
+            self._held().append(lock)
+
+    def after_release(self, lock: "_SanLock",
+                      t0: Optional[float]) -> None:
+        if self._in_hook():
+            return
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+        if t0 is not None:
+            self._observe_hold(lock.name, time.perf_counter() - t0)
+
+    def _observe_hold(self, name: str, dt: float) -> None:
+        self._tls.in_hook = True
+        try:
+            hist = self._hold_hist
+            if hist is None:
+                from presto_tpu.obs.metrics import histogram
+                hist = self._hold_hist = histogram(
+                    "presto_tpu_lock_hold_seconds",
+                    "Lock hold duration by lock allocation site "
+                    "(present while the lock sanitizer is active)",
+                    ("lock",), buckets=_HOLD_BUCKETS)
+            hist.observe(dt, lock=name)
+        except Exception:   # noqa: BLE001 — interpreter teardown etc.
+            pass
+        finally:
+            self._tls.in_hook = False
+
+    # ---------------------------------------------------------- verdicts
+    def edges(self) -> Dict[Tuple[str, str], str]:
+        with self._mutex:
+            return dict(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the site-order graph (Tarjan SCCs;
+        within each nontrivial SCC one representative cycle is walked
+        out). Empty list == no deadlock potential observed."""
+        edges = self.edges()
+        graph: Dict[str, set] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        sccs = _tarjan(graph)
+        out: List[List[str]] = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            # walk one cycle inside the component for the report
+            start = comp[0]
+            path, seen = [start], {start}
+            node = start
+            while True:
+                nxt = next(n for n in sorted(graph[node])
+                           if n in comp_set)
+                if nxt in seen:
+                    out.append(path[path.index(nxt):])
+                    break
+                path.append(nxt)
+                seen.add(nxt)
+                node = nxt
+        return out
+
+    def report(self) -> str:
+        edges = self.edges()
+        cycles = self.cycles()
+        lines = [f"lock-order sanitizer: {self.tracked_locks} tracked "
+                 f"locks, {len(edges)} order edges, "
+                 f"{len(cycles)} cycle(s)"]
+        for cyc in cycles:
+            ring = " -> ".join(cyc + [cyc[0]])
+            lines.append(f"  CYCLE: {ring}")
+            for a, b in zip(cyc, cyc[1:] + [cyc[0]]):
+                ex = edges.get((a, b), "")
+                lines.append(f"    edge {a} -> {b} first seen at:")
+                lines.extend("      " + ln
+                             for ln in ex.rstrip().splitlines())
+        if self.same_site_nesting:
+            lines.append(
+                "  note: same-site instance nesting (deadlocks only "
+                "if two threads nest opposite instances): "
+                + ", ".join(sorted(self.same_site_nesting)))
+        return "\n".join(lines)
+
+    def assert_no_cycles(self) -> None:
+        if self.cycles():
+            raise LockOrderError(self.report())
+
+
+def _tarjan(graph: Dict[str, set]) -> List[List[str]]:
+    """Iterative Tarjan strongly-connected components."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: set = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for child in it:
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack.add(child)
+                    work.append((child, iter(sorted(graph[child]))))
+                    advanced = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+    return sccs
+
+
+# ------------------------------------------------------------- wrappers
+class _SanLock:
+    """Instrumented non-reentrant lock: full Lock protocol, every
+    transition accounted against the owning sanitizer."""
+
+    def __init__(self, san: LockSanitizer, inner, name: str):
+        self._san = san
+        self._inner = inner
+        self.name = name
+        self._t0: Optional[float] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._san.before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._t0 = time.perf_counter()
+            self._san.after_acquire(self)
+        return got
+
+    def release(self) -> None:
+        t0, self._t0 = self._t0, None
+        self._inner.release()
+        self._san.after_release(self, t0)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return (f"<{type(self).__name__} {self.name} "
+                f"wrapping {self._inner!r}>")
+
+
+class _SanRLock(_SanLock):
+    """Instrumented reentrant lock, including the Condition protocol
+    (_release_save/_acquire_restore/_is_owned) so a Condition built
+    over it keeps the accounting exact across wait()."""
+
+    def __init__(self, san: LockSanitizer, inner, name: str):
+        super().__init__(san, inner, name)
+        self._count = 0          # owner-only mutation: no race
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if self._inner._is_owned():
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                self._count += 1
+            return got
+        self._san.before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._count = 1
+            self._t0 = time.perf_counter()
+            self._san.after_acquire(self)
+        return got
+
+    def release(self) -> None:
+        if self._count > 1:
+            self._inner.release()
+            self._count -= 1
+            return
+        t0, self._t0 = self._t0, None
+        self._count = 0
+        self._inner.release()
+        self._san.after_release(self, t0)
+
+    # Condition protocol --------------------------------------------------
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        t0, self._t0 = self._t0, None
+        count, self._count = self._count, 0
+        state = self._inner._release_save()
+        self._san.after_release(self, t0)
+        return (state, count)
+
+    def _acquire_restore(self, saved) -> None:
+        state, count = saved
+        self._san.before_acquire(self)
+        self._inner._acquire_restore(state)
+        self._count = count
+        self._t0 = time.perf_counter()
+        self._san.after_acquire(self)
+
+
+# ---------------------------------------------------- global installation
+#: repo root: locks allocated from files under here are instrumented
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+_active: Optional[LockSanitizer] = None
+
+
+def _caller_site() -> str:
+    """repo-relative file:line of the nearest frame outside this
+    module and threading.py — the lock's allocation site, which is the
+    graph node (all instances from one site share ordering facts)."""
+    f = sys._getframe(1)
+    here = os.path.abspath(__file__)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != here and "threading" not in \
+                os.path.basename(fn):
+            rel = os.path.relpath(fn, _REPO_ROOT)
+            return f"{rel}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>:0"
+
+
+def _track_site() -> Optional[str]:
+    """The allocation site if it should be instrumented (repo code,
+    not blocklisted), else None for raw pass-through."""
+    f = sys._getframe(2)
+    here = os.path.abspath(__file__)
+    while f is not None:
+        fn = os.path.abspath(f.f_code.co_filename)
+        if fn != here and os.path.basename(
+                f.f_code.co_filename) != "threading.py":
+            if not fn.startswith(_REPO_ROOT + os.sep):
+                return None
+            for blocked in _SITE_BLOCKLIST:
+                if fn.endswith(blocked):
+                    return None
+            return f"{os.path.relpath(fn, _REPO_ROOT)}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+def _patched_lock():
+    site = _track_site()
+    if _active is None or site is None:
+        return _REAL_LOCK()
+    return _active.lock(site)
+
+
+def _patched_rlock():
+    site = _track_site()
+    if _active is None or site is None:
+        return _REAL_RLOCK()
+    return _active.rlock(site)
+
+
+def _patched_condition(lock=None):
+    if lock is not None:
+        return _REAL_CONDITION(lock)
+    site = _track_site()
+    if _active is None or site is None:
+        return _REAL_CONDITION()
+    return _active.condition(site)
+
+
+def install(san: Optional[LockSanitizer] = None) -> LockSanitizer:
+    """Activate the global sanitizer: every threading.Lock/RLock/
+    Condition subsequently allocated from repo code is instrumented.
+    Idempotent; returns the active sanitizer."""
+    global _active
+    if _active is not None:
+        return _active
+    _active = san or LockSanitizer()
+    threading.Lock = _patched_lock
+    threading.RLock = _patched_rlock
+    threading.Condition = _patched_condition
+    return _active
+
+
+def uninstall() -> None:
+    """Restore the real factories. Locks already created stay
+    instrumented (they hold their own sanitizer reference)."""
+    global _active
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    _active = None
+
+
+def active() -> Optional[LockSanitizer]:
+    return _active

@@ -1,0 +1,551 @@
+"""The engine rule set: every architectural invariant as one
+declarative rule.
+
+Four of these re-express the ad-hoc chokepoint guards that used to be
+standalone regex tests (rpc/exchange/spool/mesh); the rest are the
+concurrency-discipline rules the threaded engine grew to need. Each
+rule carries its own allowlist-honesty check where applicable: if the
+exempted implementation file stops matching the policed idiom, the
+rule reports itself vacuous instead of silently passing forever.
+
+Regex rules scan raw text (docstrings included — prose must not spell
+the policed idiom with a literal call paren); AST rules skip strings
+by construction."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from presto_tpu.analysis.framework import (
+    Finding, Package, Rule, SourceFile, honesty_finding, regex_findings,
+    register,
+)
+
+# =====================================================================
+# 1. rpc-chokepoint — protocol/transport.py is the only urlopen site
+# =====================================================================
+
+_URLOPEN_DIRECT = re.compile(r"urllib\s*\.\s*request\s*\.\s*urlopen")
+_URLOPEN_IMPORT = re.compile(
+    r"from\s+urllib\s*\.\s*request\s+import\s+[^\n]*\burlopen\b")
+
+_TRANSPORT = "presto_tpu/protocol/transport.py"
+
+
+class RpcChokepointRule(Rule):
+    name = "rpc-chokepoint"
+    description = (
+        "every HTTP request rides protocol/transport.HttpClient so "
+        "retry policies, error classification, circuit breakers and "
+        "fault injection apply uniformly; a raw urlopen anywhere else "
+        "opts that call site out of all of it")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_URLOPEN_DIRECT, _URLOPEN_IMPORT),
+            "raw urlopen outside protocol/transport.py — route this "
+            "through transport.HttpClient",
+            allowed=(_TRANSPORT,))
+        out.extend(honesty_finding(
+            self, pkg, _TRANSPORT, (_URLOPEN_DIRECT,),
+            "the urlopen transport"))
+        return out
+
+
+register(RpcChokepointRule())
+
+# =====================================================================
+# 2. exchange-chokepoint — exchange.py/exchange_client.py are the only
+#    consumers of /results/ page GETs
+# =====================================================================
+
+#: an f-string literal interpolating into a /results/ path = building a
+#: results GET/DELETE url client-side (the server's route regexes use
+#: groups, not interpolation, so they never match)
+_RESULTS_URL = re.compile(r"""f["'][^"'\n]*/results/\{""")
+_PAGESTREAM = re.compile(r"\bPageStream\s*\(")
+
+_EXCHANGE_ALLOWED = ("presto_tpu/protocol/exchange.py",
+                     "presto_tpu/protocol/exchange_client.py")
+
+
+class ExchangeChokepointRule(Rule):
+    name = "exchange-chokepoint"
+    description = (
+        "only protocol/exchange.py + exchange_client.py may consume "
+        "/results/ page streams; any other consumer bypasses the "
+        "bounded exchange buffer, truncation-before-ack validation "
+        "and the spool fallback")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_RESULTS_URL, _PAGESTREAM),
+            "page-protocol consumption outside protocol/exchange*.py — "
+            "route through exchange.ExchangeClient/stream_pages",
+            allowed=_EXCHANGE_ALLOWED)
+        out.extend(honesty_finding(
+            self, pkg, "presto_tpu/protocol/exchange_client.py",
+            (_RESULTS_URL,), "results-url construction"))
+        out.extend(honesty_finding(
+            self, pkg, "presto_tpu/protocol/exchange.py",
+            (_PAGESTREAM,), "PageStream construction"))
+        return out
+
+
+register(ExchangeChokepointRule())
+
+# =====================================================================
+# 3. spool-chokepoint — spool/ is the single task-output file writer
+#    in the distributed-execution layers (server/, protocol/)
+# =====================================================================
+
+_WRITE_PATTERNS = (
+    re.compile(r"""open\s*\([^)\n]*,\s*["'][wax]b?\+?["']"""),
+    re.compile(r"tempfile\s*\.\s*(mkstemp|mkdtemp|NamedTemporaryFile|"
+               r"TemporaryFile|TemporaryDirectory)"),
+    re.compile(r"from\s+tempfile\s+import\b"),
+    re.compile(r"os\s*\.\s*(open|mkstemp)\s*\("),
+)
+
+
+class SpoolChokepointRule(Rule):
+    name = "spool-chokepoint"
+    description = (
+        "task output in server/ and protocol/ must go through "
+        "presto_tpu/spool (TaskSpoolWriter/FrameFile) so atomic "
+        "commit manifests, checksums and GC cover every byte; exec/ "
+        "keeps its own node-local spill files and is out of scope")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, _WRITE_PATTERNS,
+            "file-writing call site in a distributed-execution layer — "
+            "task output must ride presto_tpu/spool",
+            prefixes=("presto_tpu/server/", "presto_tpu/protocol/"))
+        # honesty: the spool package must itself still match the write
+        # idioms this rule polices
+        spool = [f for f in pkg.walk("presto_tpu/spool/")]
+        if spool and not any(
+                p.search(f.text) for f in spool for p in _WRITE_PATTERNS):
+            out.append(Finding(
+                self.name, "presto_tpu/spool/files.py", 1,
+                "presto_tpu/spool no longer matches the write patterns "
+                "this rule scans for — update the rule's patterns"))
+        return out
+
+
+register(SpoolChokepointRule())
+
+# =====================================================================
+# 4. mesh-chokepoint — parallel/shuffle.py is the single ICI
+#    collective call site
+# =====================================================================
+
+_COLLECTIVE_CALL = re.compile(
+    r"\blax\s*\.\s*(all_to_all|all_gather)\s*\(")
+_COLLECTIVE_IMPORT = re.compile(
+    r"from\s+jax\s*\.\s*lax\s+import\s+[^\n]*\b(all_to_all|all_gather)\b")
+
+_SHUFFLE = "presto_tpu/parallel/shuffle.py"
+
+
+class MeshChokepointRule(Rule):
+    name = "mesh-chokepoint"
+    description = (
+        "every cross-device exchange rides parallel/shuffle.py's "
+        "page-level helpers (repartition_page/all_gather_page) — the "
+        "packed same-dtype layout, overflow-retry counters and wire-"
+        "byte metrics all live there")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_COLLECTIVE_CALL, _COLLECTIVE_IMPORT),
+            "raw ICI collective outside parallel/shuffle.py — exchange "
+            "pages via repartition_page/all_gather_page",
+            allowed=(_SHUFFLE,))
+        shuffle = pkg.get(_SHUFFLE)
+        if shuffle is None:
+            out.append(Finding(
+                self.name, _SHUFFLE, 1,
+                "allowlisted file is missing — the collective "
+                "chokepoint moved? update the rule"))
+        else:
+            kinds = {m.group(1)
+                     for m in _COLLECTIVE_CALL.finditer(shuffle.text)}
+            if kinds != {"all_to_all", "all_gather"}:
+                out.append(Finding(
+                    self.name, _SHUFFLE, 1,
+                    f"allowlist gone vacuous: shuffle.py calls "
+                    f"{sorted(kinds) or 'no collectives'}, expected "
+                    f"both all_to_all and all_gather — update the rule"))
+        return out
+
+
+register(MeshChokepointRule())
+
+# =====================================================================
+# 5. metric-name-grammar — every registered metric name is Prometheus-
+#    valid and registered from exactly one call site
+# =====================================================================
+
+#: registration call with a literal first argument — matches the bare
+#: helpers, aliased imports (_counter, _obs_gauge, ...) and registry
+#: methods (REGISTRY.counter)
+_METRIC_CALL = re.compile(
+    r"\b[A-Za-z_.]*(?:counter|gauge|histogram)\s*\(\s*[\"']"
+    r"([^\"']+)[\"']")
+
+#: the registry module itself holds class definitions and docstring
+#: examples, not registrations
+_METRIC_EXCLUDED = ("presto_tpu/obs/metrics.py",)
+
+
+class MetricNameRule(Rule):
+    name = "metric-name-grammar"
+    description = (
+        "every metric name registered anywhere in the package must "
+        "match the Prometheus grammar and appear at exactly one call "
+        "site — an invalid name corrupts /v1/metrics at scrape time, "
+        "a duplicate aliases two meanings onto one series")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        from presto_tpu.obs.metrics import METRIC_NAME_RE
+        sites: Dict[str, List[Tuple[SourceFile, int]]] = {}
+        for f in pkg.walk("presto_tpu/"):
+            if f.relpath in _METRIC_EXCLUDED:
+                continue
+            for m in _METRIC_CALL.finditer(f.text):
+                sites.setdefault(m.group(1), []).append(
+                    (f, f.line_at(m.start())))
+        out: List[Finding] = []
+        for mname, where in sorted(sites.items()):
+            if not METRIC_NAME_RE.match(mname):
+                for f, line in where:
+                    out.append(self.finding(
+                        f, line,
+                        f"invalid Prometheus metric name {mname!r}"))
+            if len(where) > 1:
+                locs = ", ".join(f"{f.relpath}:{ln}" for f, ln in where)
+                f, line = where[1]
+                out.append(self.finding(
+                    f, line,
+                    f"metric {mname!r} registered from {len(where)} "
+                    f"call sites ({locs}) — move it to one module-"
+                    f"level registration"))
+        return out
+
+
+register(MetricNameRule())
+
+# =====================================================================
+# 6. thread-discipline — every spawned thread is attributable
+# =====================================================================
+
+#: the one sanctioned spawn helper (names presto-tpu-<role>-<purpose>)
+_THREADS_HELPER = "presto_tpu/utils/threads.py"
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread" \
+            and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading":
+        return True
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+class ThreadDisciplineRule(Rule):
+    name = "thread-discipline"
+    description = (
+        "every threading.Thread must be constructed with both name= "
+        "and daemon= (or spawned via utils/threads.spawn, which names "
+        "it presto-tpu-<role>-<purpose>) so stuck-thread dumps are "
+        "attributable and shutdown behavior is uniform")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for f in pkg.walk("presto_tpu/"):
+            if f.relpath == _THREADS_HELPER or f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call)
+                        and _is_thread_ctor(node)):
+                    continue
+                kw = {k.arg for k in node.keywords}
+                missing = [k for k in ("name", "daemon") if k not in kw]
+                if missing:
+                    out.append(self.finding(
+                        f, node.lineno,
+                        f"thread spawned without {'/'.join(missing)} — "
+                        f"use presto_tpu.utils.threads.spawn (names it "
+                        f"presto-tpu-<role>-<purpose>) or pass both"))
+        return out
+
+
+register(ThreadDisciplineRule())
+
+# =====================================================================
+# 7. no-blocking-under-lock — no sleeps / transport calls / thread
+#    joins lexically inside a `with <lock>:` body
+# =====================================================================
+
+#: a with-item whose terminal name segment looks like a mutex or
+#: condition variable
+_LOCKISH = re.compile(
+    r"(?i)(?:^|_)(?:lock|mutex|cond|condition)$|lock$|^state_change$")
+
+#: method names that issue a network RPC (the transport chokepoint's
+#: public surface + the announcer's one-shot)
+_RPC_METHODS = {"request", "get_json", "post", "urlopen",
+                "announce_once"}
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _lockish(expr: ast.AST) -> bool:
+    n = _terminal_name(expr)
+    return n is not None and bool(_LOCKISH.search(n))
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """`x.join()` / `x.join(5)` / `x.join(timeout=...)` — a string
+    join always takes a non-numeric positional iterable, so those
+    shapes are thread (or process) joins."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "join"):
+        return False
+    if call.keywords:
+        return all(k.arg == "timeout" for k in call.keywords) \
+            and not call.args
+    if not call.args:
+        return True
+    return len(call.args) == 1 \
+        and isinstance(call.args[0], ast.Constant) \
+        and isinstance(call.args[0].value, (int, float))
+
+
+def _blocking_reason(call: ast.Call,
+                     lock_expr: ast.AST) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            return "time.sleep under a lock"
+        if fn.attr in _RPC_METHODS:
+            return f".{fn.attr}() RPC under a lock"
+        if fn.attr == "wait" \
+                and ast.dump(fn.value) != ast.dump(lock_expr):
+            return (".wait() on a different object than the held "
+                    "lock (a condition wait only releases its own "
+                    "lock)")
+    if _is_thread_join(call):
+        return ".join() under a lock"
+    return None
+
+
+class _UnderLockVisitor(ast.NodeVisitor):
+    """Walk a with-body without descending into nested function or
+    lambda bodies — those run later, not under the lock."""
+
+    def __init__(self, rule: Rule, f: SourceFile, lock_expr: ast.AST,
+                 out: List[Finding]):
+        self.rule, self.f = rule, f
+        self.lock_expr, self.out = lock_expr, out
+
+    def visit_FunctionDef(self, node):   # noqa: N802 — ast API
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):          # noqa: N802 — ast API
+        reason = _blocking_reason(node, self.lock_expr)
+        if reason is not None:
+            self.out.append(self.rule.finding(
+                self.f, node.lineno,
+                f"{reason} — hoist it out of the `with "
+                f"{_terminal_name(self.lock_expr)}:` body"))
+        self.generic_visit(node)
+
+
+class NoBlockingUnderLockRule(Rule):
+    name = "no-blocking-under-lock"
+    description = (
+        "no time.sleep, transport RPC, thread join, or foreign .wait "
+        "lexically inside a `with <lock>:` body — a blocked holder "
+        "stalls every other thread contending the lock (the exchange "
+        "fetchers and breaker paths are exactly where this bites)")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for f in pkg.walk("presto_tpu/"):
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in node.items:
+                    if not _lockish(item.context_expr):
+                        continue
+                    v = _UnderLockVisitor(self, f, item.context_expr,
+                                          out)
+                    for stmt in node.body:
+                        v.visit(stmt)
+        return out
+
+
+register(NoBlockingUnderLockRule())
+
+# =====================================================================
+# 8. lock-leak — bare .acquire() without with/try-finally
+# =====================================================================
+
+#: receivers the leak rule covers: locks, conditions, semaphores
+_ACQUIRABLE = re.compile(
+    r"(?i)(?:^|_)(?:lock|mutex|cond|condition|sem|semaphore|permits?)s?$"
+    r"|lock$")
+
+
+def _release_targets(try_node: ast.Try) -> List[str]:
+    out = []
+    for stmt in try_node.finalbody:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                out.append(ast.dump(node.func.value))
+    return out
+
+
+def _trailing_acquires(stmt: ast.stmt) -> List[ast.Call]:
+    """Acquire calls a following try/finally can cover: a bare
+    acquire expression statement, or — the guarded-acquire idiom —
+    an acquire as the LAST statement of an if/else branch whose
+    matching release in the try's finally carries the same guard."""
+    if isinstance(stmt, ast.Expr) \
+            and isinstance(stmt.value, ast.Call) \
+            and isinstance(stmt.value.func, ast.Attribute) \
+            and stmt.value.func.attr == "acquire":
+        return [stmt.value]
+    if isinstance(stmt, ast.If):
+        out = []
+        for branch in (stmt.body, stmt.orelse):
+            if branch:
+                out.extend(_trailing_acquires(branch[-1]))
+        return out
+    return []
+
+
+class LockLeakRule(Rule):
+    name = "lock-leak"
+    description = (
+        "a bare lock/semaphore .acquire() must be immediately followed "
+        "by try/finally that releases the same object (or use `with`) "
+        "— any exception between acquire and release leaks the lock "
+        "and wedges every future contender")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for f in pkg.walk("presto_tpu/"):
+            if f.tree is None:
+                continue
+            safe: set = set()
+            # pass 1: expression-statement acquire immediately followed
+            # by a try whose finally releases the same receiver
+            for node in ast.walk(f.tree):
+                for body in (getattr(node, "body", None),
+                             getattr(node, "orelse", None),
+                             getattr(node, "finalbody", None)):
+                    if not isinstance(body, list):
+                        continue
+                    for i, stmt in enumerate(body):
+                        for call in _trailing_acquires(stmt):
+                            if i + 1 < len(body) \
+                                    and isinstance(body[i + 1], ast.Try) \
+                                    and ast.dump(call.func.value) in \
+                                    _release_targets(body[i + 1]):
+                                safe.add(id(call))
+            # pass 2: flag every uncovered acquire on a lock-like
+            # receiver
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "acquire"):
+                    continue
+                rname = _terminal_name(node.func.value)
+                if rname is None or not _ACQUIRABLE.search(rname):
+                    continue
+                if id(node) not in safe:
+                    out.append(self.finding(
+                        f, node.lineno,
+                        f"bare {rname}.acquire() without an immediate "
+                        f"try/finally release — use `with {rname}:` or "
+                        f"follow with try/finally"))
+        return out
+
+
+register(LockLeakRule())
+
+# =====================================================================
+# 9. no-jax-in-control-plane — server/, protocol/, spool/, obs/ stay
+#    importable and fast on device-less nodes
+# =====================================================================
+
+_CONTROL_PLANE = ("presto_tpu/server/", "presto_tpu/protocol/",
+                  "presto_tpu/spool/", "presto_tpu/obs/")
+
+
+def _module_level_stmts(tree: ast.Module) -> Iterable[ast.stmt]:
+    """Top-level statements, descending into module-level if/try
+    blocks (conditional imports) but never into defs/classes."""
+    stack = list(tree.body)
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        if isinstance(stmt, (ast.If, ast.Try)):
+            for body in (stmt.body, stmt.orelse,
+                         getattr(stmt, "finalbody", []),
+                         *[h.body for h in
+                           getattr(stmt, "handlers", [])]):
+                stack.extend(body)
+
+
+class NoJaxInControlPlaneRule(Rule):
+    name = "no-jax-in-control-plane"
+    description = (
+        "server/, protocol/, spool/ and obs/ must not import jax at "
+        "module level — the coordinator and the wire protocol must "
+        "import fast on device-less nodes; the device path may "
+        "lazy-import inside the function that needs it")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for prefix in _CONTROL_PLANE:
+            for f in pkg.walk(prefix):
+                if f.tree is None:
+                    continue
+                for stmt in _module_level_stmts(f.tree):
+                    mods: List[str] = []
+                    if isinstance(stmt, ast.Import):
+                        mods = [a.name for a in stmt.names]
+                    elif isinstance(stmt, ast.ImportFrom):
+                        mods = [stmt.module or ""]
+                    for mod in mods:
+                        if mod == "jax" or mod.startswith("jax."):
+                            out.append(self.finding(
+                                f, stmt.lineno,
+                                f"module-level `import {mod}` in the "
+                                f"control plane — lazy-import inside "
+                                f"the device-path function instead"))
+        return out
+
+
+register(NoJaxInControlPlaneRule())

@@ -387,7 +387,8 @@ class Executor:
             finally:
                 done.set()
 
-        threading.Thread(target=waiter, daemon=True).start()
+        from presto_tpu.utils.threads import spawn
+        spawn("exec", "counter-waiter", waiter)
         while not done.wait(0.5):
             self._check_deadline()
         if "e" in box:

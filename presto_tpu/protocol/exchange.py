@@ -38,6 +38,7 @@ from presto_tpu.obs.metrics import (
     gauge as _gauge, histogram as _histogram,
 )
 from presto_tpu.protocol.exchange_client import PageStream, decode_pages
+from presto_tpu.utils.threads import spawn
 
 _M_BUF_BYTES_HIGH = _gauge(
     "presto_tpu_exchange_buffered_bytes_high_water",
@@ -120,8 +121,8 @@ class ExchangeClient:
             threading.BoundedSemaphore(self.config.max_concurrent_fetchers)
             if self.config.max_concurrent_fetchers > 0 else None)
         self._threads = [
-            threading.Thread(target=self._fetch_loop, args=(s,),
-                             daemon=True, name=f"exchange-fetch-{i}")
+            spawn("exchange", f"fetch-{i}", self._fetch_loop, args=(s,),
+                  start=False)
             for i, s in enumerate(self._streams)]
         for t in self._threads:
             t.start()

@@ -12,6 +12,7 @@ import logging
 import threading
 
 from presto_tpu.protocol.transport import HttpClient, get_client
+from presto_tpu.utils.threads import spawn
 
 log = logging.getLogger("presto_tpu.announcer")
 
@@ -29,7 +30,8 @@ class Announcer:
         self.connector_ids = connector_ids
         self.interval_s = interval_s
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = spawn("worker", "announcer", self._loop,
+                             start=False)
         self.announcements = 0
         self.last_error = None
 

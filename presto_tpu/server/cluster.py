@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from presto_tpu.config import DEFAULT_OBS, TransportConfig
 from presto_tpu.obs.metrics import gauge as _obs_gauge
 from presto_tpu.plan.fragment import add_exchanges, create_fragments
+from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import TRACER, trace_scope
 from presto_tpu.plan.nodes import ExchangeNode, Partitioning, PlanNode
 from presto_tpu.protocol import structs as S
@@ -190,7 +191,8 @@ def bounded_merge(batch_sources, key, queue_pages=4):
             for row in batch:
                 yield row
 
-    threads = [threading.Thread(target=produce, args=(i,), daemon=True)
+    threads = [spawn("coordinator", f"merge-produce-{i}", produce,
+                     args=(i,), start=False)
                for i in range(n)]
     for t in threads:
         t.start()
@@ -386,8 +388,7 @@ class TpuCluster:
                     log.exception(
                         "heartbeat probe sweep failed; continuing")
 
-        self._hb_thread = threading.Thread(target=loop, daemon=True)
-        self._hb_thread.start()
+        self._hb_thread = spawn("coordinator", "heartbeat", loop)
         return self
 
     def stop(self):
@@ -1375,8 +1376,9 @@ class TpuCluster:
                     if remaining[0] == 0:
                         wake.set()
 
-        threads = [threading.Thread(target=watch, args=(u,), daemon=True)
-                   for u in uris]
+        threads = [spawn("coordinator", f"task-watch-{i}", watch,
+                         args=(u,), start=False)
+                   for i, u in enumerate(uris)]
         for t in threads:
             t.start()
         # wake on the FIRST failure (fail-fast) or when every watcher

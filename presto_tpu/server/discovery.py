@@ -16,6 +16,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from presto_tpu.utils.threads import spawn
+
 _ANNOUNCE = re.compile(r"^/v1/announcement/([^/?]+)$")
 
 
@@ -76,8 +78,8 @@ class DiscoveryService:
         self.httpd.service = self
         self.port = self.httpd.server_address[1]
         self.uri = f"http://{host}:{self.port}"
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+        self._thread = spawn("coordinator", "discovery-http",
+                             self.httpd.serve_forever, start=False)
 
     # -- server lifecycle -------------------------------------------------
     def start(self) -> "DiscoveryService":

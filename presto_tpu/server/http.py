@@ -33,6 +33,7 @@ import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.obs.metrics import gauge as _gauge, render_prometheus
 from presto_tpu.protocol import structs as S
 from presto_tpu.server.task_manager import TpuTaskManager
+from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import (
     TRACE_HEADER, TRACER, parse_trace_header,
 )
@@ -394,8 +395,8 @@ class TpuWorkerServer:
             self.httpd.authenticator = InternalAuthenticator(
                 shared_secret, node_id)
             configure(shared_secret, node_id)
-        self.thread = threading.Thread(target=self.httpd.serve_forever,
-                                       daemon=True)
+        self.thread = spawn("worker", "http-server",
+                            self.httpd.serve_forever, start=False)
         self.announcer = None
         if coordinator_uri:
             from presto_tpu.server.announcer import Announcer

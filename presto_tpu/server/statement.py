@@ -26,6 +26,7 @@ import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.obs.metrics import (
     counter as _counter, gauge as _gauge, render_prometheus,
 )
+from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import TRACER
 
 _EXECUTING = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
@@ -313,8 +314,8 @@ class StatementServer:
         self.port = self.httpd.server_address[1]
         self.base = f"http://{host}:{self.port}"
         self.httpd.base = self.base
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+        self._thread = spawn("coordinator", "statement-http",
+                             self.httpd.serve_forever, start=False)
 
     #: completed queries kept for /v1/query info (QueryTracker role)
     MAX_TRACKED = 200
@@ -343,8 +344,7 @@ class StatementServer:
                 self._idempotency = {
                     k: v for k, v in self._idempotency.items()
                     if v in self.queries}
-        threading.Thread(target=q.run, args=(self.engine,),
-                         daemon=True).start()
+        spawn("coordinator", f"query-{qid}", q.run, args=(self.engine,))
         return q
 
     def start(self) -> "StatementServer":

@@ -28,6 +28,7 @@ from presto_tpu.protocol.serde import (
     encode_serialized_page, page_to_wire_blocks,
 )
 from presto_tpu.server.buffers import OutputBufferManager
+from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import TRACER, TraceContext, trace_scope
 
 _M_TASKS_CREATED = _counter("presto_tpu_tasks_created_total",
@@ -464,8 +465,8 @@ class TpuTaskManager:
             if start:
                 task.set_state("RUNNING")
         if start:
-            threading.Thread(target=self._run, args=(task,),
-                             daemon=True).start()
+            spawn("worker", f"task-run-{task_id}", self._run,
+                  args=(task,))
         return task.info(self.base_uri)
 
     # ------------------------------------------------------------------

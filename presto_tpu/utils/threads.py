@@ -1,0 +1,40 @@
+"""The one sanctioned thread-spawn helper.
+
+Every background thread in the engine is spawned here (the
+`thread-discipline` lint rule enforces it), so all of them are:
+
+  - named ``presto-tpu-<role>-<purpose>-<seq>`` — a stuck-thread dump
+    (`py-spy`, faulthandler, `threading.enumerate()`) attributes every
+    thread to the subsystem that started it;
+  - daemon-flagged uniformly (default True: engine threads must never
+    keep a dying process alive — clean shutdown paths stop them
+    explicitly via events/joins, not via interpreter refusal to exit).
+
+`role` is the node role or subsystem (coordinator / worker / exchange /
+exec); `purpose` says what this specific thread does (heartbeat,
+task-run-3.0.0, fetch-2)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Optional
+
+_seq = itertools.count()
+
+
+def thread_name(role: str, purpose: str) -> str:
+    return f"presto-tpu-{role}-{purpose}-{next(_seq)}"
+
+
+def spawn(role: str, purpose: str, target: Callable, *,
+          args: tuple = (), kwargs: Optional[dict] = None,
+          daemon: bool = True, start: bool = True) -> threading.Thread:
+    """Create (and by default start) a named daemon thread."""
+    t = threading.Thread(target=target, args=args,
+                         kwargs=kwargs or {},
+                         name=thread_name(role, purpose),
+                         daemon=daemon)
+    if start:
+        t.start()
+    return t

@@ -39,9 +39,29 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", \
     f"test harness needs 8 CPU devices, got {jax.devices()}"
 
+# Lock-order sanitizer: every Lock/RLock/Condition allocated from repo
+# code during the suite is instrumented; pytest_sessionfinish fails the
+# run if the global acquisition-order graph picked up a cycle. Opt out
+# with PRESTO_TPU_LOCKSAN=0.
+if os.environ.get("PRESTO_TPU_LOCKSAN", "1").lower() not in ("0", "false"):
+    from presto_tpu.analysis import locksan
+
+    locksan.install()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: excluded from the smoke tier (-m 'not slow'); heavy XLA "
         "collective compiles or large scale factors")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    from presto_tpu.analysis import locksan
+
+    san = locksan.active()
+    if san is None:
+        return
+    print("\n" + san.report())
+    if san.cycles() and session.exitstatus == 0:
+        session.exitstatus = 1

@@ -1,0 +1,355 @@
+"""Tier-1 gate for the static-analysis framework + lock sanitizer.
+
+Three layers:
+
+  1. The repo itself is clean: the full rule set over the real tree
+     returns zero findings (this subsumes the four retired chokepoint
+     guard tests — their patterns now live in analysis/rules.py).
+  2. Honesty: every rule FIRES on a planted in-memory violation, so a
+     rule that silently went vacuous fails here, not in production.
+  3. The lock-order sanitizer reports a cycle on a deliberate ABBA
+     fixture (driven through a private sanitizer so the global tier-1
+     graph stays clean) and stays quiet on consistent ordering.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from presto_tpu.analysis import Package, all_rules, analyze, get_rule, main
+from presto_tpu.analysis import locksan
+from presto_tpu.analysis.locksan import LockOrderError, LockSanitizer
+
+
+def _findings(rule_name, sources, planted=None):
+    """Run one rule over an in-memory package; keep findings anchored
+    to `planted` (allowlist-honesty findings for files absent from the
+    minimal package are expected noise here)."""
+    pkg = Package.from_sources(sources)
+    out = list(get_rule(rule_name).run(pkg))
+    if planted is not None:
+        out = [f for f in out if f.path == planted]
+    return out
+
+
+# ===================================================================
+# 1. the real tree is clean
+# ===================================================================
+
+def test_repo_is_clean_under_full_rule_set():
+    findings = analyze(Package.from_path(), all_rules())
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_rule_catalog_complete():
+    names = {r.name for r in all_rules()}
+    assert {"rpc-chokepoint", "exchange-chokepoint", "spool-chokepoint",
+            "mesh-chokepoint", "metric-name-grammar", "thread-discipline",
+            "no-blocking-under-lock", "lock-leak",
+            "no-jax-in-control-plane"} <= names
+
+
+# ===================================================================
+# 2. honesty: every rule fires on a planted violation
+# ===================================================================
+
+def test_rpc_chokepoint_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("rpc-chokepoint", {
+        bad: "from urllib.request import urlopen\n"}, planted=bad)
+    assert fs and fs[0].line == 1 and "urlopen" in fs[0].message
+
+
+def test_rpc_chokepoint_allowlist_honesty():
+    # transport.py present but no longer containing the idiom => the
+    # rule must report itself vacuous instead of passing silently
+    fs = _findings("rpc-chokepoint", {
+        "presto_tpu/protocol/transport.py": "x = 1\n"})
+    assert fs and "vacuous" in fs[0].message
+
+
+def test_exchange_chokepoint_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("exchange-chokepoint", {
+        bad: 'url = f"http://w/v1/task/1/results/{buf}/{seq}"\n'},
+        planted=bad)
+    assert fs and fs[0].rule == "exchange-chokepoint"
+
+
+def test_spool_chokepoint_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("spool-chokepoint", {
+        bad: 'fh = open(path, "wb")\n'}, planted=bad)
+    assert fs and "spool" in fs[0].message
+    # exec/ keeps node-local spill files: out of scope by design
+    assert not _findings("spool-chokepoint", {
+        "presto_tpu/exec/spill.py": 'fh = open(path, "wb")\n'},
+        planted="presto_tpu/exec/spill.py")
+
+
+def test_mesh_chokepoint_fires():
+    bad = "presto_tpu/exec/evil.py"
+    fs = _findings("mesh-chokepoint", {
+        bad: "from jax.lax import all_to_all\n"}, planted=bad)
+    assert fs and "collective" in fs[0].message
+
+
+def test_metric_name_grammar_fires():
+    bad = "presto_tpu/exec/evil.py"
+    fs = _findings("metric-name-grammar", {
+        bad: 'from presto_tpu.obs.metrics import counter\n'
+             'M = counter("bad name!", "h")\n'}, planted=bad)
+    assert fs and "invalid" in fs[0].message
+
+
+def test_metric_name_duplicate_fires():
+    fs = _findings("metric-name-grammar", {
+        "presto_tpu/a.py": 'M = counter("presto_tpu_x_total", "h")\n',
+        "presto_tpu/b.py": 'M = counter("presto_tpu_x_total", "h")\n'})
+    assert fs and "2 call sites" in fs[0].message
+
+
+def test_thread_discipline_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("thread-discipline", {
+        bad: "import threading\n"
+             "t = threading.Thread(target=print)\n"}, planted=bad)
+    assert fs and fs[0].line == 2 and "name/daemon" in fs[0].message
+    # both kwargs present => clean
+    assert not _findings("thread-discipline", {
+        bad: "import threading\n"
+             "t = threading.Thread(target=print, name='x', "
+             "daemon=True)\n"}, planted=bad)
+
+
+def test_no_blocking_under_lock_fires():
+    bad = "presto_tpu/server/evil.py"
+    src = (
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "def f(client):\n"
+        "    with _lock:\n"
+        "        time.sleep(1)\n"
+        "        client.get_json('http://x')\n"
+    )
+    fs = _findings("no-blocking-under-lock", {bad: src}, planted=bad)
+    assert {f.line for f in fs} == {5, 6}
+    # a nested def under the lock runs later — must NOT fire
+    deferred = (
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    with _lock:\n"
+        "        def later():\n"
+        "            time.sleep(1)\n"
+        "        return later\n"
+    )
+    assert not _findings("no-blocking-under-lock", {bad: deferred},
+                         planted=bad)
+
+
+def test_lock_leak_fires():
+    bad = "presto_tpu/server/evil.py"
+    src = (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    _lock.acquire()\n"
+        "    print('no release on this path')\n"
+    )
+    fs = _findings("lock-leak", {bad: src}, planted=bad)
+    assert fs and fs[0].line == 4
+
+
+def test_lock_leak_accepts_guarded_acquire():
+    # the exchange fetcher idiom: optional semaphore, guard repeated
+    # around both acquire and the finally release
+    ok = "presto_tpu/server/ok.py"
+    src = (
+        "def f(sem):\n"
+        "    if sem is not None:\n"
+        "        sem.acquire()\n"
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        if sem is not None:\n"
+        "            sem.release()\n"
+    )
+    assert not _findings("lock-leak", {ok: src}, planted=ok)
+
+
+def test_no_jax_in_control_plane_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("no-jax-in-control-plane", {
+        bad: "import jax\n"}, planted=bad)
+    assert fs and "control plane" in fs[0].message
+    # lazy function-level import is the sanctioned pattern
+    assert not _findings("no-jax-in-control-plane", {
+        bad: "def f():\n    import jax\n    return jax\n"}, planted=bad)
+
+
+# ===================================================================
+# suppressions
+# ===================================================================
+
+_SUPPRESSED = (
+    "import threading\n"
+    "t = threading.Thread(target=print)"
+    "  # lint: disable=thread-discipline\n"
+)
+
+
+def test_suppression_silences_finding():
+    pkg = Package.from_sources({"presto_tpu/server/s.py": _SUPPRESSED})
+    fs = analyze(pkg, [get_rule("thread-discipline")])
+    assert fs == []
+
+
+def test_unused_suppression_reported():
+    pkg = Package.from_sources({
+        "presto_tpu/server/s.py":
+            "x = 1  # lint: disable=thread-discipline\n"})
+    fs = analyze(pkg, [get_rule("thread-discipline")])
+    assert [f.rule for f in fs] == ["unused-suppression"]
+
+
+def test_comment_only_suppression_covers_next_line():
+    pkg = Package.from_sources({
+        "presto_tpu/server/s.py":
+            "import threading\n"
+            "# lint: disable=thread-discipline\n"
+            "t = threading.Thread(target=print)\n"})
+    assert analyze(pkg, [get_rule("thread-discipline")]) == []
+
+
+def test_parse_error_is_a_finding():
+    pkg = Package.from_sources({"presto_tpu/server/s.py": "def f(:\n"})
+    fs = analyze(pkg, [])
+    assert [f.rule for f in fs] == ["parse-error"]
+
+
+# ===================================================================
+# CLI
+# ===================================================================
+
+def test_cli_json_clean_on_repo(capsys):
+    rc = main(["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["findings"] == []
+    assert out["files"] > 50
+    assert "thread-discipline" in out["rules"]
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    assert "lock-leak:" in capsys.readouterr().out
+
+
+# ===================================================================
+# 3. lock-order sanitizer
+# ===================================================================
+
+def test_locksan_reports_abba_cycle():
+    san = LockSanitizer()        # private graph: tier-1 gate untouched
+    a, b = san.lock("site-A"), san.lock("site-B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = san.cycles()
+    assert cycles and set(cycles[0]) == {"site-A", "site-B"}
+    rep = san.report()
+    assert "CYCLE" in rep and "site-A" in rep and "site-B" in rep
+    with pytest.raises(LockOrderError):
+        san.assert_no_cycles()
+
+
+def test_locksan_consistent_order_is_clean():
+    san = LockSanitizer()
+    a, b = san.lock("site-A"), san.lock("site-B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert san.cycles() == []
+    san.assert_no_cycles()
+
+
+def test_locksan_reentrant_rlock_not_an_edge():
+    san = LockSanitizer()
+    r = san.rlock("site-R")
+    with r:
+        with r:                   # reentrancy is not an ordering fact
+            pass
+    assert san.edges() == {} and san.cycles() == []
+
+
+def test_locksan_condition_wait_notify():
+    san = LockSanitizer()
+    cond = san.condition("site-cond")
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=5)
+
+    t = threading.Thread(target=waiter, name="t-locksan-wait",
+                         daemon=True)
+    t.start()
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert san.cycles() == []
+
+
+def test_locksan_exports_hold_histogram():
+    from presto_tpu.obs.metrics import REGISTRY
+    san = LockSanitizer()
+    lk = san.lock("tests/test_analysis.py:histogram-probe")
+    with lk:
+        pass
+    assert "presto_tpu_lock_hold_seconds" in REGISTRY.names()
+    assert 'lock="tests/test_analysis.py:histogram-probe"' \
+        in REGISTRY.render()
+
+
+# ===================================================================
+# runtime registry (migrated from test_metric_names.py) + global gate
+# ===================================================================
+
+def test_runtime_registry_matches_grammar():
+    """Importing the serving stack must leave only grammar-clean names
+    in the process-global registry (labels validated at registration)."""
+    import presto_tpu.exec.executor           # noqa: F401
+    import presto_tpu.server.cluster          # noqa: F401
+    import presto_tpu.server.statement        # noqa: F401
+    from presto_tpu.obs.metrics import METRIC_NAME_RE, REGISTRY
+
+    names = REGISTRY.names()
+    assert names
+    for name in names:
+        assert METRIC_NAME_RE.match(name), name
+
+
+@pytest.mark.skipif(
+    os.environ.get("PRESTO_TPU_LOCKSAN", "1").lower() in ("0", "false"),
+    reason="lock sanitizer disabled via PRESTO_TPU_LOCKSAN")
+def test_global_sanitizer_active_and_instrumenting():
+    """conftest installed the process-global sanitizer: repo-allocated
+    locks are wrapped, and the order graph has no cycle so far (the
+    full-suite verdict lands in pytest_sessionfinish)."""
+    san = locksan.active()
+    assert san is not None
+    probe = threading.Lock()      # allocated from repo code => wrapped
+    assert isinstance(probe, locksan._SanLock)
+    with probe:
+        pass
+    assert san.cycles() == [], san.report()
