@@ -252,6 +252,8 @@ def main() -> None:
     pq_one = os.environ.get("BENCH_PQ_ONE")
     if os.environ.get("BENCH_CHILD") != "1":
         return _main_orchestrator(sf, qids)
+    if os.environ.get("BENCH_LOAD_ONE"):
+        return _load_child()
     if ds_one:
         return _ds_child(int(ds_one), runs, warmup)
     if pq_one:
@@ -317,7 +319,9 @@ def _headline(detail):
     if clean:
         k = sorted(clean)[0]
         return k, clean[k]
-    qkeys = sorted(k for k, v in detail.items() if isinstance(v, dict))
+    qkeys = sorted(k for k, v in detail.items()
+                   if isinstance(v, dict) and k.startswith(("q", "ds_",
+                                                            "pq_")))
     k = qkeys[0] if qkeys else "none"
     return k, {"rows_per_sec": 0.0, "vs_baseline": 0.0}
 
@@ -582,6 +586,16 @@ def _main_orchestrator(sf, qids) -> None:
         if tail:
             sys.stderr.write(tail + "\n")
 
+    # admission front-door round (one JSON `admission` entry: ledger,
+    # queue-wait percentiles, shed counters); BENCH_LOAD=0 disables
+    if os.environ.get("BENCH_LOAD", "1") != "0":
+        if wedged is not None:
+            detail["admission"] = {"error": f"infra: {wedged}"}
+        else:
+            detail["admission"] = _run_load_child(
+                float(os.environ.get("BENCH_LOAD_TIMEOUT_S", "240"))
+                + 120.0)
+
     if wedged is not None:
         detail["infra_error"] = wedged
         detail["probe_log"] = probe_log
@@ -734,6 +748,87 @@ def _pq_child(qid: int, sf: float, runs: int, warmup: int) -> None:
     print(json.dumps({"metric": f"tpch_parquet_q{qid}", "value": 0,
                       "unit": "rows/s", "vs_baseline": 0,
                       "detail": detail}))
+
+
+def _load_child() -> None:
+    """Admission front-door round: stand up a real statement server
+    over a small TPC-H cluster, drive it with the closed-loop load
+    harness (3 tenants at weights 2:1:1, zipfian mix), and emit the
+    accepted/rejected/shed/dropped ledger plus queue-wait percentiles
+    and the dispatcher's counter snapshot as one JSON line."""
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    from presto_tpu.admission import (ResourceGroup,
+                                      ResourceGroupManager, Selector)
+    from presto_tpu.config import AdmissionConfig
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.server.cluster import TpuCluster
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.testing.load import LoadHarness
+
+    statements = int(os.environ.get("BENCH_LOAD_STATEMENTS", "120"))
+    clients = int(os.environ.get("BENCH_LOAD_CLIENTS", "24"))
+    tenants = {"alpha": 2, "beta": 1, "gamma": 1}
+    leaves = [ResourceGroup(n, hard_concurrency=4,
+                            max_queued=max(statements, 64),
+                            scheduling_weight=w)
+              for n, w in tenants.items()]
+    root = ResourceGroup("front", hard_concurrency=4, max_queued=0,
+                         children=leaves)
+    mgr = ResourceGroupManager(
+        [root],
+        [Selector(n, user_regex=n) for n in tenants]
+        + [Selector("alpha")])
+    cluster = TpuCluster(TpchConnector(0.01), n_workers=2,
+                         resource_groups=mgr)
+    srv = StatementServer(
+        cluster, admission=AdmissionConfig(max_dispatch_threads=4))
+    srv.start()
+    try:
+        harness = LoadHarness(
+            srv.base, tenants, clients=clients, statements=statements,
+            sql="select count(*) from nation", seed=11,
+            timeout_s=float(os.environ.get("BENCH_LOAD_TIMEOUT_S",
+                                           "240")))
+        t0 = time.perf_counter()
+        report = harness.run(dispatcher=srv.dispatcher, groups=mgr)
+        wall = time.perf_counter() - t0
+        out = report.to_dict()
+        out["wall_s"] = round(wall, 3)
+        out["statements_per_sec"] = (round(report.completed / wall, 1)
+                                     if wall > 0 else 0.0)
+        out["front_door"] = srv.dispatcher.snapshot()
+    finally:
+        srv.stop()
+        cluster.stop()
+    print(json.dumps({"metric": "admission_load_round", "value":
+                      out["statements_per_sec"], "unit": "stmt/s",
+                      "detail": {"admission": out}}))
+
+
+def _run_load_child(timeout_s: float):
+    """Run the admission load round in a subprocess; returns the
+    `admission` detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_LOAD_ONE="1", BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "admission", {"error": "child produced no admission entry"})
 
 
 def _plan_has_join(plan) -> bool:

@@ -1,0 +1,33 @@
+"""Admission control: the single front door for every statement.
+
+Reference: Presto's L1 dispatcher layer (QueuedStatementResource →
+DispatchManager → InternalResourceGroupManager).  Three pieces:
+
+- :mod:`~presto_tpu.admission.groups` — hierarchical resource groups
+  with weighted-fair (stride) scheduling, per-tenant concurrency and
+  memory quotas, and queue timeouts;
+- :mod:`~presto_tpu.admission.dispatcher` — explicit
+  QUEUED→WAITING_FOR_RESOURCES→DISPATCHING→RUNNING state machine over
+  a bounded execution pool;
+- :mod:`~presto_tpu.admission.shedding` — door-level load shedding
+  with HTTP 503 + Retry-After semantics.
+"""
+
+from presto_tpu.admission.dispatcher import (DISPATCHING, FAILED,
+                                             FINISHED, QUEUED, RUNNING,
+                                             WAITING_FOR_RESOURCES,
+                                             DispatchedQuery,
+                                             DispatchManager)
+from presto_tpu.admission.groups import (QueryQueueFull, ResourceGroup,
+                                         ResourceGroupManager, Selector,
+                                         admission_scope,
+                                         current_admission)
+from presto_tpu.admission.shedding import LoadShedder, OverloadedError
+
+__all__ = [
+    "DISPATCHING", "FAILED", "FINISHED", "QUEUED", "RUNNING",
+    "WAITING_FOR_RESOURCES", "DispatchedQuery", "DispatchManager",
+    "QueryQueueFull", "ResourceGroup", "ResourceGroupManager",
+    "Selector", "admission_scope", "current_admission", "LoadShedder",
+    "OverloadedError",
+]

@@ -1,0 +1,230 @@
+"""Statement dispatcher: bounded execution pool + explicit states.
+
+Reference: dispatcher/DispatchManager.java (QueuedStatementResource →
+DispatchManager → resource-group admission → a bounded dispatch
+executor).  Accepting a statement is cheap and never blocks the HTTP
+handler: ``submit`` runs the shed check, resolves the resource group,
+and offers the query to the admission queue — all O(1).  Execution
+capacity is a scheduled resource: a fixed pool of dispatch threads
+drains granted queries, so the coordinator's thread count is bounded
+by configuration instead of by offered load.
+
+State machine per statement::
+
+    QUEUED -> WAITING_FOR_RESOURCES -> DISPATCHING -> RUNNING
+                                                   -> FINISHED | FAILED
+
+QUEUED is the instant between arrival and group resolution;
+WAITING_FOR_RESOURCES means the query sits in a resource-group queue;
+DISPATCHING means admission granted, waiting for a pool thread;
+RUNNING means a pool thread is executing it.  Rejections (queue full,
+queue timeout, cancellation while queued) land in FAILED with a
+QUERY_QUEUE_FULL-class error.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from presto_tpu.admission.groups import (QueryQueueFull,
+                                         ResourceGroupManager,
+                                         admission_scope)
+from presto_tpu.admission.shedding import LoadShedder
+from presto_tpu.config import DEFAULT_ADMISSION
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+from presto_tpu.utils.threads import spawn
+
+_M_SUBMITTED = _counter("presto_tpu_admission_submitted_total",
+                        "Statements offered to the dispatcher")
+_M_DISPATCHED = _counter("presto_tpu_admission_dispatched_total",
+                         "Statements handed to the execution pool")
+_M_POOL_ACTIVE = _gauge("presto_tpu_admission_pool_active",
+                        "Dispatch-pool threads currently executing")
+
+QUEUED = "QUEUED"
+WAITING_FOR_RESOURCES = "WAITING_FOR_RESOURCES"
+DISPATCHING = "DISPATCHING"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+_ORDER = {QUEUED: 0, WAITING_FOR_RESOURCES: 1, DISPATCHING: 2,
+          RUNNING: 3, FINISHED: 4, FAILED: 4}
+
+
+class DispatchedQuery:
+    """Dispatcher-side handle for one submitted statement."""
+
+    def __init__(self, query_id: Optional[str], run_fn: Callable[[], None],
+                 listener: Optional[Callable[[str, Optional[BaseException]],
+                                             None]] = None):
+        self.query_id = query_id
+        self.run_fn = run_fn
+        self.group_path: Optional[str] = None
+        self.state = QUEUED
+        self.error: Optional[BaseException] = None
+        self.queue_wait_s: Optional[float] = None
+        self.done = threading.Event()
+        self._listener = listener
+        self._slot = None
+        self._waiter = None
+        self._state_lock = threading.Lock()
+
+    def _advance(self, state: str,
+                 error: Optional[BaseException] = None) -> None:
+        with self._state_lock:
+            if state == self.state:
+                return
+            if _ORDER[state] <= _ORDER.get(self.state, -1):
+                return          # never move backwards or out of terminal
+            self.state = state
+            if error is not None:
+                self.error = error
+        if self._listener is not None:
+            self._listener(state, error)
+        if state in (FINISHED, FAILED):
+            self.done.set()
+
+
+class DispatchManager:
+    """Front door: shed check → group selection → admission queue →
+    bounded execution pool."""
+
+    def __init__(self, groups: Optional[ResourceGroupManager] = None,
+                 config=DEFAULT_ADMISSION, memory_pool=None):
+        self.groups = groups or ResourceGroupManager()
+        self.config = config
+        self.memory_pool = memory_pool
+        if memory_pool is not None:
+            self.groups.attach_memory_pool(memory_pool)
+        self._waits = collections.deque(maxlen=config.wait_window)
+        self.shedder = LoadShedder(config, self.groups, memory_pool,
+                                   recent_waits=lambda: tuple(self._waits))
+        self._ready: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._active = 0
+        self._active_lock = threading.Lock()
+        self._threads: List = [
+            spawn("coordinator", f"dispatch-{i}", self._pool_loop)
+            for i in range(config.max_dispatch_threads)]
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, run_fn: Callable[[], None], user: str = "",
+               source: str = "", query_id: Optional[str] = None,
+               listener: Optional[Callable] = None) -> DispatchedQuery:
+        """Admit one statement.  Never blocks: raises
+        :class:`~presto_tpu.admission.shedding.OverloadedError` when
+        the door is shedding, :class:`QueryQueueFull` when the target
+        group's queue is full; otherwise returns a handle whose
+        ``done`` event fires on FINISHED/FAILED."""
+        _M_SUBMITTED.inc()
+        self.shedder.check()
+        group = self.groups.select(user=user, source=source)
+        h = DispatchedQuery(query_id, run_fn, listener)
+        h.group_path = group.path
+
+        def _grant(slot):
+            h._slot = slot
+            h.queue_wait_s = slot.queue_wait_s
+            self._waits.append(slot.queue_wait_s)
+            h._advance(DISPATCHING)
+            _M_DISPATCHED.inc()
+            self._ready.put(h)
+
+        def _reject(exc):
+            h._advance(FAILED, exc)
+
+        try:
+            h._waiter = group.offer(_grant, _reject, query_id=query_id)
+        except QueryQueueFull:
+            h._advance(FAILED)
+            raise
+        if h.state == QUEUED:
+            h._advance(WAITING_FOR_RESOURCES)
+        return h
+
+    def cancel(self, h: DispatchedQuery) -> bool:
+        """Withdraw a statement still waiting for resources.  Returns
+        False once it is dispatching or running."""
+        if h._waiter is None or h._slot is not None:
+            return False
+        group = self.groups.groups.get((h.group_path or "").split(".")[-1])
+        if group is None or not group.withdraw(h._waiter):
+            return False
+        h._advance(FAILED, QueryQueueFull(
+            f"query {h.query_id} cancelled while queued"))
+        return True
+
+    # -- execution pool -----------------------------------------------
+
+    def _pool_loop(self) -> None:
+        while True:
+            try:
+                h = self._ready.get(timeout=self.config.dispatch_tick_s)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                # housekeeping: evict expired waiters, re-check quotas
+                self.groups.evict_expired()
+                self.groups.poke()
+                continue
+            if h is None:
+                return
+            with self._active_lock:
+                self._active += 1
+                _M_POOL_ACTIVE.set(self._active)
+            try:
+                self._run_one(h)
+            finally:
+                with self._active_lock:
+                    self._active -= 1
+                    _M_POOL_ACTIVE.set(self._active)
+
+    def _run_one(self, h: DispatchedQuery) -> None:
+        try:
+            with admission_scope(h._slot):
+                h._advance(RUNNING)
+                h.run_fn()
+        except BaseException as exc:           # noqa: BLE001 — ledger
+            h._advance(FAILED, exc)
+            return
+        finally:
+            if h._slot is not None:
+                h._slot.release()
+        h._advance(FINISHED)
+
+    # -- introspection / lifecycle ------------------------------------
+
+    def recent_waits(self) -> List[float]:
+        return list(self._waits)
+
+    def wait_percentiles(self) -> dict:
+        waits = sorted(self._waits)
+        if not waits:
+            return {"p50": 0.0, "p99": 0.0, "samples": 0}
+        def pct(p):
+            return waits[min(len(waits) - 1, int(p * len(waits)))]
+        return {"p50": pct(0.50), "p99": pct(0.99),
+                "samples": len(waits)}
+
+    def snapshot(self) -> dict:
+        d = {"pool_size": self.config.max_dispatch_threads,
+             "pool_active": self._active,
+             "queued": self.groups.total_queued(),
+             "running": self.groups.total_running(),
+             "queue_wait": self.wait_percentiles()}
+        d.update(self.shedder.snapshot())
+        return d
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        for _ in self._threads:
+            self._ready.put(None)
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))

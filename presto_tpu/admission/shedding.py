@@ -1,0 +1,96 @@
+"""Load shedding at the statement front door.
+
+Reference: dispatcher/DispatchManager + server's ClusterMemoryManager
+OOM-killer posture, collapsed to a door-level check: when the cluster
+is visibly overloaded, refuse new statements *before* they consume a
+queue slot, with HTTP 503 + ``Retry-After`` so well-behaved clients
+back off for the advised interval (the transport layer treats this as
+a distinct retry class).
+
+Three signals, each with a configured threshold (see
+:class:`~presto_tpu.config.AdmissionConfig`):
+
+- total queued statements across all resource groups
+  (``shed_max_queued``);
+- memory-pool heap fraction ``reserved / budget``
+  (``shed_heap_fraction``);
+- recent p99 admission queue wait (``shed_queue_wait_p99_s``) — the
+  closed-loop signal: when dispatch latency blows up, admitting more
+  work only makes it worse.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from presto_tpu.obs.metrics import counter as _counter
+
+_M_SHED = _counter("presto_tpu_admission_shed_total",
+                   "Statements refused at the front door, by signal",
+                   ("reason",))
+
+#: minimum recent queue-wait samples before the p99 signal can trip
+_MIN_WAIT_SAMPLES = 20
+
+
+class OverloadedError(RuntimeError):
+    """The front door refused the statement; retry after
+    ``retry_after_s`` seconds (maps to HTTP 503 + ``Retry-After``)."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(
+            f"server overloaded ({reason}); retry after "
+            f"{retry_after_s:g}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class LoadShedder:
+    def __init__(self, config, groups, memory_pool=None,
+                 recent_waits: Optional[Callable[[], Sequence[float]]]
+                 = None):
+        self.config = config
+        self.groups = groups
+        self.memory_pool = memory_pool
+        self._recent_waits = recent_waits or (lambda: ())
+        self.shed_counts = {"queue_depth": 0, "heap": 0,
+                            "queue_wait": 0}
+
+    def _trip(self, reason: str, detail: str) -> None:
+        self.shed_counts[reason] += 1
+        _M_SHED.inc(reason=reason)
+        raise OverloadedError(f"{reason}: {detail}",
+                              self.config.retry_after_s)
+
+    def check(self) -> None:
+        """Raise :class:`OverloadedError` when any signal is over its
+        threshold; otherwise return quietly."""
+        cfg = self.config
+        queued = self.groups.total_queued()
+        if queued >= cfg.shed_max_queued:
+            self._trip("queue_depth",
+                       f"{queued} queued >= {cfg.shed_max_queued}")
+        pool = self.memory_pool
+        if pool is not None and pool.budget > 0:
+            frac = pool.reserved / pool.budget
+            if frac >= cfg.shed_heap_fraction:
+                self._trip("heap",
+                           f"heap {frac:.2f} >= "
+                           f"{cfg.shed_heap_fraction:.2f}")
+        waits = list(self._recent_waits())
+        if len(waits) >= _MIN_WAIT_SAMPLES:
+            waits.sort()
+            p99 = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+            if p99 >= cfg.shed_queue_wait_p99_s:
+                self._trip("queue_wait",
+                           f"p99 queue wait {p99:.3f}s >= "
+                           f"{cfg.shed_queue_wait_p99_s:g}s")
+
+    def snapshot(self) -> dict:
+        return {"shed": dict(self.shed_counts),
+                "thresholds": {
+                    "max_queued": self.config.shed_max_queued,
+                    "heap_fraction": self.config.shed_heap_fraction,
+                    "queue_wait_p99_s":
+                        self.config.shed_queue_wait_p99_s,
+                    "retry_after_s": self.config.retry_after_s}}

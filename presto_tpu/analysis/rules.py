@@ -549,3 +549,71 @@ class NoJaxInControlPlaneRule(Rule):
 
 
 register(NoJaxInControlPlaneRule())
+
+# =====================================================================
+# 10. no-spawn-in-request-handler — HTTP handler bodies never spawn
+#     execution threads; all statement execution goes through the
+#     admission dispatcher's bounded pool
+# =====================================================================
+
+_HANDLER_METHODS = ("do_GET", "do_POST", "do_DELETE", "do_PUT",
+                    "do_HEAD")
+
+
+def _is_spawn_call(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "spawn":
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "spawn"
+
+
+class _HandlerBodyVisitor(ast.NodeVisitor):
+    """Collect spawn()/Thread() calls in the LEXICAL body of a handler
+    method — nested function definitions are someone else's body (a
+    closure handed to the dispatcher is exactly the sanctioned
+    pattern)."""
+
+    def __init__(self):
+        self.calls: List[ast.Call] = []
+
+    def visit_FunctionDef(self, node):      # noqa: N802 — ast API
+        pass                                # do not descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):             # noqa: N802 — ast API
+        if _is_spawn_call(node) or _is_thread_ctor(node):
+            self.calls.append(node)
+        self.generic_visit(node)
+
+
+class NoSpawnInRequestHandlerRule(Rule):
+    name = "no-spawn-in-request-handler"
+    description = (
+        "HTTP request handlers (do_GET/do_POST/do_DELETE/...) must "
+        "not call threads.spawn or construct Thread objects — "
+        "per-request thread creation is unbounded under load; route "
+        "execution through the admission dispatcher's bounded pool")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for f in pkg.walk("presto_tpu/"):
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.FunctionDef)
+                        and node.name in _HANDLER_METHODS):
+                    continue
+                v = _HandlerBodyVisitor()
+                for stmt in node.body:
+                    v.visit(stmt)
+                for call in v.calls:
+                    out.append(self.finding(
+                        f, call.lineno,
+                        f"thread spawned inside {node.name} — accept "
+                        f"cheaply and hand execution to the admission "
+                        f"dispatcher pool instead"))
+        return out
+
+
+register(NoSpawnInRequestHandlerRule())

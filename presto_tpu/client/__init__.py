@@ -5,5 +5,6 @@ presto-python-client)."""
 
 from presto_tpu.client.dbapi import (  # noqa: F401
     Connection, Cursor, DatabaseError, Error, InterfaceError,
-    OperationalError, apilevel, connect, paramstyle, threadsafety,
+    OperationalError, OverloadedError, apilevel, connect, paramstyle,
+    threadsafety,
 )

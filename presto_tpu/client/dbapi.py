@@ -46,16 +46,30 @@ class OperationalError(DatabaseError):
     pass
 
 
-def connect(base_uri: str, timeout_s: float = 600.0) -> "Connection":
+class OverloadedError(OperationalError):
+    """The server kept shedding load (HTTP 429/503 + Retry-After) past
+    the transport's retry policy — the cluster is busy, not broken;
+    callers should back off and try again later."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+def connect(base_uri: str, timeout_s: float = 600.0,
+            user: str = "") -> "Connection":
     """Open a connection to a statement server
-    (server/statement.StatementServer.base)."""
-    return Connection(base_uri, timeout_s)
+    (server/statement.StatementServer.base).  ``user`` rides the
+    X-Presto-User header — the coordinator's resource-group selectors
+    key tenant admission on it."""
+    return Connection(base_uri, timeout_s, user=user)
 
 
 class Connection:
-    def __init__(self, base_uri: str, timeout_s: float):
+    def __init__(self, base_uri: str, timeout_s: float, user: str = ""):
         self.base = base_uri.rstrip("/")
         self.timeout_s = timeout_s
+        self.user = user
         self.closed = False
 
     def cursor(self) -> "Cursor":
@@ -201,7 +215,12 @@ class Cursor:
     # (protocol/transport.py: retries with backoff + error
     # classification; every transport failure subclasses OSError)
     def _post(self, sql: str) -> dict:
-        from presto_tpu.protocol.transport import get_client
+        from presto_tpu.protocol.transport import (ServerOverloadedError,
+                                                   get_client)
+        headers = {"Content-Type": "text/plain",
+                   "X-Presto-Idempotency-Key": uuid.uuid4().hex}
+        if self._conn.user:
+            headers["X-Presto-User"] = self._conn.user
         try:
             # per-execute idempotency key: the transport auto-retries
             # the POST, and the server dedupes on the key so a retry
@@ -209,16 +228,22 @@ class Cursor:
             # instead of re-executing (INSERT/CTAS must not duplicate)
             return get_client().post(
                 f"{self._conn.base}/v1/statement", sql.encode(),
-                headers={"Content-Type": "text/plain",
-                         "X-Presto-Idempotency-Key": uuid.uuid4().hex},
+                headers=headers,
                 request_class="statement").json()
+        except ServerOverloadedError as e:
+            raise OverloadedError(
+                str(e), retry_after_s=e.retry_after_s) from e
         except OSError as e:
             raise OperationalError(str(e)) from e
 
     def _get(self, uri: str) -> dict:
-        from presto_tpu.protocol.transport import get_client
+        from presto_tpu.protocol.transport import (ServerOverloadedError,
+                                                   get_client)
         try:
             return get_client().get_json(uri, request_class="statement")
+        except ServerOverloadedError as e:
+            raise OverloadedError(
+                str(e), retry_after_s=e.retry_after_s) from e
         except OSError as e:
             raise OperationalError(str(e)) from e
 

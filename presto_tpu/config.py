@@ -172,6 +172,10 @@ class TransportConfig:
     breaker_failure_threshold: int = 3
     breaker_cooldown_s: float = 5.0
 
+    # cap on a server-advised Retry-After sleep (overload responses,
+    # 429 / 503 + Retry-After header); the retry budget still applies
+    retry_after_max_s: float = 30.0
+
 
 #: process defaults; tests construct their own with tighter windows
 DEFAULT_TRANSPORT = TransportConfig()
@@ -296,6 +300,43 @@ class ExchangeConfig:
 
 #: process defaults
 DEFAULT_EXCHANGE = ExchangeConfig()
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """Statement front-door knobs (reference: dispatcher/
+    DispatchManager + query-manager config — max-queued-queries,
+    dispatcher concurrency — plus the resource-group manager's queue
+    limits). One per coordinator; `admission/DispatchManager` and its
+    `LoadShedder` are built from this."""
+
+    #: bounded execution pool: how many statements run concurrently
+    #: (replaces the old unbounded thread-per-query path)
+    max_dispatch_threads: int = 8
+    #: pool-thread housekeeping interval — queue-timeout eviction and
+    #: memory-quota re-checks happen at least this often while idle
+    dispatch_tick_s: float = 0.25
+    #: default per-group queue timeout applied when a group does not
+    #: set its own (None = wait forever, bounded by the client)
+    default_queue_timeout_s: Optional[float] = None
+
+    # -- load shedding thresholds ------------------------------------
+    #: refuse new statements when this many are queued across all
+    #: resource groups
+    shed_max_queued: int = 256
+    #: refuse when memory-pool reserved/budget reaches this fraction
+    shed_heap_fraction: float = 0.95
+    #: refuse when the recent p99 admission queue wait reaches this
+    shed_queue_wait_p99_s: float = 20.0
+    #: Retry-After interval advertised on shed responses
+    retry_after_s: float = 1.0
+    #: recent queue-wait samples kept for the p99 shedding signal and
+    #: the /v1/status percentiles
+    wait_window: int = 1024
+
+
+#: process defaults
+DEFAULT_ADMISSION = AdmissionConfig()
 
 
 class Session:

@@ -79,6 +79,10 @@ _M_BREAKER_REJECTS = _counter(
     "presto_tpu_transport_breaker_rejections_total",
     "Requests fast-failed because the host's circuit breaker was OPEN",
     ("host",))
+_M_RETRY_AFTER = _counter(
+    "presto_tpu_transport_retry_after_honored_total",
+    "Overload responses (429/503 + Retry-After) whose advised "
+    "interval was slept before retrying", ("host",))
 _M_BREAKER_TRANSITIONS = _counter(
     "presto_tpu_transport_breaker_transitions_total",
     "Circuit-breaker state transitions", ("host", "to_state"))
@@ -113,6 +117,17 @@ class RetriesExhaustedError(TransportError):
     retry budget; `__cause__` carries the last underlying error."""
 
 
+class ServerOverloadedError(RetriesExhaustedError):
+    """The server kept shedding load (429, or 503 + Retry-After) past
+    the retry policy.  A RetriesExhaustedError subclass so existing
+    recovery ladders treat it identically, but distinct so clients can
+    surface 'server busy, try later' instead of 'server broken'."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class FatalResponseError(TransportError):
     """A 4xx response: the request itself is wrong (or the resource is
     gone) — retrying the same bytes cannot succeed."""
@@ -133,11 +148,42 @@ class WorkerRestartedError(TransportError):
     and its buffers are gone (worker-death classification)."""
 
 
+def _retry_after_of(exc: BaseException) -> Optional[str]:
+    """The raw Retry-After header of an HTTPError, if any."""
+    if isinstance(exc, urllib.error.HTTPError) \
+            and exc.headers is not None:
+        return exc.headers.get("Retry-After")
+    return None
+
+
+def _parse_retry_after(raw: Optional[str]) -> Optional[float]:
+    """Seconds from a Retry-After header value (delta-seconds form;
+    fractional values accepted for test speed). None when absent or
+    unparseable (HTTP-date form falls back to jitter backoff)."""
+    if raw is None:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return max(0.0, v)
+
+
+def _is_overload(exc: BaseException) -> bool:
+    """429, or 503 carrying Retry-After: the server is alive and
+    deliberately shedding — a distinct retry class that honors the
+    advised interval instead of full-jitter backoff."""
+    if not isinstance(exc, urllib.error.HTTPError):
+        return False
+    return exc.code == 429 \
+        or (exc.code == 503 and _retry_after_of(exc) is not None)
+
+
 def is_retryable(exc: BaseException) -> bool:
     """Classify an exception from one attempt. HTTPError must be
     checked before URLError (it is a subclass)."""
     if isinstance(exc, urllib.error.HTTPError):
-        return exc.code >= 500
+        return exc.code >= 500 or exc.code == 429
     if isinstance(exc, (FatalResponseError, CircuitOpenError,
                         WorkerRestartedError)):
         return False
@@ -340,6 +386,31 @@ class HttpClient:
                     err_body = e.read()
                 except Exception:   # noqa: BLE001 — body is best-effort
                     pass
+                if _is_overload(e):
+                    # load shed: the server answered deliberately — the
+                    # host is alive (no breaker penalty) and retrying
+                    # helps, but on the SERVER's schedule: sleep the
+                    # advised Retry-After interval (capped by config
+                    # and the retry budget) instead of jitter backoff
+                    breaker.record_success()
+                    last = e
+                    if attempt + 1 >= max_attempts:
+                        break
+                    advised = _parse_retry_after(_retry_after_of(e))
+                    if advised is None:
+                        delay = min(self.config.retry_base_backoff_s
+                                    * (2 ** attempt),
+                                    self.config.retry_max_backoff_s)
+                        delay *= self._rng.random()
+                    else:
+                        delay = min(advised,
+                                    self.config.retry_after_max_s)
+                        _M_RETRY_AFTER.inc(host=host)
+                    if self._clock() + delay > deadline:
+                        break                 # retry budget exhausted
+                    _M_RETRIES.inc(host=host)
+                    self._sleep(delay)
+                    continue
                 if e.code < 500:
                     # the worker answered: it is alive, the REQUEST is
                     # bad — don't punish the breaker, don't retry
@@ -373,6 +444,12 @@ class HttpClient:
             _M_RETRIES.inc(host=host)
             self._sleep(backoff)
         _M_EXHAUSTED.inc(host=host)
+        if last is not None and _is_overload(last):
+            raise ServerOverloadedError(
+                f"{method} {url} still shedding load after "
+                f"{max_attempts} attempt(s): {last}",
+                retry_after_s=_parse_retry_after(
+                    _retry_after_of(last))) from last
         raise RetriesExhaustedError(
             f"{method} {url} failed after {max_attempts} attempt(s): "
             f"{last}") from last

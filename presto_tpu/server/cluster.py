@@ -438,7 +438,14 @@ class TpuCluster:
             group = self.resource_groups.select(
                 user=self.session_properties.get("user", ""),
                 source=self.session_properties.get("source", ""))
-            with group.acquire(timeout_s=600):
+            # when the statement front door already admitted this query
+            # (dispatcher pool thread), acquire returns a no-op nested
+            # slot — admission happens exactly once per statement
+            slot = group.acquire(timeout_s=600, query_id=qid)
+            self.last_admission = {
+                "group": slot.group.path,
+                "queue_wait_s": slot.queue_wait_s or 0.0}
+            with slot:
                 head = (sql.lstrip().split(None, 1)[0].lower()
                         if sql.strip() else "")
                 if head == "explain":
@@ -639,6 +646,11 @@ class TpuCluster:
                 f"recoveries={spool['recoveries']} "
                 f"fallback_reads={spool['fallback_reads']} "
                 f"gc={spool['gc']}")
+        adm = getattr(self, "last_admission", None)
+        if adm is not None:
+            lines.append(
+                f"Admission: group={adm['group']} "
+                f"queue_wait={adm['queue_wait_s']:.3f}s")
         trace = self.render_trace()
         if trace:
             lines.append(

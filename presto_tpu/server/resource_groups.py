@@ -1,129 +1,19 @@
-"""Resource groups — admission control for cluster queries.
+"""Resource groups — compatibility shim.
 
-Reference: execution/resourceGroups/InternalResourceGroupManager.java:86 +
-InternalResourceGroup (hierarchical groups, per-group concurrency and
-queue limits, selector rules mapping sessions to groups;
-presto-resource-group-managers' file-based config). Collapsed to its
-functional core: flat named groups with hard-concurrency / max-queued
-limits and first-match selectors on (user, source); queries block FIFO
-for a slot or are rejected with QUERY_QUEUE_FULL."""
+The flat semaphore groups that used to live here grew into the
+hierarchical weighted-fair implementation in
+:mod:`presto_tpu.admission.groups`; this module re-exports the public
+names so existing imports (`from presto_tpu.server.resource_groups
+import ResourceGroup, ...`) keep working.  The blocking
+``acquire(timeout_s)`` semantics are preserved bit-for-bit: FIFO
+no-overtake fast path, ``max_queued`` counting only WAITING queries
+(``max_queued=0`` == run-or-reject), and QUERY_QUEUE_FULL on overflow
+or timeout."""
 
-from __future__ import annotations
+from presto_tpu.admission.groups import (QueryQueueFull, ResourceGroup,
+                                         ResourceGroupManager, Selector,
+                                         admission_scope,
+                                         current_admission)
 
-import dataclasses
-import re
-import threading
-from typing import List, Optional, Tuple
-
-from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
-
-_M_ADMITTED = _counter("presto_tpu_resource_group_admitted_total",
-                       "Queries admitted per resource group", ("group",))
-_M_REJECTED = _counter("presto_tpu_resource_group_rejected_total",
-                       "Queries rejected (queue full / slot timeout) "
-                       "per resource group", ("group",))
-_M_PEAK_QUEUED = _gauge("presto_tpu_resource_group_peak_queued",
-                        "High-water mark of queued queries per "
-                        "resource group", ("group",))
-
-
-class QueryQueueFull(RuntimeError):
-    """Reference: QUERY_QUEUE_FULL StandardErrorCode."""
-
-
-@dataclasses.dataclass
-class ResourceGroup:
-    name: str
-    hard_concurrency: int = 4
-    max_queued: int = 16
-
-    def __post_init__(self):
-        self._lock = threading.Lock()
-        self._slots = threading.Semaphore(self.hard_concurrency)
-        self._queued = 0
-        self.stats = {"admitted": 0, "rejected": 0, "peak_queued": 0}
-
-    def acquire(self, timeout_s: Optional[float] = None):
-        # a free slot admits immediately — but only when nothing is
-        # already waiting (FIFO: arrivals must not overtake the queue);
-        # max_queued only limits WAITING queries (max_queued=0 ==
-        # run-or-reject, the reference semantics)
-        with self._lock:
-            fast = self._queued == 0
-        if fast and self._slots.acquire(blocking=False):
-            with self._lock:
-                self.stats["admitted"] += 1
-            _M_ADMITTED.inc(group=self.name)
-            return _Slot(self)
-        with self._lock:
-            if self._queued >= self.max_queued:
-                self.stats["rejected"] += 1
-                _M_REJECTED.inc(group=self.name)
-                raise QueryQueueFull(
-                    f"group {self.name}: {self._queued} queued "
-                    f">= max_queued {self.max_queued}")
-            self._queued += 1
-            self.stats["peak_queued"] = max(self.stats["peak_queued"],
-                                            self._queued)
-            _M_PEAK_QUEUED.set_max(self.stats["peak_queued"],
-                                   group=self.name)
-        ok = self._slots.acquire(timeout=timeout_s)
-        with self._lock:
-            self._queued -= 1
-            if not ok:
-                self.stats["rejected"] += 1
-            else:
-                self.stats["admitted"] += 1
-        if ok:
-            _M_ADMITTED.inc(group=self.name)
-        else:
-            _M_REJECTED.inc(group=self.name)
-        if not ok:
-            raise QueryQueueFull(
-                f"group {self.name}: no slot within {timeout_s}s")
-        return _Slot(self)
-
-
-class _Slot:
-    def __init__(self, group: ResourceGroup):
-        self.group = group
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.group._slots.release()
-        return False
-
-
-@dataclasses.dataclass(frozen=True)
-class Selector:
-    """First-match rule (reference: StaticSelector user/source regexes)."""
-    group: str
-    user_regex: Optional[str] = None
-    source_regex: Optional[str] = None
-
-    def matches(self, user: str, source: str) -> bool:
-        if self.user_regex and not re.fullmatch(self.user_regex, user):
-            return False
-        if self.source_regex and not re.fullmatch(self.source_regex,
-                                                  source):
-            return False
-        return True
-
-
-class ResourceGroupManager:
-    def __init__(self, groups: Optional[List[ResourceGroup]] = None,
-                 selectors: Optional[List[Selector]] = None):
-        gs = groups or [ResourceGroup("global")]
-        self.groups = {g.name: g for g in gs}
-        self.selectors = selectors or [Selector(gs[0].name)]
-
-    def select(self, user: str = "", source: str = "") -> ResourceGroup:
-        for s in self.selectors:
-            if s.matches(user, source):
-                return self.groups[s.group]
-        raise QueryQueueFull(f"no resource group matches user={user!r}")
-
-    def info(self) -> List[Tuple[str, dict]]:
-        return [(n, dict(g.stats)) for n, g in sorted(self.groups.items())]
+__all__ = ["QueryQueueFull", "ResourceGroup", "ResourceGroupManager",
+           "Selector", "admission_scope", "current_admission"]

@@ -8,9 +8,11 @@ receives a QueryResults JSON with a `nextUri`, and polls it until
 `nextUri` disappears; `columns` + `data` batches carry the rows, and
 `stats.state` tracks QUEUED -> RUNNING -> FINISHED/FAILED.
 
-This is the L0 surface over TpuCluster: queries run on a background
-thread (the dispatcher role), results buffer per query, and each GET
-serves one data batch."""
+This is the L0 surface over TpuCluster: accepted statements go through
+the admission front door (`presto_tpu/admission/`) — shed check,
+resource-group queueing, then a bounded dispatch pool executes them —
+results buffer per query, and each GET serves one data batch.  The
+HTTP handler never spawns execution threads itself."""
 
 from __future__ import annotations
 
@@ -23,6 +25,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
+from presto_tpu.admission import (DispatchManager, OverloadedError,
+                                  QueryQueueFull, ResourceGroupManager)
+from presto_tpu.admission import dispatcher as _dispatch
+from presto_tpu.config import DEFAULT_ADMISSION
 from presto_tpu.obs.metrics import (
     counter as _counter, gauge as _gauge, render_prometheus,
 )
@@ -51,11 +57,15 @@ def _type_name(t) -> str:
 
 
 class _Query:
-    def __init__(self, qid: str, sql: str):
+    def __init__(self, qid: str, sql: str, user: str = ""):
         self.qid = qid
         self.sql = sql
+        self.user = user
         self.state = "QUEUED"
+        self.dispatch_state: Optional[str] = None
         self.error: Optional[str] = None
+        self.error_name = "GENERIC_INTERNAL_ERROR"
+        self.error_type = "INTERNAL_ERROR"
         self.columns: Optional[List[dict]] = None
         self.rows: List[tuple] = []
         self.done = threading.Event()
@@ -102,6 +112,9 @@ class _Query:
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 — rendered to the client
             self.error = f"{type(e).__name__}: {e}"[:500]
+            if isinstance(e, QueryQueueFull):
+                self.error_name = "QUERY_QUEUE_FULL"
+                self.error_type = "INSUFFICIENT_RESOURCES"
             self.state = "FAILED"
         finally:
             if self.cancelled:
@@ -122,8 +135,8 @@ class _Query:
         }
         if self.state == "FAILED":
             out["error"] = {"message": self.error,
-                            "errorName": "GENERIC_INTERNAL_ERROR",
-                            "errorType": "INTERNAL_ERROR"}
+                            "errorName": self.error_name,
+                            "errorType": self.error_type}
             return out
         if self.state != "FINISHED":
             out["nextUri"] = \
@@ -161,6 +174,8 @@ class _Query:
 def _query_info(q) -> dict:
     """ONE query-info shape for the list and detail endpoints."""
     return {"queryId": q.qid, "state": q.state, "query": q.sql,
+            "user": getattr(q, "user", ""),
+            "dispatchState": getattr(q, "dispatch_state", None),
             "error": q.error}
 
 
@@ -181,9 +196,29 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(404, {"error": "no route"})
         length = int(self.headers.get("Content-Length", 0))
         sql = self.rfile.read(length).decode()
-        q = self.server.coordinator.submit(
-            sql, idempotency_key=self.headers.get(
-                "X-Presto-Idempotency-Key"))
+        try:
+            q = self.server.coordinator.submit(
+                sql,
+                user=self.headers.get("X-Presto-User", "") or "",
+                source=self.headers.get("X-Presto-Source", "") or "",
+                idempotency_key=self.headers.get(
+                    "X-Presto-Idempotency-Key"))
+        except OverloadedError as e:
+            # load shed: refuse at the door with the advised back-off;
+            # the transport layer treats 503 + Retry-After as its own
+            # retry class and sleeps exactly this interval
+            body = json.dumps({"error": {
+                "message": str(e),
+                "errorName": "SERVER_OVERLOADED",
+                "errorType": "INSUFFICIENT_RESOURCES",
+                "retryAfterSeconds": e.retry_after_s}}).encode()
+            self.send_response(503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After", f"{e.retry_after_s:g}")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         return self._json(200, q.results_json(self.server.base, 0))
 
     def do_GET(self):
@@ -228,7 +263,7 @@ class _Handler(BaseHTTPRequestHandler):
             qs = list(co.queries.values())
             eng = co.engine
             pool = getattr(eng, "memory_pool", None)
-            rgs = getattr(eng, "resource_groups", None)
+            rgs = co.resource_groups
             return self._json(200, {
                 "nodeId": "tpu-coordinator", "role": "coordinator",
                 "environment": "tpu",
@@ -241,11 +276,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "heapUsed": pool.reserved if pool is not None else 0,
                 "heapAvailable": 16 << 30, "nonHeapUsed": 0,
                 # per-group admission stats (reference:
-                # ResourceGroupInfo on the cluster resource) — absent
-                # when the engine has no admission control attached
+                # ResourceGroupInfo on the cluster resource): live
+                # queue depth / running plus lifetime counters per row
                 "resourceGroups": (
                     {name: stats for name, stats in rgs.info()}
-                    if rgs is not None else {})})
+                    if rgs is not None else {}),
+                # front-door snapshot: pool occupancy, queue-wait
+                # percentiles, shed counters and thresholds
+                "admission": co.dispatcher.snapshot()})
         m = _TRACE.match(path)
         if m:
             # stitched cross-node span dump for one query id (worker
@@ -286,21 +324,44 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         m = _CANCEL.match(self.path.split("?")[0])
         if m:
-            q = self.server.coordinator.queries.get(m.group(1))
+            co = self.server.coordinator
+            q = co.queries.get(m.group(1))
             if q is not None:
                 q.cancelled = True
+                co.cancel(q)
             self.send_response(204)      # no body with 204
             self.end_headers()
             return
         return self._json(404, {"error": "no route"})
 
 
+class _StatementHTTPServer(ThreadingHTTPServer):
+    #: default socketserver backlog is 5 — a burst of concurrent
+    #: clients gets connection-reset at the ACCEPT queue before
+    #: admission control can even answer; the front door must be able
+    #: to say no itself (shed/reject) instead of the kernel dropping
+    #: connections
+    request_queue_size = 256
+
+
 class StatementServer:
     """The coordinator's client-facing HTTP surface over any engine with
     execute_sql/plan_sql (TpuCluster or LocalEngine)."""
 
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 admission=None, resource_groups=None):
         self.engine = engine
+        # share the engine's resource groups when it has them so the
+        # front door and the engine agree on admission state (the
+        # engine's own acquire becomes a no-op under the dispatcher)
+        self.resource_groups = (resource_groups
+                                or getattr(engine, "resource_groups",
+                                           None)
+                                or ResourceGroupManager())
+        self.admission_config = admission or DEFAULT_ADMISSION
+        self.dispatcher = DispatchManager(
+            self.resource_groups, self.admission_config,
+            memory_pool=getattr(engine, "memory_pool", None))
         self.queries: Dict[str, _Query] = {}
         # client idempotency key -> qid: POST /v1/statement is
         # auto-retried by the transport, and a retry after a LOST
@@ -309,7 +370,7 @@ class StatementServer:
         # duplicate rows)
         self._idempotency: Dict[str, str] = {}
         self._submit_lock = threading.Lock()
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd = _StatementHTTPServer((host, port), _Handler)
         self.httpd.coordinator = self
         self.port = self.httpd.server_address[1]
         self.base = f"http://{host}:{self.port}"
@@ -320,7 +381,7 @@ class StatementServer:
     #: completed queries kept for /v1/query info (QueryTracker role)
     MAX_TRACKED = 200
 
-    def submit(self, sql: str,
+    def submit(self, sql: str, user: str = "", source: str = "",
                idempotency_key: Optional[str] = None) -> _Query:
         with self._submit_lock:
             if idempotency_key is not None:
@@ -328,8 +389,12 @@ class StatementServer:
                 dup = self.queries.get(known) if known else None
                 if dup is not None:
                     return dup          # retried POST: do NOT re-execute
+            # shed BEFORE registering: a refused statement must leave
+            # no trace (the client retries with the same idempotency
+            # key and must get a fresh admission decision)
+            self.dispatcher.shedder.check()
             qid = f"{uuid.uuid4().hex[:16]}"
-            q = _Query(qid, sql)
+            q = _Query(qid, sql, user=user)
             self.queries[qid] = q
             if idempotency_key is not None:
                 self._idempotency[idempotency_key] = qid
@@ -344,8 +409,42 @@ class StatementServer:
                 self._idempotency = {
                     k: v for k, v in self._idempotency.items()
                     if v in self.queries}
-        spawn("coordinator", f"query-{qid}", q.run, args=(self.engine,))
+
+        def _on_state(state: str, error) -> None:
+            q.dispatch_state = state
+            if state == _dispatch.FAILED and error is not None \
+                    and not q.done.is_set():
+                # rejected before execution (queue full, queue-timeout
+                # eviction, cancelled while queued): q.run never ran,
+                # so close the protocol query here
+                q.error = f"{type(error).__name__}: {error}"[:500]
+                if isinstance(error, QueryQueueFull):
+                    q.error_name = "QUERY_QUEUE_FULL"
+                    q.error_type = "INSUFFICIENT_RESOURCES"
+                q.state = "FAILED"
+                _M_QUERIES.inc(state="FAILED")
+                q.done.set()
+
+        try:
+            q._handle = self.dispatcher.submit(
+                lambda: q.run(self.engine), user=user, source=source,
+                query_id=qid, listener=_on_state)
+        except OverloadedError:
+            with self._submit_lock:
+                self.queries.pop(qid, None)
+                if idempotency_key is not None:
+                    self._idempotency.pop(idempotency_key, None)
+            raise
+        except QueryQueueFull as e:
+            _on_state(_dispatch.FAILED, e)      # clean rejection
         return q
+
+    def cancel(self, q: _Query) -> bool:
+        """Withdraw a statement still waiting for admission; running
+        queries are only flagged (the engine call is uninterruptible,
+        `_Query.run` reports the cancellation when it returns)."""
+        h = getattr(q, "_handle", None)
+        return h is not None and self.dispatcher.cancel(h)
 
     def start(self) -> "StatementServer":
         self._thread.start()
@@ -354,9 +453,11 @@ class StatementServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.dispatcher.stop()
 
 
-def run_statement(base_uri: str, sql: str, timeout_s: float = 600):
+def run_statement(base_uri: str, sql: str, timeout_s: float = 600,
+                  user: str = ""):
     """Client side of the protocol (StatementClientV1.advance loop):
     POST, then follow nextUri until it disappears; returns
     (columns, rows). Raises on FAILED."""
@@ -369,10 +470,12 @@ def run_statement(base_uri: str, sql: str, timeout_s: float = 600):
     # and the server dedupes on the key so a retry after a lost
     # response attaches to the in-flight query instead of re-running
     # the SQL (which would duplicate INSERT/CTAS writes)
+    headers = {"Content-Type": "text/plain",
+               "X-Presto-Idempotency-Key": uuid.uuid4().hex}
+    if user:
+        headers["X-Presto-User"] = user
     payload = client.post(f"{base_uri}/v1/statement", sql.encode(),
-                          headers={"Content-Type": "text/plain",
-                                   "X-Presto-Idempotency-Key":
-                                   uuid.uuid4().hex},
+                          headers=headers,
                           request_class="statement").json()
     columns, rows = None, []
     deadline = time.time() + timeout_s

@@ -1,6 +1,8 @@
 """Deterministic fault-injection tooling for chaos testing the
-transport layer (see testing/faults.py)."""
+transport layer (testing/faults.py) and the closed-loop load harness
+for the admission front door (testing/load.py)."""
 
 from presto_tpu.testing.faults import FaultInjector, FaultSpec
+from presto_tpu.testing.load import LoadHarness, LoadReport
 
-__all__ = ["FaultInjector", "FaultSpec"]
+__all__ = ["FaultInjector", "FaultSpec", "LoadHarness", "LoadReport"]

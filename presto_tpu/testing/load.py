@@ -1,0 +1,283 @@
+"""Closed-loop load harness for the admission front door.
+
+Reference: the benchto/verifier closed-loop drivers used against the
+reference engine's dispatcher — N concurrent dbapi clients, a zipfian
+tenant mix, optional deterministic FaultInjector chaos, and an
+accepted/rejected/dropped ledger with queue-wait and end-to-end
+latency percentiles.
+
+The central SLO is the **zero-dropped-query invariant**: every
+submitted statement either completes or is *cleanly* rejected with a
+retryable, well-formed error (QUERY_QUEUE_FULL-class or an overload
+response).  Anything else — a hung client, a torn response, an
+unclassified exception — counts as *dropped* and fails the gate.
+
+Usage (in-process server):
+
+    harness = LoadHarness(server.base,
+                          tenants={"alpha": 2, "beta": 1, "gamma": 1},
+                          clients=200, statements=200)
+    report = harness.run(dispatcher=server.dispatcher,
+                         groups=server.resource_groups)
+    report.assert_zero_dropped()
+    report.assert_wfq_ratio(tolerance=0.30)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.utils.threads import spawn
+
+
+def percentile(values: Sequence[float], p: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, int(p * len(s)))]
+
+
+def zipf_weights(n: int, s: float) -> List[float]:
+    """Normalized zipfian pmf over ranks 1..n."""
+    raw = [1.0 / (k ** s) for k in range(1, n + 1)]
+    total = sum(raw)
+    return [r / total for r in raw]
+
+
+class LoadReport:
+    """Ledger + latency percentiles + WFQ verification for one run."""
+
+    def __init__(self, tenants: Dict[str, int]):
+        self.tenants = dict(tenants)
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0          # clean QUERY_QUEUE_FULL-class
+        self.shed = 0              # clean overload (429/503+Retry-After)
+        self.dropped = 0           # anything unclean — must be zero
+        self.drop_reasons: List[str] = []
+        self.e2e_s: List[float] = []
+        self.queue_wait_s: List[float] = []
+        self.per_tenant: Dict[str, Dict[str, int]] = {
+            t: {"submitted": 0, "completed": 0, "rejected": 0,
+                "shed": 0}
+            for t in tenants}
+        self.grant_counts: Dict[str, int] = {}
+        self.saturated_grants: Dict[str, int] = {}
+        self.peak_threads = 0
+
+    # -- summaries ----------------------------------------------------
+
+    def ledger(self) -> dict:
+        return {"submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected, "shed": self.shed,
+                "dropped": self.dropped}
+
+    def latency(self) -> dict:
+        return {"e2e_p50_s": percentile(self.e2e_s, 0.50),
+                "e2e_p99_s": percentile(self.e2e_s, 0.99),
+                "queue_wait_p50_s": percentile(self.queue_wait_s, 0.50),
+                "queue_wait_p99_s": percentile(self.queue_wait_s, 0.99)}
+
+    def to_dict(self) -> dict:
+        return {"ledger": self.ledger(), "latency": self.latency(),
+                "per_tenant": self.per_tenant,
+                "saturated_grants": self.saturated_grants,
+                "peak_threads": self.peak_threads}
+
+    # -- SLO gates ----------------------------------------------------
+
+    def assert_zero_dropped(self) -> None:
+        if self.dropped:
+            raise AssertionError(
+                f"{self.dropped} dropped queries (first reasons: "
+                f"{self.drop_reasons[:5]})")
+        if self.completed + self.rejected + self.shed != self.submitted:
+            raise AssertionError(
+                f"ledger does not balance: {self.ledger()}")
+
+    def assert_wfq_ratio(self, tolerance: float = 0.30,
+                         min_samples: int = 20) -> None:
+        """Dispatch counts in the saturated window (every tenant
+        backlogged) must match configured weights within
+        ``tolerance``."""
+        sat = self.saturated_grants
+        if sum(sat.values()) < min_samples:
+            raise AssertionError(
+                f"too few saturated grants to judge WFQ "
+                f"({sum(sat.values())} < {min_samples}): the load run "
+                f"never backlogged every tenant simultaneously")
+        total_g = sum(sat.values())
+        total_w = sum(self.tenants.values())
+        for tenant, weight in self.tenants.items():
+            want = weight / total_w
+            got = sat.get(tenant, 0) / total_g
+            if abs(got - want) > tolerance * want:
+                raise AssertionError(
+                    f"WFQ share for {tenant}: got {got:.3f}, want "
+                    f"{want:.3f} ±{tolerance:.0%} "
+                    f"(saturated grants {sat})")
+
+
+class LoadHarness:
+    """Drive a statement server with concurrent dbapi clients."""
+
+    def __init__(self, base_uri: str, tenants: Dict[str, int],
+                 clients: int = 32, statements: int = 200,
+                 sql: str = "select 1", zipf_s: float = 1.1,
+                 seed: int = 0, timeout_s: float = 120.0,
+                 fault_injector=None):
+        if not tenants:
+            raise ValueError("at least one tenant required")
+        self.base_uri = base_uri
+        self.tenants = dict(tenants)
+        self.clients = clients
+        self.statements = statements
+        self.sql = sql
+        self.zipf_s = zipf_s
+        self.seed = seed
+        self.timeout_s = timeout_s
+        self.fault_injector = fault_injector
+
+    def _tenant_mix(self) -> List[str]:
+        """Zipfian tenant assignment per statement, deterministic in
+        the seed; every tenant appears at least once when statement
+        count allows."""
+        rng = random.Random(self.seed)
+        names = list(self.tenants)
+        weights = zipf_weights(len(names), self.zipf_s)
+        mix = [rng.choices(names, weights=weights)[0]
+               for _ in range(self.statements)]
+        for i, t in enumerate(names):
+            if t not in mix and i < len(mix):
+                mix[i] = t
+        return mix
+
+    def run(self, dispatcher=None, groups=None) -> LoadReport:
+        """Submit ``statements`` statements from ``clients`` concurrent
+        dbapi clients.  ``dispatcher`` / ``groups`` (the in-process
+        server's objects) enrich the report with queue-wait
+        percentiles and the WFQ grant log."""
+        from presto_tpu.client.dbapi import (DatabaseError,
+                                             OverloadedError, connect)
+
+        report = LoadReport(self.tenants)
+        mix = self._tenant_mix()
+        report.submitted = len(mix)
+        for t in mix:
+            report.per_tenant[t]["submitted"] += 1
+        work: List[Tuple[int, str]] = list(enumerate(mix))
+        work_lock = threading.Lock()
+        results_lock = threading.Lock()
+        start_gate = threading.Event()
+
+        injector = self.fault_injector
+        if injector is not None:
+            from presto_tpu.protocol.transport import get_client
+            get_client().fault_injector = injector
+
+        def _one(tenant: str) -> Tuple[str, float, Optional[str]]:
+            conn = connect(self.base_uri, timeout_s=self.timeout_s,
+                           user=tenant)
+            t0 = time.monotonic()
+            try:
+                cur = conn.cursor()
+                cur.execute(self.sql)
+                cur.fetchall()
+                return "completed", time.monotonic() - t0, None
+            except OverloadedError:
+                return "shed", time.monotonic() - t0, None
+            except DatabaseError as e:
+                msg = str(e)
+                if "QueryQueueFull" in msg or "QUEUE" in msg.upper():
+                    return "rejected", time.monotonic() - t0, None
+                return "dropped", time.monotonic() - t0, msg
+            except Exception as e:  # noqa: BLE001 — ledger, not crash
+                return ("dropped", time.monotonic() - t0,
+                        f"{type(e).__name__}: {e}")
+            finally:
+                conn.close()
+
+        def _client_loop() -> None:
+            start_gate.wait()
+            while True:
+                with work_lock:
+                    if not work:
+                        return
+                    _, tenant = work.pop(0)
+                outcome, dt, reason = _one(tenant)
+                with results_lock:
+                    if outcome == "dropped":
+                        report.dropped += 1
+                        if reason:
+                            report.drop_reasons.append(reason)
+                    else:
+                        setattr(report, outcome,
+                                getattr(report, outcome) + 1)
+                        report.per_tenant[tenant][outcome] += 1
+                    if outcome == "completed":
+                        report.e2e_s.append(dt)
+
+        threads = [spawn("loadgen", f"client-{i}", _client_loop,
+                         start=False)
+                   for i in range(min(self.clients, len(work)) or 1)]
+        for t in threads:
+            t.start()
+        start_gate.set()
+        deadline = time.monotonic() + self.timeout_s
+        sampler_stop = threading.Event()
+
+        def _sample_threads() -> None:
+            while not sampler_stop.is_set():
+                report.peak_threads = max(report.peak_threads,
+                                          threading.active_count())
+                sampler_stop.wait(0.05)
+
+        sampler = spawn("loadgen", "thread-sampler", _sample_threads)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        sampler_stop.set()
+        sampler.join(timeout=1.0)
+        still = [t for t in threads if t.is_alive()]
+        if still:
+            with results_lock:
+                report.dropped += len(still)
+                report.drop_reasons.append(
+                    f"{len(still)} client(s) hung past "
+                    f"{self.timeout_s}s")
+        if injector is not None:
+            from presto_tpu.protocol.transport import get_client
+            get_client().fault_injector = None
+
+        if dispatcher is not None:
+            report.queue_wait_s = dispatcher.recent_waits()
+        if groups is not None:
+            self._fold_grant_log(report, groups)
+        return report
+
+    def _fold_grant_log(self, report: LoadReport, groups) -> None:
+        """Count grants per tenant, plus grants made while EVERY tenant
+        group had backlog — the window where WFQ ratios are defined."""
+        tenant_paths = {}
+        for name in self.tenants:
+            g = groups.groups.get(name)
+            if g is not None:
+                tenant_paths[g.path] = name
+        if not tenant_paths:
+            return
+        for leaf_path, backlogged in groups.grant_log():
+            tenant = tenant_paths.get(leaf_path)
+            if tenant is None:
+                continue
+            report.grant_counts[tenant] = \
+                report.grant_counts.get(tenant, 0) + 1
+            # the grant log snapshots backlog AFTER the granted waiter
+            # was popped, so the granted leaf itself counts as
+            # backlogged for the saturation test
+            if all(p in backlogged or p == leaf_path
+                   for p in tenant_paths):
+                report.saturated_grants[tenant] = \
+                    report.saturated_grants.get(tenant, 0) + 1

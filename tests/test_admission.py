@@ -1,0 +1,419 @@
+"""Admission front door unit tests: hierarchical resource groups with
+stride WFQ (admission/groups.py), the bounded dispatcher state machine
+(admission/dispatcher.py), and the load shedder
+(admission/shedding.py).
+
+Reference semantics: InternalResourceGroup.java (hierarchical caps,
+scheduling_weight, per-group memory quota, queue timeout) +
+DispatchManager / QueuedStatementResource (QUEUED ->
+WAITING_FOR_RESOURCES -> DISPATCHING -> RUNNING over a bounded
+dispatch pool) + ClusterMemoryManager-style shedding.
+"""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.admission import (
+    DISPATCHING, FAILED, FINISHED, QUEUED, RUNNING,
+    WAITING_FOR_RESOURCES, DispatchManager, OverloadedError,
+    QueryQueueFull, ResourceGroup, ResourceGroupManager, Selector,
+)
+from presto_tpu.admission.shedding import LoadShedder
+from presto_tpu.config import AdmissionConfig
+from presto_tpu.exec.memory import MemoryPool
+
+
+def _collector():
+    grants, rejects = [], []
+    return grants, rejects, grants.append, rejects.append
+
+
+# ===================================================================
+# WFQ stride scheduling
+# ===================================================================
+
+def test_wfq_stride_ratio_two_to_one():
+    """With both children backlogged and one slot cycling, grants in
+    the saturated window follow scheduling_weight 2:1 exactly (stride
+    scheduling is deterministic — no statistical tolerance needed)."""
+    a = ResourceGroup("a", hard_concurrency=1, max_queued=64,
+                      scheduling_weight=2)
+    b = ResourceGroup("b", hard_concurrency=1, max_queued=64,
+                      scheduling_weight=1)
+    root = ResourceGroup("root", hard_concurrency=1, max_queued=0,
+                         children=[a, b])
+    grants, _, g, r = _collector()
+    for _ in range(30):
+        a.offer(g, r)
+    for _ in range(30):
+        b.offer(g, r)
+    # drain: each release frees the single root slot -> one new grant
+    i = 0
+    while i < len(grants):
+        slot = grants[i]
+        i += 1
+        slot.release()
+    assert len(grants) == 60
+    sat = {"root.a": 0, "root.b": 0}
+    for leaf_path, backlogged in root.grant_log:
+        # post-pop snapshot: the granted leaf counts as backlogged
+        if all(p in backlogged or p == leaf_path for p in sat):
+            sat[leaf_path] += 1
+    assert sat["root.a"] >= 20          # window is most of the run
+    # deterministic up to the window's edge grants (the first `a`
+    # grant lands before `b` has any backlog)
+    assert abs(sat["root.a"] - 2 * sat["root.b"]) <= 2
+
+
+def test_wfq_dormant_group_forfeits_banked_credit():
+    """A group idle while its sibling ran does not bank pass credit:
+    on waking it shares from *now* instead of monopolising the
+    scheduler until it catches up."""
+    a = ResourceGroup("a", hard_concurrency=1, max_queued=64)
+    b = ResourceGroup("b", hard_concurrency=1, max_queued=64)
+    root = ResourceGroup("root", hard_concurrency=1, max_queued=0,
+                         children=[a, b])
+    grants, _, g, r = _collector()
+    for _ in range(20):
+        a.offer(g, r)
+    i = 0
+    while i < len(grants):
+        slot = grants[i]
+        i += 1
+        slot.release()
+    assert a._pass > 0 and b._pass == 0.0
+    # b wakes with a long-banked deficit; its pass normalizes to the
+    # active sibling minimum, so it shares from now instead of
+    # monopolising until the deficit is repaid
+    hold = []
+    a.offer(hold.append, r)             # takes the root slot
+    a.offer(g, r)                       # a is backlogged again
+    b.offer(g, r)                       # b wakes beside it
+    assert b._pass == a._pass           # credit forfeited on wake
+
+
+# ===================================================================
+# hierarchy: ancestor caps, memory quotas, queue timeout
+# ===================================================================
+
+def test_internal_node_cap_is_aggregate_over_subtree():
+    a = ResourceGroup("a", hard_concurrency=2, max_queued=8)
+    b = ResourceGroup("b", hard_concurrency=2, max_queued=8)
+    root = ResourceGroup("root", hard_concurrency=2, max_queued=0,
+                         children=[a, b])
+    grants, _, g, r = _collector()
+    a.offer(g, r)
+    b.offer(g, r)
+    assert len(grants) == 2
+    a.offer(g, r)                       # leaf has room, root does not
+    assert len(grants) == 2
+    assert len(a._queue) == 1
+    grants[0].release()                 # root slot frees -> drain
+    assert len(grants) == 3
+    assert root._running == 2
+
+
+def test_memory_quota_blocks_until_freed_then_fifo():
+    g1 = ResourceGroup("etl", hard_concurrency=4, max_queued=8,
+                       memory_quota_bytes=100)
+    mgr = ResourceGroupManager([g1], [Selector("etl")])
+    pool = MemoryPool(10_000)
+    mgr.attach_memory_pool(pool)
+    grants, _, g, r = _collector()
+    g1.offer(g, r, query_id="q1")
+    assert len(grants) == 1
+    pool.reserve("q1", 150)             # group now over its quota
+    order = []
+    g1.offer(lambda s: order.append("A"), r, query_id="qA")
+    # capacity is free but the quota blocks: a later arrival must
+    # queue BEHIND the waiter, not overtake it
+    g1.offer(lambda s: order.append("B"), r, query_id="qB")
+    assert order == [] and len(g1._queue) == 2
+    pool.free("q1")
+    mgr.poke()                          # re-check quotas -> drain FIFO
+    assert order == ["A", "B"]
+
+
+def test_queue_timeout_evicts_with_queue_full_error():
+    g1 = ResourceGroup("adhoc", hard_concurrency=1, max_queued=8,
+                       queue_timeout_s=0.05)
+    mgr = ResourceGroupManager([g1], [Selector("adhoc")])
+    grants, rejects, g, r = _collector()
+    g1.offer(g, r)
+    g1.offer(g, r)                      # queued behind the first
+    time.sleep(0.08)
+    mgr.evict_expired()
+    assert len(rejects) == 1
+    assert isinstance(rejects[0], QueryQueueFull)
+    assert "queue_timeout" in str(rejects[0])
+    assert g1.stats["rejected"] == 1 and len(g1._queue) == 0
+
+
+# ===================================================================
+# legacy blocking acquire() edge semantics
+# ===================================================================
+
+def test_acquire_timeout_while_queued_releases_queue_slot():
+    g1 = ResourceGroup("q", hard_concurrency=1, max_queued=1)
+    ResourceGroupManager([g1], [Selector("q")])
+    slot = g1.acquire(timeout_s=1)
+    with pytest.raises(QueryQueueFull) as ei:
+        g1.acquire(timeout_s=0.05)      # queues, then times out
+    assert "no slot within" in str(ei.value)
+    assert len(g1._queue) == 0          # the queue slot was withdrawn
+    # a later arrival can still ENQUEUE (not bounced off a ghost
+    # occupant) — it times out waiting, it is not rejected for overflow
+    with pytest.raises(QueryQueueFull) as ei2:
+        g1.acquire(timeout_s=0.05)
+    assert "max_queued" not in str(ei2.value)
+    assert g1.stats["rejected"] == 2
+    slot.release()
+
+
+def test_max_queued_zero_is_run_or_reject():
+    g1 = ResourceGroup("probe", hard_concurrency=1, max_queued=0)
+    ResourceGroupManager([g1], [Selector("probe")])
+    slot = g1.acquire(timeout_s=1)
+    t0 = time.monotonic()
+    with pytest.raises(QueryQueueFull) as ei:
+        g1.acquire(timeout_s=10)        # must NOT wait 10s
+    assert time.monotonic() - t0 < 1.0
+    assert "max_queued" in str(ei.value)
+    slot.release()
+    with g1.acquire(timeout_s=1):       # free again: admits
+        pass
+    assert g1.stats == {"admitted": 2, "rejected": 1, "peak_queued": 0}
+
+
+def test_acquire_fifo_no_overtake():
+    g1 = ResourceGroup("fifo", hard_concurrency=1, max_queued=4)
+    ResourceGroupManager([g1], [Selector("fifo")])
+    slot = g1.acquire(timeout_s=1)
+    order = []
+
+    def waiter(tag):
+        with g1.acquire(timeout_s=5):
+            order.append(tag)
+
+    t1 = threading.Thread(target=waiter, args=("first",))
+    t1.start()
+    while not g1._queue:                # first waiter is queued
+        time.sleep(0.005)
+    t2 = threading.Thread(target=waiter, args=("second",))
+    t2.start()
+    while len(g1._queue) < 2:
+        time.sleep(0.005)
+    slot.release()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert order == ["first", "second"]
+
+
+# ===================================================================
+# dispatcher: state machine + bounded pool
+# ===================================================================
+
+def _mgr(name="adhoc", **kw):
+    g1 = ResourceGroup(name, **kw)
+    return g1, ResourceGroupManager([g1], [Selector(name)])
+
+
+def test_dispatcher_bounded_pool_and_states():
+    _, mgr = _mgr(hard_concurrency=8, max_queued=8)
+    dm = DispatchManager(mgr, AdmissionConfig(max_dispatch_threads=2,
+                                              dispatch_tick_s=0.05))
+    try:
+        release = threading.Event()
+        names = []
+
+        def work():
+            names.append(threading.current_thread().name)
+            release.wait(5)
+
+        hs = [dm.submit(work, query_id=f"q{i}") for i in range(4)]
+        deadline = time.monotonic() + 5
+        while dm._active < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # all 4 hold admission slots, but only pool_size may RUN
+        assert dm._active == 2
+        assert sum(1 for h in hs if h.state == RUNNING) == 2
+        assert sum(1 for h in hs if h.state == DISPATCHING) == 2
+        release.set()
+        for h in hs:
+            assert h.done.wait(5)
+            assert h.state == FINISHED
+        # execution rode the pre-spawned dispatch pool, not
+        # per-query threads
+        assert all("-dispatch-" in n for n in names)
+        assert len(set(names)) <= 2
+    finally:
+        dm.stop()
+
+
+def test_dispatcher_full_state_progression_when_queued():
+    _, mgr = _mgr(hard_concurrency=1, max_queued=4)
+    dm = DispatchManager(mgr, AdmissionConfig(max_dispatch_threads=2,
+                                              dispatch_tick_s=0.05))
+    try:
+        gate = threading.Event()
+        seen = []
+        h1 = dm.submit(lambda: gate.wait(5), query_id="q1")
+        h2 = dm.submit(lambda: None, query_id="q2",
+                       listener=lambda s, e: seen.append(s))
+        assert h2.state == WAITING_FOR_RESOURCES
+        gate.set()
+        assert h2.done.wait(5) and h1.done.wait(5)
+        assert seen == [WAITING_FOR_RESOURCES, DISPATCHING, RUNNING,
+                        FINISHED]
+    finally:
+        dm.stop()
+
+
+def test_dispatcher_run_error_fails_query_and_frees_slot():
+    g1, mgr = _mgr(hard_concurrency=1, max_queued=4)
+    dm = DispatchManager(mgr, AdmissionConfig(max_dispatch_threads=1,
+                                              dispatch_tick_s=0.05))
+    try:
+        def boom():
+            raise ValueError("engine crashed")
+
+        h = dm.submit(boom, query_id="q1")
+        assert h.done.wait(5)
+        assert h.state == FAILED
+        assert isinstance(h.error, ValueError)
+        assert g1._running == 0         # slot released on failure
+        h2 = dm.submit(lambda: None, query_id="q2")
+        assert h2.done.wait(5) and h2.state == FINISHED
+    finally:
+        dm.stop()
+
+
+def test_dispatcher_cancel_while_queued():
+    _, mgr = _mgr(hard_concurrency=1, max_queued=4)
+    dm = DispatchManager(mgr, AdmissionConfig(max_dispatch_threads=1,
+                                              dispatch_tick_s=0.05))
+    try:
+        gate = threading.Event()
+        h1 = dm.submit(lambda: gate.wait(5), query_id="q1")
+        h2 = dm.submit(lambda: None, query_id="q2")
+        assert dm.cancel(h2) is True
+        assert h2.state == FAILED
+        assert isinstance(h2.error, QueryQueueFull)
+        assert dm.cancel(h2) is False   # already terminal
+        gate.set()
+        assert h1.done.wait(5)
+        assert dm.cancel(h1) is False   # ran to completion
+    finally:
+        dm.stop()
+
+
+def test_dispatcher_queue_full_raises_on_submit():
+    _, mgr = _mgr(hard_concurrency=1, max_queued=0)
+    dm = DispatchManager(mgr, AdmissionConfig(max_dispatch_threads=1,
+                                              dispatch_tick_s=0.05))
+    try:
+        gate = threading.Event()
+        dm.submit(lambda: gate.wait(5), query_id="q1")
+        with pytest.raises(QueryQueueFull):
+            dm.submit(lambda: None, query_id="q2")
+        gate.set()
+    finally:
+        dm.stop()
+
+
+# ===================================================================
+# load shedding
+# ===================================================================
+
+def test_shedder_trips_on_queue_depth():
+    g1, mgr = _mgr(hard_concurrency=1, max_queued=8)
+    grants, _, g, r = _collector()
+    g1.offer(g, r)
+    g1.offer(g, r)                      # 1 queued
+    g1.offer(g, r)                      # 2 queued
+    shed = LoadShedder(AdmissionConfig(shed_max_queued=2), mgr)
+    with pytest.raises(OverloadedError) as ei:
+        shed.check()
+    assert ei.value.reason.startswith("queue_depth")
+    assert ei.value.retry_after_s == 1.0
+    assert shed.shed_counts["queue_depth"] == 1
+
+
+def test_shedder_trips_on_heap_fraction():
+    _, mgr = _mgr()
+    pool = MemoryPool(1000)
+    pool.reserve("q1", 960)
+    shed = LoadShedder(AdmissionConfig(shed_heap_fraction=0.95), mgr,
+                       memory_pool=pool)
+    with pytest.raises(OverloadedError) as ei:
+        shed.check()
+    assert ei.value.reason.startswith("heap")
+    pool.free("q1")
+    shed.check()                        # quiet again after release
+
+
+def test_shedder_trips_on_queue_wait_p99():
+    _, mgr = _mgr()
+    shed = LoadShedder(AdmissionConfig(shed_queue_wait_p99_s=20.0),
+                       mgr, recent_waits=lambda: [30.0] * 25)
+    with pytest.raises(OverloadedError) as ei:
+        shed.check()
+    assert ei.value.reason.startswith("queue_wait")
+    # below the minimum sample count the signal is not trusted
+    quiet = LoadShedder(AdmissionConfig(shed_queue_wait_p99_s=20.0),
+                        mgr, recent_waits=lambda: [30.0] * 5)
+    quiet.check()
+
+
+# ===================================================================
+# introspection
+# ===================================================================
+
+def test_manager_info_rows_and_metrics():
+    a = ResourceGroup("a", hard_concurrency=1, max_queued=8,
+                      scheduling_weight=2)
+    root = ResourceGroup("root", hard_concurrency=1, max_queued=0,
+                         children=[a])
+    mgr = ResourceGroupManager([root], [Selector("a")])
+    grants, _, g, r = _collector()
+    a.offer(g, r)
+    a.offer(g, r)                       # queued
+    rows = dict(mgr.info())
+    assert rows["root.a"]["running"] == 1
+    assert rows["root.a"]["queued"] == 1
+    assert rows["root.a"]["weight"] == 2
+    assert rows["root.a"]["admitted"] == 1
+    from presto_tpu.obs.metrics import render_prometheus
+    text = render_prometheus()
+    assert "presto_tpu_admission_queue_depth" in text
+    assert "presto_tpu_admission_queue_wait_seconds" in text
+    grants[0].release()
+
+
+def test_selector_first_match_and_leaf_required():
+    a = ResourceGroup("a", hard_concurrency=1)
+    root = ResourceGroup("root", hard_concurrency=1, children=[a])
+    mgr = ResourceGroupManager(
+        [root], [Selector("a", user_regex="alice"), Selector("a")])
+    assert mgr.select(user="alice") is a
+    assert mgr.select(user="bob") is a
+    with pytest.raises(QueryQueueFull):
+        # a selector must land on a leaf; internal nodes cannot admit
+        ResourceGroupManager([root], [Selector("root")]).select()
+
+
+def test_explain_analyze_carries_admission_line():
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.server.cluster import TpuCluster
+
+    cluster = TpuCluster(TpchConnector(0.01), n_workers=2)
+    try:
+        rows = cluster.execute_sql(
+            "explain analyze select count(*) from nation")
+        text = "\n".join(r[0] for r in rows)
+        assert "Admission: group=" in text
+        assert "queue_wait=" in text
+    finally:
+        cluster.stop()

@@ -48,7 +48,8 @@ def test_rule_catalog_complete():
     assert {"rpc-chokepoint", "exchange-chokepoint", "spool-chokepoint",
             "mesh-chokepoint", "metric-name-grammar", "thread-discipline",
             "no-blocking-under-lock", "lock-leak",
-            "no-jax-in-control-plane"} <= names
+            "no-jax-in-control-plane",
+            "no-spawn-in-request-handler"} <= names
 
 
 # ===================================================================
@@ -188,6 +189,45 @@ def test_no_jax_in_control_plane_fires():
     # lazy function-level import is the sanctioned pattern
     assert not _findings("no-jax-in-control-plane", {
         bad: "def f():\n    import jax\n    return jax\n"}, planted=bad)
+
+
+def test_no_spawn_in_request_handler_fires():
+    bad = "presto_tpu/server/evil.py"
+    src = (
+        "from presto_tpu.utils.threads import spawn\n"
+        "class H:\n"
+        "    def do_POST(self):\n"
+        "        spawn('coordinator', 'q-1', print)\n"
+    )
+    fs = _findings("no-spawn-in-request-handler", {bad: src},
+                   planted=bad)
+    assert fs and "admission dispatcher" in fs[0].message
+    # a raw Thread in a handler fires too
+    fs = _findings("no-spawn-in-request-handler", {
+        bad: "import threading\n"
+             "class H:\n"
+             "    def do_GET(self):\n"
+             "        threading.Thread(target=print).start()\n"},
+        planted=bad)
+    assert fs
+    # spawn OUTSIDE a handler method is the dispatcher pool's job —
+    # allowed (thread-discipline governs it separately)
+    assert not _findings("no-spawn-in-request-handler", {
+        bad: "from presto_tpu.utils.threads import spawn\n"
+             "class S:\n"
+             "    def start_pool(self):\n"
+             "        spawn('coordinator', 'dispatch-0', print)\n"},
+        planted=bad)
+    # a nested def inside a handler (deferred work handed elsewhere)
+    # is not a spawn AT request time
+    assert not _findings("no-spawn-in-request-handler", {
+        bad: "from presto_tpu.utils.threads import spawn\n"
+             "class H:\n"
+             "    def do_POST(self):\n"
+             "        def later():\n"
+             "            spawn('coordinator', 'x', print)\n"
+             "        return later\n"},
+        planted=bad)
 
 
 # ===================================================================

@@ -20,7 +20,7 @@ from presto_tpu.protocol.exchange_client import PageStream, \
     count_frames, frames_complete
 from presto_tpu.protocol.transport import (
     CircuitBreaker, CircuitOpenError, FatalResponseError, HttpClient,
-    RetriesExhaustedError, WorkerRestartedError,
+    RetriesExhaustedError, ServerOverloadedError, WorkerRestartedError,
 )
 from presto_tpu.testing import FaultInjector, FaultSpec
 
@@ -186,6 +186,94 @@ def test_breaker_opens_then_half_open_readmits(scripted):
     with pytest.raises(RetriesExhaustedError):
         client.request("http://127.0.0.1:1/v1/info",
                        request_class="probe")
+
+
+# ------------------------------------------------------------ load shedding
+def test_503_retry_after_sleeps_advised_interval(scripted):
+    """A deliberate shed (503 + Retry-After) is a distinct retry class:
+    the client sleeps the SERVER's advised interval instead of jitter
+    backoff, and the breaker takes no penalty — the host answered."""
+    from presto_tpu.protocol.transport import _M_RETRY_AFTER, _host_of
+
+    srv, base = scripted([(503, b"busy", {"Retry-After": "0.5"}),
+                          (200, b"ok", None)])
+    sleeps = []
+    client = HttpClient(FAST, sleep=sleeps.append)
+    before = _M_RETRY_AFTER.value(host=_host_of(base))
+    resp = client.request(f"{base}/v1/statement", method="POST",
+                          body=b"select 1", request_class="statement")
+    assert resp.body == b"ok"
+    assert sleeps == [0.5]              # advised interval, not jitter
+    assert len(srv.requests) == 2
+    assert client.breaker(base).state == CircuitBreaker.CLOSED
+    assert _M_RETRY_AFTER.value(host=_host_of(base)) == before + 1
+
+
+def test_429_is_retried_not_fatal(scripted):
+    """429 is overload even without Retry-After — retried (with jitter
+    backoff), never classified as a fatal 4xx."""
+    srv, base = scripted([(429, b"slow down", None), (200, b"ok", None)])
+    sleeps = []
+    client = HttpClient(FAST, sleep=sleeps.append)
+    resp = client.request(f"{base}/v1/statement",
+                          request_class="statement")
+    assert resp.body == b"ok"
+    assert len(srv.requests) == 2       # retried, not FatalResponseError
+    assert len(sleeps) == 1
+    assert client.breaker(base).state == CircuitBreaker.CLOSED
+
+
+def test_retry_after_capped_by_config(scripted):
+    import dataclasses
+
+    srv, base = scripted([(503, b"busy", {"Retry-After": "9999"}),
+                          (200, b"ok", None)])
+    cfg = dataclasses.replace(FAST, retry_after_max_s=0.05)
+    sleeps = []
+    client = HttpClient(cfg, sleep=sleeps.append)
+    resp = client.request(f"{base}/v1/statement",
+                          request_class="statement")
+    assert resp.body == b"ok"
+    assert sleeps == [0.05]             # advised 9999s capped to config
+
+
+def test_retry_after_beyond_budget_fails_fast(scripted):
+    """An advised sleep that would blow the retry budget is not taken:
+    the request fails NOW instead of sleeping a hopeless interval."""
+    srv, base = scripted([(503, b"busy", {"Retry-After": "9999"})])
+    sleeps = []
+    client = HttpClient(FAST, sleep=sleeps.append)
+    with pytest.raises(ServerOverloadedError):
+        client.request(f"{base}/v1/statement",
+                       request_class="statement")
+    assert sleeps == []                 # capped 30s > 15s budget: no sleep
+    assert len(srv.requests) == 1
+
+
+def test_overload_exhaustion_raises_server_overloaded(scripted):
+    srv, base = scripted([(503, b"busy", {"Retry-After": "0.001"})])
+    client = HttpClient(FAST, sleep=lambda s: None)
+    with pytest.raises(ServerOverloadedError) as ei:
+        client.request(f"{base}/v1/statement",
+                       request_class="statement")
+    # recovery ladders catch OSError; retry wrappers catch
+    # RetriesExhaustedError — the overload subclass satisfies both
+    assert isinstance(ei.value, RetriesExhaustedError)
+    assert isinstance(ei.value, OSError)
+    assert ei.value.retry_after_s == 0.001
+    assert len(srv.requests) == FAST.statement_attempts
+    assert client.breaker(base).state == CircuitBreaker.CLOSED
+
+
+def test_plain_503_keeps_generic_retry_class(scripted):
+    """A bare 503 with no Retry-After is indistinguishable from a
+    crashing worker: old 5xx semantics (breaker penalty, generic
+    RetriesExhaustedError), NOT the overload class."""
+    srv, base = scripted([(503, b"boom", None)])
+    with pytest.raises(RetriesExhaustedError) as ei:
+        HttpClient(FAST, sleep=lambda s: None).request(
+            f"{base}/v1/info", request_class="probe")
+    assert not isinstance(ei.value, ServerOverloadedError)
 
 
 # ---------------------------------------------------------- fault injector
