@@ -30,6 +30,13 @@ last rung. Each detail entry records which `mode` executed
 (fused / islands / dist_mesh_N / lifespan_batched_N); a query that
 exhausts the ladder reports {"error": ..., "modes_tried": [...]}.
 
+Adaptive-optimizer lane (ISSUE 9): every TPC-H entry carries a `hbo`
+sub-dict — the query planned+executed twice against one shared
+HistoryStore (run1 cold, run2 history-warm), recording per run the
+HBO hit/miss counts, whether join reordering fired, and dynamic-filter
+lifespans skipped, so the history-warm second run is visible in the
+JSON.
+
 Env knobs: BENCH_SF (default 1.0), BENCH_RUNS (5), BENCH_WARMUP (2),
 BENCH_QUERIES (comma list or "all", the default), BENCH_FRAG_QUERIES
 (comma list run lifespan-batched FIRST instead, default none),
@@ -831,6 +838,37 @@ def _run_load_child(timeout_s: float):
         "admission", {"error": "child produced no admission entry"})
 
 
+def _hbo_probe(conn, sql):
+    """Adaptive-optimizer snapshot for one query: plan+execute it twice
+    against ONE shared HistoryStore so the JSON shows the history-warm
+    second run (run1 misses, run2 answers estimates from measurements).
+    Each run uses a fresh engine — plan caches are per-engine, so run 2
+    genuinely re-plans from history rather than reusing run 1's plan."""
+    from presto_tpu.config import Session
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.plan.stats import HistoryStore
+
+    hist = HistoryStore()
+    out = {}
+    for run in ("run1", "run2"):
+        eng = LocalEngine(conn,
+                          session=Session({"collect_stats": "true"}),
+                          history=hist)
+        h0 = (hist.hits, hist.misses)
+        t0 = time.perf_counter()
+        eng.execute_sql(sql)
+        out[run] = {
+            "wall_s": round(time.perf_counter() - t0, 4),
+            "hbo_hits": hist.hits - h0[0],
+            "hbo_misses": hist.misses - h0[1],
+            "reorder_applied": eng.last_join_reorders,
+            "df_lifespans_skipped": getattr(
+                eng, "last_lifespan_stats", {}).get("skipped", 0),
+        }
+    out["history_entries"] = len(hist.rows)
+    return out
+
+
 def _plan_has_join(plan) -> bool:
     from presto_tpu.plan.nodes import JoinNode
     found = [False]
@@ -894,6 +932,12 @@ def _bench_ladder(conn, engine, qid, sql, baseline, runs, warmup,
             continue
         if tried:
             detail[key]["modes_tried"] = tried + [detail[key]["mode"]]
+        # adaptive-optimizer visibility (ISSUE 9): two history-fed runs
+        # per query; failure here must not fail a rung that timed fine
+        try:
+            detail[key]["hbo"] = _hbo_probe(conn, sql)
+        except Exception as e:  # noqa: BLE001
+            detail[key]["hbo"] = {"error": _err(e)}
         return
     detail[key] = {"error": "; ".join(errs)[:400], "modes_tried": tried}
     print(f"# {key}: ladder exhausted ({'; '.join(errs)[:200]})",

@@ -617,3 +617,56 @@ class NoSpawnInRequestHandlerRule(Rule):
 
 
 register(NoSpawnInRequestHandlerRule())
+
+# =====================================================================
+# 11. no-planner-in-data-plane — ops/ and parallel/ never consult the
+#     planner's estimator or rule engine
+# =====================================================================
+
+_DATA_PLANE = ("presto_tpu/ops/", "presto_tpu/parallel/")
+
+#: planner modules the data plane must not reach (cost/history
+#: estimation and the iterative rule engine); plan.nodes stays legal —
+#: kernels legitimately pattern-match on plan node types
+_PLANNER_MODULES = ("presto_tpu.plan.stats", "presto_tpu.plan.iterative")
+
+
+class NoPlannerInDataPlaneRule(Rule):
+    name = "no-planner-in-data-plane"
+    description = (
+        "ops/ and parallel/ (the per-batch device hot paths) must not "
+        "import plan.stats or plan.iterative at ANY level — cardinality "
+        "estimation and rule rewriting are planning-time work; an "
+        "estimator call inside a kernel re-prices the plan once per "
+        "batch and drags HBO state into traced code")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for prefix in _DATA_PLANE:
+            for f in pkg.walk(prefix):
+                if f.tree is None:
+                    continue
+                for node in ast.walk(f.tree):
+                    mods: List[str] = []
+                    if isinstance(node, ast.Import):
+                        mods = [a.name for a in node.names]
+                    elif isinstance(node, ast.ImportFrom):
+                        mod = node.module or ""
+                        mods = [mod]
+                        # `from presto_tpu.plan import stats` names the
+                        # module in the alias list, not in `module`
+                        if mod == "presto_tpu.plan":
+                            mods += [f"{mod}.{a.name}"
+                                     for a in node.names]
+                    for mod in mods:
+                        if any(mod == p or mod.startswith(p + ".")
+                               for p in _PLANNER_MODULES):
+                            out.append(self.finding(
+                                f, node.lineno,
+                                f"planner import `{mod}` in the data "
+                                f"plane — estimate at planning time and "
+                                f"pass the decision in as plain data"))
+        return out
+
+
+register(NoPlannerInDataPlaneRule())

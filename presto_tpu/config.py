@@ -95,6 +95,17 @@ PROPERTIES = [
              "match the build side (reference: "
              "enable_dynamic_filtering / DynamicFilterSourceOperator)",
              _parse_bool, True),
+    Property("dynamic_filter_wait_ms",
+             "Upper bound (milliseconds) a probe-side stage waits for a "
+             "tiny build fragment's key domain before scheduling its "
+             "scans unfiltered (cross-exchange dynamic filtering; "
+             "reference: experimental.dynamic-filtering max blocking "
+             "wait)", int, 400),
+    Property("join_reordering_enabled",
+             "Commute inner equi-joins so the smaller estimated side "
+             "becomes the hash build (plan/iterative.ReorderJoins, "
+             "history-first estimates; reference: "
+             "join_reordering_strategy AUTOMATIC)", _parse_bool, True),
     Property("join_distribution_type",
              "AUTOMATIC (cost-based broadcast-vs-repartition) | "
              "PARTITIONED (always hash exchanges) | BROADCAST (force "

@@ -41,6 +41,7 @@ class LocalEngine:
         # HBO store (plan/stats.HistoryStore): observed node row counts
         # recorded after execution, consulted by the next planning
         self.history = history
+        self.last_join_reorders = 0
 
     @property
     def session(self):
@@ -48,7 +49,12 @@ class LocalEngine:
 
     def plan_sql(self, sql: str) -> PlanNode:
         if sql not in self._plans:
-            self._plans[sql] = self.planner.plan_query(parse_sql(sql))
+            plan = self.planner.plan_query(parse_sql(sql))
+            if self.session["join_reordering_enabled"]:
+                from presto_tpu.plan.iterative import reorder_joins
+                plan, self.last_join_reorders = reorder_joins(
+                    plan, self.connector, self.history)
+            self._plans[sql] = plan
         return self._plans[sql]
 
     def explain_sql(self, sql: str) -> str:
@@ -174,6 +180,7 @@ class LocalEngine:
             entry = self.executor._node_map.get(nid)
             if entry is not None:
                 self.history.record(canonical_key(entry[0]), rows)
+        self.history.save()     # no-op for in-memory stores
 
     def _execute_with_cte_materialization(self, q, qid: str
                                           ) -> List[tuple]:

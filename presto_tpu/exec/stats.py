@@ -44,29 +44,41 @@ def _detail(node) -> str:
 def render_analyzed(plan, node_map: Dict[int, tuple],
                     node_rows: Dict[int, int], wall_s: float,
                     memory_bytes: int, alias: Dict[int, int] = None,
-                    island_profile=None, mesh_stats=None) -> str:
+                    island_profile=None, mesh_stats=None,
+                    est=None) -> str:
     """Annotate the plan tree with executed row counts + footprints.
     `alias` maps island-copy node identities back to the user-facing
     plan's nodes (island mode rebuilds subtrees with
     dataclasses.replace); `island_profile` carries per-island wall
-    times — the per-operator profile fused execution cannot have."""
+    times — the per-operator profile fused execution cannot have.
+    `est` (node -> estimated rows) puts the planner's estimate next to
+    each observed count so HBO drift is visible in one rendering."""
     alias = alias or {}
     by_identity = {}
     for nid, (n, cap) in node_map.items():
         by_identity[alias.get(id(n), id(n))] = (nid, cap)
     lines = []
 
+    def est_of(node) -> str:
+        if est is None:
+            return ""
+        try:
+            return f"est_rows={int(est(node))} "
+        except Exception:       # noqa: BLE001 — estimate must never fail EXPLAIN
+            return ""
+
     def walk(node, depth):
         pad = "  " * depth
         name = type(node).__name__.replace("Node", "")
         info = by_identity.get(id(node))
         if info is None:
-            annot = "(fused into parent)"
+            annot = f"(fused into parent) {est_of(node)}".rstrip()
         else:
             nid, cap = info
             rows = node_rows.get(nid)
             bytes_ = cap * _row_bytes(node.output_types)
             annot = (f"rows={rows if rows is not None else '?'} "
+                     f"{est_of(node)}"
                      f"cap={cap} ~{bytes_ // 1024} KiB")
         lines.append(f"{pad}{name}{_detail(node)}  [{annot}]")
         for c in node.children():
@@ -115,12 +127,15 @@ def explain_analyze(engine, sql: str) -> str:
         # analyzed run measures the real (fragment-wise, mesh) shape
         ex._execute_prepared(plan)
         wall = time.perf_counter() - t0
+        from presto_tpu.plan.stats import estimate_rows
+        history = getattr(engine, "history", None)
         return render_analyzed(
             plan, ex._node_map, ex.last_node_rows, wall,
             ex.last_memory_estimate,
             alias=getattr(ex, "_island_alias", None),
             island_profile=getattr(ex, "last_island_profile", None),
-            mesh_stats=getattr(ex, "last_mesh_stats", None))
+            mesh_stats=getattr(ex, "last_mesh_stats", None),
+            est=lambda n: estimate_rows(n, engine.connector, history))
     finally:
         ex.session.values["collect_stats"] = old
         ex._compiled = compiled

@@ -318,6 +318,86 @@ class RemoveLimitOverValues(Rule):
         return None
 
 
+class ReorderJoins(Rule):
+    """Commute an INNER equi-join so the smaller estimated side is the
+    hash build (reference: sql/planner/iterative/rule/ReorderJoins.java,
+    reduced to greedy build-side commutation). Applied bottom-up over a
+    left-deep chain or bush, every join level independently puts its
+    smaller input on the build side — the q03/q18 shape. The estimator
+    is injected (plan/stats.estimate_rows closed over connector +
+    history), so once HBO has observed a query the second planning
+    reorders from measurements instead of the FK-join guess.
+
+    Swapping reverses the output layout (probe fields ++ build fields),
+    so the replacement wraps the commuted join in a permutation
+    ProjectNode restoring the original channel order; a residual filter
+    has its InputRefs remapped the same way. Strict `>` comparison
+    guarantees termination: after the swap the new build estimates
+    strictly smaller, so the rule cannot refire on its own output."""
+
+    pattern = P.JoinNode
+    name = "reorder_joins"
+
+    def __init__(self, est: Callable[[P.PlanNode], float]):
+        self.est = est
+
+    @staticmethod
+    def _remap(e: RowExpression, pw: int, bw: int) -> RowExpression:
+        """probe++build channel -> build++probe channel."""
+        if isinstance(e, InputRef):
+            f = e.field + bw if e.field < pw else e.field - pw
+            return dataclasses.replace(e, field=f)
+        if isinstance(e, (Call, SpecialForm)):
+            return dataclasses.replace(
+                e, args=tuple(ReorderJoins._remap(a, pw, bw)
+                              for a in e.args))
+        return e
+
+    def apply(self, node):
+        if node.join_type != P.JoinType.INNER or node.emit_flag:
+            return None
+        if not node.probe_keys or not node.build_keys:
+            return None
+        if self.est(node.build) <= self.est(node.probe):
+            return None
+        probe, build = node.probe, node.build
+        pw, bw = len(probe.output_types), len(build.output_types)
+        swapped = dataclasses.replace(
+            node,
+            output_names=(tuple(build.output_names)
+                          + tuple(probe.output_names)),
+            output_types=(tuple(build.output_types)
+                          + tuple(probe.output_types)),
+            probe=build, build=probe,
+            probe_keys=node.build_keys, build_keys=node.probe_keys,
+            filter=(self._remap(node.filter, pw, bw)
+                    if node.filter is not None else None))
+        restore = tuple(InputRef(bw + i, t)
+                        for i, t in enumerate(probe.output_types)) \
+            + tuple(InputRef(i, t)
+                    for i, t in enumerate(build.output_types))
+        return P.ProjectNode(node.output_names, node.output_types,
+                             source=swapped, expressions=restore)
+
+
+def reorder_joins(plan: P.PlanNode, connector, history=None
+                  ) -> Tuple[P.PlanNode, int]:
+    """Build-side commutation over a whole plan: returns the rewritten
+    plan and how many joins were commuted. Runs a dedicated optimizer
+    instance (the rule closes over connector/history state, unlike
+    DEFAULT_RULES) so estimation never interleaves with the stateless
+    simplification fixpoint."""
+    from presto_tpu.plan.stats import estimate_rows
+
+    def est(n: P.PlanNode) -> float:
+        return estimate_rows(n, connector, history)
+
+    trace: List[Tuple[str, str]] = []
+    out = IterativeOptimizer((ReorderJoins(est),)).optimize(
+        plan, trace=trace)
+    return out, sum(1 for name, _ in trace if name == "reorder_joins")
+
+
 DEFAULT_RULES: Tuple[Rule, ...] = (
     EvaluateConstantExpressions(),
     RemoveTrivialFilter(),

@@ -25,12 +25,20 @@ from typing import Dict, Optional
 from presto_tpu.expr.nodes import (
     Call, InputRef, Literal, RowExpression, SpecialForm, Form,
 )
+from presto_tpu.obs.metrics import counter as _counter
 from presto_tpu.plan.nodes import (
     AggregationNode, AssignUniqueIdNode, ExchangeNode, FilterNode,
     GroupIdNode, JoinNode, JoinType, LimitNode, OutputNode, PlanNode,
     ProjectNode, RemoteSourceNode, SortNode, TableScanNode, TopNNode,
     ValuesNode, WindowNode,
 )
+
+_M_HBO_HITS = _counter(
+    "presto_tpu_hbo_hits_total",
+    "History-store lookups answered from observed row counts")
+_M_HBO_MISSES = _counter(
+    "presto_tpu_hbo_misses_total",
+    "History-store lookups that fell back to rule-based estimates")
 
 
 # --------------------------------------------------------------- canonical
@@ -72,29 +80,74 @@ def canonical_key(node: PlanNode) -> str:
 
 class HistoryStore:
     """Observed output row counts per canonical plan key (HBO). Optional
-    JSON persistence (reference: redis-hbo-provider's role)."""
+    JSON persistence (reference: redis-hbo-provider's role). Bounded:
+    insertion order IS the eviction order (a re-recorded key moves to
+    the back), so a long-lived coordinator's history can't grow the
+    JSON without bound."""
 
-    def __init__(self, path: Optional[str] = None):
+    #: entry cap — far above any one workload's distinct plan shapes,
+    #: small enough that the persisted JSON stays trivially loadable
+    MAX_ENTRIES = 4096
+
+    def __init__(self, path: Optional[str] = None,
+                 max_entries: Optional[int] = None):
         self.path = path
+        self.max_entries = int(max_entries or self.MAX_ENTRIES)
+        # lookup counters for per-query deltas (EXPLAIN ANALYZE's
+        # "HBO:" line and bench detail snapshot around one planning)
+        self.hits = 0
+        self.misses = 0
         self.rows: Dict[str, int] = {}
         if path and os.path.exists(path):
             try:
                 with open(path) as f:
-                    self.rows = {k: int(v)
-                                 for k, v in json.load(f).items()}
+                    items = list(json.load(f).items())
+                # JSON preserves insertion order: keep the newest
+                self.rows = {k: int(v)
+                             for k, v in items[-self.max_entries:]}
             except Exception:     # noqa: BLE001 — corrupt history: start over
                 self.rows = {}
 
     def record(self, key: str, rows: int):
+        self.rows.pop(key, None)        # move-to-end on re-record
         self.rows[key] = int(rows)
+        while len(self.rows) > self.max_entries:
+            self.rows.pop(next(iter(self.rows)))    # evict oldest
 
     def get(self, key: str) -> Optional[int]:
-        return self.rows.get(key)
+        got = self.rows.get(key)
+        if got is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return got
 
     def save(self):
-        if self.path:
-            with open(self.path, "w") as f:
+        """Crash-safe persist: write a temp file, then atomically
+        rename over the target — a reader (or a crash mid-write) sees
+        either the old complete JSON or the new one, never a torn
+        file (the spool store's rename-to-commit discipline)."""
+        if not self.path:
+            return
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
                 json.dump(self.rows, f)
+            os.replace(tmp, self.path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+
+def default_history_path() -> Optional[str]:
+    """Opt-in HBO persistence location, mirroring the compile-cache
+    convention in presto_tpu/__init__.py: PRESTO_TPU_HBO_CACHE names
+    the JSON file; unset/empty means in-memory only (deterministic
+    tests must not inherit another process's history)."""
+    p = os.environ.get("PRESTO_TPU_HBO_CACHE", "").strip()
+    return p or None
 
 
 # -------------------------------------------------------------- estimation
@@ -146,8 +199,10 @@ def estimate_rows(node: PlanNode, connector,
         if history is not None:
             h = history.get(canonical_key(n))
             if h is not None:
+                _M_HBO_HITS.inc()
                 memo[k] = float(max(h, 1))
                 return memo[k]
+            _M_HBO_MISSES.inc()
         memo[k] = rules(n)
         return memo[k]
 

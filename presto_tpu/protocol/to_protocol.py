@@ -139,6 +139,18 @@ def remote_split_payload(location: str, buffer_id) -> dict:
             "bufferId": str(buffer_id)}
 
 
+def constrain_split_payload(payload: dict, constraint: dict) -> dict:
+    """A connector split payload carrying a dynamic-filter constraint
+    (reference: TupleDomain pushed into ConnectorSplit scan scheduling
+    by DynamicFilterService). Same one-builder discipline as
+    remote_split_payload: first posts and recovery re-posts of a
+    constrained probe scan produce identical wire shapes. `constraint`
+    is {"column", and either "empty": true or "min"/"max"/"values"}."""
+    out = dict(payload)
+    out["constraint"] = dict(constraint)
+    return out
+
+
 @dataclasses.dataclass
 class FragmentSpec:
     """A protocol fragment plus the scheduling metadata the cluster needs

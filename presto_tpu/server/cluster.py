@@ -31,6 +31,10 @@ from typing import Dict, List, Optional, Tuple
 from presto_tpu.config import DEFAULT_OBS, TransportConfig
 from presto_tpu.obs.metrics import gauge as _obs_gauge
 from presto_tpu.plan.fragment import add_exchanges, create_fragments
+from presto_tpu.plan.iterative import reorder_joins
+from presto_tpu.plan.stats import (
+    HistoryStore, canonical_key, default_history_path, estimate_rows,
+)
 from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import TRACER, trace_scope
 from presto_tpu.plan.nodes import ExchangeNode, Partitioning, PlanNode
@@ -39,7 +43,7 @@ from presto_tpu.protocol.exchange import (
     ExchangeClient, exchange_counters, stream_pages,
 )
 from presto_tpu.protocol.to_protocol import FragmentSpec, \
-    fragment_to_protocol, remote_split_payload
+    constrain_split_payload, fragment_to_protocol, remote_split_payload
 from presto_tpu.protocol.transport import HttpClient
 from presto_tpu.server.http import TpuWorkerServer
 
@@ -232,6 +236,14 @@ class _Stage:
     spool_done: set = dataclasses.field(default_factory=set)
     spool_task_ids: Dict[int, str] = dataclasses.field(
         default_factory=dict)
+    # cross-exchange dynamic filtering (reference: DynamicFilterService):
+    # a build stage publishes its join-key domain on this output channel;
+    # a probe stage carries the spec of the filter it should wait for,
+    # and — once merged — the constraint injected into its scan splits.
+    # The constraint lives HERE so recovery re-posts reproduce it.
+    df_publish_channel: Optional[int] = None
+    df_spec: Optional[dict] = None
+    df_constraint: Optional[dict] = None
 
 
 class ClusterQueryError(RuntimeError):
@@ -285,9 +297,16 @@ class TpuCluster:
         self.connector = connector
         self.planner = Planner(connector)
         # HBO store (plan/stats.HistoryStore) consulted by AddExchanges'
-        # broadcast-vs-repartition costing, like the engines' stores
-        # (reference: HistoryBasedPlanStatisticsCalculator.java:58)
-        self.history = history
+        # broadcast-vs-repartition costing AND fed back from the workers'
+        # observed cardinalities at query end (cluster-fed HBO; reference:
+        # HistoryBasedPlanStatisticsCalculator.java:58 paired with the
+        # tracker that records actuals). A default in-memory store makes
+        # the second run of a repeated query history-informed even
+        # without explicit wiring; PRESTO_TPU_HBO_CACHE persists it.
+        self.history = (history if history is not None
+                        else HistoryStore(default_history_path()))
+        self.last_hbo = {"hits": 0, "misses": 0}
+        self.last_join_reorders = 0
         self.session_properties = dict(session_properties or {})
         # admission control (reference: InternalResourceGroupManager
         # gating DispatchManager.createQueryInternal)
@@ -615,16 +634,24 @@ class TpuCluster:
                 for op in pipe.get("operatorSummaries", []):
                     key = (op.get("planNodeId"), op.get("operatorType"))
                     agg = by_frag.setdefault(fid, {}).setdefault(
-                        key, [0, 0])
+                        key, [0, 0, None])
                     agg[0] += int(op.get("outputPositions", 0))
                     agg[1] += 1
+                    agg[2] = agg[2] or op.get("canonicalKey")
         lines = [f"EXPLAIN ANALYZE ({len(rows)} result rows)"]
         for fid in sorted(by_frag):
             lines.append(f"Fragment {fid}:")
-            for (nid, op_type), (total, ntasks) in sorted(
+            for (nid, op_type), (total, ntasks, ckey) in sorted(
                     by_frag[fid].items()):
+                # estimates vs actuals: the history entry for this
+                # operator's canonical subtree is what the NEXT planning
+                # of an equivalent node will estimate
+                known = (self.history.rows.get(ckey)
+                         if self.history is not None and ckey else None)
+                est = f"est_rows={int(known)} " if known is not None \
+                    else ""
                 lines.append(
-                    f"  {op_type} [node {nid}]: {total} rows "
+                    f"  {op_type} [node {nid}]: {est}{total} rows "
                     f"across {ntasks} task(s)")
         cache_line = self._render_cache_stats(
             getattr(self, "last_task_infos", []))
@@ -651,6 +678,16 @@ class TpuCluster:
             lines.append(
                 f"Admission: group={adm['group']} "
                 f"queue_wait={adm['queue_wait_s']:.3f}s")
+        hbo = getattr(self, "last_hbo", None) or {}
+        df_pruned = sum(
+            int((((info.get("stats") or {}).get("runtimeStats") or {})
+                 .get("dynamicFilterRowsPruned") or {}).get("sum", 0))
+            for _fid, info in getattr(self, "last_task_infos", []))
+        lines.append(
+            f"HBO: hits={hbo.get('hits', 0)} "
+            f"misses={hbo.get('misses', 0)} "
+            f"join_reorders={getattr(self, 'last_join_reorders', 0)} "
+            f"dynamic_filter_rows_pruned={df_pruned}")
         trace = self.render_trace()
         if trace:
             lines.append(
@@ -753,15 +790,34 @@ class TpuCluster:
         known = {p.name for p in PROPERTIES}
         session = Session({k: v for k, v in
                            self.session_properties.items() if k in known})
+        h0 = ((self.history.hits, self.history.misses)
+              if self.history is not None else None)
+        # history-first greedy join reordering (ReorderJoins): the
+        # smaller estimated side becomes the hash build before the
+        # exchange planner decides broadcast vs repartition on it
+        self.last_join_reorders = 0
+        if session["join_reordering_enabled"]:
+            plan, self.last_join_reorders = reorder_joins(
+                plan, self.connector, self.history)
         ex_plan, merge_keys = _derange(
             add_exchanges(_unshare(plan), self.connector, session,
                           self.history))
         frags = create_fragments(ex_plan)
-        return self._run_fragments(frags, list(plan.output_types),
-                                   capture=capture,
-                                   merge_keys=merge_keys,
-                                   cancel_event=cancel_event,
-                                   writer_tasks=writer_tasks)
+        try:
+            return self._run_fragments(frags, list(plan.output_types),
+                                       capture=capture,
+                                       merge_keys=merge_keys,
+                                       cancel_event=cancel_event,
+                                       writer_tasks=writer_tasks)
+        finally:
+            # planning-time HBO consultation delta for this query
+            # (EXPLAIN ANALYZE's "HBO:" line)
+            if h0 is not None:
+                self.last_hbo = {
+                    "hits": self.history.hits - h0[0],
+                    "misses": self.history.misses - h0[1]}
+            else:
+                self.last_hbo = {"hits": 0, "misses": 0}
 
     # ------------------------------------------------------------------
     def _run_fragments(self, frags, out_types,
@@ -842,14 +898,22 @@ class TpuCluster:
                 specs[f.fragment_id], n_tasks(f.fragment_id), nbuf,
                 offsets)
 
+        self._plan_dynamic_filters(stages, by_id)
+
         # leaf-first scheduling (children before parents so producer task
-        # locations exist when consumers are created)
+        # locations exist when consumers are created); dynamic-filter
+        # build stages go before their siblings so a probe stage's
+        # bounded wait overlaps the build actually running
         scheduled = set()
 
         def schedule(fid: int):
             if fid in scheduled:
                 return
-            for src in by_id[fid].remote_sources:
+            srcs = list(dict.fromkeys(by_id[fid].remote_sources))
+            srcs.sort(key=lambda s:
+                      0 if stages[s].df_publish_channel is not None
+                      else 1)
+            for src in srcs:
                 schedule(src)
             self._start_stage(qid, fid, stages, by_id, placement)
             scheduled.add(fid)
@@ -935,8 +999,9 @@ class TpuCluster:
                             raise
                         self._await_all(stages,
                                         cancel_event=cancel_event)
-                if capture:
+                if capture or self.history is not None:
                     self._capture_task_infos(stages)
+                    self._record_history(stages, by_id)
                 return self._collect_root(stages[0], out_types,
                                           merge_keys)
             finally:
@@ -1029,8 +1094,9 @@ class TpuCluster:
                         raise
                     live_placement = [w for w in live_placement
                                       if w in alive] or live_placement
-        if capture:
+        if capture or self.history is not None:
             self._capture_task_infos(stages)
+            self._record_history(stages, by_id)
         return self._collect_root(stages[0], out_types, merge_keys)
 
     def _recover_dead_tasks(self, qid: str, stages: Dict[int, _Stage],
@@ -1205,11 +1271,220 @@ class TpuCluster:
                     pass
         self.last_task_infos = infos
 
+    def _record_history(self, stages: Dict[int, _Stage], by_id) -> None:
+        """Cluster-fed HBO: fold the workers' OBSERVED cardinalities
+        back into the coordinator's HistoryStore at query end
+        (reference: HistoryBasedPlanStatisticsTracker recording final
+        QueryStats keyed by canonical plan hashes). Two granularities:
+        per-operator summaries carry the worker-computed canonicalKey
+        (local subtrees — scan/filter chains — hash identically to the
+        planner's), and each fragment root is keyed by the
+        coordinator-side digest of its engine subtree, which is what
+        AddExchanges' est(build) consults for broadcast decisions."""
+        if self.history is None:
+            return
+        per_op: Dict[tuple, int] = {}
+        per_frag: Dict[int, int] = {}
+        for fid, info in getattr(self, "last_task_infos", []):
+            stats = info.get("stats") or {}
+            per_frag[fid] = per_frag.get(fid, 0) + int(
+                stats.get("outputPositions", 0) or 0)
+            for pipe in stats.get("pipelines", []):
+                for op in pipe.get("operatorSummaries", []):
+                    key = op.get("canonicalKey")
+                    if key:
+                        k = (fid, str(op.get("planNodeId")), key)
+                        per_op[k] = per_op.get(k, 0) + int(
+                            op.get("outputPositions", 0) or 0)
+        for (_fid, _nid, key), rows in per_op.items():
+            self.history.record(key, rows)
+        for fid, rows in per_frag.items():
+            frag = by_id.get(fid)
+            if frag is None:
+                continue
+            try:
+                self.history.record(canonical_key(frag.root), rows)
+            except Exception:  # noqa: BLE001 — feedback is best-effort
+                pass
+        try:
+            self.history.save()
+        except OSError:
+            log.debug("HBO save failed", exc_info=True)
+
+    # ----------------------------------------- cross-exchange dynamic filters
+    def _plan_dynamic_filters(self, stages: Dict[int, _Stage],
+                              by_id) -> None:
+        """Decide, per query, which build stage publishes a join-key
+        domain and which probe-side scan stage waits for it (reference:
+        DynamicFilterService collecting build summaries and pushing
+        TupleDomains into not-yet-scheduled probe splits). Eligibility:
+        INNER/filtering-SEMI equi-join whose build side was cut into its
+        own fragment, numeric key, and a build estimated small enough
+        that waiting `dynamic_filter_wait_ms` is plausibly repaid."""
+        from presto_tpu.config import PROPERTIES, Session
+        from presto_tpu.plan import nodes as P
+        from presto_tpu.expr.nodes import InputRef
+        known = {p.name for p in PROPERTIES}
+        session = Session({k: v for k, v in
+                           self.session_properties.items() if k in known})
+        if not session["dynamic_filtering_enabled"]:
+            return
+        wait_ms = int(session["dynamic_filter_wait_ms"])
+        threshold = int(session["broadcast_join_threshold_rows"])
+
+        def resolve(fid: int, node, ch: int):
+            """Trace output channel `ch` of `node` (in fragment `fid`)
+            back to a (fragment, table, column) scan origin, hopping
+            exchange cuts into producer fragments."""
+            if isinstance(node, P.TableScanNode):
+                return (fid, node.table, node.columns[ch])
+            if isinstance(node, P.FilterNode):
+                return resolve(fid, node.source, ch)
+            if isinstance(node, P.ProjectNode):
+                e = node.expressions[ch]
+                if isinstance(e, InputRef):
+                    return resolve(fid, node.source, e.field)
+                return None
+            if isinstance(node, P.ExchangeNode):
+                if node.source is not None:
+                    return resolve(fid, node.source, ch)
+                pfid = node.remote_fragment
+                if pfid is None or pfid not in by_id:
+                    return None
+                return resolve(pfid, by_id[pfid].root, ch)
+            if isinstance(node, P.JoinNode):
+                if ch < len(node.probe.output_types):
+                    return resolve(fid, node.probe, ch)
+                return None
+            if isinstance(node, P.AggregationNode):
+                # group keys pass values through unchanged: filtering
+                # the input on a key domain removes exactly the groups
+                # that could not match
+                if ch < len(node.group_fields):
+                    return resolve(fid, node.source,
+                                   node.group_fields[ch])
+                return None
+            return None
+
+        def walk(n):
+            yield n
+            for c in n.children():
+                if c is not None:
+                    yield from walk(c)
+
+        for fid in sorted(by_id):
+            for node in walk(by_id[fid].root):
+                if not isinstance(node, P.JoinNode) \
+                        or not node.probe_keys:
+                    continue
+                if node.join_type not in (P.JoinType.INNER,
+                                          P.JoinType.SEMI) \
+                        or node.emit_flag:
+                    continue
+                build = node.build
+                if not (isinstance(build, P.ExchangeNode)
+                        and build.source is None
+                        and build.remote_fragment in stages):
+                    continue
+                bfid = build.remote_fragment
+                key_t = build.output_types[node.build_keys[0]]
+                if key_t.is_string:
+                    continue
+                try:
+                    est = estimate_rows(by_id[bfid].root,
+                                        self.connector, self.history)
+                except Exception:  # noqa: BLE001 — est gate is advisory
+                    continue
+                if est > threshold:
+                    continue
+                resolved = resolve(fid, node.probe,
+                                   node.probe_keys[0])
+                if resolved is None:
+                    continue
+                tfid, table, column = resolved
+                target = stages.get(tfid)
+                if target is None or target.df_spec is not None \
+                        or stages[bfid].df_publish_channel is not None:
+                    continue
+                scan_ids = [nid for nid, tb in
+                            target.spec.scan_nodes.items()
+                            if tb == table]
+                if len(scan_ids) != 1 or tfid == bfid:
+                    continue
+                stages[bfid].df_publish_channel = node.build_keys[0]
+                target.df_spec = {
+                    "build_fid": bfid, "scan_node": scan_ids[0],
+                    "column": column, "wait_ms": wait_ms}
+
+    def _await_dynamic_filter(self, stages: Dict[int, _Stage],
+                              spec: dict) -> Optional[dict]:
+        """Poll the build stage's TaskInfos until every task FINISHED
+        and published its key domain, bounded by `wait_ms`. Any miss —
+        deadline, failed/killed build worker, no domain published —
+        degrades to None (unfiltered probe scan): a dynamic filter is
+        an optimization, never a correctness dependency."""
+        build = stages.get(spec["build_fid"])
+        if build is None or build.df_publish_channel is None \
+                or not build.task_uris:
+            return None
+        ch = str(build.df_publish_channel)
+        deadline = time.time() + spec["wait_ms"] / 1000.0
+        while True:
+            domains = []
+            done = True
+            for uri in build.task_uris:
+                try:
+                    info = self.http.get_json(
+                        uri, request_class="status_poll")
+                except Exception:  # noqa: BLE001 — degrade, never block
+                    return None
+                state = (info.get("taskStatus") or {}).get("state")
+                if state in ("FAILED", "ABORTED", "CANCELED"):
+                    return None
+                if state != "FINISHED":
+                    done = False
+                    continue
+                d = ((info.get("stats") or {})
+                     .get("dynamicFilterDomains") or {}).get(ch)
+                if d is None:
+                    return None   # finished without a domain (e.g.
+                                  # string key): nothing to wait for
+                domains.append(d)
+            if done:
+                break
+            if time.time() > deadline:
+                return None
+            time.sleep(0.02)
+        col = spec["column"]
+        if sum(int(d.get("count", 0) or 0) for d in domains) == 0:
+            return {"column": col, "empty": True}
+        mins = [d["min"] for d in domains if d.get("min") is not None]
+        maxs = [d["max"] for d in domains if d.get("max") is not None]
+        if not mins:
+            return None
+        con = {"column": col, "min": min(mins), "max": max(maxs)}
+        vals: Optional[set] = set()
+        for d in domains:
+            v = d.get("values")
+            if v is None:
+                vals = None
+                break
+            vals.update(v)
+        if vals:
+            con["values"] = sorted(vals)
+        return con
+
     # ------------------------------------------------------------------
     def _start_stage(self, qid: str, fid: int, stages: Dict[int, _Stage],
                      by_id, placement: List[str]):
         stage = stages[fid]
         self._ensure_scan_splits(stage)
+        # probe stage with a pending dynamic filter: wait (bounded) for
+        # the build stage's domain BEFORE posting tasks, so the
+        # constraint rides the very first split assignment
+        if stage.df_spec is not None and stage.df_constraint is None:
+            stage.df_constraint = self._await_dynamic_filter(
+                stages, stage.df_spec)
         # cache-affinity placement: when result caching is on, route each
         # leaf task to the worker that (per the router's memory) holds
         # its fragment's cached result; rendezvous hashing places
@@ -1285,10 +1560,16 @@ class TpuCluster:
         sources: List[S.TaskSource] = []
         seq = 0
         for node_id, (cid, all_splits) in stage.scan_splits.items():
+            payload = all_splits[t]
+            if stage.df_constraint is not None \
+                    and stage.df_spec is not None \
+                    and node_id == stage.df_spec["scan_node"]:
+                payload = constrain_split_payload(
+                    payload, stage.df_constraint)
             splits = [S.ScheduledSplit(
                 sequenceId=seq, planNodeId=node_id,
                 split=S.Split(connectorId=cid,
-                              connectorSplit=all_splits[t]))]
+                              connectorSplit=payload))]
             seq += 1
             sources.append(S.TaskSource(planNodeId=node_id,
                                         splits=splits,
@@ -1312,10 +1593,16 @@ class TpuCluster:
             sources.append(S.TaskSource(planNodeId=node_id,
                                         splits=splits,
                                         noMoreSplits=True))
+        props = dict(self.session_properties)
+        if stage.df_publish_channel is not None:
+            # marks this task as a dynamic-filter build source; the
+            # worker summarizes this output channel's key domain
+            props["x_dynamic_filter_channel"] = str(
+                stage.df_publish_channel)
         tur = S.TaskUpdateRequest(
             session=S.SessionRepresentation(
                 queryId=qid, user="cluster",
-                systemProperties=dict(self.session_properties)),
+                systemProperties=props),
             extraCredentials={},
             fragment=spec.fragment.to_bytes(),
             sources=sources,

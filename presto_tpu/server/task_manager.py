@@ -10,6 +10,7 @@ through the token/ack buffer protocol unchanged."""
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 import traceback
@@ -51,6 +52,9 @@ _M_TASKS_LIVE = _gauge("presto_tpu_tasks",
 _M_LIFETIME_BYTES = _gauge(
     "presto_tpu_task_bytes_out",
     "Lifetime bytes emitted into output buffers (survives task delete)")
+_M_DF_PRUNED = _counter(
+    "presto_tpu_dynamic_filter_rows_pruned_total",
+    "Probe-side scan rows skipped by cross-exchange dynamic filters")
 
 #: task states the by-state gauge always reports (zeros included, so a
 #: scrape sees a stable series set)
@@ -192,6 +196,16 @@ class Task:
         # when set to a list, _emit_output also records the pre-
         # partitioning pages for the populate step
         self._cache_pages: Optional[list] = None
+        # cross-exchange dynamic filtering (reference:
+        # DynamicFilterSourceOperator feeding the coordinator's
+        # DynamicFilterService): a build task summarizes one output
+        # channel's key domain; a probe task applies coordinator-pushed
+        # scan constraints before executing
+        self.df_channel: Optional[int] = None
+        self.df_domain: Optional[dict] = None
+        self.scan_constraints: Dict[str, dict] = {}
+        self.df_pruned = 0
+        self._df_nodes: List[tuple] = []
         # propagated X-Presto-Trace context (query trace id + the
         # coordinator-side parent span) — None when the query is
         # unsampled or the coordinator predates tracing
@@ -236,6 +250,15 @@ class Task:
         start = self.start_time or self.created
         done = self.state in ("FINISHED", "FAILED", "ABORTED",
                               "CANCELED")
+        df_domains = {}
+        if done and self.state == "FINISHED" \
+                and self.df_channel is not None \
+                and self.df_domain is not None:
+            d = dict(self.df_domain)
+            vals = d.get("values")
+            d["values"] = (sorted(vals) if isinstance(vals, set)
+                           else None)
+            df_domains = {str(self.df_channel): d}
         return {
             "createTimeInMillis": int(self.created * 1000),
             "firstStartTimeInMillis": int(start * 1000),
@@ -276,6 +299,9 @@ class Task:
             "physicalWrittenDataSizeInBytes": self.bytes_out,
             "fullGcCount": 0,
             "fullGcTimeInMillis": 0,
+            # build-side key domains, published only once the task is
+            # FINISHED so a consumer never applies a partial domain
+            "dynamicFilterDomains": df_domains,
             "runtimeStats": self._runtime_stats(),
             "pipelines": ([{
                 "pipelineId": 0,
@@ -309,6 +335,8 @@ class Task:
             metric("fragmentResultCacheSizeBytes",
                    self.cache_stats.get("bytes", 0))
             metric("fragmentResultCacheHit", 1 if self.cache_hit else 0)
+        if self.df_pruned:
+            metric("dynamicFilterRowsPruned", self.df_pruned)
         return out
 
     def info(self, base_uri: str = "") -> S.TaskInfo:
@@ -443,6 +471,11 @@ class TpuTaskManager:
                         continue
                     table = task.scan_tables.get(ss.planNodeId)
                     if table is not None:
+                        # coordinator-pushed dynamic-filter constraint
+                        # riding the scan split (one per scan node)
+                        if isinstance(cs.get("constraint"), dict):
+                            task.scan_constraints[table] = \
+                                cs["constraint"]
                         task.splits.setdefault(table, []).append(
                             (int(cs.get("part", 0)),
                              int(cs.get("numParts", 1))))
@@ -493,6 +526,15 @@ class TpuTaskManager:
             # connectors / unknown nodes / unsupported features fail with
             # a precise reason, not a mid-execution traceback.
             plan = translate_validated(task.fragment)
+            ch = (task.session_properties or {}).get(
+                "x_dynamic_filter_channel")
+            if ch is not None:
+                try:
+                    task.df_channel = int(ch)
+                except (TypeError, ValueError):
+                    task.df_channel = None
+            if task.scan_constraints:
+                plan = self._apply_scan_constraints(task, plan)
             # Session properties arrive on the wire as strings
             # (SessionRepresentation.systemProperties); unknown ones are
             # coordinator-side and ignored here, like the C++ worker's
@@ -597,6 +639,146 @@ class TpuTaskManager:
             return None
         return fragment_cache_key(plan, versions, task.splits)
 
+    def _apply_scan_constraints(self, task: Task, plan):
+        """Push the coordinator's dynamic-filter constraints into this
+        task's scans (reference: DynamicFilterService pushing summaries
+        into not-yet-scheduled probe-side TableScan constraints).
+
+        Two composing layers, both strictly row-removing on key values
+        the build side cannot contain — correct for the INNER/SEMI probe
+        paths the coordinator derives them from:
+          1. split pruning: a split whose key min/max cannot intersect
+             the domain is dropped whole (the parquet row-group-stats
+             discipline of exec/lifespan; connectors without metadata
+             stats fall back to one host-side column scan);
+          2. residual FilterNode over the scan for the surviving splits.
+        """
+        from presto_tpu.expr.nodes import (
+            Call, InputRef, Literal, SpecialForm, Form,
+        )
+        from presto_tpu.plan.nodes import FilterNode, TableScanNode
+        from presto_tpu.types import BOOLEAN
+
+        def coerce(t, v):
+            return float(v) if t.dtype.kind == "f" else int(v)
+
+        # ---- layer 1: whole-split pruning on key range ----------------
+        for table, con in task.scan_constraints.items():
+            splits = task.splits.get(table)
+            if not splits or con.get("empty") \
+                    or con.get("min") is None or con.get("max") is None:
+                continue
+            lo, hi = con["min"], con["max"]
+            kept, dropped = [], []
+            for (p, np_) in splits:
+                try:
+                    t = self.connector.table(table, part=p,
+                                             num_parts=np_)
+                    mm = (t.column_minmax(con["column"])
+                          if hasattr(t, "column_minmax") else None)
+                    if mm is None and t.num_rows:
+                        sv = t.arrays[con["column"]][:t.num_rows]
+                        mm = (sv.min(), sv.max())
+                    pruned = (bool(mm[0] > hi or mm[1] < lo)
+                              if mm is not None else False)
+                except Exception:   # noqa: BLE001 — pruning is advisory
+                    pruned = False
+                (dropped if pruned else kept).append((p, np_))
+            if not kept and dropped:
+                # the executor needs at least one split bound; the
+                # residual filter yields zero rows from it anyway
+                kept.append(dropped.pop(0))
+            for (p, np_) in dropped:
+                task.df_pruned += int(self.connector.table(
+                    table, part=p, num_parts=np_).num_rows)
+            task.splits[table] = kept
+
+        # ---- layer 2: residual FilterNode over each constrained scan --
+        def predicate(con, ref, t):
+            if con.get("empty"):
+                # build produced zero rows: a contradiction the
+                # compiler already supports (ge AND le with crossed
+                # bounds) — every probe row is filtered
+                return SpecialForm(Form.AND, (
+                    Call("ge", (ref, Literal(coerce(t, 1), t)), BOOLEAN),
+                    Call("le", (ref, Literal(coerce(t, 0), t)), BOOLEAN),
+                ), BOOLEAN)
+            if con.get("values"):
+                return SpecialForm(
+                    Form.IN,
+                    (ref,) + tuple(Literal(coerce(t, v), t)
+                                   for v in con["values"]), BOOLEAN)
+            return SpecialForm(Form.AND, (
+                Call("ge", (ref, Literal(coerce(t, con["min"]), t)),
+                     BOOLEAN),
+                Call("le", (ref, Literal(coerce(t, con["max"]), t)),
+                     BOOLEAN),
+            ), BOOLEAN)
+
+        def rewrite(n):
+            if isinstance(n, TableScanNode):
+                con = task.scan_constraints.get(n.table)
+                if con is not None and con.get("column") in n.columns:
+                    ci = n.columns.index(con["column"])
+                    t = n.output_types[ci]
+                    if not t.is_string:
+                        f = FilterNode(
+                            n.output_names, n.output_types, source=n,
+                            predicate=predicate(
+                                con, InputRef(ci, t), t))
+                        task._df_nodes.append((n, f))
+                        return f
+                return n
+            names = [fld.name for fld in dataclasses.fields(n)]
+            repl = {}
+            if "probe" in names:
+                repl = {"probe": rewrite(n.probe),
+                        "build": rewrite(n.build)}
+            elif "sources" in names:
+                repl = {"sources": tuple(rewrite(s)
+                                         for s in n.sources)}
+            elif "source" in names and n.source is not None:
+                repl = {"source": rewrite(n.source)}
+            return dataclasses.replace(n, **repl) if repl else n
+
+        return rewrite(plan)
+
+    #: distinct build keys kept exactly per domain; past this only the
+    #: [min, max] range survives (the reference's
+    #: dynamic-filtering.max-distinct-values-per-driver role)
+    DF_VALUES_CAP = 64
+
+    def _accumulate_df_domain(self, task: Task, page: Page) -> None:
+        """Fold one output page into the task's build-key domain summary
+        (DynamicFilterSourceOperator role: min/max always, the exact
+        distinct set while it stays small)."""
+        ch = task.df_channel
+        if ch is None or ch >= len(page.columns):
+            return
+        col = page.columns[ch]
+        if col.type.is_string:
+            return     # dictionary codes are per-task, not comparable
+        d = task.df_domain
+        if d is None:
+            d = task.df_domain = {"min": None, "max": None,
+                                  "values": set(), "count": 0}
+        n = int(page.num_rows)
+        if n == 0:
+            return
+        v, nl = col.to_numpy(n)
+        v = np.asarray(v)[:n][~np.asarray(nl)[:n]]
+        if not len(v):
+            return
+        as_py = (float if v.dtype.kind == "f" else int)
+        lo, hi = as_py(v.min()), as_py(v.max())
+        d["count"] += int(len(v))
+        d["min"] = lo if d["min"] is None else min(d["min"], lo)
+        d["max"] = hi if d["max"] is None else max(d["max"], hi)
+        if isinstance(d["values"], set):
+            d["values"].update(as_py(x) for x in np.unique(v))
+            if len(d["values"]) > self.DF_VALUES_CAP:
+                d["values"] = None     # range-only past the cap
+
     def _run_streaming(self, task: Task, plan, ex: SplitExecutor) -> bool:
         """Leaf-fragment streaming: execute one driving-scan lifespan at a
         time, emitting each batch's output into the token/ack buffers
@@ -652,13 +834,25 @@ class TpuTaskManager:
                 if sub >= 256:
                     raise
                 sub *= 2
+        # per-node row counters are per-execute; fold them across
+        # lifespans so _collect_stats reports whole-task cardinalities
+        acc: Dict[int, int] = {}
+
+        def soak():
+            for nid, r in (getattr(ex, "last_node_rows", None)
+                           or {}).items():
+                acc[nid] = acc.get(nid, 0) + int(r)
+
+        soak()
         task.output_positions += int(first.num_rows)
         self._emit_output(task, first)
         for ls in lifespans[1:]:
             ex.set_splits({**task.splits, driving: [ls]})
             out = ex.execute(plan)
+            soak()
             task.output_positions += int(out.num_rows)
             self._emit_output(task, out)
+        ex.last_node_rows = acc
         self._collect_stats(task, ex)
         return True
 
@@ -711,6 +905,7 @@ class TpuTaskManager:
         ex.set_splits(task.splits)
 
         emitted = [0]
+        acc: Dict[int, int] = {}
 
         def run_chunk(pages: List[Page]) -> None:
             if not pages:
@@ -720,6 +915,9 @@ class TpuTaskManager:
             chunk = concat_pages_host(pages)
             ex.set_remote_pages({**others, driving.node_id: chunk})
             out = ex.execute(plan)
+            for nid, r in (getattr(ex, "last_node_rows", None)
+                           or {}).items():
+                acc[nid] = acc.get(nid, 0) + int(r)
             task.output_positions += int(out.num_rows)
             self._emit_output(task, out)
             emitted[0] += 1
@@ -746,6 +944,7 @@ class TpuTaskManager:
                     for t in driving.output_types]
             run_chunk([Page.from_columns(cols, 0,
                                          driving.output_names)])
+        ex.last_node_rows = acc
         self._collect_stats(task, ex)
         return True
 
@@ -755,6 +954,7 @@ class TpuTaskManager:
         OperatorStats; planNodeId/operatorType/outputPositions are the
         fields the coordinator's UI and EXPLAIN ANALYZE consume)."""
         from presto_tpu.plan.nodes import TableScanNode
+        from presto_tpu.plan.stats import canonical_key
         task.memory_bytes = int(
             getattr(ex, "last_memory_estimate", 0) or 0)
         rows = getattr(ex, "last_node_rows", None) or {}
@@ -767,7 +967,7 @@ class TpuTaskManager:
             op_type = type(node).__name__ if node is not None else "?"
             if isinstance(node, TableScanNode):
                 raw_in += int(out_rows)
-            summaries.append({
+            summary = {
                 "pipelineId": 0,
                 "operatorId": op_id,
                 "planNodeId": str(nid),
@@ -775,9 +975,45 @@ class TpuTaskManager:
                 "totalDrivers": 1,
                 "outputPositions": int(out_rows),
                 "outputDataSizeInBytes": 0,
-            })
+            }
+            if node is not None:
+                # structural digest the coordinator folds into its
+                # HistoryStore — worker-local subtrees (scan/filter
+                # chains) hash identically to the planner's subtrees,
+                # which is exactly where history informs estimates
+                try:
+                    summary["canonicalKey"] = canonical_key(node)
+                except Exception:  # noqa: BLE001 — stats stay best-effort
+                    pass
+            summaries.append(summary)
         task.raw_input_positions = raw_in
         task.operator_stats = summaries
+        # dynamic-filter effectiveness: rows the injected residual
+        # filter removed on top of whole-split pruning (delta is
+        # unavailable when the filter fused into its parent — fine,
+        # split-level pruning still counted)
+        if task._df_nodes:
+            # Locate the injected filter/scan pair STRUCTURALLY: the
+            # executor rebuilds subtrees (island copies), so identity
+            # does not survive — but the predicate is a frozen
+            # dataclass tree and compares by value. The scan nid comes
+            # from the filter copy's own source, which shares the
+            # rebuilt tree.
+            from presto_tpu.plan.nodes import FilterNode
+            nid_of = {id(n): nid for nid, (n, _c) in node_map.items()}
+            wanted = {(s.table, f.predicate)
+                      for s, f in task._df_nodes}
+            for f_nid, (n, _c) in node_map.items():
+                if not (isinstance(n, FilterNode)
+                        and isinstance(n.source, TableScanNode)
+                        and (n.source.table, n.predicate) in wanted):
+                    continue
+                s_nid = nid_of.get(id(n.source))
+                if s_nid in rows and f_nid in rows:
+                    task.df_pruned += max(
+                        0, int(rows[s_nid]) - int(rows[f_nid]))
+        if task.df_pruned:
+            _M_DF_PRUNED.inc(task.df_pruned)
         # per-operator worker spans from the island profile: wall times
         # are real, placement is a sequential reconstruction from the
         # task start (islands execute in dependency order)
@@ -852,6 +1088,10 @@ class TpuTaskManager:
             # step (replay re-partitions, so a later consumer-count
             # change still routes correctly)
             task._cache_pages.append(page)
+        if task.df_channel is not None:
+            # build-side fragment: summarize the join-key domain from
+            # the pre-partitioning page (DynamicFilterSourceOperator)
+            self._accumulate_df_domain(task, page)
         codec = (task.session_properties or {}).get(
             "exchange_compression_codec")
         if codec in (None, "", "none"):

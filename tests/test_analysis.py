@@ -49,7 +49,8 @@ def test_rule_catalog_complete():
             "mesh-chokepoint", "metric-name-grammar", "thread-discipline",
             "no-blocking-under-lock", "lock-leak",
             "no-jax-in-control-plane",
-            "no-spawn-in-request-handler"} <= names
+            "no-spawn-in-request-handler",
+            "no-planner-in-data-plane"} <= names
 
 
 # ===================================================================
@@ -228,6 +229,30 @@ def test_no_spawn_in_request_handler_fires():
              "            spawn('coordinator', 'x', print)\n"
              "        return later\n"},
         planted=bad)
+
+
+def test_no_planner_in_data_plane_fires():
+    bad = "presto_tpu/ops/evil.py"
+    # module-level import of the estimator fires
+    fs = _findings("no-planner-in-data-plane", {
+        bad: "from presto_tpu.plan.stats import estimate_rows\n"},
+        planted=bad)
+    assert fs and fs[0].line == 1 and "planner import" in fs[0].message
+    # a lazy import inside a kernel function is still the data plane
+    # consulting the planner per batch — fires too
+    fs = _findings("no-planner-in-data-plane", {
+        "presto_tpu/parallel/evil.py":
+            "def kernel(page):\n"
+            "    from presto_tpu.plan import iterative\n"
+            "    return iterative\n"},
+        planted="presto_tpu/parallel/evil.py")
+    assert fs
+    # plan.nodes pattern-matching stays legal; planner imports outside
+    # the data-plane prefixes are someone else's business
+    assert not _findings("no-planner-in-data-plane", {
+        bad: "from presto_tpu.plan.nodes import JoinNode\n",
+        "presto_tpu/server/fine.py":
+            "from presto_tpu.plan.stats import estimate_rows\n"})
 
 
 # ===================================================================
