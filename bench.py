@@ -261,6 +261,8 @@ def main() -> None:
         return _main_orchestrator(sf, qids)
     if os.environ.get("BENCH_LOAD_ONE"):
         return _load_child()
+    if os.environ.get("BENCH_CHURN_ONE"):
+        return _churn_child()
     if ds_one:
         return _ds_child(int(ds_one), runs, warmup)
     if pq_one:
@@ -603,6 +605,17 @@ def _main_orchestrator(sf, qids) -> None:
                 float(os.environ.get("BENCH_LOAD_TIMEOUT_S", "240"))
                 + 120.0)
 
+    # elastic-membership churn round (one JSON `churn` entry: query
+    # correctness under seeded join/drain/kill, membership counters);
+    # BENCH_CHURN=0 disables
+    if os.environ.get("BENCH_CHURN", "1") != "0":
+        if wedged is not None:
+            detail["churn"] = {"error": f"infra: {wedged}"}
+        else:
+            detail["churn"] = _run_churn_child(
+                float(os.environ.get("BENCH_CHURN_TIMEOUT_S", "240"))
+                + 120.0)
+
     if wedged is not None:
         detail["infra_error"] = wedged
         detail["probe_log"] = probe_log
@@ -836,6 +849,103 @@ def _run_load_child(timeout_s: float):
                          f"{tail[:120]}"[:200]}
     return json.loads(line).get("detail", {}).get(
         "admission", {"error": "child produced no admission entry"})
+
+
+def _churn_child() -> None:
+    """Elastic-membership churn round: a small TPC-H cluster with a
+    discovery service and `retry_policy=TASK` runs the chaos query set
+    repeatedly while a seeded ChurnDriver joins, drains, and kills
+    dynamic workers in the background. Emits the correctness ledger
+    (rounds, failures, row mismatches vs the quiet baseline run), the
+    churn schedule counters, and the coordinator's membership stats as
+    one JSON line."""
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.protocol.transport import TransportConfig
+    from presto_tpu.server.cluster import TpuCluster
+    from presto_tpu.server.discovery import DiscoveryService
+    from presto_tpu.testing.churn import ChurnDriver
+
+    seed = int(os.environ.get("BENCH_CHURN_SEED", "0"))
+    rounds = int(os.environ.get("BENCH_CHURN_ROUNDS", "6"))
+    queries = (
+        "select count(*) from lineitem",
+        "select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+        "from lineitem group by l_returnflag, l_linestatus "
+        "order by l_returnflag, l_linestatus",
+        "select r_name, count(*) from nation, region "
+        "where n_regionkey = r_regionkey group by r_name "
+        "order by r_name",
+    )
+    disc = DiscoveryService("127.0.0.1", expiry_s=2.0).start()
+    cluster = TpuCluster(
+        TpchConnector(0.01), n_workers=2, discovery=disc,
+        session_properties={"retry_policy": "TASK",
+                            "query_max_execution_time": "120"},
+        transport_config=TransportConfig(
+            retry_base_backoff_s=0.01, retry_max_backoff_s=0.2,
+            retry_budget_s=5.0, breaker_failure_threshold=3,
+            breaker_cooldown_s=0.3))
+    driver = ChurnDriver(cluster, seed=seed, max_dynamic=2,
+                         drain_timeout_s=30.0)
+    out = {"seed": seed, "rounds": rounds, "queries": len(queries),
+           "executed": 0, "failures": 0, "mismatches": 0}
+    wall = 0.0
+    try:
+        # quiet baseline on the static fleet = the row oracle
+        want = {sql: sorted(cluster.execute_sql(sql)) for sql in queries}
+        driver.start(interval_s=0.4)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for sql in queries:
+                try:
+                    got = sorted(cluster.execute_sql(sql))
+                except Exception:
+                    out["failures"] += 1
+                    continue
+                out["executed"] += 1
+                if got != want[sql]:
+                    out["mismatches"] += 1
+        wall = time.perf_counter() - t0
+    finally:
+        driver.close()
+        cluster.stop()
+        disc.stop()
+    out["wall_s"] = round(wall, 3)
+    out["queries_per_sec"] = (round(out["executed"] / wall, 2)
+                              if wall > 0 else 0.0)
+    out["churn"] = {k: v for k, v in driver.report().items()
+                    if k != "events"}
+    out["membership"] = cluster.membership_snapshot()
+    print(json.dumps({"metric": "elastic_churn_round",
+                      "value": out["queries_per_sec"], "unit": "q/s",
+                      "detail": {"churn": out}}))
+
+
+def _run_churn_child(timeout_s: float):
+    """Run the elastic churn round in a subprocess; returns the
+    `churn` detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_CHURN_ONE="1", BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "churn", {"error": "child produced no churn entry"})
 
 
 def _hbo_probe(conn, sql):

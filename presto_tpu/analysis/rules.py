@@ -670,3 +670,46 @@ class NoPlannerInDataPlaneRule(Rule):
 
 
 register(NoPlannerInDataPlaneRule())
+
+# =====================================================================
+# 12. membership-chokepoint — cluster.py's _membership() is the only
+#     mutator of the dead/drained sets
+# =====================================================================
+
+#: a direct mutation of the coordinator's dead/drained membership sets;
+#: every such write must sit inside TpuCluster._membership() under
+#: _membership_lock (the chokepoint lines there carry suppressions) so
+#: a failure-detector sweep can never interleave with a scheduler's
+#: placement snapshot and observe half-applied membership
+_MEMBERSHIP_MUTATION = re.compile(
+    r"\.\s*(?:dead|drained)\s*\.\s*"
+    r"(?:add|discard|remove|clear|update|pop)\s*\(")
+
+_CLUSTER = "presto_tpu/server/cluster.py"
+
+
+class MembershipChokepointRule(Rule):
+    name = "membership-chokepoint"
+    description = (
+        "every mutation of the coordinator's dead/drained worker sets "
+        "flows through TpuCluster._membership() under _membership_lock "
+        "— a bare .dead.add / .drained.discard elsewhere races the "
+        "failure detector against placement snapshots (the "
+        "check_workers membership-mutation race)")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_MEMBERSHIP_MUTATION,),
+            "dead/drained set mutated outside the _membership() "
+            "chokepoint — pass dead_add/dead_remove/drained_add/"
+            "drained_remove to _membership() instead",
+            prefixes=("presto_tpu/server/",))
+        # honesty: the chokepoint itself must still mutate the sets via
+        # the idiom this rule polices (its lines carry suppressions)
+        out.extend(honesty_finding(
+            self, pkg, _CLUSTER, (_MEMBERSHIP_MUTATION,),
+            "the membership chokepoint"))
+        return out
+
+
+register(MembershipChokepointRule())

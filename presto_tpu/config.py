@@ -350,6 +350,35 @@ class AdmissionConfig:
 DEFAULT_ADMISSION = AdmissionConfig()
 
 
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic-cluster knobs (reference: the graceful-shutdown handler
+    in the native worker — PrestoServer's shutdown sequence drains
+    tasks before exiting — plus Presto@Meta VLDB'23 §3's fluid worker
+    membership). One per process; the worker's drain path and the
+    coordinator's query journal are built from this."""
+
+    #: upper bound a draining worker waits for its running tasks to
+    #: finish before shutting down anyway (tasks past the deadline are
+    #: left to TASK-retry recovery on the coordinator)
+    drain_timeout_s: float = 30.0
+    #: poll interval while waiting for running tasks to drain
+    drain_poll_s: float = 0.05
+    #: write-ahead query journal location; None = journaling off (the
+    #: statement server keeps no crash-recoverable query log)
+    journal_path: Optional[str] = None
+    #: compact the journal (rewrite live records only) once the dead-
+    #: record count crosses this threshold
+    journal_compact_threshold: int = 256
+    #: how long a coordinator restart keeps absorbing journaled RUNNING
+    #: queries before declaring them failed (0 = re-run immediately)
+    recover_grace_s: float = 0.0
+
+
+#: process defaults — journaling off: tests opt in with a tmp path
+DEFAULT_ELASTIC = ElasticConfig()
+
+
 class Session:
     """One query session: defaults overridden by string-typed properties
     (the wire form). Unknown properties are rejected loudly, like the

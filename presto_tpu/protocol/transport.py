@@ -132,10 +132,20 @@ class FatalResponseError(TransportError):
     """A 4xx response: the request itself is wrong (or the resource is
     gone) — retrying the same bytes cannot succeed."""
 
-    def __init__(self, url: str, status: int, body: bytes = b""):
+    def __init__(self, url: str, status: int, body: bytes = b"",
+                 headers: Optional[dict] = None):
         super().__init__(f"HTTP {status} from {url}")
         self.status = status
         self.body = body
+        self.headers = dict(headers or {})
+
+    @property
+    def draining(self) -> bool:
+        """410 + X-Presto-Draining: the worker is gracefully
+        decommissioning — reschedule the work elsewhere; the node is
+        healthy (this path already records breaker success)."""
+        return self.status == 410 and str(self.headers.get(
+            "X-Presto-Draining", "")).lower() == "true"
 
 
 class CircuitOpenError(TransportError):
@@ -413,11 +423,14 @@ class HttpClient:
                     continue
                 if e.code < 500:
                     # the worker answered: it is alive, the REQUEST is
-                    # bad — don't punish the breaker, don't retry
+                    # bad — don't punish the breaker, don't retry.
+                    # Headers travel with the error so callers can read
+                    # markers like X-Presto-Draining (410 decommission)
                     breaker.record_success()
                     _M_FATAL.inc(host=host)
-                    raise FatalResponseError(url, e.code, err_body) \
-                        from e
+                    raise FatalResponseError(
+                        url, e.code, err_body,
+                        headers=dict(e.headers or {})) from e
                 breaker.record_failure()
                 last = e
             except (urllib.error.URLError, TimeoutError, ConnectionError,

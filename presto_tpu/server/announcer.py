@@ -74,8 +74,24 @@ class Announcer:
                 log.exception("announcement attempt failed; continuing")
             self._stop.wait(self.interval_s)
 
+    def retract(self) -> bool:
+        """Best-effort final DELETE /v1/announcement/{nodeId}: the
+        coordinator learns of departure immediately instead of waiting
+        out announcement staleness (DiscoveryNodeManager's expiry)."""
+        url = f"{self.coordinator_uri}/v1/announcement/{self.node_id}"
+        try:
+            self.client.request(url, method="DELETE",
+                                request_class="announce")
+            return True
+        except Exception as e:   # noqa: BLE001 — departure is advisory
+            self.last_error = str(e)
+            return False
+
     def start(self):
         self._thread.start()
 
-    def stop(self):
+    def stop(self, retract: bool = True):
+        already = self._stop.is_set()
         self._stop.set()
+        if retract and not already:
+            self.retract()

@@ -17,6 +17,16 @@ from typing import Dict, List, Optional, Tuple
 from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
 from presto_tpu.spool.files import FrameFile
 
+class BufferClosedError(RuntimeError):
+    """GET on a buffer whose task (or worker) already closed it. A
+    closed buffer must REFUSE rather than answer `complete` with no
+    frames: a worker shutting down mid-long-poll would otherwise hand
+    every consumer a fake clean end-of-stream and the rows it never
+    served would silently vanish from the query (the continuous-churn
+    row-loss bug). The HTTP layer turns this into a retryable 503 —
+    or serves the committed spool when one exists."""
+
+
 _M_PAGES_ADDED = _counter(
     "presto_tpu_output_buffer_pages_added_total",
     "Frames enqueued into task output buffers")
@@ -98,7 +108,9 @@ class FileBackedClientBuffer(ClientBuffer):
 
     def get(self, token: int, max_bytes: int):
         if self._closed:
-            return [], max(token, 0), True
+            raise BufferClosedError(
+                f"buffer closed at token {token} (task deleted or "
+                "worker shutting down)")
         out, t = self._file.read_range(token, max_bytes)
         complete = self.no_more_pages and t >= self._file.frame_count
         return out, t, complete

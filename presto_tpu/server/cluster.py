@@ -29,7 +29,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.config import DEFAULT_OBS, TransportConfig
-from presto_tpu.obs.metrics import gauge as _obs_gauge
+from presto_tpu.obs.metrics import counter as _obs_counter, \
+    gauge as _obs_gauge
 from presto_tpu.plan.fragment import add_exchanges, create_fragments
 from presto_tpu.plan.iterative import reorder_joins
 from presto_tpu.plan.stats import (
@@ -44,7 +45,8 @@ from presto_tpu.protocol.exchange import (
 )
 from presto_tpu.protocol.to_protocol import FragmentSpec, \
     constrain_split_payload, fragment_to_protocol, remote_split_payload
-from presto_tpu.protocol.transport import HttpClient
+from presto_tpu.protocol.transport import (FatalResponseError,
+                                           HttpClient, TransportError)
 from presto_tpu.server.http import TpuWorkerServer
 
 log = logging.getLogger("presto_tpu.cluster")
@@ -52,6 +54,20 @@ log = logging.getLogger("presto_tpu.cluster")
 _M_MERGE_HIGH = _obs_gauge(
     "presto_tpu_merge_inflight_high_water",
     "Max in-flight row batches during bounded k-way root merges")
+
+# elastic-membership counters (Presto@Meta VLDB'23 §3 fluid worker
+# membership): admissions into, and departures from, the schedulable set
+_M_MEMBER_JOINS = _obs_counter(
+    "presto_tpu_membership_joins_total",
+    "Workers admitted to the schedulable set (first announcement or "
+    "re-admission after death/drain)")
+_M_MEMBER_DEPARTURES = _obs_counter(
+    "presto_tpu_membership_departures_total",
+    "Workers removed from the schedulable set by the failure detector")
+_M_MEMBER_DRAINS = _obs_counter(
+    "presto_tpu_membership_drains_total",
+    "Workers that left the schedulable set via graceful decommission "
+    "(SHUTTING_DOWN)")
 
 
 def _unshare(plan: PlanNode) -> PlanNode:
@@ -352,6 +368,20 @@ class TpuCluster:
         self.all_worker_uris = [f"http://127.0.0.1:{w.port}"
                                 for w in self.workers]
         self.dead: set = set()
+        # graceful-decommission set: workers that reported SHUTTING_DOWN
+        # (or answered a task POST with the draining 410). They leave
+        # the schedulable set WITHOUT a breaker penalty; their running
+        # tasks finish and their committed spools stay readable.
+        self.drained: set = set()
+        # THE membership lock: every read of the schedulable set and
+        # every dead/drained mutation flows through _membership() under
+        # this lock (membership-chokepoint rule) so a failure-detector
+        # sweep can never interleave with a scheduler's placement
+        # snapshot and observe half-applied state
+        self._membership_lock = threading.Lock()
+        self._members_seen: set = set(self.all_worker_uris)
+        self.membership_stats = {"joins": 0, "departures": 0,
+                                 "drains": 0}
         # this cluster's fault-tolerant RPC chokepoint: per-worker
         # circuit breakers + per-request-class retry policies; chaos
         # tests install a FaultInjector on it
@@ -362,35 +392,153 @@ class TpuCluster:
 
     @property
     def worker_uris(self) -> List[str]:
-        uris = list(self.all_worker_uris)
-        if self.discovery is not None:
-            uris += [u for u in self.discovery.active_workers()
-                     if u not in uris]
-        return [u for u in uris if u not in self.dead]
+        return self._membership()
+
+    def _membership(self, dead_add=(), dead_remove=(), drained_add=(),
+                    drained_remove=()) -> List[str]:
+        """THE membership chokepoint (membership-chokepoint rule):
+        every read of the schedulable worker set and every mutation of
+        the dead/drained sets happens inside this one lock. Callers
+        collect probe verdicts FIRST (RPCs never run under the lock)
+        and apply them here in one shot, so scheduling snapshots always
+        see a consistent membership state. Returns the live URI list:
+        static workers plus fresh discovery announcements, minus dead
+        and draining nodes."""
+        with self._membership_lock:
+            for u in dead_add:
+                if u not in self.dead:
+                    # lint: disable=membership-chokepoint
+                    self.dead.add(u)
+                    self.membership_stats["departures"] += 1
+                    _M_MEMBER_DEPARTURES.inc()
+            for u in dead_remove:
+                if u in self.dead:
+                    # lint: disable=membership-chokepoint
+                    self.dead.discard(u)
+                    self.membership_stats["joins"] += 1
+                    _M_MEMBER_JOINS.inc()
+            for u in drained_add:
+                if u not in self.drained:
+                    # lint: disable=membership-chokepoint
+                    self.drained.add(u)
+                    self.membership_stats["drains"] += 1
+                    _M_MEMBER_DRAINS.inc()
+            for u in drained_remove:
+                if u in self.drained:
+                    # lint: disable=membership-chokepoint
+                    self.drained.discard(u)
+                    self.membership_stats["joins"] += 1
+                    _M_MEMBER_JOINS.inc()
+            uris = list(self.all_worker_uris)
+            if self.discovery is not None:
+                uris += [u for u in self.discovery.active_workers()
+                         if u not in uris]
+            # forget dead/drained entries that are neither static nor
+            # announced: they cannot re-enter placement without a fresh
+            # announcement, which re-evaluates them anyway — without
+            # this, continuous churn grows the sets without bound
+            known = set(uris)
+            for u in [u for u in self.dead if u not in known]:
+                # lint: disable=membership-chokepoint
+                self.dead.discard(u)
+            for u in [u for u in self.drained if u not in known]:
+                # lint: disable=membership-chokepoint
+                self.drained.discard(u)
+            live = [u for u in uris if u not in self.dead
+                    and u not in self.drained]
+            for u in live:
+                if u not in self._members_seen:
+                    self._members_seen.add(u)
+                    self.membership_stats["joins"] += 1
+                    _M_MEMBER_JOINS.inc()
+            return live
+
+    def _probe_candidates(self) -> List[str]:
+        """Every URI the failure detector should probe: static workers,
+        fresh discovery announcements, and currently dead/drained nodes
+        (the re-admission path needs to see them answer again). Built
+        under the membership lock; the probes themselves run outside."""
+        with self._membership_lock:
+            uris = list(self.all_worker_uris)
+            if self.discovery is not None:
+                uris += [u for u in self.discovery.active_workers()
+                         if u not in uris]
+            uris += [u for u in sorted(self.dead) if u not in uris]
+            uris += [u for u in sorted(self.drained) if u not in uris]
+            return uris
+
+    def membership_snapshot(self) -> dict:
+        """Locked point-in-time membership view (EXPLAIN ANALYZE's
+        "Membership:" line and status surfaces)."""
+        live = self._membership()
+        with self._membership_lock:
+            return {"live": len(live), "dead": len(self.dead),
+                    "drained": len(self.drained),
+                    **self.membership_stats}
 
     # ---------------------------------------------------- failure detector
     def check_workers(self) -> List[str]:
         """Active liveness probe (reference:
         failureDetector/HeartbeatFailureDetector.java:76 + the
         discovery-announcement timeout in DiscoveryNodeManager): probe
-        /v1/info, mark unreachable workers dead so the scheduler stops
-        placing tasks on them — and RE-ADMIT recovered ones. Dead
+        /v1/info/state so one sweep yields both verdicts — unreachable
+        workers are marked dead so the scheduler stops placing tasks on
+        them (and RE-ADMITTED when they answer again), and workers
+        reporting SHUTTING_DOWN move to the drained set while their
+        running tasks finish and their spools stay readable. Dead
         workers keep being probed through the circuit breaker: while
         its breaker is OPEN the probe fast-fails without touching the
         network; once the cooldown elapses the half-open state lets
         exactly one real probe through, and a restarted worker rejoins
-        the schedulable set instead of staying banned forever.
-        Returns the live URI list."""
-        for uri in list(self.all_worker_uris):
+        the schedulable set instead of staying banned forever. All
+        verdicts are applied through the single locked membership
+        chokepoint; the probe RPCs run outside it. Returns the live
+        URI list."""
+        dead_add: List[str] = []
+        dead_remove: List[str] = []
+        drained_add: List[str] = []
+        drained_remove: List[str] = []
+        for uri in self._probe_candidates():
             try:
-                self.http.request(f"{uri}/v1/info",
-                                  request_class="probe")
+                state = self.http.get_json(f"{uri}/v1/info/state",
+                                           request_class="probe")
+            except Exception:     # noqa: BLE001 — any failure = dead node
+                dead_add.append(uri)
+                continue
+            if str(state).upper() == "SHUTTING_DOWN":
+                drained_add.append(uri)
+                dead_remove.append(uri)
+            else:
                 if uri in self.dead:
                     log.info("worker %s recovered; re-admitting", uri)
-                    self.dead.discard(uri)
-            except Exception:     # noqa: BLE001 — any failure = dead node
-                self.dead.add(uri)
-        return self.worker_uris
+                dead_remove.append(uri)
+                drained_remove.append(uri)
+        return self._membership(
+            dead_add=dead_add, dead_remove=dead_remove,
+            drained_add=drained_add, drained_remove=drained_remove)
+
+    def decommission(self, worker_uri: str,
+                     timeout_s: Optional[float] = None) -> dict:
+        """Gracefully drain one worker: PUT /v1/info/state
+        "SHUTTING_DOWN" (the native worker's node-state shutdown
+        protocol) and mark it drained through the membership
+        chokepoint. The PUT blocks until the worker's running tasks
+        finished and committed their spools (or its drain timeout
+        elapsed), so on return the node holds no live work and new
+        queries schedule around it. Returns the worker's drain
+        report."""
+        import json as _json
+        from presto_tpu.config import DEFAULT_ELASTIC
+        wait_s = (DEFAULT_ELASTIC.drain_timeout_s
+                  if timeout_s is None else timeout_s)
+        resp = self.http.request(
+            f"{worker_uri}/v1/info/state", method="PUT",
+            body=_json.dumps("SHUTTING_DOWN").encode(),
+            headers={"Content-Type": "application/json"},
+            request_class="control", timeout=wait_s + 10.0,
+            attempts=1)
+        self._membership(drained_add=[worker_uri])
+        return resp.json()
 
     def start_heartbeat(self, interval_s: float = 5.0) -> "TpuCluster":
         """Periodic background liveness prober (reference:
@@ -678,6 +826,13 @@ class TpuCluster:
             lines.append(
                 f"Admission: group={adm['group']} "
                 f"queue_wait={adm['queue_wait_s']:.3f}s")
+        mem = getattr(self, "last_membership", None)
+        if mem is not None:
+            lines.append(
+                f"Membership: live={mem['live']} dead={mem['dead']} "
+                f"drained={mem['drained']} joins={mem['joins']} "
+                f"departures={mem['departures']} "
+                f"drains={mem['drains']}")
         hbo = getattr(self, "last_hbo", None) or {}
         df_pruned = sum(
             int((((info.get("stats") or {}).get("runtimeStats") or {})
@@ -840,10 +995,14 @@ class TpuCluster:
                     "partitioned producer shared by several consumer "
                     "fragments (CTE materialization boundary — planned)")
 
-        # snapshot membership for this query: placement must not shift if
-        # an announcement arrives mid-schedule
+        # membership snapshot at query START fixes the task COUNTS (W)
+        # for the whole query — buffer wiring and split assignment must
+        # not shift once any stage is posted. PLACEMENT, by contrast,
+        # re-snapshots per stage (see schedule()) so mid-query joins and
+        # drains are visible to every not-yet-scheduled stage.
         placement = list(self.worker_uris)
         W = len(placement)
+        self.last_membership = self.membership_snapshot()
         specs = {f.fragment_id: fragment_to_protocol(f, self.connector)
                  for f in frags}
 
@@ -915,7 +1074,14 @@ class TpuCluster:
                       else 1)
             for src in srcs:
                 schedule(src)
-            self._start_stage(qid, fid, stages, by_id, placement)
+            # per-STAGE placement snapshot (mid-query join): a worker
+            # that announced after the query started is schedulable for
+            # every stage not yet placed, and one that began draining
+            # stops receiving new stages — while task counts stay
+            # pinned to the query-start W so buffer wiring never shifts
+            # under running stages
+            stage_placement = self.worker_uris or placement
+            self._start_stage(qid, fid, stages, by_id, stage_placement)
             scheduled.add(fid)
 
         batch_mode = (str(self.session_properties.get(
@@ -1017,6 +1183,9 @@ class TpuCluster:
                     k: (ex_after[k] - exchange_before[k]
                         if not k.endswith("high_water") else ex_after[k])
                     for k in ex_after}
+                # post-query membership view: joins/drains that landed
+                # DURING the query show up in EXPLAIN ANALYZE
+                self.last_membership = self.membership_snapshot()
 
         if not DEFAULT_OBS.sampled(random.random()):
             return run_query()
@@ -1133,10 +1302,15 @@ class TpuCluster:
         False means this error is not recoverable here."""
         from presto_tpu.spool.store import record_recovery
 
-        alive = set(self.check_workers())
+        # survivors keep MEMBERSHIP order (static fleet first, then
+        # announced joiners in announce order): deterministic like a
+        # sort, but a worker that announced mid-query slots into the
+        # index the departed worker vacated instead of wherever its
+        # ephemeral port happens to sort
+        survivors = self.check_workers()
+        alive = set(survivors)
         if not alive:
             return False
-        survivors = sorted(alive)
         order: List[int] = []
         seen: set = set()
 
@@ -1225,11 +1399,11 @@ class TpuCluster:
         re-posts EVERY task — needed when upstream producers moved and
         surviving tasks' remote splits still point at the old
         locations (batch-mode recovery)."""
-        alive = set(self.check_workers())
+        survivors = self.check_workers()   # membership order, as above
+        alive = set(survivors)
         if not alive:
             return False
         stage = stages[fid]
-        survivors = sorted(alive)
         recovered = False
         for t, uri in enumerate(list(stage.task_uris)):
             worker = uri.split("/v1/task/")[0]
@@ -1609,8 +1783,44 @@ class TpuCluster:
             outputIds=S.OutputBuffers(
                 type="PARTITIONED", version=1, noMoreBufferIds=True,
                 buffers={str(j): j for j in range(stage.n_buffers)}))
-        self._post(uri, tur.dumps().encode())
-        return task_id, uri
+        body = tur.dumps().encode()
+        tried = set()
+        while True:
+            try:
+                self._post(uri, body)
+                return task_id, uri
+            except FatalResponseError as e:
+                if not e.draining:
+                    raise
+                # graceful decommission mid-schedule: the worker
+                # refused the NEW task with 410 + X-Presto-Draining
+                # (the transport already recorded breaker SUCCESS on
+                # the 4xx — a draining node takes no availability
+                # penalty). Mark it drained through the chokepoint and
+                # re-place this task on another live worker.
+                err, mutation = e, {"drained_add": [worker_uri]}
+                log.info("worker %s draining; re-placing task %s",
+                         worker_uri, task_id)
+            except TransportError as e:
+                # the target died between the membership snapshot and
+                # this POST (continuous churn): mark it dead through
+                # the chokepoint and re-place instead of failing the
+                # query. Safe even if the POST half-landed — task
+                # updates are at-least-once and split assignment is
+                # deterministic, so a duplicate produces identical
+                # output under one task id.
+                err, mutation = e, {"dead_add": [worker_uri]}
+                log.info("worker %s unreachable; re-placing task %s",
+                         worker_uri, task_id)
+            tried.add(worker_uri)
+            live = [w for w in self._membership(**mutation)
+                    if w not in tried]
+            if not live:
+                raise ClusterQueryError(
+                    f"no live workers to place task {task_id}: "
+                    f"all candidates draining or dead") from err
+            worker_uri = live[t % len(live)]
+            uri = f"{worker_uri}/v1/task/{task_id}"
 
     # ------------------------------------------------------------------
     def _post(self, uri: str, body: bytes) -> dict:

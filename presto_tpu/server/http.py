@@ -32,7 +32,10 @@ from typing import Optional
 import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.obs.metrics import gauge as _gauge, render_prometheus
 from presto_tpu.protocol import structs as S
-from presto_tpu.server.task_manager import TpuTaskManager
+from presto_tpu.server.buffers import BufferClosedError
+from presto_tpu.server.task_manager import (
+    TpuTaskManager, WorkerDrainingError,
+)
 from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import (
     TRACE_HEADER, TRACER, parse_trace_header,
@@ -155,6 +158,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _draining_reject(self, e: WorkerDrainingError):
+        """410 Gone + X-Presto-Draining: the coordinator reads the
+        marker as 'reschedule elsewhere', not as a worker fault — a
+        4xx already records breaker success, so a draining node takes
+        no availability penalty."""
+        return self._json(410, {"error": str(e), "draining": True},
+                          headers={"X-Presto-Draining": "true"})
+
     # ------------------------------------------------------------- POST
     def do_POST(self):
         if not self._authorized():
@@ -168,17 +179,52 @@ class _Handler(BaseHTTPRequestHandler):
             # accepted and ignored (no Spark shuffle backend)
             breq = S.BatchTaskUpdateRequest.from_json(
                 self._read_body_doc())
-            info = self.tm.create_or_update(m.group(1),
-                                            breq.taskUpdateRequest,
-                                            trace_ctx=trace_ctx)
+            try:
+                info = self.tm.create_or_update(m.group(1),
+                                                breq.taskUpdateRequest,
+                                                trace_ctx=trace_ctx)
+            except WorkerDrainingError as e:
+                return self._draining_reject(e)
             return self._json(200, S.TaskInfo.to_json(info))
         m = _TASK.match(path)
         if m:
             req = S.TaskUpdateRequest.from_json(self._read_body_doc())
-            info = self.tm.create_or_update(m.group(1), req,
-                                            trace_ctx=trace_ctx)
+            try:
+                info = self.tm.create_or_update(m.group(1), req,
+                                                trace_ctx=trace_ctx)
+            except WorkerDrainingError as e:
+                return self._draining_reject(e)
             return self._json(200, S.TaskInfo.to_json(info))
         self._json(404, {"error": f"no route {self.path}"})
+
+    # -------------------------------------------------------------- PUT
+    def do_PUT(self):
+        """PUT /v1/info/state (reference: PrestoServer.cpp's node-state
+        endpoint): body "SHUTTING_DOWN" starts a graceful decommission.
+        The drain runs synchronously on this handler thread — new task
+        creations are refused from the first instant, running tasks
+        finish and commit their spools, then the announcer retracts the
+        node before the response returns, so a 200 means the node is
+        fully drained (or the drain timeout elapsed)."""
+        if not self._authorized():
+            return
+        path = self.path.split("?")[0]
+        if path != "/v1/info/state":
+            return self._json(404, {"error": f"no route {path}"})
+        try:
+            want = self._read_body_doc()
+        except Exception:   # noqa: BLE001 — malformed body
+            return self._json(400, {"error": "unparseable state body"})
+        if want != "SHUTTING_DOWN":
+            return self._json(400, {
+                "error": f"unsupported state {want!r}; only "
+                         f"SHUTTING_DOWN is accepted"})
+        ws = getattr(self.server, "worker_server", None)
+        if ws is not None:
+            report = ws.drain()
+        else:
+            report = self.tm.drain()
+        return self._json(200, report)
 
     # -------------------------------------------------------------- GET
     def do_GET(self):
@@ -225,7 +271,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "starting": False,
                 "uptime": f"{time.time() - _SERVER_START:.2f}s"})
         if path == "/v1/info/state":
-            return self._json(200, "ACTIVE")
+            return self._json(200, self.tm.lifecycle_state)
         if path == "/v1/status":
             # NodeStatus role (PrestoServer.cpp /v1/status): JSON node
             # snapshot — identity, role, uptime, task counts, heap-proxy
@@ -240,6 +286,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "internalAddress": "127.0.0.1",
                 "taskCount": len(tasks),
                 "tasksCreated": self.tm.lifetime_tasks,
+                "nodeState": self.tm.lifecycle_state,
+                "drain": {
+                    "state": self.tm.lifecycle_state,
+                    "rejected": self.tm.drain_rejected,
+                    "drainSeconds": self.tm.drain_seconds,
+                },
                 "memoryInfo": {"availableProcessors": 1},
                 "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
                 "heapUsed": self.tm.memory_bytes(),
@@ -328,7 +380,21 @@ class _Handler(BaseHTTPRequestHandler):
         deadline = time.time() + _parse_duration(
             self.headers.get("X-Presto-Max-Wait"), 1.0)
         while True:
-            frames, nxt, complete = buf.get(tok, max_bytes)
+            try:
+                frames, nxt, complete = buf.get(tok, max_bytes)
+            except BufferClosedError:
+                # the task's buffers were closed under this long-poll
+                # (worker shutting down, task deleted): a committed
+                # spool serves the SAME bytes at the same tokens;
+                # otherwise refuse retryably — never answer `complete`
+                # for frames this buffer no longer serves
+                committed = self._spool_for(task_id)
+                if committed is not None:
+                    return self._spool_results(committed, buffer_id,
+                                               token)
+                return self._json(
+                    503, {"error": "output buffer closed (worker "
+                          "shutting down); retry"})
             if frames or complete or time.time() >= deadline:
                 break
             time.sleep(0.01)
@@ -373,7 +439,11 @@ class TpuWorkerServer:
                  node_id: str = "tpu-worker-0",
                  shared_secret: Optional[str] = None,
                  cache_config=None, spool_config=None,
-                 exchange_config=None):
+                 exchange_config=None, elastic_config=None):
+        from presto_tpu.config import DEFAULT_ELASTIC
+        self.elastic_config = (elastic_config
+                               if elastic_config is not None
+                               else DEFAULT_ELASTIC)
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.port = self.httpd.server_address[1]
         base = f"http://{host}:{self.port}"
@@ -401,6 +471,9 @@ class TpuWorkerServer:
         if coordinator_uri:
             from presto_tpu.server.announcer import Announcer
             self.announcer = Announcer(coordinator_uri, base, node_id)
+        # back-reference for the PUT /v1/info/state handler: a drain
+        # request must also retract the announcement once drained
+        self.httpd.worker_server = self
 
     def start(self):
         self.thread.start()
@@ -408,9 +481,27 @@ class TpuWorkerServer:
             self.announcer.start()
         return self
 
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful decommission: refuse new tasks, let running ones
+        finish and commit spools, then retract the announcement so the
+        coordinator drops this node from live membership immediately.
+        The HTTP server keeps serving — already-produced pages and
+        committed spools remain fetchable until stop()."""
+        cfg = self.elastic_config
+        report = self.task_manager.drain(
+            timeout_s=cfg.drain_timeout_s if timeout_s is None
+            else timeout_s,
+            poll_s=cfg.drain_poll_s)
+        if self.announcer:
+            self.announcer.stop(retract=True)
+        return report
+
     def stop(self):
         if self.announcer:
-            self.announcer.stop()
+            # clean departure: halt the loop AND send the final
+            # DELETE /v1/announcement/{nodeId} so the coordinator
+            # learns immediately instead of waiting out staleness
+            self.announcer.stop(retract=True)
         self.httpd.shutdown()
         self.httpd.server_close()
         self.task_manager.shutdown()

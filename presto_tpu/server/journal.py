@@ -1,0 +1,183 @@
+"""Coordinator write-ahead query journal: crash recovery for the
+statement front door.
+
+Reference: the dispatcher-side durability that makes coordinator
+restarts survivable in fault-tolerant-execution deployments (Project
+Tardigrade's exchange-backed recovery paired with Presto@Meta VLDB'23
+§3's recoverable coordinator state). Every accepted statement is
+journaled BEFORE it is dispatched, and every lifecycle transition is
+appended after it happens, so a coordinator that crashes mid-fleet
+restarts knowing exactly which queries were QUEUED/RUNNING and can
+re-queue them through the admission front door; under
+``retry_policy=TASK`` the re-run absorbs any spools the previous run
+committed instead of redoing that work.
+
+Format: append-only JSONL — one ``{"qid", "sql", "user", "source",
+"state", "ts"}`` object per line; later lines for the same qid merge
+over earlier ones (state transitions append, never rewrite). Appends
+are flushed per record; compaction rewrites the file atomically with
+the same tmp-file + ``os.replace`` discipline as
+``plan/stats.HistoryStore.save`` and drops terminal (FINISHED/FAILED)
+queries. A journal that fails to parse is moved aside to
+``<path>.corrupt`` and the coordinator starts fresh — a torn journal
+must never wedge startup."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from presto_tpu.obs.metrics import counter as _counter
+
+log = logging.getLogger("presto_tpu.journal")
+
+_M_APPENDS = _counter(
+    "presto_tpu_coordinator_journal_appends_total",
+    "Records appended to the coordinator's write-ahead query journal")
+_M_RECOVERED = _counter(
+    "presto_tpu_coordinator_journal_recovered_queries_total",
+    "Journaled queries re-queued through admission after a "
+    "coordinator restart")
+
+#: states that need no recovery — compaction drops them
+TERMINAL_STATES = ("FINISHED", "FAILED")
+
+
+class QueryJournal:
+    """Append-only, crash-safe query journal for one coordinator."""
+
+    def __init__(self, path: str, compact_threshold: int = 256):
+        self.path = path
+        self.compact_threshold = max(int(compact_threshold), 1)
+        self._lock = threading.Lock()
+        self.appends = 0
+        self.compactions = 0
+        self.recovered = 0
+        #: True when the on-disk journal failed to parse at load time
+        #: and was moved aside (observability for the corruption tests)
+        self.started_fresh = False
+        self.records: Dict[str, dict] = self._load()
+
+    # ------------------------------------------------------------- load
+    def _load(self) -> Dict[str, dict]:
+        if not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path) as f:
+                text = f.read()
+        except OSError:
+            log.warning("journal %s unreadable; starting fresh",
+                        self.path, exc_info=True)
+            self.started_fresh = True
+            return {}
+        records: Dict[str, dict] = {}
+        try:
+            for line in text.splitlines():
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                qid = rec["qid"]
+                merged = dict(records.get(qid, {}))
+                merged.update({k: v for k, v in rec.items()
+                               if v is not None})
+                records[qid] = merged
+        except (ValueError, KeyError, TypeError):
+            # corruption / partial write beyond a clean prefix: the
+            # journal is not trustworthy — preserve the evidence and
+            # start fresh rather than recovering from garbage
+            log.warning("journal %s corrupt; moving aside and starting "
+                        "fresh", self.path)
+            self.started_fresh = True
+            try:
+                os.replace(self.path, f"{self.path}.corrupt")
+            except OSError:
+                pass
+            return {}
+        return records
+
+    # ----------------------------------------------------------- append
+    def append(self, qid: str, sql: Optional[str] = None,
+               user: Optional[str] = None, source: Optional[str] = None,
+               group: Optional[str] = None,
+               state: Optional[str] = None) -> None:
+        """Append one record. Fields left None are inherited from the
+        qid's earlier records at merge time. A torn append makes the
+        journal unparsable, which the next load treats as corruption
+        (move aside + start fresh) — never as partial truth."""
+        rec = {"qid": qid, "sql": sql, "user": user, "source": source,
+               "group": group, "state": state, "ts": time.time()}
+        line = json.dumps({k: v for k, v in rec.items()
+                           if v is not None})
+        with self._lock:
+            merged = dict(self.records.get(qid, {}))
+            merged.update({k: v for k, v in rec.items()
+                           if v is not None})
+            self.records[qid] = merged
+            try:
+                # lint: disable=spool-chokepoint
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+            except OSError:
+                log.warning("journal append failed for %s", qid,
+                            exc_info=True)
+                return
+            self.appends += 1
+            _M_APPENDS.inc()
+            if self.appends % self.compact_threshold == 0:
+                self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal atomically keeping only non-terminal
+        queries (same tmp + os.replace discipline as HistoryStore —
+        a crash mid-compaction leaves the old journal intact)."""
+        live = {qid: r for qid, r in self.records.items()
+                if r.get("state") not in TERMINAL_STATES}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            # lint: disable=spool-chokepoint
+            with open(tmp, "w") as f:
+                for r in live.values():
+                    f.write(json.dumps(r) + "\n")
+            os.replace(tmp, self.path)
+            self.records = live
+            self.compactions += 1
+        except OSError:
+            log.warning("journal compaction failed", exc_info=True)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def compact(self) -> None:
+        with self._lock:
+            self._compact_locked()
+
+    # --------------------------------------------------------- recovery
+    def pending(self) -> List[dict]:
+        """Records not in a terminal state — the restart worklist, in
+        journal (submission) order."""
+        with self._lock:
+            return [dict(r) for r in self.records.values()
+                    if r.get("state") not in TERMINAL_STATES]
+
+    def mark_recovered(self, n: int = 1) -> None:
+        with self._lock:
+            self.recovered += n
+        for _ in range(n):
+            _M_RECOVERED.inc()
+
+    def stats(self) -> dict:
+        with self._lock:
+            pending = sum(1 for r in self.records.values()
+                          if r.get("state") not in TERMINAL_STATES)
+            return {"path": self.path, "appends": self.appends,
+                    "compactions": self.compactions,
+                    "pending": pending, "recovered": self.recovered,
+                    "startedFresh": self.started_fresh}

@@ -28,7 +28,8 @@ import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.admission import (DispatchManager, OverloadedError,
                                   QueryQueueFull, ResourceGroupManager)
 from presto_tpu.admission import dispatcher as _dispatch
-from presto_tpu.config import DEFAULT_ADMISSION
+from presto_tpu.config import DEFAULT_ADMISSION, DEFAULT_ELASTIC
+from presto_tpu.server.journal import QueryJournal
 from presto_tpu.obs.metrics import (
     counter as _counter, gauge as _gauge, render_prometheus,
 )
@@ -283,7 +284,14 @@ class _Handler(BaseHTTPRequestHandler):
                     if rgs is not None else {}),
                 # front-door snapshot: pool occupancy, queue-wait
                 # percentiles, shed counters and thresholds
-                "admission": co.dispatcher.snapshot()})
+                "admission": co.dispatcher.snapshot(),
+                # write-ahead journal state (None when crash recovery
+                # is not configured) + the engine's membership view
+                "journal": (co.journal.stats()
+                            if co.journal is not None else None),
+                "membership": (eng.membership_snapshot()
+                               if hasattr(eng, "membership_snapshot")
+                               else None)})
         m = _TRACE.match(path)
         if m:
             # stitched cross-node span dump for one query id (worker
@@ -349,8 +357,16 @@ class StatementServer:
     execute_sql/plan_sql (TpuCluster or LocalEngine)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 admission=None, resource_groups=None):
+                 admission=None, resource_groups=None, elastic=None):
         self.engine = engine
+        # coordinator crash recovery: with a journal path configured
+        # (ElasticConfig.journal_path) every accepted statement is
+        # write-ahead journaled and re-queued by recover() on restart
+        self.elastic = elastic if elastic is not None else DEFAULT_ELASTIC
+        self.journal = (QueryJournal(
+            self.elastic.journal_path,
+            compact_threshold=self.elastic.journal_compact_threshold)
+            if self.elastic.journal_path else None)
         # share the engine's resource groups when it has them so the
         # front door and the engine agree on admission state (the
         # engine's own acquire becomes a no-op under the dispatcher)
@@ -409,6 +425,36 @@ class StatementServer:
                 self._idempotency = {
                     k: v for k, v in self._idempotency.items()
                     if v in self.queries}
+        # write-ahead: journal the statement BEFORE dispatch so a
+        # coordinator crash between admission and completion leaves a
+        # recoverable record (group path is advisory — selection is
+        # deterministic on (user, source), so recovery re-selects it)
+        if self.journal is not None:
+            self.journal.append(qid, sql=sql, user=user, source=source,
+                                group=self._group_path(user, source),
+                                state="QUEUED")
+        try:
+            self._dispatch(q, user=user, source=source)
+        except OverloadedError:
+            with self._submit_lock:
+                self.queries.pop(qid, None)
+                if idempotency_key is not None:
+                    self._idempotency.pop(idempotency_key, None)
+            raise
+        return q
+
+    def _group_path(self, user: str, source: str) -> Optional[str]:
+        try:
+            return self.resource_groups.select(
+                user=user, source=source).path
+        except Exception:   # noqa: BLE001 — the path is advisory
+            return None
+
+    def _dispatch(self, q: _Query, user: str, source: str) -> None:
+        """Route one registered _Query through the admission
+        dispatcher, with journal appends on every lifecycle transition.
+        Raises OverloadedError (shed); queue-full failures close the
+        query cleanly instead."""
 
         def _on_state(state: str, error) -> None:
             q.dispatch_state = state
@@ -424,20 +470,63 @@ class StatementServer:
                 q.state = "FAILED"
                 _M_QUERIES.inc(state="FAILED")
                 q.done.set()
+                if self.journal is not None:
+                    self.journal.append(q.qid, state="FAILED")
+
+        def _run() -> None:
+            if self.journal is not None:
+                self.journal.append(q.qid, state="RUNNING")
+            q.run(self.engine)
+            if self.journal is not None:
+                self.journal.append(q.qid, state=q.state)
 
         try:
             q._handle = self.dispatcher.submit(
-                lambda: q.run(self.engine), user=user, source=source,
-                query_id=qid, listener=_on_state)
+                _run, user=user, source=source,
+                query_id=q.qid, listener=_on_state)
         except OverloadedError:
-            with self._submit_lock:
-                self.queries.pop(qid, None)
-                if idempotency_key is not None:
-                    self._idempotency.pop(idempotency_key, None)
             raise
         except QueryQueueFull as e:
             _on_state(_dispatch.FAILED, e)      # clean rejection
-        return q
+
+    def recover(self) -> int:
+        """Coordinator crash recovery: re-queue every journaled
+        non-terminal query from a previous coordinator process through
+        the admission front door, under the ORIGINAL query ids so
+        clients polling pre-crash nextUris re-attach. QUEUED queries
+        re-dispatch exactly like fresh submissions; RUNNING ones re-run
+        — under ``retry_policy=TASK`` the re-execution absorbs any
+        spools the previous run committed instead of redoing that work.
+        Returns the number of queries re-queued."""
+        if self.journal is None:
+            return 0
+        grace = float(getattr(self.elastic, "recover_grace_s", 0) or 0)
+        if grace > 0:
+            _time.sleep(grace)
+        n = 0
+        for rec in self.journal.pending():
+            qid, sql = rec.get("qid"), rec.get("sql")
+            if not qid or not sql or qid in self.queries:
+                continue
+            user = rec.get("user", "") or ""
+            q = _Query(qid, sql, user=user)
+            with self._submit_lock:
+                self.queries[qid] = q
+            self.journal.append(qid, state="QUEUED")
+            try:
+                self._dispatch(q, user=user,
+                               source=rec.get("source", "") or "")
+            except OverloadedError as e:
+                # recovery never sheds silently: close the query with
+                # the rejection so the journal reaches a terminal state
+                q.error = f"{type(e).__name__}: {e}"[:500]
+                q.state = "FAILED"
+                q.done.set()
+                self.journal.append(qid, state="FAILED")
+                continue
+            self.journal.mark_recovered()
+            n += 1
+        return n
 
     def cancel(self, q: _Query) -> bool:
         """Withdraw a statement still waiting for admission; running
@@ -448,6 +537,11 @@ class StatementServer:
 
     def start(self) -> "StatementServer":
         self._thread.start()
+        # crash recovery before the first client request lands: any
+        # journaled non-terminal queries from a previous process are
+        # back in the admission queue by the time start() returns
+        if self.journal is not None:
+            self.recover()
         return self
 
     def stop(self):

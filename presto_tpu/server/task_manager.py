@@ -22,7 +22,9 @@ import numpy as np
 
 from presto_tpu.data.column import Page, concat_pages_host, select_page_host
 from presto_tpu.exec.split_executor import SplitExecutor
-from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+from presto_tpu.obs.metrics import (
+    counter as _counter, gauge as _gauge, histogram as _histogram,
+)
 from presto_tpu.plan.nodes import RemoteSourceNode
 from presto_tpu.protocol import structs as S
 from presto_tpu.protocol.serde import (
@@ -55,6 +57,13 @@ _M_LIFETIME_BYTES = _gauge(
 _M_DF_PRUNED = _counter(
     "presto_tpu_dynamic_filter_rows_pruned_total",
     "Probe-side scan rows skipped by cross-exchange dynamic filters")
+_M_DRAIN_SECONDS = _histogram(
+    "presto_tpu_worker_drain_seconds",
+    "Wall seconds a graceful decommission spent waiting for running "
+    "tasks to finish")
+_M_DRAIN_REJECTS = _counter(
+    "presto_tpu_worker_drain_rejected_tasks_total",
+    "Task creations refused because this worker was SHUTTING_DOWN")
 
 #: task states the by-state gauge always reports (zeros included, so a
 #: scrape sees a stable series set)
@@ -155,6 +164,12 @@ def _hash_partition_ids(page: Page, channels: Tuple[int, ...],
     acc *= np.uint64(0xFF51AFD7ED558CCD)
     acc ^= acc >> np.uint64(33)
     return (acc % np.uint64(max(nbuf, 1))).astype(np.int64)
+
+
+class WorkerDrainingError(RuntimeError):
+    """A task creation arrived while this worker was SHUTTING_DOWN.
+    The HTTP layer maps this to 410 + X-Presto-Draining so the
+    coordinator reschedules elsewhere without a breaker penalty."""
 
 
 class Task:
@@ -394,6 +409,12 @@ class TpuTaskManager:
         self.aborted_ids: "collections.deque" = collections.deque()
         self._aborted_set: set = set()
         self.lock = threading.Lock()
+        # graceful-decommission lifecycle (reference: the native
+        # worker's NodeState — ACTIVE until PUT /v1/info/state moves it
+        # to SHUTTING_DOWN; new tasks are refused, running ones finish)
+        self.lifecycle_state = "ACTIVE"
+        self.drain_rejected = 0
+        self.drain_seconds: Optional[float] = None
 
     # ------------------------------------------------------------------
     def create_or_update(self, task_id: str,
@@ -401,6 +422,16 @@ class TpuTaskManager:
                          trace_ctx: Optional[TraceContext] = None
                          ) -> S.TaskInfo:
         with self.lock:
+            if self.lifecycle_state != "ACTIVE" \
+                    and task_id not in self.tasks:
+                # draining: refuse NEW work only — updates to tasks
+                # already running here must still land so they can
+                # finish and commit their spools
+                self.drain_rejected += 1
+                _M_DRAIN_REJECTS.inc()
+                raise WorkerDrainingError(
+                    f"worker {self.node_id} is SHUTTING_DOWN; "
+                    f"task {task_id} must be scheduled elsewhere")
             if task_id in self._aborted_set:     # O(1) tombstone lookup
                 # the task was aborted before it was created — never run
                 # it (reference: TaskManager.cpp:564 out-of-order
@@ -1192,6 +1223,38 @@ class TpuTaskManager:
         if task.buffers is not None:
             task.buffers.close()     # materialized shuffle files
         return task.info(self.base_uri)
+
+    def drain(self, timeout_s: float = 30.0,
+              poll_s: float = 0.05) -> dict:
+        """Graceful decommission (reference: the native worker's
+        shutdown handler draining tasks before exit): flip the
+        lifecycle to SHUTTING_DOWN so new task creations are refused,
+        then wait — up to `timeout_s` — for every PLANNED/RUNNING task
+        to reach a terminal state. Spool commits happen inside the task
+        run path before FINISHED, so a clean drain leaves every output
+        either served or atomically committed to the spool. Idempotent;
+        only the first call observes the drain histogram."""
+        with self.lock:
+            first = self.lifecycle_state == "ACTIVE"
+            self.lifecycle_state = "SHUTTING_DOWN"
+        t0 = time.time()
+        deadline = t0 + max(timeout_s, 0.0)
+        while True:
+            with self.lock:
+                live = [t.task_id for t in self.tasks.values()
+                        if t.state in ("PLANNED", "RUNNING")]
+            if not live or time.time() >= deadline:
+                break
+            time.sleep(poll_s)
+        took = time.time() - t0
+        if first:
+            self.drain_seconds = took
+            _M_DRAIN_SECONDS.observe(took)
+        return {"state": self.lifecycle_state,
+                "drain_seconds": round(took, 4),
+                "tasks_remaining": len(live),
+                "remaining_task_ids": live[:16],
+                "rejected": self.drain_rejected}
 
     def shutdown(self):
         """Release every live task's disk-backed output on worker stop.

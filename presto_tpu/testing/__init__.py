@@ -1,8 +1,11 @@
 """Deterministic fault-injection tooling for chaos testing the
-transport layer (testing/faults.py) and the closed-loop load harness
-for the admission front door (testing/load.py)."""
+transport layer (testing/faults.py), the closed-loop load harness for
+the admission front door (testing/load.py), and the seeded
+continuous-churn driver for elastic membership (testing/churn.py)."""
 
+from presto_tpu.testing.churn import ChurnDriver
 from presto_tpu.testing.faults import FaultInjector, FaultSpec
 from presto_tpu.testing.load import LoadHarness, LoadReport
 
-__all__ = ["FaultInjector", "FaultSpec", "LoadHarness", "LoadReport"]
+__all__ = ["ChurnDriver", "FaultInjector", "FaultSpec", "LoadHarness",
+           "LoadReport"]

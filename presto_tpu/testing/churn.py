@@ -1,0 +1,189 @@
+"""Continuous-churn chaos driver: joins, drains, and kills workers on
+a seeded schedule while queries run.
+
+Reference: the fluid-membership discipline of Presto@Meta (VLDB'23 §3)
+— an autoscaled fleet where workers appear and disappear continuously
+and the coordinator must keep every in-flight query correct. The
+driver exercises all three membership transitions:
+
+- **join**: start a fresh ``TpuWorkerServer`` that announces itself to
+  the cluster's discovery service; the scheduler's per-stage placement
+  snapshots pick it up mid-query.
+- **drain**: graceful decommission — ``PUT /v1/info/state`` →
+  ``SHUTTING_DOWN`` via ``cluster.decommission``; running tasks
+  finish, spools commit, the announcement is retracted.
+- **kill**: a crash — the announcer stops WITHOUT retracting (a dead
+  process sends no goodbye), the HTTP server and task manager are torn
+  down mid-flight; failure detection + ``retry_policy=TASK`` recovery
+  must absorb it.
+
+Determinism follows the faults.py discipline: every decision draws
+from ``random.Random(f"{seed}:{kind}:{ordinal}")`` so a churn schedule
+replays exactly from its seed regardless of wall-clock interleaving.
+The driver only ever touches the *dynamic* workers it created — the
+cluster's static workers stay up, so the zero-dropped-queries
+guarantee has a capacity floor to stand on.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Dict, List, Optional
+
+from presto_tpu.server.http import TpuWorkerServer
+from presto_tpu.utils.threads import spawn
+
+log = logging.getLogger("presto_tpu.churn")
+
+ACTIONS = ("join", "drain", "kill")
+
+
+class ChurnDriver:
+    """Seeded join/drain/kill schedule against a live ``TpuCluster``.
+
+    Use either synchronously (call :meth:`step` between queries) or in
+    the background (:meth:`start` / :meth:`close`) while a workload
+    runs. The cluster must have a ``DiscoveryService`` attached —
+    joins announce through it.
+    """
+
+    def __init__(self, cluster, seed: int = 0, max_dynamic: int = 2,
+                 announce_interval_s: float = 0.5,
+                 drain_timeout_s: float = 10.0):
+        if cluster.discovery is None:
+            raise ValueError(
+                "ChurnDriver needs a cluster with a discovery service: "
+                "joins announce through it")
+        self.cluster = cluster
+        self.seed = int(seed)
+        self.max_dynamic = max(int(max_dynamic), 1)
+        self.announce_interval_s = announce_interval_s
+        self.drain_timeout_s = drain_timeout_s
+        #: node_id -> live dynamic TpuWorkerServer
+        self.dynamic: Dict[str, TpuWorkerServer] = {}
+        self.counts = {"joins": 0, "drains": 0, "kills": 0}
+        self.events: List[dict] = []
+        self._ordinal = 0
+        self._joined = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step_lock = threading.Lock()
+
+    # ------------------------------------------------------ determinism
+    def _rng(self, kind: str, ordinal: int) -> random.Random:
+        # same seeding discipline as testing/faults.py: the stream is a
+        # pure function of (seed, kind, ordinal), never of timing
+        return random.Random(f"{self.seed}:{kind}:{ordinal}")
+
+    def _pick_victim(self, ordinal: int) -> str:
+        return self._rng("victim", ordinal).choice(sorted(self.dynamic))
+
+    # ----------------------------------------------------------- actions
+    def step(self) -> str:
+        """Run one seeded membership transition and return its name."""
+        with self._step_lock:
+            self._ordinal += 1
+            ordinal = self._ordinal
+            if not self.dynamic:
+                action = "join"
+            else:
+                r = self._rng("action", ordinal).random()
+                if len(self.dynamic) < self.max_dynamic and r < 0.45:
+                    action = "join"
+                elif r < 0.75:
+                    action = "drain"
+                else:
+                    action = "kill"
+            detail = getattr(self, f"_{action}")(ordinal)
+            self.counts[action + "s"] += 1
+            self.events.append({"ordinal": ordinal, "action": action,
+                                **detail})
+            log.info("churn[%d] step %d: %s %s", self.seed, ordinal,
+                     action, detail)
+            return action
+
+    def _join(self, ordinal: int) -> dict:
+        self._joined += 1
+        nid = f"churn-{self.seed}-{self._joined}"
+        c = self.cluster
+        w = TpuWorkerServer(c.connector, node_id=nid,
+                            coordinator_uri=c.discovery.uri,
+                            shared_secret=c.shared_secret,
+                            cache_config=c.cache_config,
+                            spool_config=c.spool_config,
+                            exchange_config=c.exchange_config)
+        # announce fast so the worker is schedulable within the test's
+        # patience, not the production 5 s cadence
+        if w.announcer is not None:
+            w.announcer.interval_s = self.announce_interval_s
+        w.start()
+        self.dynamic[nid] = w
+        return {"node": nid, "uri": f"http://127.0.0.1:{w.port}"}
+
+    def _drain(self, ordinal: int) -> dict:
+        nid = self._pick_victim(ordinal)
+        w = self.dynamic.pop(nid)
+        uri = f"http://127.0.0.1:{w.port}"
+        try:
+            self.cluster.decommission(uri, timeout_s=self.drain_timeout_s)
+        except Exception:
+            # best-effort from the driver's seat: even if the control
+            # PUT times out, stop() below still drains announcer-side
+            log.warning("decommission of %s failed; stopping anyway",
+                        uri, exc_info=True)
+        w.stop()
+        return {"node": nid, "uri": uri}
+
+    def _kill(self, ordinal: int) -> dict:
+        nid = self._pick_victim(ordinal)
+        w = self.dynamic.pop(nid)
+        uri = f"http://127.0.0.1:{w.port}"
+        # simulate a crash, NOT TpuWorkerServer.stop(): a dead process
+        # never retracts its announcement, so the coordinator must
+        # notice via probe failures / announcement expiry
+        if w.announcer is not None:
+            w.announcer.stop(retract=False)
+        w.httpd.shutdown()
+        w.httpd.server_close()
+        w.task_manager.shutdown()
+        return {"node": nid, "uri": uri}
+
+    # -------------------------------------------------- background mode
+    def start(self, interval_s: float = 0.5) -> "ChurnDriver":
+        """Run seeded steps every ``interval_s`` until :meth:`close`."""
+        self._thread = spawn("testing", "churn-driver", self._loop,
+                             args=(interval_s,))
+        return self
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.step()
+            except Exception:
+                # the workload's own asserts are the oracle; a failed
+                # transition must not take the driver thread down
+                log.warning("churn step failed; continuing",
+                            exc_info=True)
+
+    def close(self) -> None:
+        """Stop the background loop and gracefully stop every dynamic
+        worker still alive (so tests end with a clean fleet)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        for nid in sorted(self.dynamic):
+            w = self.dynamic.pop(nid)
+            try:
+                w.stop()
+            except Exception:
+                log.warning("stopping dynamic worker %s failed", nid,
+                            exc_info=True)
+
+    # ----------------------------------------------------------- report
+    def report(self) -> dict:
+        return {"seed": self.seed, "steps": self._ordinal,
+                **self.counts, "liveDynamic": len(self.dynamic),
+                "events": list(self.events)}

@@ -91,6 +91,26 @@ def test_spool_chokepoint_fires():
         planted="presto_tpu/exec/spill.py")
 
 
+def test_membership_chokepoint_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("membership-chokepoint", {
+        bad: "self.dead.add(uri)\n"}, planted=bad)
+    assert fs and "chokepoint" in fs[0].message
+    # only server/ is in scope: testing helpers may track their own sets
+    assert not _findings("membership-chokepoint", {
+        "presto_tpu/testing/churn.py": "self.dead.add(uri)\n"},
+        planted="presto_tpu/testing/churn.py")
+
+
+def test_membership_chokepoint_honesty():
+    # cluster.py present but no longer mutating the sets => the rule
+    # must report itself vacuous instead of silently passing
+    fs = _findings("membership-chokepoint", {
+        "presto_tpu/server/cluster.py": "x = 1\n"},
+        planted="presto_tpu/server/cluster.py")
+    assert fs and "membership chokepoint" in fs[0].message
+
+
 def test_mesh_chokepoint_fires():
     bad = "presto_tpu/exec/evil.py"
     fs = _findings("mesh-chokepoint", {

@@ -1,0 +1,457 @@
+"""Elastic-cluster suite: graceful decommission, mid-query join,
+coordinator crash recovery, and continuous-churn chaos.
+
+Reference: Presto@Meta VLDB'23 §3's fluid worker membership — an
+autoscaled fleet where workers join and leave continuously while the
+coordinator keeps every in-flight query correct. Four contracts:
+
+- **drain**: ``PUT /v1/info/state`` → SHUTTING_DOWN finishes running
+  tasks, commits spools, retracts the announcement; queries running
+  across the drain finish with oracle-exact rows, zero failures.
+- **mid-query join**: a worker that announces itself while a query is
+  in flight receives recovery (attempt N+1) and tail tasks — placement
+  snapshots are per-stage, not per-query (execution-probe verified).
+- **coordinator restart**: the write-ahead query journal re-queues
+  every non-terminal statement under its ORIGINAL query id; a corrupt
+  or torn journal is moved aside and the coordinator starts fresh.
+- **continuous churn**: a seeded join/drain/kill schedule runs against
+  the cluster while the chaos query set executes — rows stay
+  oracle-exact, no query is dropped, and no spool/shuffle temp
+  directory survives.
+
+Results check against an independent sqlite oracle, same discipline as
+tests/test_spool_chaos.py."""
+
+import math
+import os
+import sqlite3
+import tempfile
+import threading
+import time
+
+import pytest
+
+from presto_tpu.config import ElasticConfig, TransportConfig
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.protocol import transport as _transport
+from presto_tpu.protocol.structs import TaskId
+from presto_tpu.server.cluster import TpuCluster
+from presto_tpu.server.discovery import DiscoveryService
+from presto_tpu.server.http import TpuWorkerServer
+from presto_tpu.server.journal import QueryJournal
+from presto_tpu.server.statement import StatementServer
+from presto_tpu.server.task_manager import TpuTaskManager
+from presto_tpu.testing import ChurnDriver
+
+SF = 0.01
+
+_TMP_PREFIXES = ("presto_tpu_spill_", "presto_tpu_spool_",
+                 "presto_tpu_shuffle_")
+_PREEXISTING_TMP = {n for n in os.listdir(tempfile.gettempdir())
+                    if n.startswith(_TMP_PREFIXES)}
+
+#: same exchange-shape coverage as the chaos matrices: single gather;
+#: hash-partitioned partial/final aggregation; join + grouped agg
+QUERIES = (
+    "select count(*) from lineitem",
+    "select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus",
+    "select r_name, count(*) from nation, region "
+    "where n_regionkey = r_regionkey group by r_name order by r_name",
+)
+
+CHAOS_TRANSPORT = TransportConfig(
+    retry_base_backoff_s=0.01, retry_max_backoff_s=0.2,
+    retry_budget_s=5.0, breaker_failure_threshold=3,
+    breaker_cooldown_s=0.3)
+
+DEADLINE_S = 120.0
+
+
+@pytest.fixture(scope="module")
+def disc():
+    d = DiscoveryService(expiry_s=2.0).start()
+    yield d
+    d.stop()
+
+
+@pytest.fixture(scope="module")
+def cluster(disc):
+    c = TpuCluster(
+        TpchConnector(SF), n_workers=2, discovery=disc,
+        session_properties={"query_max_execution_time": str(DEADLINE_S),
+                            "retry_policy": "TASK"},
+        transport_config=CHAOS_TRANSPORT)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """Independent sqlite oracle over the same connector data."""
+    conn = TpchConnector(SF)
+    db = sqlite3.connect(":memory:")
+    for name in ("lineitem", "nation", "region"):
+        page = conn.table(name).page()
+        cols = list(page.names)
+        db.execute(f"create table {name} ({', '.join(cols)})")
+        db.executemany(
+            f"insert into {name} values "
+            f"({', '.join('?' * len(cols))})", page.to_pylist())
+    db.commit()
+    want = {sql: db.execute(sql).fetchall() for sql in QUERIES}
+    db.close()
+    return want
+
+
+def _assert_rows_match(got, want, ctx=""):
+    assert len(got) == len(want), \
+        f"{ctx}: {len(got)} rows, oracle has {len(want)}"
+    for g, w in zip(sorted(map(tuple, got)), sorted(want)):
+        assert len(g) == len(w), f"{ctx}: row arity {g} vs {w}"
+        for gc, wc in zip(g, w):
+            if isinstance(wc, float) or isinstance(gc, float):
+                assert math.isclose(gc, wc, rel_tol=1e-6, abs_tol=1e-9), \
+                    f"{ctx}: {g} vs oracle {w}"
+            else:
+                assert gc == wc, f"{ctx}: {g} vs oracle {w}"
+
+
+@pytest.fixture()
+def probe(monkeypatch):
+    """Record every REAL task execution (node, stage, task-index,
+    attempt) through the worker's actual entry point."""
+    executed = []
+    orig = TpuTaskManager._run_inner
+
+    def spy(self, task):
+        try:
+            tid = TaskId.parse(task.task_id)
+            executed.append((self.node_id, tid.stage_id,
+                             tid.task_index, tid.attempt))
+        except ValueError:
+            pass
+        return orig(self, task)
+
+    monkeypatch.setattr(TpuTaskManager, "_run_inner", spy)
+    return executed
+
+
+def _dynamic_worker(cluster, disc, node_id):
+    w = TpuWorkerServer(cluster.connector, node_id=node_id,
+                        coordinator_uri=disc.uri,
+                        shared_secret=cluster.shared_secret,
+                        spool_config=cluster.spool_config,
+                        exchange_config=cluster.exchange_config)
+    w.announcer.interval_s = 0.2    # announce fast under test patience
+    w.start()
+    return w
+
+
+def _wait_member(cluster, uri, deadline_s=15.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if uri in cluster.check_workers():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"{uri} never joined the schedulable set")
+
+
+def _settle(cluster, deadline_s=30.0):
+    """Wait until the schedulable set is exactly the static fleet again
+    (dynamic announcements expired, dead/drained entries pruned)."""
+    static = set(cluster.all_worker_uris)
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if set(cluster.check_workers()) == static:
+            return
+        time.sleep(0.2)
+    raise AssertionError(
+        f"membership never settled back to the static fleet: "
+        f"live={sorted(cluster.check_workers())} dead="
+        f"{sorted(cluster.dead)} drained={sorted(cluster.drained)}")
+
+
+# ===================================================================
+# graceful decommission
+# ===================================================================
+
+@pytest.mark.slow
+def test_drain_under_load_zero_failures(cluster, disc, oracle):
+    """Decommission a worker while queries run: every query finishes
+    with oracle-exact rows, the worker reports SHUTTING_DOWN until it
+    stops, and the membership ledger records the drain."""
+    w = _dynamic_worker(cluster, disc, "drainee-0")
+    uri = f"http://127.0.0.1:{w.port}"
+    _wait_member(cluster, uri)
+    before = dict(cluster.membership_stats)
+
+    results, failures = [], []
+
+    def load():
+        try:
+            for sql in QUERIES:
+                results.append((sql, cluster.execute_sql(sql)))
+        except Exception as e:   # noqa: BLE001 — collected for assert
+            failures.append(e)
+
+    threads = [threading.Thread(target=load, name=f"elastic-load-{i}",
+                                daemon=True) for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)              # let tasks land on the drainee
+    report = cluster.decommission(uri)
+    assert isinstance(report, dict)
+    # the worker is draining but still serving: status shows the state
+    st = cluster.http.get_json(f"{uri}/v1/status",
+                               request_class="probe")
+    assert st["nodeState"] == "SHUTTING_DOWN"
+    assert w.task_manager.lifecycle_state == "SHUTTING_DOWN"
+    for t in threads:
+        t.join(timeout=DEADLINE_S + 60)
+        assert not t.is_alive(), "query load wedged across the drain"
+    assert not failures, f"queries failed across the drain: {failures}"
+    for sql, got in results:
+        _assert_rows_match(got, oracle[sql], ctx=f"drain {sql!r}")
+    snap = cluster.membership_snapshot()
+    assert snap["drains"] >= before["drains"] + 1
+    # EXPLAIN ANALYZE surfaces the coordinator's membership view
+    out = cluster.explain_analyze_sql(QUERIES[0])
+    assert "Membership:" in out
+    w.stop()
+    _settle(cluster)
+
+
+# ===================================================================
+# mid-query join
+# ===================================================================
+
+def _hard_kill(worker):
+    """Simulate a crash: no announcement retraction, HTTP and task
+    execution torn down mid-flight."""
+    if worker.announcer is not None:
+        worker.announcer.stop(retract=False)
+    worker.httpd.shutdown()
+    worker.httpd.server_close()
+    worker.task_manager.shutdown()
+
+
+@pytest.mark.slow
+def test_mid_query_join_receives_recovery_tasks(oracle, probe):
+    """Hard-kill a static worker mid-query; a worker that announces
+    itself AFTER the query started must execute the dead worker's
+    recovery (attempt N+1) tasks — recovery consults live membership,
+    and the joiner slots into the index the victim vacated."""
+    d = DiscoveryService(expiry_s=2.0).start()
+    c = TpuCluster(
+        TpchConnector(SF), n_workers=2, discovery=d,
+        session_properties={"query_max_execution_time": str(DEADLINE_S),
+                            "retry_policy": "TASK"},
+        transport_config=CHAOS_TRANSPORT)
+    sql = QUERIES[1]
+    got, errors = [], []
+
+    def run():
+        try:
+            got.extend(c.execute_sql(sql))
+        except Exception as e:   # noqa: BLE001 — collected for assert
+            errors.append(e)
+
+    joiner = None
+    t = threading.Thread(target=run, name="elastic-midquery",
+                         daemon=True)
+    try:
+        t.start()
+        # the query is genuinely in flight once a task has executed;
+        # the victim (placement index 1) then dies with work unfinished
+        deadline = time.monotonic() + 30.0
+        while not probe and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert probe, "query never started executing"
+        _hard_kill(c.workers[1])
+        joiner = _dynamic_worker(c, d, "joiner-0")
+        t.join(timeout=DEADLINE_S + 60)
+        assert not t.is_alive(), "query wedged across the join"
+        assert not errors, f"query failed despite the joiner: {errors}"
+        _assert_rows_match(got, oracle[sql], ctx="mid-query join")
+        ran_on = {n for n, _f, _t, _a in probe}
+        assert joiner.task_manager.node_id in ran_on, \
+            (f"mid-query joiner never executed a task; "
+             f"executions ran on {sorted(ran_on)}")
+        # the kill engaged recovery: attempt>0 executions happened
+        assert any(a > 0 for _n, _f, _t, a in probe), \
+            "victim kill never produced an attempt>0 execution"
+    finally:
+        if joiner is not None:
+            joiner.stop()
+        c.stop()
+        d.stop()
+
+
+# ===================================================================
+# coordinator crash recovery
+# ===================================================================
+
+class _BlockingEngine:
+    """Engine stub whose queries block until released — holds journal
+    records in RUNNING exactly like a coordinator that crashed
+    mid-query left them."""
+
+    def __init__(self, release: threading.Event):
+        self.release = release
+
+    def execute_sql(self, sql):
+        self.release.wait(timeout=60)
+        return [[1]]
+
+
+@pytest.mark.slow
+def test_coordinator_restart_recovers_journaled_queries(
+        cluster, oracle, tmp_path):
+    jpath = str(tmp_path / "journal.jsonl")
+    release = threading.Event()
+    ecfg = ElasticConfig(journal_path=jpath)
+    # coordinator #1 accepts two statements and "crashes" (abandoned)
+    # with both journaled non-terminal
+    srv1 = StatementServer(_BlockingEngine(release), elastic=ecfg)
+    q1 = srv1.submit(QUERIES[0], user="alice")
+    q2 = srv1.submit(QUERIES[2], user="alice")
+    assert {r["qid"] for r in srv1.journal.pending()} == {q1.qid, q2.qid}
+    srv1.httpd.server_close()    # the journal FILE is all that survives
+
+    # coordinator #2 over the real cluster: recovery runs in start()
+    srv2 = StatementServer(cluster, elastic=ecfg).start()
+    try:
+        assert srv2.journal.stats()["recovered"] == 2
+        # ORIGINAL qids: pre-crash nextUris re-attach
+        for qid, sql in ((q1.qid, QUERIES[0]), (q2.qid, QUERIES[2])):
+            q = srv2.queries[qid]
+            assert q.done.wait(timeout=DEADLINE_S), qid
+            assert q.state == "FINISHED", (qid, q.error)
+            _assert_rows_match(q.rows, oracle[sql],
+                               ctx=f"recovered {qid}")
+        # a client polling a pre-crash nextUri gets the rows back
+        payload = _transport.get_client().get_json(
+            f"{srv2.base}/v1/statement/executing/{q1.qid}/0",
+            request_class="statement")
+        _assert_rows_match(payload["data"], oracle[QUERIES[0]],
+                           ctx="pre-crash nextUri")
+        # /v1/status carries the journal + membership state
+        st = _transport.get_client().get_json(f"{srv2.base}/v1/status",
+                                              request_class="probe")
+        assert st["journal"]["recovered"] == 2
+        assert st["membership"] is not None
+    finally:
+        release.set()            # unwedge coordinator #1's pool
+        srv2.stop()
+        srv1.dispatcher.stop()
+
+
+def test_journal_corruption_starts_fresh(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    with open(p, "w") as f:
+        f.write('{"qid": "a", "sql": "select 1", "state": "QUEUED"}\n')
+        f.write('{"qid": "b", "sql": "sel')     # torn partial write
+    j = QueryJournal(p)
+    assert j.started_fresh
+    assert j.pending() == []
+    assert os.path.exists(p + ".corrupt"), \
+        "corrupt journal must be preserved as evidence"
+    # the fresh journal is immediately usable again
+    j.append("c", sql="select 2", state="QUEUED")
+    j2 = QueryJournal(p)
+    assert not j2.started_fresh
+    assert [r["qid"] for r in j2.pending()] == ["c"]
+
+
+def test_journal_compaction_drops_terminal(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j = QueryJournal(p, compact_threshold=4)
+    j.append("done", sql="select 1", state="QUEUED")
+    j.append("done", state="RUNNING")
+    j.append("done", state="FINISHED")
+    j.append("live", sql="select 2", state="QUEUED")   # 4th: compacts
+    assert j.compactions == 1
+    with open(p) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    assert len(lines) == 1, "compaction must drop terminal queries"
+    assert [r["qid"] for r in QueryJournal(p).pending()] == ["live"]
+
+
+def test_closed_buffer_refuses_instead_of_fake_complete():
+    """A worker shutting down closes its tasks' output buffers under
+    in-flight long-polls. The closed buffer must REFUSE (consumers then
+    retry into spool fallback / task recovery) — answering `complete`
+    with no frames hands every consumer a fake clean end-of-stream and
+    silently drops the task's rows from the query (the continuous-churn
+    row-loss bug)."""
+    from presto_tpu.server.buffers import (
+        BufferClosedError, FileBackedClientBuffer,
+    )
+    buf = FileBackedClientBuffer()
+    buf.add(b"\x00" * 32)
+    buf.close()
+    with pytest.raises(BufferClosedError):
+        buf.get(0, 1 << 20)
+
+
+# ===================================================================
+# continuous churn
+# ===================================================================
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_continuous_churn_matrix(cluster, oracle, probe, seed):
+    """Seeded join/drain/kill schedule runs in the background while the
+    chaos query set executes twice: oracle-exact rows, zero dropped
+    queries, completed (spool-absorbed) tasks never re-executed, and
+    the spool base is GC'd after every query."""
+    driver = ChurnDriver(cluster, seed=seed, max_dynamic=2,
+                         drain_timeout_s=30.0)
+    driver.start(interval_s=0.3)
+    try:
+        for round_no in range(2):
+            for sql in QUERIES:
+                del probe[:]
+                got = cluster.execute_sql(sql)
+                _assert_rows_match(
+                    got, oracle[sql],
+                    ctx=f"churn seed {seed} round {round_no} {sql!r}")
+                # execution probe: any attempt>0 execution must be a
+                # recorded recovery re-plan, and spool-absorbed tasks
+                # must never re-execute
+                events = list(getattr(cluster, "last_recovery_events",
+                                      []))
+                retasked = {(f, t) for kind, f, t in events
+                            if kind == "retask"}
+                absorbed = {(f, t) for kind, f, t in events
+                            if kind == "spool"}
+                rerun = {(f, t) for _n, f, t, att in probe if att > 0}
+                assert rerun <= retasked, \
+                    (f"seed {seed}: tasks {sorted(rerun - retasked)} "
+                     "re-executed without a recorded recovery")
+                assert not (absorbed & rerun), \
+                    (f"seed {seed}: spool-absorbed tasks "
+                     f"{sorted(absorbed & rerun)} were re-executed")
+    finally:
+        driver.close()
+        _settle(cluster)
+    assert driver.report()["steps"] >= 1
+    assert os.listdir(cluster.spool.base_dir) == [], \
+        f"seed {seed}: spool not GC'd after churn"
+
+
+@pytest.mark.slow
+def test_no_stray_dirs_after_elastic_chaos(cluster):
+    """Module guard: the elastic suite (drains, kills, dynamic workers)
+    must leave no spill/spool/shuffle temp entries behind. The module
+    cluster's own spool base is still alive (fixture teardown comes
+    later) — exempt by name but must already be empty."""
+    own = os.path.basename(cluster.spool.base_dir)
+    assert os.listdir(cluster.spool.base_dir) == []
+    leaked = sorted(
+        n for n in os.listdir(tempfile.gettempdir())
+        if n.startswith(_TMP_PREFIXES) and n not in _PREEXISTING_TMP
+        and n != own)
+    assert not leaked, f"temp directories leaked by the suite: {leaked}"
