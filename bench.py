@@ -895,7 +895,11 @@ def _churn_child() -> None:
     out = {"seed": seed, "rounds": rounds, "queries": len(queries),
            "executed": 0, "failures": 0, "mismatches": 0}
     wall = 0.0
+    intro = {}
     try:
+        from presto_tpu.obs.profiler import PROFILER
+        from presto_tpu.obs.wide_events import LEDGER
+        LEDGER.clear()
         # quiet baseline on the static fleet = the row oracle
         want = {sql: sorted(cluster.execute_sql(sql)) for sql in queries}
         driver.start(interval_s=0.4)
@@ -911,6 +915,34 @@ def _churn_child() -> None:
                 if got != want[sql]:
                     out["mismatches"] += 1
         wall = time.perf_counter() - t0
+        # wide-event ledger: exactly ONE event per cluster query
+        # (baseline + churn round), summarized per query BEFORE the
+        # introspection probes below append their own events
+        evs = LEDGER.snapshot()
+        out["wide_events"] = {
+            "count": len(evs),
+            "expected": (out["executed"] + out["failures"]
+                         + len(queries)),
+            "per_query": [
+                {"query_id": e["query_id"], "state": e["state"],
+                 "wall_s": e["wall_s"],
+                 "result_rows": e["result_rows"],
+                 "membership_epoch": e["membership"]["epoch"],
+                 "stages": len(e["stages"])}
+                for e in evs]}
+        # introspection rides the same engine path as the bench load
+        intro["tasks_by_state"] = {
+            s: int(n) for s, n in cluster.execute_sql(
+                "select state, count(*) from system.runtime.tasks "
+                "group by state")}
+        intro["nodes_by_state"] = {
+            s: int(n) for s, n in cluster.execute_sql(
+                "select state, count(*) from system.runtime.nodes "
+                "group by state")}
+        pstats = PROFILER.stats()
+        intro["profiler"] = {
+            "samples": pstats["samples"], "buckets": pstats["buckets"],
+            "overhead": round(PROFILER.overhead_fraction(), 5)}
     finally:
         driver.close()
         cluster.stop()
@@ -921,6 +953,7 @@ def _churn_child() -> None:
     out["churn"] = {k: v for k, v in driver.report().items()
                     if k != "events"}
     out["membership"] = cluster.membership_snapshot()
+    out["introspection"] = intro
     print(json.dumps({"metric": "elastic_churn_round",
                       "value": out["queries_per_sec"], "unit": "q/s",
                       "detail": {"churn": out}}))

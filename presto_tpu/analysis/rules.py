@@ -19,8 +19,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from presto_tpu.analysis.framework import (
-    Finding, Package, Rule, SourceFile, honesty_finding, regex_findings,
-    register,
+    PKG_ROOT, Finding, Package, Rule, SourceFile, honesty_finding,
+    regex_findings, register,
 )
 
 # =====================================================================
@@ -713,3 +713,129 @@ class MembershipChokepointRule(Rule):
 
 
 register(MembershipChokepointRule())
+
+# =====================================================================
+# 13. metric-docs-sync — the README metric catalog and the registered
+#     metric set agree in both directions
+# =====================================================================
+
+#: the catalog section opener in README.md; entries follow as a bullet
+#: list (blank lines allowed) until the first non-bullet paragraph
+_CATALOG_HEADER = re.compile(r"^Metric catalog \(prefix `presto_tpu_`")
+
+_BACKTICK_TOKEN = re.compile(r"`([^`\n]+)`")
+
+#: a {a,b,c} alternation inside a catalog token (never token-final —
+#: token-final braces are label annotations and are stripped first)
+_ALTERNATION = re.compile(r"\{([A-Za-z0-9_]*(?:,[A-Za-z0-9_]*)+)\}")
+
+_README = "README.md"
+
+
+def _catalog_entries(text: str) -> Tuple[Optional[int],
+                                         List[Tuple[str, int]]]:
+    """Parse the README metric catalog: returns (header line or None,
+    [(metric name, line)]). Token grammar: backticked, optional
+    trailing ``{label,...}`` annotation (stripped), inner ``{a,b}``
+    alternations expanded, ``presto_tpu_`` prefix implied."""
+    lines = text.splitlines()
+    header_at: Optional[int] = None
+    out: List[Tuple[str, int]] = []
+    for i, line in enumerate(lines, start=1):
+        if header_at is None:
+            if _CATALOG_HEADER.match(line.strip()):
+                header_at = i
+            continue
+        stripped = line.strip()
+        if stripped and not stripped.startswith(("-", "`")) \
+                and not line.startswith(" "):
+            break                          # first paragraph after list
+        for m in _BACKTICK_TOKEN.finditer(line):
+            tok = m.group(1)
+            if " " in tok or "/" in tok or "." in tok:
+                continue                   # prose in backticks, not a name
+            # token-final braces are a label annotation UNLESS the name
+            # is incomplete without them (`result_cache_{bytes,entries}`
+            # — the char before `{` is `_`, so it's an alternation)
+            tok = re.sub(r"(?<=[A-Za-z0-9])\{[A-Za-z0-9_,=]*\}$", "",
+                         tok)
+            variants = [tok]
+            while any("{" in v for v in variants):
+                nxt: List[str] = []
+                for v in variants:
+                    am = _ALTERNATION.search(v)
+                    if am is None:
+                        if "{" in v:       # unbalanced/unknown braces
+                            break
+                        nxt.append(v)
+                        continue
+                    for opt in am.group(1).split(","):
+                        nxt.append(v[:am.start()] + opt + v[am.end():])
+                variants = nxt
+            for v in variants:
+                if not v:
+                    continue
+                if not v.startswith("presto_tpu_"):
+                    v = "presto_tpu_" + v
+                out.append((v, i))
+    return header_at, out
+
+
+class MetricDocsSyncRule(Rule):
+    name = "metric-docs-sync"
+    description = (
+        "every metric name registered in code must appear in the "
+        "README metric catalog and every catalog entry must still be "
+        "registered — an undocumented series is invisible to the ops "
+        "runbook, a stale entry sends an operator hunting for a "
+        "series that no longer exists")
+
+    def _readme_text(self, pkg: Package) -> Optional[str]:
+        f = pkg.get(_README)
+        if f is not None:
+            return f.text
+        path = PKG_ROOT.parent / _README
+        try:
+            return path.read_text()
+        except OSError:
+            return None
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        registered: Dict[str, Tuple[SourceFile, int]] = {}
+        for f in pkg.walk("presto_tpu/"):
+            if f.relpath in _METRIC_EXCLUDED:
+                continue
+            for m in _METRIC_CALL.finditer(f.text):
+                registered.setdefault(
+                    m.group(1), (f, f.line_at(m.start())))
+        text = self._readme_text(pkg)
+        if text is None:
+            return [Finding(self.name, _README, 1,
+                            "README.md is missing — the metric catalog "
+                            "has nowhere to live")]
+        header_at, entries = _catalog_entries(text)
+        if header_at is None:
+            return [Finding(
+                self.name, _README, 1,
+                "README.md has no 'Metric catalog (prefix "
+                "`presto_tpu_`)' section — restore it (or update this "
+                "rule's header pattern)")]
+        documented: Dict[str, int] = {}
+        for mname, line in entries:
+            documented.setdefault(mname, line)
+        out: List[Finding] = []
+        for mname in sorted(set(registered) - set(documented)):
+            f, line = registered[mname]
+            out.append(self.finding(
+                f, line,
+                f"metric {mname!r} is registered here but absent from "
+                f"the README metric catalog — document it"))
+        for mname in sorted(set(documented) - set(registered)):
+            out.append(Finding(
+                self.name, _README, documented[mname],
+                f"README catalog documents {mname!r} but nothing "
+                f"registers it — stale docs entry, delete or fix it"))
+        return out
+
+
+register(MetricDocsSyncRule())

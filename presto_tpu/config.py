@@ -244,6 +244,27 @@ class ObsConfig:
     #: row-count histogram buckets
     rows_buckets: tuple = (1.0, 100.0, 10_000.0, 100_000.0,
                            1_000_000.0, 10_000_000.0, 100_000_000.0)
+    #: wide-event query log sink (obs/wide_events.py): JSONL path the
+    #: coordinator appends one QueryCompletedEvent to per cluster query;
+    #: None keeps the in-memory ledger only. PRESTO_TPU_EVENT_LOG
+    #: overrides at sink-install time.
+    event_log_path: Optional[str] = None
+    #: rotate the event log when it exceeds this many bytes
+    event_log_max_bytes: int = 16 << 20
+    #: rotated generations kept (event_log.1 .. event_log.N)
+    event_log_max_files: int = 3
+    #: always-on sampling profiler (obs/profiler.py) master switch
+    profiler_enabled: bool = True
+    #: profiler sampling frequency (Hz); the sampler self-throttles
+    #: whenever its own cost exceeds `profiler_max_overhead`
+    profiler_hz: float = 97.0
+    #: retained stack buckets per (role, purpose, query) key
+    profiler_top_k: int = 64
+    #: frames kept per sampled stack (deepest-callee end)
+    profiler_max_depth: int = 24
+    #: self-time budget as a fraction of wall time — above it the
+    #: sampler doubles its sleep until it is back under budget
+    profiler_max_overhead: float = 0.01
 
     def sampled(self, rng_value: float) -> bool:
         """Decide sampling from a caller-supplied uniform [0,1) draw

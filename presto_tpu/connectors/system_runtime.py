@@ -1,0 +1,303 @@
+"""`system` catalog — live cluster state exposed as real tables.
+
+Reference role: presto-main's SystemConnector / system.runtime schema
+(SystemTablesMetadata + RuntimeQueriesSystemTable / TaskSystemTable /
+NodesSystemTable, SURVEY.md §5): the cluster observes itself through its
+own query engine, so `SELECT state, count(*) FROM system.runtime.tasks
+GROUP BY state` plans, schedules and filters with the engine's own
+operators instead of a bespoke admin endpoint.
+
+Shape: a facade connector (the MemoryConnector fallback idiom) wraps the
+cluster's real connector; names under `system.` route to providers that
+snapshot coordinator state, everything else delegates untouched. The
+cluster reference is late-bound (`attach_cluster`) because the facade
+must exist before TpuCluster finishes constructing.
+
+Split model: system tables ride the normal split/scan path, but their
+snapshots are point-in-time — handing every task its own row-range of a
+*different* snapshot would duplicate or drop rows. So `table_splits`
+returns the standard one-split-per-task payloads while `table()` serves
+the full snapshot for part 0 and an empty slice for every other part:
+one consistent snapshot per query, engine operators downstream.
+
+Tables (schemas frozen in README "Introspection"):
+  system.runtime.queries — statement front-door queries + the wide-event
+      ledger (source column distinguishes them)
+  system.runtime.tasks   — fan-out over worker GET /v1/tasks
+  system.runtime.nodes   — membership view incl. DRAINING/DEAD workers
+  system.runtime.profile — sampling profiler buckets (obs/profiler.py)
+  system.metrics         — every registry series as rows
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors.base import SplitSource
+from presto_tpu.connectors.tpch import HostTable
+from presto_tpu.data.column import StringDict
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR, Type
+
+log = logging.getLogger("presto_tpu.system")
+
+QUERIES = "system.runtime.queries"
+TASKS = "system.runtime.tasks"
+NODES = "system.runtime.nodes"
+PROFILE = "system.runtime.profile"
+METRICS = "system.metrics"
+
+SYSTEM_SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
+    QUERIES: [
+        ("query_id", VARCHAR), ("source", VARCHAR), ("state", VARCHAR),
+        ("user_name", VARCHAR), ("query", VARCHAR),
+        ("resource_group", VARCHAR), ("queue_wait_s", DOUBLE),
+        ("wall_s", DOUBLE), ("result_rows", BIGINT),
+        ("hbo_hits", BIGINT), ("hbo_misses", BIGINT),
+        ("cached_tasks", BIGINT), ("spooled_bytes", BIGINT),
+        ("trace_id", VARCHAR), ("error", VARCHAR)],
+    TASKS: [
+        ("node_id", VARCHAR), ("task_id", VARCHAR), ("query_id", VARCHAR),
+        ("state", VARCHAR), ("splits", BIGINT), ("bytes_out", BIGINT),
+        ("output_rows", BIGINT), ("cache_hit", BIGINT),
+        ("df_pruned", BIGINT), ("wall_s", DOUBLE), ("trace_id", VARCHAR)],
+    NODES: [
+        ("uri", VARCHAR), ("node_id", VARCHAR), ("state", VARCHAR),
+        ("uptime_s", DOUBLE), ("task_count", BIGINT),
+        ("tasks_created", BIGINT), ("drain_seconds", DOUBLE),
+        ("drain_rejected", BIGINT), ("announce_age_s", DOUBLE)],
+    PROFILE: [
+        ("role", VARCHAR), ("purpose", VARCHAR), ("query_id", VARCHAR),
+        ("stack", VARCHAR), ("samples", BIGINT)],
+    METRICS: [
+        ("name", VARCHAR), ("kind", VARCHAR), ("labels", VARCHAR),
+        ("value", DOUBLE)],
+}
+
+
+def _host_table(name: str, schema: List[Tuple[str, Type]],
+                rows: List[tuple]) -> HostTable:
+    """Python rows -> the HostTable shape every scan path expects:
+    string columns as int32 codes + a table-wide StringDict, numerics
+    as typed arrays, None as a null-mask bit."""
+    n = len(rows)
+    arrays: Dict[str, np.ndarray] = {}
+    dicts: Dict[str, StringDict] = {}
+    types: Dict[str, Type] = {}
+    nulls: Dict[str, np.ndarray] = {}
+    for i, (c, t) in enumerate(schema):
+        vals = [r[i] for r in rows]
+        types[c] = t
+        nulls[c] = np.asarray([v is None for v in vals], bool)
+        if t.is_string:
+            d, codes = StringDict.build(
+                np.asarray(["" if v is None else str(v) for v in vals],
+                           dtype=object))
+            arrays[c], dicts[c] = codes, d
+        else:
+            arrays[c] = np.asarray([0 if v is None else v for v in vals],
+                                   dtype=t.dtype)
+    return HostTable(name, n, arrays, types, dicts, nulls)
+
+
+class SystemTablesConnector(SplitSource):
+    """Facade: `system.*` names answer from cluster state, everything
+    else reads/writes through the wrapped delegate connector."""
+
+    NAME = "system"
+
+    def __init__(self, delegate):
+        self.delegate = delegate
+        self._cluster = None
+
+    def attach_cluster(self, cluster) -> None:
+        """Late binding: TpuCluster installs the facade before its own
+        membership/journal state exists, then attaches itself."""
+        self._cluster = cluster
+
+    # ----------------------------------------------------------- identity
+    @staticmethod
+    def is_system_table(table: Optional[str]) -> bool:
+        return bool(table) and table in SYSTEM_SCHEMAS
+
+    def connector_id(self, table: Optional[str] = None) -> str:
+        if self.is_system_table(table):
+            return self.NAME
+        return self.delegate.connector_id(table)
+
+    def table_splits(self, table: str, n_splits: int) -> List[dict]:
+        if self.is_system_table(table):
+            return [{"@type": self.NAME, "part": i, "numParts": n_splits}
+                    for i in range(n_splits)]
+        return self.delegate.table_splits(table, n_splits)
+
+    def table_version(self, table: str) -> int:
+        if self.is_system_table(table):
+            # live state: a fresh version per call keys every fragment-
+            # cache entry uniquely, so snapshots are never served stale
+            return time.time_ns()
+        return self.delegate.table_version(table)
+
+    def bump_table_version(self, table: str) -> int:
+        return self.delegate.bump_table_version(table)
+
+    # -------------------------------------------------------------- reads
+    def schema(self, table: str) -> List[Tuple[str, Type]]:
+        if self.is_system_table(table):
+            return list(SYSTEM_SCHEMAS[table])
+        return self.delegate.schema(table)
+
+    def row_count(self, table: str) -> int:
+        if self.is_system_table(table):
+            # planner estimate only — never pay a cluster fan-out at
+            # plan time; system tables are small by construction
+            return 128
+        return self.delegate.row_count(table)
+
+    def table(self, name: str, part: int = 0, num_parts: int = 1
+              ) -> HostTable:
+        if not self.is_system_table(name):
+            return self.delegate.table(name, part, num_parts)
+        schema = SYSTEM_SCHEMAS[name]
+        # one consistent snapshot per query: part 0 serves everything,
+        # sibling tasks scan an empty slice (see module docstring)
+        if part != 0:
+            return _host_table(name, schema, [])
+        try:
+            rows = self._rows(name)
+        except Exception:   # noqa: BLE001 — introspection never fails a query
+            log.exception("system table %s snapshot failed", name)
+            rows = []
+        return _host_table(name, schema, rows)
+
+    # everything else (exists/create/drop/append_rows/move_table_rows,
+    # connector-specific surfaces) passes through so the facade is
+    # write-transparent — hasattr(conn, "create") keeps answering for
+    # exactly the connectors that are actually writable
+    def __getattr__(self, attr):
+        return getattr(self.delegate, attr)
+
+    # ---------------------------------------------------------- providers
+    def _rows(self, name: str) -> List[tuple]:
+        if name == QUERIES:
+            return self._query_rows()
+        if name == TASKS:
+            return self._task_rows()
+        if name == NODES:
+            return self._node_rows()
+        if name == PROFILE:
+            return self._profile_rows()
+        return self._metric_rows()
+
+    def _query_rows(self) -> List[tuple]:
+        rows: List[tuple] = []
+        cl = self._cluster
+        # finished cluster queries: the wide-event ledger already joins
+        # the full stat surface per query — reuse it verbatim
+        from presto_tpu.obs.wide_events import LEDGER
+        for ev in LEDGER.snapshot():
+            adm = ev.get("admission") or {}
+            hbo = ev.get("hbo") or {}
+            cache = ev.get("cache") or {}
+            spool = ev.get("spool") or {}
+            rows.append((
+                ev.get("query_id"), "cluster", ev.get("state"),
+                ev.get("user_name"), ev.get("query"),
+                adm.get("group"), adm.get("queue_wait_s"),
+                ev.get("wall_s"), ev.get("result_rows"),
+                hbo.get("hits"), hbo.get("misses"),
+                cache.get("cached_tasks"), spool.get("bytes_written"),
+                ev.get("trace_id"), ev.get("error")))
+        # statement front door: live dispatcher states (the journal's
+        # in-flight view), matched by tests against GET /v1/status
+        frontend = getattr(cl, "statement_frontend", None) \
+            if cl is not None else None
+        if frontend is not None:
+            for q in list(frontend.queries.values()):
+                rows.append((
+                    q.qid, "statement", q.state, q.user, q.sql,
+                    None, None, None, None, None, None, None, None,
+                    None, q.error))
+        return rows
+
+    def _task_rows(self) -> List[tuple]:
+        cl = self._cluster
+        if cl is None:
+            return []
+        rows: List[tuple] = []
+        uris = list(cl.worker_uris)
+        uris += [u for u in sorted(set(cl.drained)) if u not in uris]
+        for uri in uris:
+            try:
+                docs = cl.http.get_json(f"{uri}/v1/tasks",
+                                        request_class="control",
+                                        timeout=5.0)
+            except Exception:   # noqa: BLE001 — a dying worker just drops out
+                continue
+            for d in docs:
+                tid = str(d.get("taskId", ""))
+                rows.append((
+                    d.get("nodeId"), tid, tid.split(".", 1)[0] or None,
+                    d.get("state"), d.get("splits"), d.get("bytesOut"),
+                    d.get("outputRows"), int(bool(d.get("cacheHit"))),
+                    d.get("dfPruned"), d.get("wallS"), d.get("traceId")))
+        return rows
+
+    def _node_rows(self) -> List[tuple]:
+        cl = self._cluster
+        if cl is None:
+            return []
+        dead, drained = set(cl.dead), set(cl.drained)
+        announce: Dict[str, float] = {}
+        disc = getattr(cl, "discovery", None)
+        if disc is not None:
+            for _nid, (uri, ts) in disc.snapshot().items():
+                announce[uri] = ts
+        now = time.time()
+        rows: List[tuple] = []
+        for uri in cl._probe_candidates():
+            state = ("DEAD" if uri in dead
+                     else "DRAINING" if uri in drained else "ACTIVE")
+            node_id = uptime = tasks = created = None
+            drain_s = rejected = None
+            if state != "DEAD":
+                try:
+                    st = cl.http.get_json(f"{uri}/v1/status",
+                                          request_class="control",
+                                          timeout=5.0)
+                    node_id = st.get("nodeId")
+                    uptime = st.get("uptimeSeconds")
+                    tasks = st.get("taskCount")
+                    created = st.get("tasksCreated")
+                    dr = st.get("drain") or {}
+                    drain_s = dr.get("drainSeconds")
+                    rejected = dr.get("rejected")
+                    if str(st.get("nodeState", "")).upper() \
+                            == "SHUTTING_DOWN":
+                        state = "DRAINING"
+                except Exception:   # noqa: BLE001 — probe verdict: unreachable
+                    state = "DEAD"
+            age = (now - announce[uri]) if uri in announce else None
+            rows.append((uri, node_id, state, uptime, tasks, created,
+                         drain_s, rejected, age))
+        return rows
+
+    def _profile_rows(self) -> List[tuple]:
+        from presto_tpu.obs.profiler import PROFILER
+        return PROFILER.rows()
+
+    def _metric_rows(self) -> List[tuple]:
+        from presto_tpu.obs.metrics import REGISTRY
+        rows: List[tuple] = []
+        for mname in REGISTRY.names():
+            m = REGISTRY.get(mname)
+            kind = m.kind
+            for sname, lnames, lvalues, value in m.samples():
+                labels = json.dumps(dict(zip(lnames, lvalues)),
+                                    sort_keys=True) if lnames else "{}"
+                rows.append((sname, kind, labels, float(value)))
+        return rows

@@ -1,0 +1,71 @@
+"""Process-level gauges + the shared metrics scrape entry point.
+
+Reference role: airlift's JmxExporter / the JVM process metrics every
+Presto deployment graphs next to engine counters: resident memory, open
+file descriptors, GC pressure, and a `build_info` info-gauge carrying
+the version as a label (value constant 1 — the Prometheus info-metric
+idiom). `render_metrics_payload()` is the one scrape path both servers'
+`/v1/metrics` handlers call: it refreshes these gauges, times the
+render, and records the scrape duration histogram.
+
+No psutil in the image: RSS and fd counts read /proc directly and
+degrade to 0 off Linux — gauges must never fail a scrape.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import time
+
+from presto_tpu.obs.metrics import gauge, histogram, render_prometheus
+
+_M_RSS = gauge("presto_tpu_process_resident_memory_bytes",
+               "Resident set size of this process")
+_M_FDS = gauge("presto_tpu_process_open_fds",
+               "Open file descriptors of this process")
+_M_GC = gauge("presto_tpu_process_gc_collections",
+              "Cumulative Python GC collections", ("generation",))
+_M_BUILD = gauge("presto_tpu_build_info",
+                 "Build metadata as labels (constant 1)", ("version",))
+_M_SCRAPE = histogram("presto_tpu_metrics_scrape_seconds",
+                      "Wall time of one /v1/metrics render")
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/status", encoding="ascii",
+                  errors="replace") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def refresh_process_gauges() -> None:
+    _M_RSS.set(_rss_bytes())
+    _M_FDS.set(_open_fds())
+    for gen, st in enumerate(gc.get_stats()):
+        _M_GC.set(int(st.get("collections", 0)), generation=str(gen))
+    from presto_tpu import __version__
+    _M_BUILD.set(1, version=__version__)
+
+
+def render_metrics_payload() -> str:
+    """THE scrape path: refresh process gauges, render the whole
+    registry, record how long the scrape took."""
+    t0 = time.time()
+    try:
+        refresh_process_gauges()
+        return render_prometheus()
+    finally:
+        _M_SCRAPE.observe(time.time() - t0)

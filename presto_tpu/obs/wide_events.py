@@ -1,0 +1,274 @@
+"""Wide-event query log: ONE structured event per cluster query.
+
+Reference role: spi/eventlistener QueryCompletedEvent + QueryMonitor
+(SURVEY.md §5.5) — at query end the coordinator assembles the full stat
+surface (admission, HBO, dynamic filtering, result cache, spool,
+exchange, mesh collectives, membership, trace id, per-stage wall) into
+one JSON-compatible dict and emits it through EventListenerManager as a
+`kind="wide"` QueryEvent. Two listeners ship here:
+
+  - an in-memory ring LEDGER feeding `system.runtime.queries`
+  - JsonlEventSink: crash-safe JSONL file (single O_APPEND write per
+    event — atomic on POSIX — with size-capped rotation)
+
+The JSON schema is FROZEN and versioned (`event_version`, documented in
+README "Introspection"); additions bump the version, fields are never
+repurposed. Emission happens exactly once per cluster query id —
+recovery under retry_policy=TASK runs *inside* the execution the event
+wraps, so retries never duplicate events.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from presto_tpu.config import DEFAULT_OBS
+from presto_tpu.obs.metrics import REGISTRY, counter
+from presto_tpu.utils.tracing import EVENTS, QueryEvent
+
+log = logging.getLogger("presto_tpu.wide_events")
+
+#: bump on any schema change; fields are append-only, never repurposed
+WIDE_EVENT_VERSION = 1
+
+_M_EVENTS = counter("presto_tpu_wide_events_total",
+                    "Wide query events emitted", ("state",))
+_M_SINK_BYTES = counter("presto_tpu_wide_event_log_bytes_total",
+                        "Bytes appended to the wide-event JSONL log")
+_M_SINK_ROTATIONS = counter("presto_tpu_wide_event_log_rotations_total",
+                            "Size-cap rotations of the wide-event log")
+_M_BUILD_ERRORS = counter(
+    "presto_tpu_wide_event_build_errors_total",
+    "Exceptions swallowed while assembling wide events")
+
+#: process-global mesh collective counters (exec/dist_executor.py);
+#: the wide event records per-query deltas of their label-summed totals
+_MESH_COUNTERS = {
+    "exchange_bytes": "presto_tpu_mesh_exchange_bytes_total",
+    "collective_launches": "presto_tpu_mesh_collective_launches_total",
+    "overflow_retries": "presto_tpu_mesh_exchange_overflow_retries_total",
+    "fragment_compiles": "presto_tpu_mesh_fragment_compiles_total",
+}
+
+
+def mesh_counters() -> Dict[str, float]:
+    """Label-summed snapshot of the mesh collective counters (0.0 for
+    counters not yet registered — the mesh path is lazy-imported)."""
+    out: Dict[str, float] = {}
+    for short, name in _MESH_COUNTERS.items():
+        m = REGISTRY.get(name)
+        out[short] = (sum(v for _n, _ln, _lv, v in m.samples())
+                      if m is not None else 0.0)
+    return out
+
+
+# --------------------------------------------------------------------------
+class _Ledger:
+    """Bounded in-memory ring of recent wide events — the coordinator-
+    resident backing store of `system.runtime.queries`."""
+
+    def __init__(self, cap: int = 512):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=cap)
+
+    def record(self, detail: dict) -> None:
+        with self._lock:
+            self._events.append(detail)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+LEDGER = _Ledger()
+
+
+def _ledger_listener(event: QueryEvent) -> None:
+    if event.kind == "wide" and event.detail is not None:
+        LEDGER.record(event.detail)
+
+
+EVENTS.register(_ledger_listener)
+
+
+# --------------------------------------------------------------------------
+class JsonlEventSink:
+    """Crash-safe JSONL sink: one os.write of one whole line per event
+    through an O_APPEND descriptor (atomic append on POSIX — concurrent
+    writers never interleave mid-line), rotated by size cap so the log
+    is bounded: path -> path.1 -> ... -> path.N, oldest dropped."""
+
+    def __init__(self, path: str, max_bytes: int, max_files: int):
+        self.path = path
+        self.max_bytes = max(int(max_bytes), 4096)
+        self.max_files = max(int(max_files), 1)
+        self._lock = threading.Lock()
+
+    def __call__(self, event: QueryEvent) -> None:
+        if event.kind != "wide" or event.detail is None:
+            return
+        line = (json.dumps(event.detail, sort_keys=True,
+                           default=str) + "\n").encode("utf-8")
+        with self._lock:
+            self._rotate_if_needed(len(line))
+            fd = os.open(self.path,
+                         os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        _M_SINK_BYTES.inc(len(line))
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        _M_SINK_ROTATIONS.inc()
+
+
+_SINK_LOCK = threading.Lock()
+_SINK: Optional[JsonlEventSink] = None
+
+
+def install_event_log_sink(path: Optional[str] = None
+                           ) -> Optional[JsonlEventSink]:
+    """Idempotently register the JSONL sink on the process event
+    pipeline. Path resolution: explicit arg > PRESTO_TPU_EVENT_LOG env
+    > ObsConfig.event_log_path; None everywhere means no sink (the
+    default — tests and library users opt in)."""
+    global _SINK
+    resolved = (path or os.environ.get("PRESTO_TPU_EVENT_LOG")
+                or DEFAULT_OBS.event_log_path)
+    if not resolved:
+        return None
+    with _SINK_LOCK:
+        if _SINK is not None and _SINK.path == resolved:
+            return _SINK
+        if _SINK is not None:
+            EVENTS.unregister(_SINK)
+        _SINK = JsonlEventSink(resolved, DEFAULT_OBS.event_log_max_bytes,
+                               DEFAULT_OBS.event_log_max_files)
+        EVENTS.register(_SINK)
+        from presto_tpu.spi import count_listener_registration
+        count_listener_registration("jsonl-sink")
+        return _SINK
+
+
+# --------------------------------------------------------------------------
+def pre_query_snapshot(cluster) -> dict:
+    """Taken by the coordinator before execution: baselines for the
+    per-query deltas the wide event reports."""
+    return {"t0": time.time(),
+            "mesh": mesh_counters(),
+            "trace_id": getattr(cluster, "last_trace_id", None)}
+
+
+def build_wide_event(cluster, qid: str, sql: str, *,
+                     rows: Optional[list], error: Optional[str],
+                     pre: dict) -> dict:
+    now = time.time()
+    mesh_now = mesh_counters()
+    mesh_delta = {k: mesh_now[k] - pre.get("mesh", {}).get(k, 0.0)
+                  for k in mesh_now}
+    # last_trace_id is only written when the query is trace-sampled; a
+    # change during this query means the id is ours, else no trace
+    trace_after = getattr(cluster, "last_trace_id", None)
+    trace_id = trace_after if trace_after != pre.get("trace_id") else None
+
+    infos = getattr(cluster, "last_task_infos", []) or []
+    df_pruned = 0
+    task_hits = 0
+    cached_tasks = 0
+    stage_acc: Dict[int, List[Any]] = {}
+    for fid, info in infos:
+        stats = info.get("stats") or {}
+        rt = stats.get("runtimeStats") or {}
+        df_pruned += int((rt.get("dynamicFilterRowsPruned") or {}
+                          ).get("sum", 0))
+        if "fragmentResultCacheHitCount" in rt:
+            cached_tasks += 1
+            task_hits += int((rt.get("fragmentResultCacheHit") or {}
+                              ).get("sum", 0))
+        acc = stage_acc.setdefault(fid, [0, None, None])
+        acc[0] += 1
+        start = stats.get("firstStartTimeInMillis")
+        end = stats.get("endTimeInMillis")
+        if start:
+            acc[1] = start if acc[1] is None else min(acc[1], start)
+        if end:
+            acc[2] = end if acc[2] is None else max(acc[2], end)
+    stages = [{"fragment": fid, "tasks": acc[0],
+               "wall_s": (round((acc[2] - acc[1]) / 1000.0, 6)
+                          if acc[1] is not None and acc[2] is not None
+                          else None)}
+              for fid, acc in sorted(stage_acc.items())]
+
+    hbo = getattr(cluster, "last_hbo", None) or {}
+    membership = dict(cluster.membership_snapshot())
+    # one monotone number a dashboard can diff: total membership edges
+    membership["epoch"] = (membership.get("joins", 0)
+                           + membership.get("departures", 0)
+                           + membership.get("drains", 0))
+    return {
+        "event_version": WIDE_EVENT_VERSION,
+        "ts": now,
+        "query_id": qid,
+        "query": sql,
+        "user_name": cluster.session_properties.get("user", "") or None,
+        "state": "FAILED" if error is not None else "FINISHED",
+        "error": error,
+        "wall_s": round(now - pre.get("t0", now), 6),
+        "result_rows": len(rows) if rows is not None else None,
+        "admission": getattr(cluster, "last_admission", None),
+        "hbo": {"hits": int(hbo.get("hits", 0)),
+                "misses": int(hbo.get("misses", 0)),
+                "join_reorders": int(getattr(cluster,
+                                             "last_join_reorders", 0))},
+        "dynamic_filter_rows_pruned": df_pruned,
+        "cache": {"cached_tasks": cached_tasks, "task_hits": task_hits},
+        "spool": getattr(cluster, "last_spool_stats", None),
+        "exchange": getattr(cluster, "last_exchange_stats", None),
+        "mesh": mesh_delta,
+        "membership": membership,
+        "trace_id": trace_id,
+        "stages": stages,
+    }
+
+
+def emit_wide_event(cluster, qid: str, sql: str, *,
+                    rows: Optional[list], error: Optional[str],
+                    pre: dict) -> None:
+    """Assemble + emit; never raises (a broken stat source must not
+    fail the query it describes)."""
+    try:
+        detail = build_wide_event(cluster, qid, sql, rows=rows,
+                                  error=error, pre=pre)
+    except Exception:   # noqa: BLE001 — observability is best-effort
+        _M_BUILD_ERRORS.inc()
+        log.exception("wide event build failed for %s", qid)
+        return
+    _M_EVENTS.inc(state=detail["state"])
+    EVENTS.emit(QueryEvent("wide", qid, sql, wall_s=detail["wall_s"],
+                           rows=detail["result_rows"], error=error,
+                           detail=detail))

@@ -310,6 +310,16 @@ class TpuCluster:
             from presto_tpu.server.auth import configure
             configure(shared_secret, "tpu-coordinator")
 
+        # introspection facade: `system.*` tables answer from this
+        # cluster's live state, everything else delegates to the real
+        # connector. Wrapped FIRST so the planner and every in-process
+        # worker (which share the object) see one catalog; the cluster
+        # reference is attached at the end of construction.
+        from presto_tpu.connectors.system_runtime import \
+            SystemTablesConnector
+        if not isinstance(connector, SystemTablesConnector):
+            connector = SystemTablesConnector(connector)
+
         self.connector = connector
         self.planner = Planner(connector)
         # HBO store (plan/stats.HistoryStore) consulted by AddExchanges'
@@ -389,6 +399,14 @@ class TpuCluster:
         self._query_counter = 0
         self._lock = threading.Lock()
         self._plans: Dict[str, PlanNode] = {}
+        # introspection plane: system tables can now see this cluster;
+        # the wide-event JSONL sink registers (a no-op without a
+        # configured path) and the sampling profiler starts
+        connector.attach_cluster(self)
+        from presto_tpu.obs.profiler import PROFILER
+        from presto_tpu.obs.wide_events import install_event_log_sink
+        install_event_log_sink()
+        PROFILER.ensure_started()
 
     @property
     def worker_uris(self) -> List[str]:
@@ -601,35 +619,47 @@ class TpuCluster:
         with self._lock:
             self._query_counter += 1
             qid = f"cluster_q{self._query_counter}"
-        with query_lifecycle(qid, sql) as box:
-            group = self.resource_groups.select(
-                user=self.session_properties.get("user", ""),
-                source=self.session_properties.get("source", ""))
-            # when the statement front door already admitted this query
-            # (dispatcher pool thread), acquire returns a no-op nested
-            # slot — admission happens exactly once per statement
-            slot = group.acquire(timeout_s=600, query_id=qid)
-            self.last_admission = {
-                "group": slot.group.path,
-                "queue_wait_s": slot.queue_wait_s or 0.0}
-            with slot:
-                head = (sql.lstrip().split(None, 1)[0].lower()
-                        if sql.strip() else "")
-                if head == "explain":
-                    from presto_tpu.plan.nodes import explain as _ex
-                    rest = sql.lstrip()[len("explain"):].lstrip()
-                    if rest.lower().startswith("analyze"):
-                        text = self.explain_analyze_sql(
-                            rest[len("analyze"):].lstrip())
+        # wide-event query log: exactly ONE event per cluster query id,
+        # success or failure — recovery retries happen INSIDE the body,
+        # so they can never duplicate it (obs/wide_events.py)
+        from presto_tpu.obs import wide_events as _wide
+        pre = _wide.pre_query_snapshot(self)
+        try:
+            with query_lifecycle(qid, sql) as box:
+                group = self.resource_groups.select(
+                    user=self.session_properties.get("user", ""),
+                    source=self.session_properties.get("source", ""))
+                # when the statement front door already admitted this
+                # query (dispatcher pool thread), acquire returns a no-op
+                # nested slot — admission happens once per statement
+                slot = group.acquire(timeout_s=600, query_id=qid)
+                self.last_admission = {
+                    "group": slot.group.path,
+                    "queue_wait_s": slot.queue_wait_s or 0.0}
+                with slot:
+                    head = (sql.lstrip().split(None, 1)[0].lower()
+                            if sql.strip() else "")
+                    if head == "explain":
+                        from presto_tpu.plan.nodes import explain as _ex
+                        rest = sql.lstrip()[len("explain"):].lstrip()
+                        if rest.lower().startswith("analyze"):
+                            text = self.explain_analyze_sql(
+                                rest[len("analyze"):].lstrip())
+                        else:
+                            text = _ex(self.plan_sql(rest))
+                        box[0] = [(line,) for line in text.splitlines()]
+                    elif head in ("create", "insert", "drop", "delete"):
+                        box[0] = self._execute_write(sql)
                     else:
-                        text = _ex(self.plan_sql(rest))
-                    box[0] = [(line,) for line in text.splitlines()]
-                elif head in ("create", "insert", "drop", "delete"):
-                    box[0] = self._execute_write(sql)
-                else:
-                    box[0] = self._execute_plan(
-                        self.plan_sql(sql), capture=_capture,
-                        cancel_event=cancel_event)
+                        box[0] = self._execute_plan(
+                            self.plan_sql(sql), capture=_capture,
+                            cancel_event=cancel_event)
+        except Exception as e:
+            _wide.emit_wide_event(self, qid, sql, rows=None,
+                                  error=str(e), pre=pre)
+            raise
+        _wide.emit_wide_event(self, qid, sql, rows=box[0], error=None,
+                              pre=pre)
         return box[0]
 
     def _execute_write(self, sql: str) -> List[tuple]:
@@ -843,6 +873,11 @@ class TpuCluster:
             f"misses={hbo.get('misses', 0)} "
             f"join_reorders={getattr(self, 'last_join_reorders', 0)} "
             f"dynamic_filter_rows_pruned={df_pruned}")
+        from presto_tpu.obs.profiler import PROFILER
+        ps = PROFILER.stats()
+        lines.append(
+            f"Profile: samples={ps['samples']} buckets={ps['buckets']} "
+            f"overhead={PROFILER.overhead_fraction() * 100:.2f}%")
         trace = self.render_trace()
         if trace:
             lines.append(

@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
-from presto_tpu.obs.metrics import gauge as _gauge, render_prometheus
+from presto_tpu.obs.metrics import gauge as _gauge
 from presto_tpu.protocol import structs as S
 from presto_tpu.server.buffers import BufferClosedError
 from presto_tpu.server.task_manager import (
@@ -296,15 +296,32 @@ class _Handler(BaseHTTPRequestHandler):
                 "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
                 "heapUsed": self.tm.memory_bytes(),
                 "heapAvailable": 16 << 30, "nonHeapUsed": 0})
+        if path == "/v1/tasks":
+            # per-task summary rows — the worker-side feed of
+            # system.runtime.tasks (fanned out by the system connector)
+            return self._json(200, self.tm.task_rows())
+        if path == "/v1/profile":
+            # collapsed-stack text (flamegraph.pl-ready) from the
+            # always-on sampling profiler
+            from presto_tpu.obs.profiler import PROFILER
+            body = (PROFILER.collapsed() + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if path in ("/v1/metrics", "/v1/info/metrics"):
             # Prometheus text exposition of the process-global registry
             # (reference: presto_cpp/main/runtime-metrics/
             # PrometheusStatsReporter.cpp, registered at
             # PrestoServer.cpp:562). /v1/info/metrics is the legacy
-            # alias; scrape-time gauges refresh first.
+            # alias; scrape-time gauges (worker + process) refresh first
+            # inside the shared render_metrics_payload() scrape path.
+            from presto_tpu.obs.process import render_metrics_payload
             self.tm.record_gauges()
             _M_UPTIME.set(time.time() - _SERVER_START)
-            body = render_prometheus().encode()
+            body = render_metrics_payload().encode()
             self.send_response(200)
             self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
             self.send_header("Content-Length", str(len(body)))
@@ -474,6 +491,10 @@ class TpuWorkerServer:
         # back-reference for the PUT /v1/info/state handler: a drain
         # request must also retract the announcement once drained
         self.httpd.worker_server = self
+        # always-on sampling profiler (GET /v1/profile); started from
+        # the constructor, never from a request handler
+        from presto_tpu.obs.profiler import PROFILER
+        PROFILER.ensure_started()
 
     def start(self):
         self.thread.start()

@@ -30,9 +30,7 @@ from presto_tpu.admission import (DispatchManager, OverloadedError,
 from presto_tpu.admission import dispatcher as _dispatch
 from presto_tpu.config import DEFAULT_ADMISSION, DEFAULT_ELASTIC
 from presto_tpu.server.journal import QueryJournal
-from presto_tpu.obs.metrics import (
-    counter as _counter, gauge as _gauge, render_prometheus,
-)
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
 from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import TRACER
 
@@ -247,12 +245,25 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/metrics":
             # same process-global registry the workers render — on the
             # coordinator a scrape additionally shows transport/breaker
-            # counters for every worker host it talks to
+            # counters for every worker host it talks to; process
+            # gauges + scrape histogram via the shared scrape path
+            from presto_tpu.obs.process import render_metrics_payload
             _M_COORD_UPTIME.set(_time.time() - _COORD_START)
-            body = render_prometheus().encode()
+            body = render_metrics_payload().encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path == "/v1/profile":
+            # coordinator-side collapsed stacks (the profiler is
+            # process-global, so in-process workers show here too)
+            from presto_tpu.obs.profiler import PROFILER
+            body = (PROFILER.collapsed() + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -393,6 +404,15 @@ class StatementServer:
         self.httpd.base = self.base
         self._thread = spawn("coordinator", "statement-http",
                              self.httpd.serve_forever, start=False)
+        # introspection plane: the system connector unions this front
+        # door's live dispatcher states into system.runtime.queries via
+        # this back-reference; the wide-event sink and profiler start
+        # here too so a statement-only deployment still gets both
+        setattr(engine, "statement_frontend", self)
+        from presto_tpu.obs.profiler import PROFILER
+        from presto_tpu.obs.wide_events import install_event_log_sink
+        install_event_log_sink()
+        PROFILER.ensure_started()
 
     #: completed queries kept for /v1/query info (QueryTracker role)
     MAX_TRACKED = 200

@@ -1199,6 +1199,33 @@ class TpuTaskManager:
                     max(0.0, deadline - time.time()))
         return task.status(self.base_uri)
 
+    def task_rows(self) -> List[dict]:
+        """Per-task summary rows for GET /v1/tasks — the worker-side
+        feed of `system.runtime.tasks` (connectors/system_runtime.py).
+        One locked snapshot of the task map; per-task fields read
+        without per-task locks (monotone counters, point-in-time)."""
+        with self.lock:
+            tasks = list(self.tasks.values())
+        now = time.time()
+        rows = []
+        for t in tasks:
+            start = t.start_time
+            wall = ((t.end_time or now) - start) if start else 0.0
+            rows.append({
+                "nodeId": self.node_id,
+                "taskId": t.task_id,
+                "state": t.state,
+                "splits": t.total_splits,
+                "bytesOut": t.bytes_out,
+                "outputRows": t.output_positions,
+                "cacheHit": bool(t.cache_hit),
+                "dfPruned": int(t.df_pruned),
+                "wallS": round(wall, 6),
+                "traceId": (t.trace_ctx.trace_id
+                            if t.trace_ctx is not None else None),
+            })
+        return rows
+
     #: tombstone bound (the reference caps its zombie task list too) —
     #: enough to cover any realistic coordinator retry window
     MAX_TOMBSTONES = 4096

@@ -25,7 +25,20 @@ import importlib
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+from presto_tpu.obs.metrics import counter as _counter
 from presto_tpu.types import Type
+
+#: every listener registered on the process event pipeline, by source —
+#: "plugin" (EventListenerFactory.create() via PluginManager.install)
+#: or "jsonl-sink" (the wide-event log, obs/wide_events.py)
+_M_LISTENER_REGS = _counter(
+    "presto_tpu_event_listener_registrations_total",
+    "Event listeners registered on the process event pipeline",
+    ("source",))
+
+
+def count_listener_registration(source: str) -> None:
+    _M_LISTENER_REGS.inc(source=source)
 
 
 class AccessDeniedError(RuntimeError):
@@ -154,6 +167,7 @@ class PluginManager:
             cb = lf.create()
             self._listeners.append(cb)
             EVENTS.register(cb)
+            count_listener_registration("plugin")
 
     def shutdown(self) -> None:
         """Unregister this manager's event listeners from the global

@@ -545,6 +545,13 @@ class Parser:
             return ast.TableFunctionRef(fn, tuple(args), alias,
                                         col_aliases)
         name = self.ident_text()
+        # dotted names (catalog.schema.table): the engine's connectors
+        # key tables by the full dotted string (system.runtime.tasks),
+        # so the segments collapse back into one TableRef name
+        while self.peek().kind == "op" and self.peek().text == "." \
+                and self.peek(1).kind == "ident":
+            self.next()
+            name += "." + self.ident_text()
         alias = None
         if self.accept_kw("as"):
             alias = self.ident_text()

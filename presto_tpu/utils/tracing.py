@@ -88,9 +88,23 @@ class TraceContext:
 
 _ACTIVE = threading.local()
 
+# tid -> trace id mirror of the thread-local context. `threading.local`
+# cannot be read from another thread, but the sampling profiler
+# (obs/profiler.py) attributes stacks to the query each thread is
+# working on — so trace_scope maintains this parallel map too. Guarded
+# by its own lock; entries live exactly as long as the scope.
+_THREAD_TRACES: Dict[int, str] = {}
+_THREAD_TRACES_LOCK = threading.Lock()
+
 
 def current_trace() -> Optional[TraceContext]:
     return getattr(_ACTIVE, "ctx", None)
+
+
+def thread_traces() -> Dict[int, str]:
+    """Snapshot of thread-id -> active trace id (profiler attribution)."""
+    with _THREAD_TRACES_LOCK:
+        return dict(_THREAD_TRACES)
 
 
 @contextmanager
@@ -99,10 +113,19 @@ def trace_scope(trace_id: str, parent_span_id: str = ""):
     transport.HttpClient carry it as X-Presto-Trace until exit."""
     prev = getattr(_ACTIVE, "ctx", None)
     _ACTIVE.ctx = TraceContext(trace_id, parent_span_id)
+    tid = threading.get_ident()
+    with _THREAD_TRACES_LOCK:
+        prev_tid = _THREAD_TRACES.get(tid)
+        _THREAD_TRACES[tid] = trace_id
     try:
         yield _ACTIVE.ctx
     finally:
         _ACTIVE.ctx = prev
+        with _THREAD_TRACES_LOCK:
+            if prev_tid is None:
+                _THREAD_TRACES.pop(tid, None)
+            else:
+                _THREAD_TRACES[tid] = prev_tid
 
 
 def parse_trace_header(value: Optional[str]) -> Optional[TraceContext]:
@@ -239,12 +262,15 @@ class Tracer:
 class QueryEvent:
     """QueryCreated/QueryCompleted payload subset (reference:
     spi/eventlistener/QueryCompletedEvent.java)."""
-    kind: str                 # "created" | "completed" | "failed"
+    kind: str                 # "created" | "completed" | "failed" | "wide"
     query_id: str
     sql: str
     wall_s: Optional[float] = None
     rows: Optional[int] = None
     error: Optional[str] = None
+    #: structured payload for "wide" events (obs/wide_events.py): the
+    #: full per-query stat surface as one JSON-compatible dict
+    detail: Optional[dict] = None
 
 
 class EventListenerManager:

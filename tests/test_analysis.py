@@ -50,7 +50,8 @@ def test_rule_catalog_complete():
             "no-blocking-under-lock", "lock-leak",
             "no-jax-in-control-plane",
             "no-spawn-in-request-handler",
-            "no-planner-in-data-plane"} <= names
+            "no-planner-in-data-plane", "membership-chokepoint",
+            "metric-docs-sync"} <= names
 
 
 # ===================================================================
@@ -131,6 +132,55 @@ def test_metric_name_duplicate_fires():
         "presto_tpu/a.py": 'M = counter("presto_tpu_x_total", "h")\n',
         "presto_tpu/b.py": 'M = counter("presto_tpu_x_total", "h")\n'})
     assert fs and "2 call sites" in fs[0].message
+
+
+_CATALOG = (
+    "# engine\n\n"
+    "Metric catalog (prefix `presto_tpu_`):\n\n"
+    "- **x** — `x_{a,b}_total`, `x_gauge{label}`\n\n"
+    "Prose after the list ends the catalog: `x_prose_total`.\n"
+)
+
+_X_REGS = (
+    'A = counter("presto_tpu_x_a_total", "h")\n'
+    'B = counter("presto_tpu_x_b_total", "h")\n'
+    'G = gauge("presto_tpu_x_gauge", "h", ("label",))\n'
+)
+
+
+def test_metric_docs_sync_clean_when_synced():
+    # alternation + trailing-label tokens in the catalog both resolve;
+    # backticked names outside the list (prose) are not entries
+    assert not _findings("metric-docs-sync", {
+        "presto_tpu/exec/m.py": _X_REGS, "README.md": _CATALOG})
+
+
+def test_metric_docs_sync_flags_undocumented_metric():
+    bad = "presto_tpu/exec/m.py"
+    fs = _findings("metric-docs-sync", {
+        bad: _X_REGS + 'N = counter("presto_tpu_x_new_total", "h")\n',
+        "README.md": _CATALOG}, planted=bad)
+    assert fs and fs[0].line == 4
+    assert "presto_tpu_x_new_total" in fs[0].message
+    assert "absent from the README" in fs[0].message
+
+
+def test_metric_docs_sync_flags_stale_docs_entry():
+    stale = _CATALOG.replace(
+        "`x_gauge{label}`", "`x_gauge{label}`, `x_gone_total`")
+    fs = _findings("metric-docs-sync", {
+        "presto_tpu/exec/m.py": _X_REGS, "README.md": stale},
+        planted="README.md")
+    assert fs and "presto_tpu_x_gone_total" in fs[0].message
+    assert "stale" in fs[0].message
+
+
+def test_metric_docs_sync_flags_missing_catalog_section():
+    fs = _findings("metric-docs-sync", {
+        "presto_tpu/exec/m.py": _X_REGS,
+        "README.md": "# engine\n\nno catalog here\n"},
+        planted="README.md")
+    assert fs and "no 'Metric catalog" in fs[0].message
 
 
 def test_thread_discipline_fires():

@@ -442,6 +442,51 @@ def test_continuous_churn_matrix(cluster, oracle, probe, seed):
         f"seed {seed}: spool not GC'd after churn"
 
 
+# ===================================================================
+# introspection: membership through the engine path
+# ===================================================================
+
+def test_nodes_table_reflects_drained_and_killed(oracle):
+    """`system.runtime.nodes` rides the NORMAL engine path and reports
+    the coordinator's live membership view: a decommissioned worker
+    shows DRAINING (it still answers /v1/status with SHUTTING_DOWN), a
+    hard-killed one shows DEAD, the survivor ACTIVE — and the scan
+    itself schedules around both."""
+    c = TpuCluster(
+        TpchConnector(SF), n_workers=3,
+        session_properties={"query_max_execution_time": str(DEADLINE_S),
+                            "retry_policy": "TASK"},
+        transport_config=CHAOS_TRANSPORT)
+    try:
+        uris = list(c.all_worker_uris)
+        c.decommission(uris[1])
+        _hard_kill(c.workers[2])
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            c.check_workers()
+            if uris[2] in c.dead and uris[1] in c.drained:
+                break
+            time.sleep(0.1)
+        assert uris[1] in c.drained, "decommission never registered"
+        assert uris[2] in c.dead, "hard kill never detected"
+
+        rows = c.execute_sql(
+            "select uri, node_id, state from system.runtime.nodes")
+        states = {r[0]: r[2] for r in rows}
+        assert states[uris[0]] == "ACTIVE", states
+        assert states[uris[1]] == "DRAINING", states
+        assert states[uris[2]] == "DEAD", states
+        ids = {r[0]: r[1] for r in rows}
+        assert ids[uris[0]] == c.workers[0].task_manager.node_id
+        assert ids[uris[1]] == c.workers[1].task_manager.node_id
+        # data queries stay correct with one live worker
+        got = c.execute_sql(QUERIES[0])
+        _assert_rows_match(got, oracle[QUERIES[0]],
+                           ctx="nodes survivor")
+    finally:
+        c.stop()
+
+
 @pytest.mark.slow
 def test_no_stray_dirs_after_elastic_chaos(cluster):
     """Module guard: the elastic suite (drains, kills, dynamic workers)

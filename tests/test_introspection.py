@@ -1,0 +1,420 @@
+"""Introspection-plane suite: system.runtime tables, the wide-event
+query log, and the always-on sampling profiler.
+
+Contracts (README "Introspection"):
+
+- `system.runtime.tasks` rides the NORMAL engine path and agrees with
+  each worker's `/v1/status` taskCount — verified against a query held
+  in flight by a gate on the worker's real task entry point;
+- `system.runtime.queries` unions the coordinator's wide-event ledger
+  with the statement front door's live dispatcher view, matching the
+  coordinator `/v1/status` queryCount;
+- every cluster query emits exactly ONE wide event (frozen, versioned
+  JSON schema) — including a query that rides task recovery after a
+  mid-flight worker kill under retry_policy=TASK;
+- the JSONL sink appends whole lines crash-safely and rotates at its
+  size cap; `install_event_log_sink` is idempotent;
+- the profiler stays under its overhead bound, buckets by the
+  presto-tpu thread-name discipline, and surfaces via
+  `system.runtime.profile`, `GET /v1/profile`, and EXPLAIN ANALYZE;
+- plugin event listeners register through the SPI and are counted.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.config import TransportConfig
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.obs import wide_events as wide_events_mod
+from presto_tpu.obs.metrics import REGISTRY
+from presto_tpu.obs.profiler import PROFILER
+from presto_tpu.obs.wide_events import (LEDGER, WIDE_EVENT_VERSION,
+                                        JsonlEventSink,
+                                        install_event_log_sink)
+from presto_tpu.server.cluster import TpuCluster
+from presto_tpu.server.statement import StatementServer
+from presto_tpu.server.task_manager import TpuTaskManager
+from presto_tpu.spi import EventListenerFactory, Plugin, PluginManager
+from presto_tpu.utils.tracing import EVENTS, QueryEvent
+
+SF = 0.01
+
+FAST = TransportConfig(
+    retry_base_backoff_s=0.01, retry_max_backoff_s=0.2,
+    retry_budget_s=5.0, breaker_failure_threshold=3,
+    breaker_cooldown_s=0.3)
+
+#: the frozen wide-event key set (event_version=1); a key change here
+#: must bump WIDE_EVENT_VERSION
+WIDE_KEYS = {
+    "event_version", "ts", "query_id", "query", "user_name", "state",
+    "error", "wall_s", "result_rows", "admission", "hbo",
+    "dynamic_filter_rows_pruned", "cache", "spool", "exchange", "mesh",
+    "membership", "trace_id", "stages"}
+
+PRESTO_ROLES = {"worker", "coordinator", "exchange", "obs",
+                "discovery", "statement", "admission"}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = TpuCluster(
+        TpchConnector(SF), n_workers=2,
+        session_properties={"query_max_execution_time": "120",
+                            "retry_policy": "TASK"},
+        transport_config=FAST)
+    yield c
+    c.stop()
+
+
+# ===================================================================
+# system.runtime.tasks vs the workers' own /v1/status
+# ===================================================================
+
+def test_tasks_table_matches_worker_status(cluster, monkeypatch):
+    """Hold a query's tasks in flight with a gate on the worker's real
+    entry point, snapshot system.runtime.tasks THROUGH the engine, and
+    verify the per-node RUNNING counts against each worker's
+    /v1/status taskCount (finished tasks are deleted at query end, so
+    status converges to exactly the gated tasks)."""
+    baseline = cluster.execute_sql("select count(*) from lineitem")
+
+    orig = TpuTaskManager._run_inner
+    lock = threading.Lock()
+    gate = {"qid": None}
+    seen = threading.Event()
+    release = threading.Event()
+
+    def gated(self, task):
+        qid = task.task_id.split(".", 1)[0]
+        with lock:
+            if gate["qid"] is None:
+                gate["qid"] = qid
+        if qid == gate["qid"]:
+            seen.set()
+            release.wait(timeout=60)
+        return orig(self, task)
+
+    monkeypatch.setattr(TpuTaskManager, "_run_inner", gated)
+    got, errors = [], []
+
+    def run():
+        try:
+            got.extend(cluster.execute_sql(
+                "select count(*) from lineitem"))
+        except Exception as e:   # noqa: BLE001 — collected for assert
+            errors.append(e)
+
+    t = threading.Thread(target=run, name="intro-gated", daemon=True)
+    t.start()
+    try:
+        assert seen.wait(timeout=30), "gated query never started a task"
+        time.sleep(0.3)          # let the rest of its tasks land
+
+        rows = cluster.execute_sql(
+            "select node_id, query_id, state from system.runtime.tasks")
+        grouped = dict(cluster.execute_sql(
+            "select state, count(*) from system.runtime.tasks "
+            "group by state"))
+
+        gqid = gate["qid"]
+        gated_rows = [r for r in rows if r[1] == gqid]
+        assert gated_rows, "snapshot missed the in-flight query's tasks"
+        assert {r[2] for r in gated_rows} == {"RUNNING"}, gated_rows
+        assert grouped.get("RUNNING", 0) >= len(gated_rows), grouped
+        assert all(c > 0 for c in grouped.values()), grouped
+
+        for w, uri in zip(cluster.workers, cluster.all_worker_uris):
+            nid = w.task_manager.node_id
+            expect = sum(1 for r in gated_rows if r[0] == nid)
+            st = None
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st = cluster.http.get_json(f"{uri}/v1/status",
+                                           request_class="probe")
+                if st["taskCount"] == expect:
+                    break
+                time.sleep(0.1)
+            assert st["nodeId"] == nid
+            assert st["taskCount"] == expect, (
+                f"{nid}: /v1/status taskCount={st['taskCount']} never "
+                f"converged to the system.runtime.tasks view ({expect})")
+    finally:
+        release.set()
+    t.join(timeout=90)
+    assert not t.is_alive(), "gated query wedged"
+    assert not errors, f"gated query failed: {errors}"
+    assert got == baseline
+
+
+# ===================================================================
+# system.runtime.queries vs the statement front door's /v1/status
+# ===================================================================
+
+def test_queries_table_matches_statement_status(cluster):
+    srv = StatementServer(cluster).start()
+    try:
+        qs = [srv.submit("select count(*) from region", user="alice")
+              for _ in range(2)]
+        for q in qs:
+            assert q.done.wait(timeout=60), "statement never finished"
+
+        rows = cluster.execute_sql(
+            "select query_id, source, state, user_name "
+            "from system.runtime.queries")
+        stmt_rows = [r for r in rows if r[1] == "statement"]
+        assert {r[0] for r in stmt_rows} == set(srv.queries)
+        assert all(r[3] == "alice" for r in stmt_rows), stmt_rows
+
+        with urllib.request.urlopen(f"{srv.base}/v1/status",
+                                    timeout=10) as resp:
+            st = json.load(resp)
+        assert st["nodeId"] == "tpu-coordinator"
+        assert st["queryCount"] == len(srv.queries) == len(stmt_rows)
+
+        # the cluster-side union: every finished cluster query appears
+        # from the wide-event ledger with its stats populated
+        cl_rows = [r for r in rows if r[1] == "cluster"]
+        assert any(r[2] == "FINISHED" for r in cl_rows)
+    finally:
+        srv.stop()
+
+
+def test_metrics_table_rides_engine_path(cluster):
+    rows = cluster.execute_sql(
+        "select name, kind, value from system.metrics "
+        "where name = 'presto_tpu_profiler_samples_total'")
+    assert len(rows) == 1
+    assert rows[0][1] == "counter"
+    assert rows[0][2] >= 0.0
+
+
+# ===================================================================
+# wide-event query log
+# ===================================================================
+
+def test_wide_event_schema_roundtrip(cluster):
+    LEDGER.clear()
+    sql = "select count(*) from region"
+    rows = cluster.execute_sql(sql)
+    evs = [e for e in LEDGER.snapshot() if e.get("query") == sql]
+    assert len(evs) == 1, f"expected ONE wide event, got {len(evs)}"
+    ev = evs[0]
+    assert set(ev) == WIDE_KEYS, set(ev) ^ WIDE_KEYS
+    assert ev["event_version"] == WIDE_EVENT_VERSION
+    assert ev["state"] == "FINISHED" and ev["error"] is None
+    assert ev["result_rows"] == len(rows) == 1
+    assert ev["query_id"].startswith("cluster_q")
+    assert ev["wall_s"] > 0
+    assert ev["stages"] and all(s["tasks"] > 0 for s in ev["stages"])
+    m = ev["membership"]
+    assert m["live"] == 2
+    assert m["epoch"] == m["joins"] + m["departures"] + m["drains"]
+    # JSON-compatible by construction: a strict dumps round-trip is
+    # lossless (no default=str coercion needed)
+    assert json.loads(json.dumps(ev, sort_keys=True)) == ev
+
+
+def test_wide_event_emitted_once_on_failure(cluster):
+    LEDGER.clear()
+    sql = "select no_such_column from region"
+    with pytest.raises(Exception):
+        cluster.execute_sql(sql)
+    evs = [e for e in LEDGER.snapshot() if e.get("query") == sql]
+    assert len(evs) == 1
+    assert evs[0]["state"] == "FAILED"
+    assert evs[0]["error"]
+    assert evs[0]["result_rows"] is None
+
+
+def test_wide_event_exactly_once_under_task_recovery(monkeypatch):
+    """Kill a worker mid-query under retry_policy=TASK: recovery
+    retries run INSIDE the execution the event wraps, so the query
+    still emits exactly ONE wide event — and it reports the post-kill
+    membership."""
+    c = TpuCluster(
+        TpchConnector(SF), n_workers=2,
+        session_properties={"query_max_execution_time": "120",
+                            "retry_policy": "TASK"},
+        transport_config=FAST)
+    try:
+        baseline = c.execute_sql("select count(*) from lineitem")
+        victim = c.workers[1].task_manager.node_id
+        orig = TpuTaskManager._run_inner
+        executed = []
+        on_victim = threading.Event()
+
+        def spy(self, task):
+            executed.append(
+                (self.node_id, int(task.task_id.rsplit(".", 1)[1])))
+            if self.node_id == victim:
+                on_victim.set()
+                time.sleep(0.5)   # hold the victim's work for the kill
+            return orig(self, task)
+
+        monkeypatch.setattr(TpuTaskManager, "_run_inner", spy)
+        LEDGER.clear()
+        sql = "select count(*) from lineitem where l_quantity >= 0"
+        got, errors = [], []
+
+        def run():
+            try:
+                got.extend(c.execute_sql(sql))
+            except Exception as e:   # noqa: BLE001 — collected below
+                errors.append(e)
+
+        t = threading.Thread(target=run, name="intro-recovery",
+                             daemon=True)
+        t.start()
+        assert on_victim.wait(timeout=30), \
+            "victim never executed a task"
+        from tests.test_elastic import _hard_kill
+        _hard_kill(c.workers[1])
+        t.join(timeout=120)
+        assert not t.is_alive(), "query wedged across the kill"
+        assert not errors, f"query failed despite recovery: {errors}"
+        assert got == baseline
+
+        assert any(a > 0 for _n, a in executed), \
+            "kill never produced an attempt>0 (recovery) execution"
+        evs = [e for e in LEDGER.snapshot() if e.get("query") == sql]
+        assert len(evs) == 1, \
+            f"recovery duplicated the wide event: {len(evs)}"
+        assert evs[0]["state"] == "FINISHED"
+        assert evs[0]["membership"]["dead"] >= 1
+    finally:
+        c.stop()
+
+
+# ===================================================================
+# JSONL sink
+# ===================================================================
+
+def _wide(i, pad=""):
+    return QueryEvent(
+        "wide", f"q{i}", "select 1",
+        detail={"event_version": WIDE_EVENT_VERSION,
+                "query_id": f"q{i}", "pad": pad})
+
+
+def test_jsonl_sink_roundtrip_and_rotation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlEventSink(path, max_bytes=1, max_files=2)
+    assert sink.max_bytes == 4096          # floor keeps rotation sane
+    pad = "x" * 600
+    for i in range(40):
+        sink(_wide(i, pad))
+    # rotation chain: path -> path.1 -> path.2, oldest dropped
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".3")
+    qids = []
+    chain = [p for p in (path + ".2", path + ".1", path)
+             if os.path.exists(p)]
+    for p in chain:
+        assert os.path.getsize(p) <= 4096
+        with open(p) as f:
+            for line in f:
+                ev = json.loads(line)       # whole lines, valid JSON
+                assert ev["event_version"] == WIDE_EVENT_VERSION
+                qids.append(int(ev["query_id"][1:]))
+    assert qids == sorted(qids), "rotation reordered events"
+    assert qids[-1] == 39, "newest event lost"
+    assert len(qids) < 40, "size cap never dropped the oldest file"
+    # non-wide events are ignored
+    before = os.path.getsize(path)
+    sink(QueryEvent("completed", "qx", "select 1"))
+    assert os.path.getsize(path) == before
+
+
+def test_install_event_log_sink_idempotent(tmp_path):
+    path = str(tmp_path / "wide.jsonl")
+    m = REGISTRY.get("presto_tpu_event_listener_registrations_total")
+    before = m.value(source="jsonl-sink")
+    s1 = install_event_log_sink(path)
+    s2 = install_event_log_sink(path)
+    try:
+        assert s1 is s2 and s1.path == path
+        assert m.value(source="jsonl-sink") == before + 1
+        EVENTS.emit(_wide(0))
+        with open(path) as f:
+            assert sum(1 for _ in f) == 1   # ONE sink, ONE line
+    finally:
+        EVENTS.unregister(s1)
+        wide_events_mod._SINK = None
+        LEDGER.clear()
+
+
+def test_plugin_listener_registration_counter():
+    m = REGISTRY.get("presto_tpu_event_listener_registrations_total")
+    before = m.value(source="plugin")
+    got = []
+
+    class P(Plugin):
+        def get_event_listener_factories(self):
+            return (EventListenerFactory("collector",
+                                         lambda: got.append),)
+
+    pm = PluginManager()
+    pm.install(P())
+    try:
+        assert m.value(source="plugin") == before + 1
+        EVENTS.emit(_wide(7))
+        assert [e.query_id for e in got if e.kind == "wide"] == ["q7"]
+    finally:
+        pm.shutdown()
+        LEDGER.clear()
+    # after shutdown the listener is unregistered
+    EVENTS.emit(_wide(8))
+    assert all(e.query_id != "q8" for e in got)
+    LEDGER.clear()
+
+
+# ===================================================================
+# sampling profiler
+# ===================================================================
+
+def test_profiler_overhead_and_buckets(cluster):
+    cluster.execute_sql(
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag order by l_returnflag")
+    deadline = time.monotonic() + 10.0
+    while PROFILER.stats()["samples"] == 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    st = PROFILER.stats()
+    assert st["running"], "profiler not running with a live cluster"
+    assert st["samples"] > 0 and st["buckets"] > 0
+    assert PROFILER.overhead_fraction() < 0.02, \
+        f"profiler overhead {PROFILER.overhead_fraction():.4f} >= 2%"
+
+    rows = cluster.execute_sql(
+        "select role, purpose, samples from system.runtime.profile")
+    assert rows and all(r[2] > 0 for r in rows)
+    roles = {r[0] for r in rows}
+    assert roles & PRESTO_ROLES, \
+        f"no presto-tpu-* thread roles in the profile: {roles}"
+
+
+def test_profile_endpoint_collapsed_stacks(cluster):
+    uri = cluster.all_worker_uris[0]
+    with urllib.request.urlopen(f"{uri}/v1/profile", timeout=10) as r:
+        text = r.read().decode()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert lines, "empty /v1/profile"
+    # collapsed-stack grammar: role;purpose;qid;frames... count
+    for ln in lines[:20]:
+        head, _, count = ln.rpartition(" ")
+        assert count.isdigit() and head.count(";") >= 2, ln
+    assert any(ln.split(";", 1)[0] in PRESTO_ROLES for ln in lines), \
+        "no presto-tpu-* buckets in /v1/profile"
+
+
+def test_explain_analyze_has_profile_line(cluster):
+    out = cluster.explain_analyze_sql("select count(*) from nation")
+    assert "Profile:" in out
