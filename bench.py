@@ -263,6 +263,8 @@ def main() -> None:
         return _load_child()
     if os.environ.get("BENCH_CHURN_ONE"):
         return _churn_child()
+    if os.environ.get("BENCH_MV_ONE"):
+        return _mv_child()
     if ds_one:
         return _ds_child(int(ds_one), runs, warmup)
     if pq_one:
@@ -614,6 +616,17 @@ def _main_orchestrator(sf, qids) -> None:
         else:
             detail["churn"] = _run_churn_child(
                 float(os.environ.get("BENCH_CHURN_TIMEOUT_S", "240"))
+                + 120.0)
+
+    # streaming-ingest + materialized-view round (one JSON `mv` entry:
+    # incremental refresh cost vs full recompute over a continuously-
+    # appending lineitem, plus staleness); BENCH_MV=0 disables
+    if os.environ.get("BENCH_MV", "1") != "0":
+        if wedged is not None:
+            detail["mv"] = {"error": f"infra: {wedged}"}
+        else:
+            detail["mv"] = _run_mv_child(
+                float(os.environ.get("BENCH_MV_TIMEOUT_S", "240"))
                 + 120.0)
 
     if wedged is not None:
@@ -979,6 +992,170 @@ def _run_churn_child(timeout_s: float):
                          f"{tail[:120]}"[:200]}
     return json.loads(line).get("detail", {}).get(
         "churn", {"error": "child produced no churn entry"})
+
+
+def _mv_rows_match(a, b, rel=1e-9, absol=1e-6) -> bool:
+    """Row-set equality with float tolerance (incremental merge and
+    full recompute sum in different orders — associativity noise only)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) or isinstance(y, float):
+                if abs(float(x) - float(y)) > max(
+                        absol, rel * max(abs(float(x)), abs(float(y)))):
+                    return False
+            elif x != y:
+                return False
+    return True
+
+
+def _mv_child() -> None:
+    """Streaming-ingest + materialized-view round: a memory-connector
+    lineitem grows continuously through the coordinator's
+    `POST /v1/ingest` front door (seeded StreamDriver) while two
+    materialized views over the same TPC-H-style aggregate are
+    refreshed each round — one incrementally (watermark delta merge),
+    one forced to a full recompute (drop + recreate). Emits per-round
+    delta-row and wall costs, the steady-state incremental/full ratios
+    the <25% acceptance gate reads, observed staleness, and an
+    exactness bit (both views must agree every round)."""
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.testing.stream import StreamDriver
+    from presto_tpu.types import DOUBLE, VARCHAR
+
+    seed = int(os.environ.get("BENCH_MV_SEED", "0"))
+    seed_rows = int(os.environ.get("BENCH_MV_SEED_ROWS", "200000"))
+    rounds = int(os.environ.get("BENCH_MV_ROUNDS", "5"))
+    steps = int(os.environ.get("BENCH_MV_STEPS", "4"))
+
+    flags = ("A", "N", "R")
+    statuses = ("F", "O")
+
+    def _row(rng, _ordinal):
+        return (rng.choice(flags), rng.choice(statuses),
+                round(rng.uniform(1.0, 50.0), 2),
+                round(rng.uniform(900.0, 105000.0), 2))
+
+    conn = MemoryConnector()
+    conn.create("lineitem", [("l_returnflag", VARCHAR),
+                             ("l_linestatus", VARCHAR),
+                             ("l_quantity", DOUBLE),
+                             ("l_extendedprice", DOUBLE)])
+    import random as _random
+    base_rng = _random.Random(f"{seed}:base")
+    conn.append_rows("lineitem", [_row(base_rng, i)
+                                  for i in range(seed_rows)])
+
+    mv_sql = ("select l_returnflag, l_linestatus, count(*), "
+              "sum(l_quantity), avg(l_extendedprice) from lineitem "
+              "group by l_returnflag, l_linestatus")
+    engine = LocalEngine(conn)
+    srv = StatementServer(engine).start()
+    driver = StreamDriver(srv.base, "lineitem", _row, seed=seed,
+                          batch_min=200, batch_max=400)
+    out = {"seed": seed, "seed_rows": seed_rows, "rounds": rounds,
+           "per_round": [], "exact": True}
+    try:
+        engine.execute_sql(
+            f"create materialized view bench_inc as {mv_sql}")
+        engine.execute_sql("refresh materialized view bench_inc")
+        mgr = engine.mv_manager
+
+        def _stat(name):
+            return next(s for s in mgr.stats() if s["name"] == name)
+
+        for rnd in range(rounds):
+            for _ in range(steps):
+                driver.step()
+            staleness = _stat("bench_inc")["staleness_seconds"]
+            engine.execute_sql("refresh materialized view bench_inc")
+            inc = _stat("bench_inc")
+            # full-recompute cost of the same aggregate at the same
+            # version: a fresh view's first refresh scans everything
+            engine.execute_sql(
+                f"create materialized view bench_full as {mv_sql}")
+            engine.execute_sql("refresh materialized view bench_full")
+            full = _stat("bench_full")
+            if not _mv_rows_match(mgr.rows("bench_inc"),
+                                  mgr.rows("bench_full")):
+                out["exact"] = False
+            engine.execute_sql("drop materialized view bench_full")
+            out["per_round"].append({
+                "round": rnd,
+                "staleness_s": round(staleness, 3),
+                "inc_kind": inc["last_refresh_kind"],
+                "inc_delta_rows": inc["last_delta_rows"],
+                "inc_wall_s": round(inc["last_refresh_duration_s"], 5),
+                "full_delta_rows": full["last_delta_rows"],
+                "full_wall_s": round(
+                    full["last_refresh_duration_s"], 5)})
+    finally:
+        driver.close()
+        srv.stop()
+    out["ingest"] = driver.report()
+    inc_rows = sum(r["inc_delta_rows"] for r in out["per_round"])
+    full_rows = sum(r["full_delta_rows"] for r in out["per_round"])
+    inc_wall = sum(r["inc_wall_s"] for r in out["per_round"])
+    full_wall = sum(r["full_wall_s"] for r in out["per_round"])
+    out["incremental_rounds"] = sum(
+        1 for r in out["per_round"] if r["inc_kind"] == "incremental")
+    out["rows_ratio"] = (round(inc_rows / full_rows, 4)
+                         if full_rows else None)
+    out["wall_ratio"] = (round(inc_wall / full_wall, 4)
+                         if full_wall else None)
+    # steady state = the rounds after plan/compile caches warmed (the
+    # first two rounds pay one-time tracing for both refresh flavors)
+    steady = out["per_round"][2:]
+    s_inc_rows = sum(r["inc_delta_rows"] for r in steady)
+    s_full_rows = sum(r["full_delta_rows"] for r in steady)
+    s_inc_wall = sum(r["inc_wall_s"] for r in steady)
+    s_full_wall = sum(r["full_wall_s"] for r in steady)
+    out["steady_rows_ratio"] = (round(s_inc_rows / s_full_rows, 4)
+                                if s_full_rows else None)
+    out["steady_wall_ratio"] = (round(s_inc_wall / s_full_wall, 4)
+                                if s_full_wall else None)
+    # the acceptance gate: steady-state incremental refresh at <25% of
+    # the full-recompute cost in BOTH scanned rows and wall time
+    out["gate_under_25pct"] = bool(
+        out["steady_rows_ratio"] is not None
+        and out["steady_rows_ratio"] < 0.25
+        and out["steady_wall_ratio"] is not None
+        and out["steady_wall_ratio"] < 0.25)
+    print(json.dumps({"metric": "mv_incremental_refresh_ratio",
+                      "value": out["steady_wall_ratio"], "unit": "x",
+                      "detail": {"mv": out}}))
+
+
+def _run_mv_child(timeout_s: float):
+    """Run the streaming-mv round in a subprocess; returns the `mv`
+    detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_MV_ONE="1", BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "mv", {"error": "child produced no mv entry"})
 
 
 def _hbo_probe(conn, sql):

@@ -486,6 +486,29 @@ class ResourceGroupManager:
                 return g
         raise QueryQueueFull(f"no resource group matches user={user!r}")
 
+    def ensure_group(self, name: str, source_regex: Optional[str] = None,
+                     **group_kwargs) -> ResourceGroup:
+        """Idempotently add a leaf group as its OWN root — the
+        background-tenant hook (streaming ingest, MV refresh): system
+        work admits through its own named leaf instead of competing
+        inside the interactive trees. A sibling root (not a child of an
+        existing root) because grafting children under a configured
+        leaf would silently stop it admitting (leaves only). With
+        `source_regex`, a matching selector is prepended so statements
+        tagged with that source route here too; first-match order keeps
+        user-configured selectors from being shadowed for other
+        sources."""
+        g = self.groups.get(name)
+        if g is None:
+            g = ResourceGroup(name, **group_kwargs)
+            self.roots.append(g)
+            self.groups[name] = g
+        if source_regex is not None and not any(
+                s.group == name for s in self.selectors):
+            self.selectors.insert(
+                0, Selector(name, source_regex=source_regex))
+        return g
+
     def attach_memory_pool(self, pool) -> None:
         for r in self.roots:
             r.attach_memory_pool(pool)

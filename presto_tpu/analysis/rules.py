@@ -839,3 +839,42 @@ class MetricDocsSyncRule(Rule):
 
 
 register(MetricDocsSyncRule())
+
+# =====================================================================
+# 14. mv-cache-chokepoint — mv/manager.py is the only caller of the
+#     fragment cache's pin/unpin API
+# =====================================================================
+
+#: a pin/unpin call site — pinning exempts an entry from LRU
+#: eviction, so a stray pin anywhere else is a silent budget leak and a
+#: stray unpin can evict live materialized-view state from under a read
+_CACHE_PIN = re.compile(r"\.\s*pin\s*\(")
+_CACHE_UNPIN = re.compile(r"\.\s*unpin\s*\(")
+
+_MV_MANAGER = "presto_tpu/mv/manager.py"
+
+
+class MvCacheChokepointRule(Rule):
+    name = "mv-cache-chokepoint"
+    description = (
+        "only presto_tpu/mv/ may pin/unpin fragment-cache entries — "
+        "materialized-view state is the sole legitimate pinned "
+        "resident, and routing every pin through the mv manager keeps "
+        "the pinned-bytes accounting, journalled lifecycle and "
+        "refresh-then-release ordering in one place; a pin elsewhere "
+        "leaks budget past eviction forever, an unpin elsewhere can "
+        "drop live view state mid-read")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_CACHE_PIN, _CACHE_UNPIN),
+            "fragment-cache pin/unpin outside presto_tpu/mv/ — route "
+            "materialized state through mv.MaterializedViewManager",
+            allowed=(_MV_MANAGER,))
+        out.extend(honesty_finding(
+            self, pkg, _MV_MANAGER, (_CACHE_PIN, _CACHE_UNPIN),
+            "mv state pinning"))
+        return out
+
+
+register(MvCacheChokepointRule())

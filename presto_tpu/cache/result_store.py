@@ -65,6 +65,12 @@ class FragmentResultCache:
         self._lock = threading.Lock()
         self._entries: "collections.OrderedDict[str, tuple]" = \
             collections.OrderedDict()      # key -> (pages, nbytes)
+        # pinned keys are exempt from LRU eviction (materialized-view
+        # state — presto_tpu/mv/ is the only pin/unpin call site, the
+        # mv-cache-chokepoint rule): a pin outlives any scan burst, so
+        # eviction walks past pinned entries and bails rather than spin
+        # when only pins remain
+        self._pinned: set = set()
         self._pool = memory_pool
         self._pool_qid = pool_query_id
         # observability counters (surfaced in task runtimeStats and
@@ -106,7 +112,11 @@ class FragmentResultCache:
             if old is not None:
                 self._release(old[1])
             while self._entries and self.bytes + nbytes > self.budget_bytes:
-                _, (_, evicted_bytes) = self._entries.popitem(last=False)
+                victim = next((k for k in self._entries
+                               if k not in self._pinned), None)
+                if victim is None:
+                    break   # only pinned entries left — never evicted
+                _, evicted_bytes = self._entries.pop(victim)
                 self._release(evicted_bytes)
                 self.evictions += 1
                 _M_EVICTIONS.inc()
@@ -122,6 +132,33 @@ class FragmentResultCache:
             _M_BYTES.set(self.bytes)
             _M_ENTRIES.set(len(self._entries))
             return True
+
+    # -------------------------------------------------------------- pins
+    def pin(self, key: str) -> bool:
+        """Exempt `key` from LRU eviction until unpinned. Pinning a key
+        not (yet) present is allowed — the pin takes effect when the
+        entry lands. Returns whether the entry is currently resident."""
+        with self._lock:
+            self._pinned.add(key)
+            return key in self._entries
+
+    def unpin(self, key: str, drop: bool = False) -> None:
+        """Return `key` to ordinary LRU life; with `drop`, release the
+        entry immediately (a replaced MV state has no second reader —
+        holding it would squat pinned budget)."""
+        with self._lock:
+            self._pinned.discard(key)
+            if drop:
+                entry = self._entries.pop(key, None)
+                if entry is not None:
+                    self._release(entry[1])
+                    _M_ENTRIES.set(len(self._entries))
+
+    @property
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return sum(nb for k, (_p, nb) in self._entries.items()
+                       if k in self._pinned)
 
     def _release(self, nbytes: int) -> None:
         self.bytes -= nbytes
@@ -149,4 +186,7 @@ class FragmentResultCache:
                 "evictions": self.evictions,
                 "bytes": self.bytes,
                 "entries": len(self._entries),
+                "pinned_bytes": sum(
+                    nb for k, (_p, nb) in self._entries.items()
+                    if k in self._pinned),
             }

@@ -400,6 +400,36 @@ class ElasticConfig:
 DEFAULT_ELASTIC = ElasticConfig()
 
 
+@dataclasses.dataclass(frozen=True)
+class MVConfig:
+    """Materialized-view maintenance knobs (presto_tpu/mv/; reference:
+    the incrementally maintained MV half of Presto@Meta's VLDB'23
+    data-freshness story). One per MV manager."""
+
+    #: byte budget of the pinned accumulator-state cache; MV state is
+    #: pinned (never LRU-evicted) inside a FragmentResultCache, so this
+    #: bounds total pinned bytes across all views
+    state_budget_bytes: int = 64 << 20
+    #: background refresher: a view whose base tables moved and whose
+    #: last refresh is older than this gets re-refreshed by the
+    #: mv-refresh admission tenant
+    staleness_target_s: float = 5.0
+    #: background refresher poll cadence
+    refresh_tick_s: float = 0.5
+    #: bounded full recompute: refuse a full-recompute refresh when the
+    #: base tables hold more rows than this (the incremental path has
+    #: no such bound — its cost scales with the delta, not the table)
+    max_full_recompute_rows: int = 200_000_000
+    #: MV definition journal location; None derives it from the
+    #: elastic query-journal path (+ ".mv") when one is configured
+    journal_path: Optional[str] = None
+    #: compact the MV journal once dead records cross this threshold
+    journal_compact_threshold: int = 64
+
+
+DEFAULT_MV = MVConfig()
+
+
 class Session:
     """One query session: defaults overridden by string-typed properties
     (the wire form). Unknown properties are rejected loudly, like the

@@ -33,6 +33,19 @@ class MemoryConnector(SplitSource):
         # MemoryPagesStore synchronization)
         self._write_lock = threading.Lock()
 
+    def _record_watermark(self, name: str, version: int) -> None:
+        """Pair the just-bumped version with the table's cumulative row
+        count (stream/watermarks.py) so delta consumers can read "rows
+        since version V". A vanished table (drop / staged-move source)
+        resets its history — its row count is no longer append-only."""
+        from presto_tpu.stream.watermarks import watermark_store
+        store = watermark_store(self)
+        t = self.tables.get(name)
+        if t is None:
+            store.forget(name)
+        else:
+            store.record(name, version, t.num_rows)
+
     def connector_id(self, table: str = None) -> str:
         if table is not None and table not in self.tables \
                 and self.fallback is not None:
@@ -105,13 +118,13 @@ class MemoryConnector(SplitSource):
             else:
                 arrays[c] = np.zeros(0, t.dtype)
         self.tables[name] = HostTable(name, 0, arrays, types, dicts)
-        self.bump_table_version(name)
+        self._record_watermark(name, self.bump_table_version(name))
 
     def drop(self, name: str, if_exists: bool = False):
         if name not in self.tables and not if_exists:
             raise KeyError(f"unknown table {name}")
         if self.tables.pop(name, None) is not None:
-            self.bump_table_version(name)
+            self._record_watermark(name, self.bump_table_version(name))
 
     def append_rows(self, name: str, rows: List[tuple]) -> int:
         """Append python rows (strings decoded, decimals as python
@@ -120,7 +133,7 @@ class MemoryConnector(SplitSource):
         with self._write_lock:
             n = self._append_rows_locked(name, rows)
             if n:
-                self.bump_table_version(name)
+                self._record_watermark(name, self.bump_table_version(name))
             return n
 
     def move_table_rows(self, src: str, dst: str) -> int:
@@ -161,9 +174,31 @@ class MemoryConnector(SplitSource):
                     dst, t.num_rows + n_new, new_arrays, t.types,
                     new_dicts, new_nulls)
             self.tables.pop(src, None)
-            self.bump_table_version(src)
-            self.bump_table_version(dst)
+            self._record_watermark(src, self.bump_table_version(src))
+            self._record_watermark(dst, self.bump_table_version(dst))
             return n_new
+
+    def register_row_slice(self, src: str, dst: str, lo: int,
+                           hi: int) -> int:
+        """Register rows [lo, hi) of `src` as a temp table `dst` — a
+        zero-copy array view (dicts shared, arrays sliced) backing the
+        incremental-MV delta scan: the maintenance query runs against
+        `dst` through the ordinary scan path and sees exactly the rows
+        one watermark interval appended. Returns the view's row count;
+        drop `dst` normally when done."""
+        with self._write_lock:
+            if dst in self.tables:
+                raise ValueError(f"table {dst} already exists")
+            s = self.tables[src]
+            lo = max(0, min(int(lo), s.num_rows))
+            hi = max(lo, min(int(hi), s.num_rows))
+            arrays = {c: a[lo:hi] for c, a in s.arrays.items()}
+            nulls = ({c: m[lo:hi] for c, m in s.nulls.items()}
+                     if s.nulls is not None else None)
+            self.tables[dst] = HostTable(dst, hi - lo, arrays, s.types,
+                                         s.dicts, nulls)
+            self._record_watermark(dst, self.bump_table_version(dst))
+            return hi - lo
 
     def _append_rows_locked(self, name: str, rows: List[tuple]) -> int:
         t = self.tables[name]
@@ -195,6 +230,13 @@ class MemoryConnector(SplitSource):
                     ["" if v is None else v for v in vals])
                 union, (remap_old, remap_new) = merge_string_dicts(
                     [t.dicts[c], new_words])
+                if union.words == t.dicts[c].words:
+                    # no new words: keep the OLD dict object — it is
+                    # identity-hashed jit aux data, so swapping in an
+                    # equal copy would invalidate every compiled
+                    # program scanning this table (steady-state ingest
+                    # would recompile per batch)
+                    union = t.dicts[c]
                 old_codes = t.arrays[c][:t.num_rows]
                 old_new = (remap_old[old_codes] if len(remap_old)
                            else old_codes)

@@ -26,6 +26,8 @@ Tables (schemas frozen in README "Introspection"):
   system.runtime.tasks   — fan-out over worker GET /v1/tasks
   system.runtime.nodes   — membership view incl. DRAINING/DEAD workers
   system.runtime.profile — sampling profiler buckets (obs/profiler.py)
+  system.runtime.materialized_views — MV registry: fingerprint,
+      refreshed versions, staleness, pinned state bytes (presto_tpu/mv/)
   system.metrics         — every registry series as rows
 """
 
@@ -49,6 +51,7 @@ QUERIES = "system.runtime.queries"
 TASKS = "system.runtime.tasks"
 NODES = "system.runtime.nodes"
 PROFILE = "system.runtime.profile"
+MATERIALIZED_VIEWS = "system.runtime.materialized_views"
 METRICS = "system.metrics"
 
 SYSTEM_SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
@@ -73,6 +76,13 @@ SYSTEM_SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
     PROFILE: [
         ("role", VARCHAR), ("purpose", VARCHAR), ("query_id", VARCHAR),
         ("stack", VARCHAR), ("samples", BIGINT)],
+    MATERIALIZED_VIEWS: [
+        ("name", VARCHAR), ("fingerprint", VARCHAR),
+        ("tables", VARCHAR), ("incremental_capable", BIGINT),
+        ("last_refresh_kind", VARCHAR),
+        ("last_refresh_duration_s", DOUBLE),
+        ("last_delta_rows", BIGINT), ("staleness_seconds", DOUBLE),
+        ("pinned_bytes", BIGINT), ("refreshes", BIGINT)],
     METRICS: [
         ("name", VARCHAR), ("kind", VARCHAR), ("labels", VARCHAR),
         ("value", DOUBLE)],
@@ -191,6 +201,8 @@ class SystemTablesConnector(SplitSource):
             return self._node_rows()
         if name == PROFILE:
             return self._profile_rows()
+        if name == MATERIALIZED_VIEWS:
+            return self._mv_rows()
         return self._metric_rows()
 
     def _query_rows(self) -> List[tuple]:
@@ -289,6 +301,24 @@ class SystemTablesConnector(SplitSource):
     def _profile_rows(self) -> List[tuple]:
         from presto_tpu.obs.profiler import PROFILER
         return PROFILER.rows()
+
+    def _mv_rows(self) -> List[tuple]:
+        # non-creating read: a cluster with no MV statements yet has no
+        # manager, and introspection must not conjure one
+        mgr = getattr(self._cluster, "_mv_manager", None) \
+            if self._cluster is not None else None
+        if mgr is None:
+            return []
+        rows: List[tuple] = []
+        for s in mgr.stats():
+            rows.append((
+                s["name"], s["fingerprint"],
+                json.dumps(s["tables"], sort_keys=True),
+                int(bool(s["incremental_capable"])),
+                s["last_refresh_kind"], s["last_refresh_duration_s"],
+                s["last_delta_rows"], s["staleness_seconds"],
+                s["pinned_bytes"], s["refreshes"]))
+        return rows
 
     def _metric_rows(self) -> List[tuple]:
         from presto_tpu.obs.metrics import REGISTRY

@@ -139,7 +139,7 @@ class LocalEngine:
             else:
                 text = self.explain_sql(rest)
             return [(line,) for line in text.splitlines()]
-        if head in ("create", "insert", "drop", "delete"):
+        if head in ("create", "insert", "drop", "delete", "refresh"):
             return self._execute_statement(sql)
         if self.session["cte_materialization_enabled"]:
             q = parse_sql(sql)
@@ -225,6 +225,12 @@ class LocalEngine:
 
         stmt = parse_statement(sql)
         conn = self.connector
+        if isinstance(stmt, (A.CreateMaterializedView,
+                             A.RefreshMaterializedView,
+                             A.DropMaterializedView)):
+            # materialized views need no writable catalog (full
+            # recompute reads; delta scans degrade gracefully)
+            return self._execute_mv(stmt)
         writable = hasattr(conn, "create")
         if isinstance(stmt, A.DropTable):
             if not writable:
@@ -341,6 +347,38 @@ class LocalEngine:
             return [(n,)]
 
         raise AnalysisError(f"unsupported statement {type(stmt).__name__}")
+
+    @property
+    def mv_manager(self):
+        """Lazy materialized-view manager (presto_tpu/mv/) — created on
+        first MV statement so query-only engines pay nothing."""
+        if getattr(self, "_mv_manager", None) is None:
+            from presto_tpu.mv.manager import MaterializedViewManager
+            self._mv_manager = MaterializedViewManager(
+                self.connector, run_sql=self.execute_sql)
+        return self._mv_manager
+
+    def _execute_mv(self, stmt) -> List[tuple]:
+        """CREATE/REFRESH/DROP MATERIALIZED VIEW (reference: the
+        *MaterializedView*Task statement handlers); REFRESH returns the
+        base rows scanned, the TableWriter-style count row."""
+        from presto_tpu.mv.manager import MVError
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.analyzer import AnalysisError
+
+        try:
+            if isinstance(stmt, A.CreateMaterializedView):
+                self.mv_manager.create(
+                    stmt.name, stmt.sql,
+                    if_not_exists=stmt.if_not_exists)
+                return [(0,)]
+            if isinstance(stmt, A.RefreshMaterializedView):
+                _kind, n = self.mv_manager.refresh(stmt.name)
+                return [(n,)]
+            self.mv_manager.drop(stmt.name, if_exists=stmt.if_exists)
+            return [(0,)]
+        except MVError as e:
+            raise AnalysisError(str(e)) from e
 
     def explain_analyze_sql(self, sql: str) -> str:
         from presto_tpu.exec.stats import explain_analyze

@@ -1,0 +1,28 @@
+"""Incrementally maintained materialized views.
+
+A materialized view is a *pinned fragment-cache entry*: the defining
+query's semantic plan fingerprint (plan/fingerprint.py) plus the base
+tables' recorded versions address the view's current state in a
+coordinator-owned FragmentResultCache, and the pin exempts it from LRU
+eviction for as long as the view exists. REFRESH plans a delta query
+from the versions recorded at the last refresh — an incremental merge
+for the append-only aggregate class (sum/count/avg/min/max over a
+single table), a bounded full recompute otherwise — and the definition
+plus last-refreshed versions are journaled so views survive coordinator
+restarts (state is rebuilt by the first refresh after recovery).
+
+This package is the ONLY place allowed to call the fragment cache's
+pin/unpin API (enforced by the mv-cache-chokepoint analysis rule).
+
+Reference: Presto's materialized-view support
+(sql/tree/CreateMaterializedView + the metadata-resolved staleness
+check in MaterializedViewDefinition), recast onto the VLDB'23 §4.2
+fragment-result-cache keying that presto_tpu/cache/ already implements:
+a refresh is a cache re-population under a new (plan, versions) key,
+never an in-place mutation, so readers can never observe a torn state.
+"""
+
+from presto_tpu.mv.journal import MVJournal
+from presto_tpu.mv.manager import MaterializedViewManager, MVError
+
+__all__ = ["MVJournal", "MaterializedViewManager", "MVError"]

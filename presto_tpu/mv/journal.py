@@ -1,0 +1,152 @@
+"""Materialized-view definition journal: MV durability across
+coordinator restarts.
+
+Same crash-safety discipline as the coordinator's write-ahead query
+journal (server/journal.py): append-only JSONL with per-record flush,
+later lines for the same view name merging over earlier ones,
+tmp-file + ``os.replace`` compaction, and a corrupt journal moved
+aside to ``<path>.corrupt`` so a torn write can never wedge startup.
+
+What it records is different in kind from the query journal, though:
+not in-flight work to re-queue, but *definitions* — ``{"name", "sql",
+"state", "versions", "last_kind", "last_ts"}`` — because the view's
+materialized state itself lives in a process-local pinned cache entry
+and is intentionally NOT durable. Recovery therefore replays the
+definition and the last-refreshed versions, and the first REFRESH
+after a restart rebuilds state with a full recompute (the recovered
+versions exist for staleness reporting, not for delta proofs — a
+delta against state we no longer hold would be wrong).
+
+A dropped view appends a ``state="dropped"`` tombstone; compaction
+discards tombstones, keeping the journal proportional to live views.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("presto_tpu.mv")
+
+
+class MVJournal:
+    """Append-only, crash-safe materialized-view definition journal."""
+
+    def __init__(self, path: str, compact_threshold: int = 64):
+        self.path = path
+        self.compact_threshold = max(int(compact_threshold), 1)
+        self._lock = threading.Lock()
+        self.appends = 0
+        self.compactions = 0
+        #: True when the on-disk journal failed to parse at load time
+        #: and was moved aside (observability for corruption tests)
+        self.started_fresh = False
+        self.records: Dict[str, dict] = self._load()
+
+    # ------------------------------------------------------------- load
+    def _load(self) -> Dict[str, dict]:
+        if not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path) as f:
+                text = f.read()
+        except OSError:
+            log.warning("mv journal %s unreadable; starting fresh",
+                        self.path, exc_info=True)
+            self.started_fresh = True
+            return {}
+        records: Dict[str, dict] = {}
+        try:
+            for line in text.splitlines():
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                name = rec["name"]
+                merged = dict(records.get(name, {}))
+                merged.update({k: v for k, v in rec.items()
+                               if v is not None})
+                records[name] = merged
+        except (ValueError, KeyError, TypeError):
+            # partial write beyond a clean prefix: preserve the evidence
+            # and start fresh rather than recovering garbage definitions
+            log.warning("mv journal %s corrupt; moving aside and "
+                        "starting fresh", self.path)
+            self.started_fresh = True
+            try:
+                os.replace(self.path, f"{self.path}.corrupt")
+            except OSError:
+                pass
+            return {}
+        return records
+
+    # ----------------------------------------------------------- append
+    def append(self, name: str, sql: Optional[str] = None,
+               state: Optional[str] = None,
+               versions: Optional[Dict[str, int]] = None,
+               last_kind: Optional[str] = None) -> None:
+        """Append one record; None fields inherit from the name's
+        earlier records at merge time."""
+        rec = {"name": name, "sql": sql, "state": state,
+               "versions": versions, "last_kind": last_kind,
+               "last_ts": time.time()}
+        line = json.dumps({k: v for k, v in rec.items()
+                           if v is not None})
+        with self._lock:
+            merged = dict(self.records.get(name, {}))
+            merged.update({k: v for k, v in rec.items()
+                           if v is not None})
+            self.records[name] = merged
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+            except OSError:
+                log.warning("mv journal append failed for %s", name,
+                            exc_info=True)
+                return
+            self.appends += 1
+            if self.appends % self.compact_threshold == 0:
+                self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        live = {n: r for n, r in self.records.items()
+                if r.get("state") != "dropped"}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                for r in live.values():
+                    f.write(json.dumps(r) + "\n")
+            os.replace(tmp, self.path)
+            self.records = live
+            self.compactions += 1
+        except OSError:
+            log.warning("mv journal compaction failed", exc_info=True)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def compact(self) -> None:
+        with self._lock:
+            self._compact_locked()
+
+    # --------------------------------------------------------- recovery
+    def live(self) -> List[dict]:
+        """Definitions to recover, in journal (creation) order."""
+        with self._lock:
+            return [dict(r) for r in self.records.values()
+                    if r.get("state") != "dropped" and r.get("sql")]
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(1 for r in self.records.values()
+                       if r.get("state") != "dropped")
+            return {"path": self.path, "appends": self.appends,
+                    "compactions": self.compactions, "live": live,
+                    "startedFresh": self.started_fresh}

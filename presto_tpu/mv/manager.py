@@ -1,0 +1,690 @@
+"""Materialized-view lifecycle: create / refresh / drop, incremental
+maintenance, pinned state storage.
+
+State model — a view's materialized contents live in a
+coordinator-owned FragmentResultCache as ONE pinned entry whose key
+composes the defining query's semantic plan fingerprint with the base
+tables' versions at the last refresh (the same stale-entries-are-
+unaddressable discipline as cache/result_store.py). A refresh writes a
+NEW key and only then drops the old one, so a reader can never observe
+a torn state; the pin keeps the entry exempt from LRU eviction for the
+life of the view. The payload is pickled into a single ``np.uint8``
+array page so the cache's ``page_bytes`` accounting (which sums device
+``nbytes`` over pytree leaves) stays honest for MV state.
+
+Refresh planning — for the append-only aggregate class (one base
+table; sum/count/min/max/avg, group keys, a filter; no
+join/order/limit/having/distinct/set-ops) the defining query is
+rewritten into an *accumulator* query (avg becomes sum+count), and
+REFRESH scans only the rows the base table's recorded watermarks
+(stream/watermarks.py) prove were appended since the last refreshed
+version — exposed as a version-pinned row slice
+(``register_row_slice``) so a concurrent append can neither be double
+counted nor torn. Anything outside that class, or any break in the
+watermark proof (history reset, shrinking table, recovered-from-
+journal definitions whose state died with the process), falls back to
+a bounded full recompute of the original SQL. Both paths execute
+through the caller-provided ``run_sql`` — the cluster's normal
+statement path — so admission control, task-retry chaos recovery and
+wide-event accounting all apply to refresh work.
+
+Reference: Presto's MaterializedViewDefinition + the
+"too-stale-to-use" freshness check in its metadata layer; the delta
+merge mirrors partial-aggregation state composition
+(INTERMEDIATE -> FINAL step semantics in AggregationNode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pickle
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.config import DEFAULT_MV, MVConfig
+from presto_tpu.mv.journal import MVJournal
+from presto_tpu.mv.unparse import UnsupportedExpr, unparse_expr
+from presto_tpu.obs.metrics import (
+    counter as _counter, gauge as _gauge, histogram as _histogram,
+)
+from presto_tpu.sql import ast as A
+from presto_tpu.stream.watermarks import watermark_store
+from presto_tpu.utils.threads import spawn
+
+log = logging.getLogger("presto_tpu.mv")
+
+_M_REFRESH = _counter(
+    "presto_tpu_mv_refresh_total",
+    "Materialized-view refreshes by kind (incremental | full)",
+    ("kind",))
+_M_REFRESH_S = _histogram(
+    "presto_tpu_mv_refresh_seconds",
+    "Wall time of one materialized-view refresh")
+_M_DELTA = _counter(
+    "presto_tpu_mv_delta_rows_total",
+    "Base-table rows scanned by materialized-view refreshes")
+_M_PINNED = _gauge(
+    "presto_tpu_mv_pinned_bytes",
+    "Bytes of materialized-view state pinned in the fragment cache")
+_M_STALE = _gauge(
+    "presto_tpu_mv_staleness_seconds",
+    "Seconds since a materialized view last matched its base tables",
+    ("view",))
+
+#: admission tenant for refresh work — MV maintenance queues behind
+#: its own concurrency slot instead of competing as anonymous traffic
+MV_REFRESH_GROUP = "mv-refresh"
+MV_REFRESH_SOURCE = "mv-refresh"
+
+#: aggregate functions whose append-only delta merges losslessly
+_MERGEABLE_AGGS = ("sum", "count", "min", "max", "avg")
+
+
+class MVError(ValueError):
+    """User-visible materialized-view failure (unknown view, duplicate
+    name, refresh bound exceeded, state over budget)."""
+
+
+# --------------------------------------------------------------------------
+# incremental eligibility
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _IncrementalPlan:
+    """Accumulator rewrite of an eligible defining query.
+
+    ``item_map`` reassembles display rows from accumulator values in
+    the original projection order: ("key", i) reads group key i,
+    ("acc", j) reads accumulator j verbatim, ("avg", js, jc) divides
+    accumulator js by jc (the avg -> sum+count decomposition)."""
+    table: str
+    alias: Optional[str]
+    key_sqls: Tuple[str, ...]
+    acc_specs: Tuple[Tuple[str, str], ...]    # (func, arg sql | "*")
+    item_map: Tuple[tuple, ...]
+    where_sql: Optional[str]
+
+    def acc_sql(self, table: str) -> str:
+        """The accumulator query against `table` (the base table for a
+        full rebuild, a registered row slice for a delta scan)."""
+        cols = list(self.key_sqls)
+        cols += [f"{f}({a})" for f, a in self.acc_specs]
+        rel = f"{table} {self.alias}" if self.alias else table
+        sql = f"select {', '.join(cols)} from {rel}"
+        if self.where_sql:
+            sql += f" where {self.where_sql}"
+        if self.key_sqls:
+            sql += " group by " + ", ".join(self.key_sqls)
+        return sql
+
+
+def _analyze_incremental(q: A.Select) -> Optional[_IncrementalPlan]:
+    """The accumulator rewrite for `q`, or None when `q` is outside the
+    incrementally maintainable class. Returning None is always safe —
+    the caller falls back to full recompute — so every uncertain case
+    answers None."""
+    if (q.ctes or q.set_ops or q.order_by or q.limit is not None
+            or q.having is not None or q.distinct
+            or q.grouping_sets is not None):
+        return None
+    if len(q.relations) != 1 or not isinstance(q.relations[0], A.TableRef):
+        return None
+    tref = q.relations[0]
+    try:
+        where_sql = (unparse_expr(q.where)
+                     if q.where is not None else None)
+        key_sqls = [unparse_expr(g) for g in q.group_by]
+    except UnsupportedExpr:
+        return None
+
+    acc_specs: List[Tuple[str, str]] = []
+
+    def acc(func: str, arg: str) -> int:
+        spec = (func, arg)
+        if spec not in acc_specs:
+            acc_specs.append(spec)
+        return acc_specs.index(spec)
+
+    item_map: List[tuple] = []
+    for item in q.items:
+        e = item.expr
+        if isinstance(e, A.FuncCall) and not e.distinct \
+                and e.name.lower() in _MERGEABLE_AGGS:
+            fn = e.name.lower()
+            if e.is_star:
+                if fn != "count":
+                    return None
+                item_map.append(("acc", acc("count", "*")))
+                continue
+            if len(e.args) != 1:
+                return None
+            try:
+                arg = unparse_expr(e.args[0])
+            except UnsupportedExpr:
+                return None
+            if fn == "avg":
+                item_map.append(("avg", acc("sum", arg),
+                                 acc("count", arg)))
+            else:
+                item_map.append(("acc", acc(fn, arg)))
+            continue
+        # non-aggregate items must BE a group key (not an expression
+        # over one — merging cannot see through those)
+        try:
+            s = unparse_expr(e)
+        except UnsupportedExpr:
+            return None
+        if s not in key_sqls:
+            return None
+        item_map.append(("key", key_sqls.index(s)))
+    return _IncrementalPlan(
+        table=tref.name, alias=tref.alias, key_sqls=tuple(key_sqls),
+        acc_specs=tuple(acc_specs), item_map=tuple(item_map),
+        where_sql=where_sql)
+
+
+def _merge_val(func: str, a, b):
+    """Combine two accumulator values; None is the empty-input
+    identity for every mergeable aggregate."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if func in ("sum", "count"):
+        return a + b
+    if func == "min":
+        return a if a <= b else b
+    return a if a >= b else b            # max
+
+
+def _acc_state(rows: List[tuple], nkeys: int) -> Dict[tuple, tuple]:
+    return {tuple(r[:nkeys]): tuple(r[nkeys:]) for r in rows}
+
+
+def _merge_state(plan: _IncrementalPlan, base: Dict[tuple, tuple],
+                 delta: Dict[tuple, tuple]) -> Dict[tuple, tuple]:
+    funcs = [f for f, _a in plan.acc_specs]
+    out = dict(base)
+    for key, vals in delta.items():
+        prev = out.get(key)
+        if prev is None:
+            out[key] = vals
+        else:
+            out[key] = tuple(_merge_val(f, p, v)
+                             for f, p, v in zip(funcs, prev, vals))
+    return out
+
+
+def _display_rows(plan: _IncrementalPlan,
+                  state: Dict[tuple, tuple]) -> List[tuple]:
+    """Reassemble result rows from accumulator state in the original
+    projection order, sorted by group key for determinism (the
+    defining query carries no ORDER BY — order is a set property)."""
+    def sort_key(k):
+        return tuple((v is None, str(v)) for v in k)
+
+    rows = []
+    for key in sorted(state, key=sort_key):
+        vals = state[key]
+        row = []
+        for m in plan.item_map:
+            if m[0] == "key":
+                row.append(key[m[1]])
+            elif m[0] == "acc":
+                row.append(vals[m[1]])
+            else:                        # ("avg", sum_idx, count_idx)
+                s, c = vals[m[1]], vals[m[2]]
+                row.append(None if not c or s is None else float(s) / c)
+        rows.append(tuple(row))
+    return rows
+
+
+# --------------------------------------------------------------------------
+# view record
+# --------------------------------------------------------------------------
+
+class MaterializedView:
+    """One registered view. Planning is lazy (`query is None` until
+    `_ensure_planned`) because journal recovery may replay definitions
+    before their base tables exist again."""
+
+    def __init__(self, name: str, sql: str):
+        self.name = name
+        self.sql = sql
+        self.query: Optional[A.Select] = None
+        self.fingerprint: Optional[str] = None
+        self.output_names: Tuple[str, ...] = ()
+        self.tables: Tuple[str, ...] = ()
+        self.inc: Optional[_IncrementalPlan] = None
+        #: base-table versions the current state reflects (None before
+        #: the first refresh; recovered from the journal after restart
+        #: for staleness reporting, but state itself is process-local)
+        self.versions: Optional[Dict[str, int]] = None
+        self.recovered = False
+        self.state_key: Optional[str] = None
+        self.state_bytes = 0
+        self.last_kind: Optional[str] = None
+        self.last_refresh_ts: Optional[float] = None
+        self.last_duration_s = 0.0
+        self.last_delta_rows = 0
+        self.last_staleness_s = 0.0
+        self.refreshes = 0
+        self.created_ts = time.time()
+        #: serializes refreshes of THIS view; held across run_sql, so
+        #: it must never be taken while holding the manager registry
+        #: lock (registry lookups release before refresh work starts)
+        self.lock = threading.Lock()
+
+
+def _collect_tables(obj, tables: set, ctes: set) -> None:
+    if isinstance(obj, A.TableRef):
+        tables.add(obj.name)
+    if isinstance(obj, A.Select):
+        for n, _q in obj.ctes:
+            ctes.add(n)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            _collect_tables(getattr(obj, f.name), tables, ctes)
+    elif isinstance(obj, (tuple, list)):
+        for x in obj:
+            _collect_tables(x, tables, ctes)
+
+
+# --------------------------------------------------------------------------
+# manager
+# --------------------------------------------------------------------------
+
+class MaterializedViewManager:
+    """Owns every view of one coordinator: registry, pinned state
+    cache, refresh tenant, definition journal, background refresher."""
+
+    def __init__(self, connector, run_sql: Callable[[str], List[tuple]],
+                 groups=None, config: MVConfig = DEFAULT_MV,
+                 journal_path: Optional[str] = None):
+        from presto_tpu.cache.result_store import FragmentResultCache
+        from presto_tpu.sql.analyzer import Planner
+
+        self.connector = connector
+        self.run_sql = run_sql
+        self.config = config
+        self.planner = Planner(connector)
+        self.cache = FragmentResultCache(config.state_budget_bytes)
+        self._views: Dict[str, MaterializedView] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._group = None
+        if groups is not None:
+            self._group = groups.ensure_group(
+                MV_REFRESH_GROUP, source_regex=MV_REFRESH_SOURCE,
+                hard_concurrency=1, max_queued=8)
+        self.journal: Optional[MVJournal] = None
+        if journal_path:
+            self.journal = MVJournal(
+                journal_path,
+                compact_threshold=config.journal_compact_threshold)
+            self._recover()
+        self._stop = threading.Event()
+        self._refresher: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ recovery
+    def _recover(self) -> None:
+        """Replay journaled definitions. State died with the previous
+        process, so recovered views answer `rows()` only after their
+        first (necessarily full) refresh."""
+        for rec in self.journal.live():
+            v = MaterializedView(rec["name"], rec["sql"])
+            v.versions = ({str(t): int(n) for t, n
+                           in rec.get("versions", {}).items()}
+                          or None)
+            v.last_kind = rec.get("last_kind")
+            v.last_refresh_ts = rec.get("last_ts")
+            v.recovered = True
+            self._views[v.name] = v
+
+    # ------------------------------------------------------------ planning
+    def _ensure_planned(self, view: MaterializedView) -> None:
+        if view.query is not None:
+            return
+        from presto_tpu.plan.fingerprint import plan_fingerprint
+        from presto_tpu.sql.parser import parse_sql
+
+        q = parse_sql(view.sql)
+        plan = self.planner.plan_query(q)
+        tables: set = set()
+        ctes: set = set()
+        _collect_tables(q, tables, ctes)
+        view.query = q
+        view.fingerprint = plan_fingerprint(plan)
+        view.output_names = tuple(plan.output_names)
+        view.tables = tuple(sorted(tables - ctes))
+        view.inc = _analyze_incremental(q)
+
+    # ----------------------------------------------------------- lifecycle
+    def create(self, name: str, sql: str,
+               if_not_exists: bool = False) -> bool:
+        """Register a view. Plans eagerly (validates the definition);
+        the state materializes on the first REFRESH, matching the
+        reference engine's create/refresh split."""
+        with self._lock:
+            if name in self._views:
+                if if_not_exists:
+                    return False
+                raise MVError(f"materialized view {name} already exists")
+        view = MaterializedView(name, sql)
+        self._ensure_planned(view)
+        with self._lock:
+            if name in self._views:
+                if if_not_exists:
+                    return False
+                raise MVError(f"materialized view {name} already exists")
+            self._views[name] = view
+        if self.journal is not None:
+            self.journal.append(name, sql=sql, state="live")
+        return True
+
+    def drop(self, name: str, if_exists: bool = False) -> bool:
+        with self._lock:
+            view = self._views.pop(name, None)
+        if view is None:
+            if if_exists:
+                return False
+            raise MVError(f"unknown materialized view {name}")
+        if view.state_key is not None:
+            self.cache.unpin(view.state_key, drop=True)
+            _M_PINNED.set(self.cache.pinned_bytes)
+        if self.journal is not None:
+            self.journal.append(name, state="dropped")
+        return True
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._views)
+
+    def _get(self, name: str) -> MaterializedView:
+        with self._lock:
+            view = self._views.get(name)
+        if view is None:
+            raise MVError(f"unknown materialized view {name}")
+        return view
+
+    def rows(self, name: str) -> List[tuple]:
+        """Current contents of the view (as of its last refresh)."""
+        view = self._get(name)
+        state = self._load_state(view)
+        if state is None:
+            raise MVError(
+                f"materialized view {name} has not been refreshed")
+        return list(state["rows"])
+
+    def columns(self, name: str) -> Tuple[str, ...]:
+        view = self._get(name)
+        self._ensure_planned(view)
+        return view.output_names
+
+    # ------------------------------------------------------------- refresh
+    def refresh(self, name: str) -> Tuple[str, int]:
+        """Bring `name` up to date with its base tables. Returns
+        (kind, delta_rows) where kind is "incremental" or "full" and
+        delta_rows is the base rows this refresh scanned."""
+        view = self._get(name)
+        with view.lock:
+            self._ensure_planned(view)
+            t0 = time.monotonic()
+            staleness = self._staleness(view)
+            slot = None
+            if self._group is not None:
+                slot = self._group.acquire(
+                    timeout_s=600, query_id=f"mv-refresh-{name}")
+            try:
+                kind, delta_rows = self._do_refresh(view)
+            finally:
+                if slot is not None:
+                    slot.release()
+            dur = time.monotonic() - t0
+            view.last_kind = kind
+            view.last_delta_rows = delta_rows
+            view.last_duration_s = dur
+            view.last_staleness_s = staleness
+            view.last_refresh_ts = time.time()
+            view.refreshes += 1
+            view.recovered = False
+        _M_REFRESH.inc(kind=kind)
+        _M_REFRESH_S.observe(dur)
+        _M_DELTA.inc(delta_rows)
+        _M_STALE.set(0.0, view=name)
+        if self.journal is not None:
+            self.journal.append(name, versions=view.versions,
+                                last_kind=kind)
+        # handed to the enclosing REFRESH statement's wide event on
+        # this thread (cluster.consume_mv_event) — set LAST, after the
+        # inner delta/full queries have emitted their own events
+        self._tls.event = {"view": name, "kind": kind,
+                           "deltaRows": delta_rows,
+                           "stalenessS": round(staleness, 6),
+                           "durationS": round(dur, 6)}
+        return kind, delta_rows
+
+    def consume_event(self) -> Optional[dict]:
+        """Pop this thread's pending refresh annotation (wide-event
+        `mv` block) — at most once per refresh, per thread, so the
+        exactly-once event contract survives concurrent refreshes."""
+        ev = getattr(self._tls, "event", None)
+        if ev is not None:
+            self._tls.event = None
+        return ev
+
+    def _do_refresh(self, view: MaterializedView) -> Tuple[str, int]:
+        conn = self.connector
+        inc = view.inc
+        if inc is not None and view.versions is not None:
+            v_rec = view.versions.get(inc.table)
+            v_now = conn.table_version(inc.table)
+            state = self._load_state(view)
+            if v_rec == v_now and state is not None:
+                # already current — but only when the state is actually
+                # resident: a journal-recovered view carries versions
+                # for staleness reporting while its state died with the
+                # previous process, and must full-rebuild here
+                return "incremental", 0
+            if (state is not None and state.get("acc") is not None
+                    and v_rec is not None
+                    and hasattr(conn, "register_row_slice")):
+                rng = watermark_store(conn).delta_range(
+                    inc.table, v_rec, v_now)
+                if rng is not None:
+                    lo, hi = rng
+                    delta = self._scan_acc(view, lo, hi)
+                    merged = _merge_state(inc, state["acc"], delta)
+                    self._store_state(
+                        view, _display_rows(inc, merged), merged,
+                        {inc.table: v_now})
+                    return "incremental", hi - lo
+        return self._full_refresh(view)
+
+    def _full_refresh(self, view: MaterializedView) -> Tuple[str, int]:
+        conn = self.connector
+        total = self._base_total(view)
+        if total is not None and total > self.config.max_full_recompute_rows:
+            raise MVError(
+                f"refreshing {view.name} would recompute over {total} "
+                f"rows (> max_full_recompute_rows="
+                f"{self.config.max_full_recompute_rows})")
+        inc = view.inc
+        if inc is not None and hasattr(conn, "register_row_slice"):
+            v_now = conn.table_version(inc.table)
+            hi = watermark_store(conn).total_rows_at(inc.table, v_now)
+            if hi is not None:
+                # version-pinned rebuild: the slice freezes [0, hi) so
+                # rows appended DURING the scan stay outside the state,
+                # keeping the recorded version an exact delta base
+                acc = self._scan_acc(view, 0, hi, kind="full")
+                self._store_state(view, _display_rows(inc, acc), acc,
+                                  {inc.table: v_now})
+                return "full", hi
+        # unpinned recompute: exact snapshot of the live tables, but
+        # with no provable version point — store no accumulator state,
+        # so the next refresh recomputes instead of merging blind
+        versions = {t: conn.table_version(t) for t in view.tables}
+        rows = self.run_sql(view.sql)
+        self._store_state(view, [tuple(r) for r in rows], None, versions)
+        return "full", total if total is not None else len(rows)
+
+    def _scan_acc(self, view: MaterializedView, lo: int,
+                  hi: int, kind: str = "delta") -> Dict[tuple, tuple]:
+        """Run the accumulator query over the version-pinned row slice
+        [lo, hi) of the base table."""
+        inc = view.inc
+        if lo >= hi:
+            return {}
+        # one STABLE temp name per (view, kind) — refresh is serialized
+        # under view.lock, so the maintenance query's SQL text is
+        # identical across refreshes and plan/compile caches hit instead
+        # of re-tracing every scan. Full rebuilds and delta scans get
+        # SEPARATE names: they share a plan otherwise, and the learned
+        # scan capacity from a whole-table rebuild would pad every
+        # later delta scan up to base-table size
+        tmp = f"__mv_{kind}_{view.name}"
+        self.connector.drop(tmp, if_exists=True)
+        self.connector.register_row_slice(inc.table, tmp, lo, hi)
+        try:
+            rows = self.run_sql(inc.acc_sql(tmp))
+        finally:
+            self.connector.drop(tmp, if_exists=True)
+        return _acc_state(rows, len(inc.key_sqls))
+
+    # ------------------------------------------------------ state storage
+    def _state_pages_key(self, view: MaterializedView,
+                         versions: Dict[str, int]) -> str:
+        parts = "".join(f"|{t}@{v}" for t, v in sorted(versions.items()))
+        return f"mv:{view.name}:{view.fingerprint}{parts}"
+
+    def _store_state(self, view: MaterializedView, rows: List[tuple],
+                     acc: Optional[Dict[tuple, tuple]],
+                     versions: Dict[str, int]) -> None:
+        payload = pickle.dumps(
+            {"columns": view.output_names, "rows": rows, "acc": acc},
+            protocol=4)
+        page = np.frombuffer(payload, dtype=np.uint8)
+        key = self._state_pages_key(view, versions)
+        self.cache.pin(key)
+        if not self.cache.put(key, [page]):
+            self.cache.unpin(key)
+            raise MVError(
+                f"materialized view {view.name} state ({page.nbytes} "
+                f"bytes) exceeds the mv state budget "
+                f"({self.config.state_budget_bytes})")
+        old = view.state_key
+        view.state_key = key
+        view.state_bytes = page.nbytes
+        view.versions = dict(versions)
+        if old is not None and old != key:
+            self.cache.unpin(old, drop=True)
+        _M_PINNED.set(self.cache.pinned_bytes)
+
+    def _load_state(self, view: MaterializedView) -> Optional[dict]:
+        if view.state_key is None:
+            return None
+        pages = self.cache.get(view.state_key)
+        if not pages:
+            return None                  # pinned entries never evict;
+        return pickle.loads(bytes(pages[0]))  # None only if dropped
+
+    # -------------------------------------------------------- staleness
+    def _versions_current(self, view: MaterializedView) -> bool:
+        if view.versions is None:
+            return False
+        return all(self.connector.table_version(t) == v
+                   for t, v in view.versions.items())
+
+    def _staleness(self, view: MaterializedView) -> float:
+        """Seconds the view has potentially lagged its base tables: 0
+        while recorded versions match, else time since the last
+        refresh (or creation, before the first one)."""
+        if view.last_refresh_ts is None:
+            return time.time() - view.created_ts
+        if self._versions_current(view) and view.state_key is not None:
+            return 0.0
+        return max(time.time() - view.last_refresh_ts, 0.0)
+
+    def _base_total(self, view: MaterializedView) -> Optional[int]:
+        """Combined base-table row count where known (watermarks, or a
+        memory catalog); None when any base table's size is opaque."""
+        conn = self.connector
+        store = watermark_store(conn)
+        total = 0
+        for t in view.tables:
+            latest = store.latest(t)
+            if latest is not None:
+                total += latest[1]
+                continue
+            tables = getattr(conn, "tables", None)
+            ht = tables.get(t) if isinstance(tables, dict) else None
+            if ht is not None:
+                total += ht.num_rows
+                continue
+            return None
+        return total
+
+    # -------------------------------------------------------- refresher
+    def start_refresher(self) -> None:
+        """Background staleness-driven refresh loop: any view staler
+        than the configured target is refreshed under the mv-refresh
+        admission tenant."""
+        if self._refresher is not None:
+            return
+        self._stop.clear()
+        self._refresher = spawn("mv", "mv-refresher", self._refresh_loop)
+
+    def stop_refresher(self) -> None:
+        self._stop.set()
+        t, self._refresher = self._refresher, None
+        if t is not None:
+            t.join(timeout=10)
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self.config.refresh_tick_s):
+            for name in self.names():
+                if self._stop.is_set():
+                    return
+                try:
+                    view = self._get(name)
+                    if (self._staleness(view)
+                            > self.config.staleness_target_s):
+                        self.refresh(name)
+                except MVError:
+                    continue             # dropped concurrently
+                except Exception:
+                    log.warning("background refresh of %s failed",
+                                name, exc_info=True)
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> List[dict]:
+        """Per-view snapshot (system.runtime.materialized_views)."""
+        out = []
+        for name in self.names():
+            with self._lock:
+                view = self._views.get(name)
+            if view is None:
+                continue
+            staleness = self._staleness(view)
+            _M_STALE.set(round(staleness, 6), view=name)
+            out.append({
+                "name": name,
+                "fingerprint": view.fingerprint,
+                "tables": dict(view.versions or {}) or
+                          {t: None for t in view.tables},
+                "incremental_capable": view.inc is not None,
+                "recovered": view.recovered,
+                "last_refresh_kind": view.last_kind,
+                "last_refresh_duration_s": view.last_duration_s,
+                "last_delta_rows": view.last_delta_rows,
+                "staleness_seconds": staleness,
+                "pinned_bytes": view.state_bytes
+                                if view.state_key is not None else 0,
+                "refreshes": view.refreshes,
+            })
+        return out

@@ -1,0 +1,101 @@
+"""Minimal SQL renderer for the incremental-maintenance rewrite.
+
+The materialized-view manager rewrites an eligible defining query into
+an *accumulator* query (avg(x) becomes sum(x) + count(x), group keys
+and filters pass through) and re-runs that text over a version-pinned
+row slice of the base table. Rendering goes back through SQL text —
+not plan surgery — so the delta scan takes the exact same
+parse->plan->execute path (admission, retries, wide events) as any
+user query.
+
+Only the expression surface the eligibility analyzer admits is
+rendered; anything else raises `UnsupportedExpr`, which the caller
+treats as "not incrementally maintainable" (full recompute fallback) —
+a rendering gap can therefore never produce wrong results, only a
+slower refresh.
+"""
+
+from __future__ import annotations
+
+from presto_tpu.sql import ast as A
+
+
+class UnsupportedExpr(ValueError):
+    """Expression outside the renderable subset."""
+
+
+def _quote(s: str) -> str:
+    return "'" + s.replace("'", "''") + "'"
+
+
+#: the parser normalizes comparison operators to these names
+#: (sql/parser.py comparison()); everything else keeps its SQL spelling
+_COMPARISONS = {"eq": "=", "ne": "<>", "lt": "<", "le": "<=",
+                "gt": ">", "ge": ">="}
+
+
+def unparse_expr(e) -> str:
+    """Render an AST expression back to SQL text."""
+    if isinstance(e, A.Ident):
+        return ".".join(e.parts)
+    if isinstance(e, A.NumberLit):
+        return e.text
+    if isinstance(e, A.DecimalLit):
+        return f"decimal {_quote(e.text)}"
+    if isinstance(e, A.StringLit):
+        return _quote(e.value)
+    if isinstance(e, A.DateLit):
+        return f"date {_quote(e.value)}"
+    if isinstance(e, A.IntervalLit):
+        return f"interval {_quote(e.value)} {e.unit}"
+    if isinstance(e, A.NullLit):
+        return "null"
+    if isinstance(e, A.BoolLit):
+        return "true" if e.value else "false"
+    if isinstance(e, A.Star):
+        return f"{e.qualifier}.*" if e.qualifier else "*"
+    if isinstance(e, A.UnaryOp):
+        if e.op == "not":
+            return f"(not {unparse_expr(e.operand)})"
+        return f"({e.op}{unparse_expr(e.operand)})"
+    if isinstance(e, A.BinaryOp):
+        op = _COMPARISONS.get(e.op, e.op)
+        return (f"({unparse_expr(e.left)} {op} "
+                f"{unparse_expr(e.right)})")
+    if isinstance(e, A.Between):
+        neg = "not " if e.negated else ""
+        return (f"({unparse_expr(e.value)} {neg}between "
+                f"{unparse_expr(e.low)} and {unparse_expr(e.high)})")
+    if isinstance(e, A.InList):
+        neg = "not " if e.negated else ""
+        items = ", ".join(unparse_expr(x) for x in e.items)
+        return f"({unparse_expr(e.value)} {neg}in ({items}))"
+    if isinstance(e, A.Like):
+        neg = "not " if e.negated else ""
+        esc = f" escape {_quote(e.escape)}" if e.escape else ""
+        return (f"({unparse_expr(e.value)} {neg}like "
+                f"{unparse_expr(e.pattern)}{esc})")
+    if isinstance(e, A.IsNull):
+        neg = "not " if e.negated else ""
+        return f"({unparse_expr(e.value)} is {neg}null)"
+    if isinstance(e, A.Case):
+        parts = ["case"]
+        if e.operand is not None:
+            parts.append(unparse_expr(e.operand))
+        for w, t in e.whens:
+            parts.append(f"when {unparse_expr(w)} then {unparse_expr(t)}")
+        if e.default is not None:
+            parts.append(f"else {unparse_expr(e.default)}")
+        parts.append("end")
+        return "(" + " ".join(parts) + ")"
+    if isinstance(e, A.Cast):
+        return f"cast({unparse_expr(e.value)} as {e.type_name})"
+    if isinstance(e, A.Extract):
+        return f"extract({e.part} from {unparse_expr(e.value)})"
+    if isinstance(e, A.FuncCall):
+        if e.is_star:
+            return f"{e.name}(*)"
+        dist = "distinct " if e.distinct else ""
+        args = ", ".join(unparse_expr(a) for a in e.args)
+        return f"{e.name}({dist}{args})"
+    raise UnsupportedExpr(f"cannot render {type(e).__name__}")

@@ -35,7 +35,8 @@ from presto_tpu.utils.tracing import EVENTS, QueryEvent
 log = logging.getLogger("presto_tpu.wide_events")
 
 #: bump on any schema change; fields are append-only, never repurposed
-WIDE_EVENT_VERSION = 1
+#: (v2: added the `mv` block — materialized-view refresh annotation)
+WIDE_EVENT_VERSION = 2
 
 _M_EVENTS = counter("presto_tpu_wide_events_total",
                     "Wide query events emitted", ("state",))
@@ -224,6 +225,13 @@ def build_wide_event(cluster, qid: str, sql: str, *,
                           else None)}
               for fid, acc in sorted(stage_acc.items())]
 
+    # mv block (v2): non-None only for the REFRESH MATERIALIZED VIEW
+    # statement itself. The annotation is handed off per-thread by the
+    # mv manager and consumed here exactly once, so a concurrent
+    # query's event can never steal another refresh's block.
+    consume_mv = getattr(cluster, "consume_mv_event", None)
+    mv = consume_mv() if consume_mv is not None else None
+
     hbo = getattr(cluster, "last_hbo", None) or {}
     membership = dict(cluster.membership_snapshot())
     # one monotone number a dashboard can diff: total membership edges
@@ -250,6 +258,7 @@ def build_wide_event(cluster, qid: str, sql: str, *,
         "spool": getattr(cluster, "last_spool_stats", None),
         "exchange": getattr(cluster, "last_exchange_stats", None),
         "mesh": mesh_delta,
+        "mv": mv,
         "membership": membership,
         "trace_id": trace_id,
         "stages": stages,

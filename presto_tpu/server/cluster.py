@@ -294,7 +294,8 @@ class TpuCluster:
                  shared_secret: Optional[str] = None,
                  transport_config: Optional[TransportConfig] = None,
                  cache_config=None, spool_config=None,
-                 exchange_config=None):
+                 exchange_config=None, mv_config=None,
+                 mv_journal_path: Optional[str] = None):
         import dataclasses as _dc
 
         from presto_tpu.cache import AffinityRouter
@@ -399,6 +400,12 @@ class TpuCluster:
         self._query_counter = 0
         self._lock = threading.Lock()
         self._plans: Dict[str, PlanNode] = {}
+        # materialized views (presto_tpu/mv/): manager is lazy — built
+        # on the first MV statement — so query-only clusters pay
+        # nothing; a journal path makes definitions restart-durable
+        self.mv_config = mv_config
+        self.mv_journal_path = mv_journal_path
+        self._mv_manager = None
         # introspection plane: system tables can now see this cluster;
         # the wide-event JSONL sink registers (a no-op without a
         # configured path) and the sampling profiler starts
@@ -580,6 +587,8 @@ class TpuCluster:
         hb = getattr(self, "_hb_stop", None)
         if hb is not None:
             hb.set()
+        if self._mv_manager is not None:
+            self._mv_manager.stop_refresher()
         for w in self.workers:
             w.stop()
         if self.spool is not None:
@@ -648,7 +657,8 @@ class TpuCluster:
                         else:
                             text = _ex(self.plan_sql(rest))
                         box[0] = [(line,) for line in text.splitlines()]
-                    elif head in ("create", "insert", "drop", "delete"):
+                    elif head in ("create", "insert", "drop",
+                                  "delete", "refresh"):
                         box[0] = self._execute_write(sql)
                     else:
                         box[0] = self._execute_plan(
@@ -661,6 +671,49 @@ class TpuCluster:
         _wide.emit_wide_event(self, qid, sql, rows=box[0], error=None,
                               pre=pre)
         return box[0]
+
+    @property
+    def mv_manager(self):
+        """Lazy materialized-view manager (presto_tpu/mv/). Refresh
+        work executes through this cluster's own execute_sql, so
+        admission, task-retry recovery and wide events all apply."""
+        if self._mv_manager is None:
+            from presto_tpu.config import DEFAULT_MV
+            from presto_tpu.mv.manager import MaterializedViewManager
+            self._mv_manager = MaterializedViewManager(
+                self.connector, run_sql=self.execute_sql,
+                groups=self.resource_groups,
+                config=self.mv_config or DEFAULT_MV,
+                journal_path=self.mv_journal_path)
+        return self._mv_manager
+
+    def consume_mv_event(self) -> Optional[dict]:
+        """Pop the calling thread's pending refresh annotation for the
+        wide-event `mv` block (obs/wide_events.py) — None for queries
+        that did not refresh a materialized view."""
+        mgr = self._mv_manager
+        return mgr.consume_event() if mgr is not None else None
+
+    def _execute_mv(self, stmt) -> List[tuple]:
+        """CREATE/REFRESH/DROP MATERIALIZED VIEW — coordinator-side
+        metadata ops plus (for REFRESH) delta/full queries dispatched
+        through the normal distributed path."""
+        from presto_tpu.mv.manager import MVError
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.analyzer import AnalysisError
+
+        try:
+            if isinstance(stmt, A.CreateMaterializedView):
+                self.mv_manager.create(stmt.name, stmt.sql,
+                                       if_not_exists=stmt.if_not_exists)
+                return [(0,)]
+            if isinstance(stmt, A.RefreshMaterializedView):
+                _kind, n = self.mv_manager.refresh(stmt.name)
+                return [(n,)]
+            self.mv_manager.drop(stmt.name, if_exists=stmt.if_exists)
+            return [(0,)]
+        except MVError as e:
+            raise AnalysisError(str(e)) from e
 
     def _execute_write(self, sql: str) -> List[tuple]:
         """Distributed CTAS / INSERT ... SELECT: the coordinator runs the
@@ -676,6 +729,10 @@ class TpuCluster:
 
         stmt = parse_statement(sql)
         conn = self.connector
+        if isinstance(stmt, (A.CreateMaterializedView,
+                             A.RefreshMaterializedView,
+                             A.DropMaterializedView)):
+            return self._execute_mv(stmt)
         if not hasattr(conn, "create"):
             raise AnalysisError("connector is not writable")
         query = getattr(stmt, "query", None)

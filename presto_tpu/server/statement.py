@@ -38,6 +38,7 @@ _EXECUTING = re.compile(r"^/v1/statement/executing/([^/]+)/(\d+)$")
 _QUEUED = re.compile(r"^/v1/statement/queued/([^/]+)/(\d+)$")
 _CANCEL = re.compile(r"^/v1/statement/executing/([^/]+)$")
 _TRACE = re.compile(r"^/v1/trace/([^/]+)$")
+_INGEST = re.compile(r"^/v1/ingest/([^/]+)/([^/]+)/([^/]+)$")
 
 _M_QUERIES = _counter("presto_tpu_coordinator_queries_total",
                       "Queries submitted to the coordinator, by outcome",
@@ -191,7 +192,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self):
-        if self.path.split("?")[0] != "/v1/statement":
+        path = self.path.split("?")[0]
+        m = _INGEST.match(path)
+        if m:
+            return self._do_ingest(*m.groups())
+        if path != "/v1/statement":
             return self._json(404, {"error": "no route"})
         length = int(self.headers.get("Content-Length", 0))
         sql = self.rfile.read(length).decode()
@@ -219,6 +224,31 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         return self._json(200, q.results_json(self.server.base, 0))
+
+    def _do_ingest(self, catalog: str, schema: str, table: str):
+        """Streaming-append batch: JSON ``{"rows": [[...], ...]}`` in,
+        commit receipt (rows, post-append version, cumulative row
+        count) out. The append itself is admitted through the ingest
+        resource-group tenant inside IngestManager — the HTTP handler
+        neither executes nor schedules anything itself."""
+        from presto_tpu.stream.ingest import IngestError
+
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(self.rfile.read(length).decode() or "{}")
+            rows = body["rows"]
+            if not isinstance(rows, list):
+                raise IngestError("'rows' must be a list of rows")
+        except (ValueError, KeyError) as e:
+            return self._json(400, {"error": f"bad ingest body: {e}"})
+        try:
+            receipt = self.server.coordinator.ingest(
+                catalog, schema, table, rows)
+        except IngestError as e:
+            return self._json(400, {"error": str(e)})
+        except QueryQueueFull as e:
+            return self._json(429, {"error": str(e)})
+        return self._json(200, receipt)
 
     def do_GET(self):
         path = self.path.split("?")[0]
@@ -462,6 +492,15 @@ class StatementServer:
                     self._idempotency.pop(idempotency_key, None)
             raise
         return q
+
+    def ingest(self, catalog: str, schema: str, table: str,
+               rows) -> dict:
+        """POST /v1/ingest/{catalog}/{schema}/{table} backend: one
+        shared IngestManager per engine (lazy; tenant group + counters
+        live there)."""
+        from presto_tpu.stream.ingest import ingest_manager
+        return ingest_manager(self.engine).append(
+            catalog, schema, table, rows)
 
     def _group_path(self, user: str, source: str) -> Optional[str]:
         try:

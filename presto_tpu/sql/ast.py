@@ -303,4 +303,31 @@ class Delete:
     where: Optional["Expr"] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class CreateMaterializedView:
+    """CREATE MATERIALIZED VIEW name AS query — reference:
+    sql/tree/CreateMaterializedView.java; this engine materializes the
+    view as a pinned fragment-cache entry maintained by
+    presto_tpu/mv/."""
+    name: str
+    query: Select
+    sql: str                                  # defining query text
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshMaterializedView:
+    """REFRESH MATERIALIZED VIEW name — reference:
+    sql/tree/RefreshMaterializedView.java; incremental merge over the
+    recorded watermark delta when eligible, bounded full recompute
+    otherwise."""
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DropMaterializedView:
+    name: str
+    if_exists: bool = False
+
+
 Statement = object   # Select | CreateTableAs | CreateTable | Insert | DropTable

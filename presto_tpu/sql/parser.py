@@ -147,6 +147,19 @@ class Parser:
         if word == "create":
             self.next()
             tw = self.next()
+            if tw.text == "materialized":
+                vw = self.next()
+                if vw.text != "view":
+                    raise SyntaxError(f"expected VIEW, got {vw.text!r}")
+                ine = self._if_not_exists()
+                name = self.ident_text()
+                self.expect_kw("as")
+                start = self.peek().pos
+                q = self.query()
+                self.accept("op", ";")
+                self.expect("eof")
+                defining = self.sql[start:].strip().rstrip(";").strip()
+                return ast.CreateMaterializedView(name, q, defining, ine)
             if tw.text != "table":
                 raise SyntaxError(f"expected TABLE, got {tw.text!r}")
             ine = False
@@ -228,6 +241,15 @@ class Parser:
         if word == "drop":
             self.next()
             tw = self.next()
+            if tw.text == "materialized":
+                vw = self.next()
+                if vw.text != "view":
+                    raise SyntaxError(f"expected VIEW, got {vw.text!r}")
+                ife = self._if_exists()
+                name = self.ident_text()
+                self.accept("op", ";")
+                self.expect("eof")
+                return ast.DropMaterializedView(name, ife)
             if tw.text != "table":
                 raise SyntaxError(f"expected TABLE, got {tw.text!r}")
             ife = False
@@ -249,7 +271,40 @@ class Parser:
             self.accept("op", ";")
             self.expect("eof")
             return ast.Delete(name, where)
+        if word == "refresh":
+            self.next()
+            mw = self.next()
+            if mw.text != "materialized":
+                raise SyntaxError(
+                    f"expected MATERIALIZED, got {mw.text!r}")
+            vw = self.next()
+            if vw.text != "view":
+                raise SyntaxError(f"expected VIEW, got {vw.text!r}")
+            name = self.ident_text()
+            self.accept("op", ";")
+            self.expect("eof")
+            return ast.RefreshMaterializedView(name)
         return self.parse()
+
+    def _if_not_exists(self) -> bool:
+        if self.peek().text != "if":
+            return False
+        self.next()
+        if self.next().text != "not":
+            raise SyntaxError("expected NOT")
+        exists_t = self.next()
+        if exists_t.kind != "keyword" or exists_t.text != "exists":
+            raise SyntaxError("expected EXISTS")
+        return True
+
+    def _if_exists(self) -> bool:
+        if self.peek().text != "if":
+            return False
+        self.next()
+        ex = self.next()
+        if ex.kind != "keyword" or ex.text != "exists":
+            raise SyntaxError("expected EXISTS")
+        return True
 
     def query(self) -> ast.Select:
         ctes = []
@@ -883,5 +938,5 @@ def parse_sql(sql: str) -> ast.Select:
 
 def parse_statement(sql: str):
     """Full statement surface: SELECT | CREATE TABLE [AS] | INSERT |
-    DROP TABLE."""
+    DROP TABLE | DELETE | CREATE/DROP/REFRESH MATERIALIZED VIEW."""
     return Parser(sql).parse_statement()

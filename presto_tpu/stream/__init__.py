@@ -1,0 +1,15 @@
+"""Streaming ingest: the continuous-append front half of the
+freshness story (the other half is `presto_tpu/mv/`).
+
+`watermarks` records a per-(table, version) cumulative row count every
+time a connector write bumps `table_version`, so delta consumers can
+turn "versions V1..V2" into an exact row range. `ingest` is the
+admission-scheduled batch-append manager behind
+``POST /v1/ingest/{catalog}/{schema}/{table}``.
+"""
+
+from presto_tpu.stream.ingest import IngestError, IngestManager
+from presto_tpu.stream.watermarks import WatermarkStore, watermark_store
+
+__all__ = ["IngestError", "IngestManager", "WatermarkStore",
+           "watermark_store"]

@@ -1,0 +1,111 @@
+"""Per-version row-count watermarks over connector `table_version`
+bumps.
+
+The connector's monotonic per-table version stream (connectors/base.py)
+says *that* a table changed; it does not say *how much*. The watermark
+store pairs every version with the table's cumulative row count at that
+version, so a consumer holding "I last saw version V1" can ask for the
+exact half-open row range [rows(V1), rows(V2)) that appeared since —
+the delta-read contract incremental MV maintenance stands on.
+
+Reference: the data-freshness half of the Presto@Meta operability story
+(VLDB'23) — version-addressed deltas rather than TTL guesses. Append-
+only history is the soundness condition: any write that *shrinks* a
+table (drop/recreate, DELETE's rewrite, a staged-INSERT move emptying
+the stage) resets that table's history, so `delta_range` answers None
+and the consumer falls back to a full recompute instead of merging a
+bogus delta.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class WatermarkStore:
+    """Thread-safe (table -> [(version, cumulative_rows)]) history.
+
+    Histories are append-only and monotone in BOTH coordinates; a
+    non-monotone record (row count went down, or a version replayed)
+    resets the table's history to the new point — correctness over
+    continuity.
+    """
+
+    #: per-table history cap — ingest streams bump versions forever,
+    #: and only the suffix since the oldest live consumer matters
+    MAX_MARKS_PER_TABLE = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._marks: Dict[str, List[Tuple[int, int]]] = {}
+
+    # ------------------------------------------------------------ writes
+    def record(self, table: str, version: int, total_rows: int) -> None:
+        """Record that `table` reached `total_rows` rows at `version`."""
+        with self._lock:
+            hist = self._marks.setdefault(table, [])
+            if hist and (version <= hist[-1][0]
+                         or total_rows < hist[-1][1]):
+                # shrink or version replay: append-only history broken
+                del hist[:]
+            hist.append((int(version), int(total_rows)))
+            if len(hist) > self.MAX_MARKS_PER_TABLE:
+                del hist[:len(hist) - self.MAX_MARKS_PER_TABLE]
+
+    def forget(self, table: str) -> None:
+        with self._lock:
+            self._marks.pop(table, None)
+
+    # ------------------------------------------------------------- reads
+    def total_rows_at(self, table: str, version: int) -> Optional[int]:
+        """Cumulative row count recorded at exactly `version`; None when
+        that version predates the history (or was reset away)."""
+        with self._lock:
+            for v, rows in reversed(self._marks.get(table, ())):
+                if v == version:
+                    return rows
+                if v < version:
+                    break
+            return None
+
+    def delta_range(self, table: str, since_version: int,
+                    to_version: int) -> Optional[Tuple[int, int]]:
+        """Half-open row range [lo, hi) appended between `since_version`
+        and `to_version`, or None when the history cannot prove the
+        interval was append-only (either endpoint unrecorded, or a reset
+        happened in between)."""
+        if to_version < since_version:
+            return None
+        lo = self.total_rows_at(table, since_version)
+        hi = self.total_rows_at(table, to_version)
+        if lo is None or hi is None or hi < lo:
+            return None
+        return (lo, hi)
+
+    def latest(self, table: str) -> Optional[Tuple[int, int]]:
+        with self._lock:
+            hist = self._marks.get(table)
+            return hist[-1] if hist else None
+
+    def snapshot(self) -> Dict[str, List[Tuple[int, int]]]:
+        with self._lock:
+            return {t: list(h) for t, h in self._marks.items()}
+
+
+def watermark_store(connector) -> WatermarkStore:
+    """The connector's watermark store, created on first use (the lazy
+    `_table_versions` idiom from connectors/base.py). Facades
+    (SystemTablesConnector) are unwrapped first so readers going
+    through the facade and the writable connector recording its own
+    appends always share ONE store."""
+    while hasattr(connector, "delegate"):
+        connector = connector.delegate
+    store = connector.__dict__.get("_watermarks")
+    if store is None:
+        store = WatermarkStore()
+        # benign if two threads race: both stores are empty and the
+        # connector's write lock serializes the recording writes that
+        # follow; last assignment wins before any mark lands
+        connector._watermarks = store
+    return store

@@ -1,0 +1,175 @@
+"""Seeded streaming-ingest driver: appends batches through the
+coordinator's ``POST /v1/ingest/{catalog}/{schema}/{table}`` front
+door while queries (and MV refreshes) run.
+
+Reference: the continuous-ingestion workloads that motivate
+incrementally maintained materialized views — a table that never stops
+growing, with consumers that must see monotone progress. The driver is
+the stream/mv counterpart of testing/churn.py and follows the same
+determinism discipline: every batch size and every generated row draws
+from ``random.Random(f"{seed}:{kind}:{ordinal}")``, so an ingest
+schedule replays exactly from its seed regardless of wall-clock
+interleaving.
+
+The driver doubles as a protocol oracle: every ingest receipt is
+checked against the previous one — the table version must be strictly
+monotone and ``totalRows`` must grow by exactly the batch size — so
+any lost or doubled append surfaces at the driver, not three tests
+later.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+from typing import Callable, List, Optional
+
+from presto_tpu.protocol.transport import (
+    FatalResponseError, HttpClient, TransportError,
+)
+from presto_tpu.utils.threads import spawn
+
+log = logging.getLogger("presto_tpu.stream")
+
+
+class StreamDriver:
+    """Seeded batch-append schedule against a statement server's
+    ingest endpoint.
+
+    ``row_fn(rng, ordinal) -> tuple`` generates one row; it must be a
+    pure function of its arguments (the seeding discipline above).
+    Use synchronously (:meth:`step` between queries) or in the
+    background (:meth:`start` / :meth:`close`) while a workload runs.
+    """
+
+    def __init__(self, base: str, table: str,
+                 row_fn: Callable[[random.Random, int], tuple],
+                 catalog: str = "memory", schema: str = "default",
+                 seed: int = 0, batch_min: int = 1, batch_max: int = 64,
+                 http: Optional[HttpClient] = None):
+        self.base = base.rstrip("/")
+        self.table = table
+        self.catalog = catalog
+        self.schema = schema
+        self.row_fn = row_fn
+        self.seed = int(seed)
+        self.batch_min = max(int(batch_min), 1)
+        self.batch_max = max(int(batch_max), self.batch_min)
+        self.http = http or HttpClient()
+        self.counts = {"batches": 0, "rows": 0, "rejected": 0,
+                       "errors": 0}
+        self.last_receipt: Optional[dict] = None
+        self.events: List[dict] = []
+        self._ordinal = 0
+        self._row_ordinal = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step_lock = threading.Lock()
+
+    # ------------------------------------------------------ determinism
+    def _rng(self, kind: str, ordinal: int) -> random.Random:
+        # same seeding discipline as testing/faults.py and churn.py:
+        # the stream is a pure function of (seed, kind, ordinal)
+        return random.Random(f"{self.seed}:{kind}:{ordinal}")
+
+    # ----------------------------------------------------------- stepping
+    def step(self) -> Optional[dict]:
+        """Send one seeded batch; returns the receipt (None when the
+        front door shed the batch with 429 — admission is allowed to
+        say no, losing rows is not)."""
+        # batch construction and receipt accounting each take the lock
+        # briefly; the POST itself happens outside it (the driver is
+        # single-stepper by design — one sync caller OR one background
+        # thread — so the receipt oracle's total order still holds)
+        with self._step_lock:
+            self._ordinal += 1
+            ordinal = self._ordinal
+            n = self._rng("size", ordinal).randint(self.batch_min,
+                                                   self.batch_max)
+            rows = []
+            for _ in range(n):
+                self._row_ordinal += 1
+                rows.append(list(self.row_fn(
+                    self._rng("row", self._row_ordinal),
+                    self._row_ordinal)))
+        url = (f"{self.base}/v1/ingest/{self.catalog}/"
+               f"{self.schema}/{self.table}")
+        try:
+            resp = self.http.post(
+                url, json.dumps({"rows": rows}).encode(),
+                request_class="control", timeout=30.0)
+            receipt = resp.json()
+        except FatalResponseError as e:
+            with self._step_lock:
+                if e.status == 429:
+                    self.counts["rejected"] += 1
+                    self.events.append({"ordinal": ordinal,
+                                        "shed": True, "rows": n})
+                    return None
+                self.counts["errors"] += 1
+            raise
+        except TransportError:
+            with self._step_lock:
+                self.counts["errors"] += 1
+            raise
+        with self._step_lock:
+            self._check_receipt(receipt, n)
+            self.counts["batches"] += 1
+            self.counts["rows"] += n
+            self.last_receipt = receipt
+            self.events.append({"ordinal": ordinal, "rows": n,
+                                "version": receipt.get("version"),
+                                "totalRows": receipt.get("totalRows")})
+            return receipt
+
+    def _check_receipt(self, receipt: dict, n: int) -> None:
+        """The driver-side append-only oracle: versions strictly
+        monotone, totals growing by exactly the acked batch size."""
+        prev = self.last_receipt
+        if prev is None:
+            return
+        if receipt.get("version") <= prev.get("version"):
+            raise AssertionError(
+                f"table version went {prev.get('version')} -> "
+                f"{receipt.get('version')}: lost bump")
+        # a concurrent writer may interleave, so >= is the floor; with
+        # this driver as sole writer the equality is exact
+        expected = prev.get("totalRows", 0) + n
+        if receipt.get("totalRows", 0) < expected:
+            raise AssertionError(
+                f"totalRows {receipt.get('totalRows')} < {expected}: "
+                f"rows lost")
+
+    # -------------------------------------------------- background mode
+    def start(self, interval_s: float = 0.05) -> "StreamDriver":
+        """Send seeded batches every ``interval_s`` until
+        :meth:`close`."""
+        self._thread = spawn("testing", "stream-driver", self._loop,
+                             args=(interval_s,))
+        return self
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.step()
+            except Exception:
+                # the workload's own asserts are the oracle; a failed
+                # batch must not take the driver thread down
+                log.warning("ingest step failed; continuing",
+                            exc_info=True)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # ----------------------------------------------------------- report
+    def report(self) -> dict:
+        return {"seed": self.seed, "steps": self._ordinal,
+                **self.counts,
+                "lastVersion": (self.last_receipt or {}).get("version"),
+                "lastTotalRows": (self.last_receipt or {}
+                                  ).get("totalRows")}

@@ -51,7 +51,7 @@ def test_rule_catalog_complete():
             "no-jax-in-control-plane",
             "no-spawn-in-request-handler",
             "no-planner-in-data-plane", "membership-chokepoint",
-            "metric-docs-sync"} <= names
+            "metric-docs-sync", "mv-cache-chokepoint"} <= names
 
 
 # ===================================================================
@@ -110,6 +110,25 @@ def test_membership_chokepoint_honesty():
         "presto_tpu/server/cluster.py": "x = 1\n"},
         planted="presto_tpu/server/cluster.py")
     assert fs and "membership chokepoint" in fs[0].message
+
+
+def test_mv_cache_chokepoint_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("mv-cache-chokepoint", {
+        bad: "self.cache.pin(key)\n"}, planted=bad)
+    assert fs and "presto_tpu/mv/" in fs[0].message
+    fs = _findings("mv-cache-chokepoint", {
+        bad: "cache.unpin(key, drop=True)\n"}, planted=bad)
+    assert fs and fs[0].line == 1
+
+
+def test_mv_cache_chokepoint_allowlist_honesty():
+    # mv/manager.py present but no longer pinning => the rule must
+    # report itself vacuous instead of silently passing
+    fs = _findings("mv-cache-chokepoint", {
+        "presto_tpu/mv/manager.py": "x = 1\n"},
+        planted="presto_tpu/mv/manager.py")
+    assert fs and "vacuous" in fs[0].message
 
 
 def test_mesh_chokepoint_fires():
