@@ -871,7 +871,14 @@ def _churn_child() -> None:
     dynamic workers in the background. Emits the correctness ledger
     (rounds, failures, row mismatches vs the quiet baseline run), the
     churn schedule counters, and the coordinator's membership stats as
-    one JSON line."""
+    one JSON line.
+
+    BENCH_CHURN_COORD=1 raises the stakes to full control-plane chaos:
+    a two-coordinator fleet over the same cluster shares one query
+    journal, every query routes through the DBAPI client's rendezvous/
+    failover path against the fleet, and the ChurnDriver's schedule
+    gains seeded coordinator kills (coord_kill) alongside the worker
+    verbs — measuring end-to-end HA, not just worker elasticity."""
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         import jax
@@ -894,18 +901,65 @@ def _churn_child() -> None:
         "where n_regionkey = r_regionkey group by r_name "
         "order by r_name",
     )
+    coord_ha = os.environ.get("BENCH_CHURN_COORD", "0") != "0"
+    chaos_tr = TransportConfig(
+        retry_base_backoff_s=0.01, retry_max_backoff_s=0.2,
+        retry_budget_s=5.0, breaker_failure_threshold=3,
+        breaker_cooldown_s=0.3)
     disc = DiscoveryService("127.0.0.1", expiry_s=2.0).start()
     cluster = TpuCluster(
         TpchConnector(0.01), n_workers=2, discovery=disc,
         session_properties={"retry_policy": "TASK",
                             "query_max_execution_time": "120"},
-        transport_config=TransportConfig(
-            retry_base_backoff_s=0.01, retry_max_backoff_s=0.2,
-            retry_budget_s=5.0, breaker_failure_threshold=3,
-            breaker_cooldown_s=0.3))
+        transport_config=chaos_tr)
+
+    fleet = None
+    journal_dir = None
+    if coord_ha:
+        import tempfile
+
+        import presto_tpu.client as pclient
+        from presto_tpu.protocol import transport as _tr
+        from presto_tpu.testing.fleet import CoordinatorFleet
+
+        # the DBAPI rides the process-global transport client; give it
+        # the same chaos-tuned breaker as the cluster so a revived
+        # coordinator is reachable again on the churn timescale
+        _tr._DEFAULT_CLIENT = _tr.HttpClient(chaos_tr)
+        journal_dir = tempfile.TemporaryDirectory()
+        fleet = CoordinatorFleet(
+            cluster, n=2,
+            journal_path=os.path.join(journal_dir.name,
+                                      "journal.jsonl")).start()
+        conn = pclient.connect(fleet.bases, timeout_s=120)
+
+        def _run(sql):
+            # zero-dropped contract: clean shed / unreachable-window /
+            # queue-full errors are retryable; bounded patience
+            cur = conn.cursor()
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    cur.execute(sql)
+                    return [list(r) for r in cur.fetchall()]
+                except (pclient.OverloadedError,
+                        pclient.OperationalError):
+                    if attempts >= 20:
+                        raise
+                    time.sleep(0.1)
+                except pclient.DatabaseError as e:
+                    if "QUEUE" not in str(e) or attempts >= 20:
+                        raise
+                    time.sleep(0.1)
+    else:
+        def _run(sql):
+            return cluster.execute_sql(sql)
+
     driver = ChurnDriver(cluster, seed=seed, max_dynamic=2,
-                         drain_timeout_s=30.0)
+                         drain_timeout_s=30.0, coordinators=fleet)
     out = {"seed": seed, "rounds": rounds, "queries": len(queries),
+           "coordinator_ha": coord_ha,
            "executed": 0, "failures": 0, "mismatches": 0}
     wall = 0.0
     intro = {}
@@ -913,14 +967,16 @@ def _churn_child() -> None:
         from presto_tpu.obs.profiler import PROFILER
         from presto_tpu.obs.wide_events import LEDGER
         LEDGER.clear()
-        # quiet baseline on the static fleet = the row oracle
-        want = {sql: sorted(cluster.execute_sql(sql)) for sql in queries}
+        # quiet baseline on the static fleet = the row oracle (same
+        # client path as the churn rounds so row representation
+        # matches exactly)
+        want = {sql: sorted(_run(sql)) for sql in queries}
         driver.start(interval_s=0.4)
         t0 = time.perf_counter()
         for _ in range(rounds):
             for sql in queries:
                 try:
-                    got = sorted(cluster.execute_sql(sql))
+                    got = sorted(_run(sql))
                 except Exception:
                     out["failures"] += 1
                     continue
@@ -958,8 +1014,13 @@ def _churn_child() -> None:
             "overhead": round(PROFILER.overhead_fraction(), 5)}
     finally:
         driver.close()
+        if fleet is not None:
+            out["ha"] = fleet.snapshot()
+            fleet.close()
         cluster.stop()
         disc.stop()
+        if journal_dir is not None:
+            journal_dir.cleanup()
     out["wall_s"] = round(wall, 3)
     out["queries_per_sec"] = (round(out["executed"] / wall, 2)
                               if wall > 0 else 0.0)

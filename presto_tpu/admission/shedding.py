@@ -53,6 +53,11 @@ class LoadShedder:
         self.groups = groups
         self.memory_pool = memory_pool
         self._recent_waits = recent_waits or (lambda: ())
+        #: multi-coordinator HA: when the statement server gossips
+        #: admission state with peers, this returns the PEER-reported
+        #: queued total so the queue-depth signal sheds on the
+        #: cluster-wide backlog, not this coordinator's slice
+        self.cluster_queued: Optional[Callable[[], int]] = None
         self.shed_counts = {"queue_depth": 0, "heap": 0,
                             "queue_wait": 0}
 
@@ -67,9 +72,18 @@ class LoadShedder:
         threshold; otherwise return quietly."""
         cfg = self.config
         queued = self.groups.total_queued()
-        if queued >= cfg.shed_max_queued:
-            self._trip("queue_depth",
-                       f"{queued} queued >= {cfg.shed_max_queued}")
+        peer_queued = 0
+        if self.cluster_queued is not None:
+            try:
+                peer_queued = int(self.cluster_queued() or 0)
+            except Exception:   # noqa: BLE001 — stale gossip never
+                peer_queued = 0  # blocks a local admission decision
+        if queued + peer_queued >= cfg.shed_max_queued:
+            detail = (f"{queued + peer_queued} queued ({queued} local "
+                      f"+ {peer_queued} peer) >= {cfg.shed_max_queued}"
+                      if peer_queued
+                      else f"{queued} queued >= {cfg.shed_max_queued}")
+            self._trip("queue_depth", detail)
         pool = self.memory_pool
         if pool is not None and pool.budget > 0:
             frac = pool.reserved / pool.budget

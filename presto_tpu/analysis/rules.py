@@ -715,6 +715,49 @@ class MembershipChokepointRule(Rule):
 register(MembershipChokepointRule())
 
 # =====================================================================
+# 12b. journal-chokepoint — QueryJournal is the only coordinator
+#      query-state persistence path
+# =====================================================================
+
+#: a bare JSONL-style append (json.dumps into .write, or a manual
+#: line + "\n" write) — coordinator query state persisted outside the
+#: QueryJournal would be invisible to crash recovery AND to peer
+#: coordinators adopting queries from the shared journal
+_JOURNAL_JSONL = re.compile(
+    r"\.write\s*\(\s*(?:json\s*\.\s*dumps|[\w.]+\s*\+\s*[\"']\\n[\"'])")
+
+_JOURNAL = "presto_tpu/server/journal.py"
+
+
+class JournalChokepointRule(Rule):
+    name = "journal-chokepoint"
+    description = (
+        "all coordinator query-state persistence in presto_tpu/server/ "
+        "flows through QueryJournal — a bare JSONL write elsewhere "
+        "creates a second durability log that crash recovery and "
+        "multi-coordinator adoption never read (the HA split-brain "
+        "hazard)")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_JOURNAL_JSONL,),
+            "JSONL-style write outside QueryJournal — append through "
+            "the journal (server/journal.py) so recovery and peer "
+            "adoption see it",
+            allowed=(_JOURNAL,),
+            prefixes=("presto_tpu/server/",))
+        # honesty: the journal itself must still persist via the idiom
+        # this rule polices — an allowlist pointing at a file that no
+        # longer writes JSONL is a stale exemption
+        out.extend(honesty_finding(
+            self, pkg, _JOURNAL, (_JOURNAL_JSONL,),
+            "the query-journal chokepoint"))
+        return out
+
+
+register(JournalChokepointRule())
+
+# =====================================================================
 # 13. metric-docs-sync — the README metric catalog and the registered
 #     metric set agree in both directions
 # =====================================================================

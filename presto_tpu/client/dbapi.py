@@ -15,19 +15,36 @@ Usage:
     cur = conn.cursor()
     cur.execute("select l_returnflag, count(*) from lineitem group by 1")
     cur.fetchall()
+
+Multi-coordinator HA: ``connect()`` also accepts a LIST of coordinator
+base URIs. Sessions spread over the fleet by rendezvous (highest
+random weight) hash of a per-connection session key — the same
+affinity idiom as the result cache's AffinityRouter — and fail over
+automatically: a dead coordinator is skipped on POST, and a mid-query
+``nextUri`` that stops answering is re-resolved against a surviving
+peer, which adopts the journaled query under its ORIGINAL qid.
 """
 
 from __future__ import annotations
 
 import decimal
+import hashlib
 import json
 import time
 import uuid
 from typing import Any, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit, urlunsplit
+
+from presto_tpu.obs.metrics import counter as _counter
 
 apilevel = "2.0"
 threadsafety = 2           # threads may share the module and connections
 paramstyle = "qmark"       # execute("... where x = ?", [v])
+
+_M_FAILOVERS = _counter(
+    "presto_tpu_client_failovers_total",
+    "DBAPI connections that switched to a surviving peer coordinator "
+    "after their routed coordinator stopped answering")
 
 
 class Error(Exception):
@@ -56,21 +73,56 @@ class OverloadedError(OperationalError):
         self.retry_after_s = retry_after_s
 
 
-def connect(base_uri: str, timeout_s: float = 600.0,
+def _rendezvous_order(bases: Sequence[str], key: str) -> List[str]:
+    """Highest-random-weight ordering of coordinator URIs for one
+    session key: every client computes the same preference list for
+    the same key, spreading sessions over the fleet without shared
+    state, and the remaining order IS the failover order."""
+    return sorted(bases,
+                  key=lambda u: hashlib.sha1(
+                      f"{key}:{u}".encode()).hexdigest(),
+                  reverse=True)
+
+
+def connect(base_uri, timeout_s: float = 600.0,
             user: str = "") -> "Connection":
     """Open a connection to a statement server
-    (server/statement.StatementServer.base).  ``user`` rides the
+    (server/statement.StatementServer.base), or to a FLEET of peer
+    coordinators when ``base_uri`` is a sequence of base URIs (session
+    routed by rendezvous hash, automatic failover).  ``user`` rides the
     X-Presto-User header — the coordinator's resource-group selectors
     key tenant admission on it."""
     return Connection(base_uri, timeout_s, user=user)
 
 
 class Connection:
-    def __init__(self, base_uri: str, timeout_s: float, user: str = ""):
-        self.base = base_uri.rstrip("/")
+    def __init__(self, base_uri, timeout_s: float, user: str = ""):
+        uris = ([base_uri] if isinstance(base_uri, str)
+                else list(base_uri))
+        if not uris:
+            raise InterfaceError("no coordinator URIs given")
+        #: per-connection rendezvous key — distinct connections hash to
+        #: distinct preferred coordinators, one connection is sticky
+        self.session_key = uuid.uuid4().hex
+        self.bases = _rendezvous_order(
+            [u.rstrip("/") for u in uris], self.session_key)
+        self.base = self.bases[0]
+        self.failovers = 0
         self.timeout_s = timeout_s
         self.user = user
         self.closed = False
+
+    def _promote(self, base: str) -> None:
+        """Make ``base`` the preferred coordinator (a successful
+        request landed there). Counts as a failover only when it
+        displaces a different head."""
+        if self.bases and self.bases[0] == base:
+            self.base = base
+            return
+        self.bases = [base] + [b for b in self.bases if b != base]
+        self.base = base
+        self.failovers += 1
+        _M_FAILOVERS.inc()
 
     def cursor(self) -> "Cursor":
         if self.closed:
@@ -135,11 +187,19 @@ def _literal(v: Any) -> str:
 
 class Cursor:
     arraysize = 1
+    #: bounded full-walk retries against a FLEET: one walk can find
+    #: every peer momentarily unreachable (one freshly killed, another
+    #: revived but still behind its circuit breaker's cooldown); a
+    #: short pause and a re-walk rides out that window. Single-base
+    #: connections keep their one-walk fail-fast semantics.
+    _WALK_RETRIES = 3
+    _WALK_PAUSE_S = 0.25
 
     def __init__(self, conn: Connection):
         self._conn = conn
         self.description: Optional[List[tuple]] = None
         self.rowcount = -1
+        self.query_id: Optional[str] = None
         self._rows: List[tuple] = []
         self._pos = 0
         self.closed = False
@@ -152,6 +212,7 @@ class Cursor:
         if params:
             sql = _substitute(sql, list(params))
         payload = self._post(sql)
+        self.query_id = payload.get("id")
         columns, rows = None, []
         deadline = time.time() + self._conn.timeout_s
         while True:
@@ -165,7 +226,13 @@ class Cursor:
                 break
             if time.time() > deadline:
                 raise OperationalError("query timed out")
-            payload = self._get(nxt)
+            try:
+                payload = self._get(nxt)
+            except OperationalError as e:
+                # mid-query coordinator death: re-resolve the SAME
+                # nextUri path against surviving peers; the one that
+                # answers adopts the journaled query under this qid
+                payload = self._refetch(nxt, e)
         self.description = [
             (c["name"], c["type"], None, None, None, None, None)
             for c in (columns or [])]
@@ -221,20 +288,47 @@ class Cursor:
                    "X-Presto-Idempotency-Key": uuid.uuid4().hex}
         if self._conn.user:
             headers["X-Presto-User"] = self._conn.user
-        try:
-            # per-execute idempotency key: the transport auto-retries
-            # the POST, and the server dedupes on the key so a retry
-            # after a lost response attaches to the in-flight query
-            # instead of re-executing (INSERT/CTAS must not duplicate)
-            return get_client().post(
-                f"{self._conn.base}/v1/statement", sql.encode(),
-                headers=headers,
-                request_class="statement").json()
-        except ServerOverloadedError as e:
-            raise OverloadedError(
-                str(e), retry_after_s=e.retry_after_s) from e
-        except OSError as e:
-            raise OperationalError(str(e)) from e
+        # walk the rendezvous preference order: a dead or draining
+        # coordinator is skipped and the next peer tried; the peer
+        # that accepts becomes the session's preferred head. Re-walking
+        # is idempotent-safe: failover happens only when NO server
+        # accepted the request, and the idempotency key is stable
+        # across walks.
+        last: Optional[Error] = None
+        walks = (self._WALK_RETRIES
+                 if len(self._conn.bases) > 1 else 1)
+        for walk in range(walks):
+            if walk:
+                time.sleep(self._WALK_PAUSE_S)
+            for base in list(self._conn.bases):
+                try:
+                    # per-execute idempotency key: the transport auto-
+                    # retries the POST, and the server dedupes on the
+                    # key so a retry after a lost response attaches to
+                    # the in-flight query instead of re-executing
+                    # (INSERT/CTAS must not duplicate). NOTE the dedup
+                    # cache is per coordinator — failover happens only
+                    # on transport errors (no accepted response),
+                    # never after one
+                    payload = get_client().post(
+                        f"{base}/v1/statement", sql.encode(),
+                        headers=headers,
+                        request_class="statement").json()
+                except ServerOverloadedError as e:
+                    last = OverloadedError(
+                        str(e), retry_after_s=e.retry_after_s)
+                    last.__cause__ = e
+                    continue
+                except OSError as e:
+                    last = OperationalError(str(e))
+                    last.__cause__ = e
+                    continue
+                self._conn._promote(base)
+                return payload
+            if isinstance(last, OverloadedError):
+                break   # the fleet is shedding, not down — surface it
+        assert last is not None
+        raise last
 
     def _get(self, uri: str) -> dict:
         from presto_tpu.protocol.transport import (ServerOverloadedError,
@@ -246,6 +340,35 @@ class Cursor:
                 str(e), retry_after_s=e.retry_after_s) from e
         except OSError as e:
             raise OperationalError(str(e)) from e
+
+    def _refetch(self, uri: str, err: OperationalError) -> dict:
+        """Failover for a mid-query nextUri whose coordinator died:
+        keep the path (it encodes qid + batch token) and swap in each
+        surviving peer's authority in preference order. The peer
+        adopts the journaled query under the original qid and serves
+        the poll; if nobody answers, the original error stands."""
+        parts = urlsplit(uri)
+        for walk in range(self._WALK_RETRIES):
+            if walk:
+                time.sleep(self._WALK_PAUSE_S)
+            tried = 0
+            for base in list(self._conn.bases):
+                bparts = urlsplit(base)
+                if (bparts.scheme, bparts.netloc) == (parts.scheme,
+                                                      parts.netloc):
+                    continue    # the coordinator that just failed
+                alt = urlunsplit((bparts.scheme, bparts.netloc,
+                                  parts.path, parts.query, ""))
+                tried += 1
+                try:
+                    payload = self._get(alt)
+                except (OverloadedError, OperationalError):
+                    continue
+                self._conn._promote(base)
+                return payload
+            if not tried:
+                break       # no surviving peers to re-resolve against
+        raise err
 
 
 def _decode(v: Any, type_name: str):

@@ -394,6 +394,12 @@ class ElasticConfig:
     #: how long a coordinator restart keeps absorbing journaled RUNNING
     #: queries before declaring them failed (0 = re-run immediately)
     recover_grace_s: float = 0.0
+    #: crash-recovery re-queue cap: a journaled query that has already
+    #: been re-queued this many times by coordinator restarts is
+    #: abandoned with a terminal FAILED record instead of re-running —
+    #: under repeated coordinator crashes an unbounded recovery storm
+    #: would otherwise clog admission with orphaned re-executions
+    recover_max_requeues: int = 3
 
 
 #: process defaults — journaling off: tests opt in with a tmp path

@@ -72,7 +72,9 @@ SYSTEM_SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("uri", VARCHAR), ("node_id", VARCHAR), ("state", VARCHAR),
         ("uptime_s", DOUBLE), ("task_count", BIGINT),
         ("tasks_created", BIGINT), ("drain_seconds", DOUBLE),
-        ("drain_rejected", BIGINT), ("announce_age_s", DOUBLE)],
+        ("drain_rejected", BIGINT), ("announce_age_s", DOUBLE),
+        ("role", VARCHAR), ("queries_owned", BIGINT),
+        ("journal_lag_s", DOUBLE)],
     PROFILE: [
         ("role", VARCHAR), ("purpose", VARCHAR), ("query_id", VARCHAR),
         ("stack", VARCHAR), ("samples", BIGINT)],
@@ -295,7 +297,33 @@ class SystemTablesConnector(SplitSource):
                     state = "DEAD"
             age = (now - announce[uri]) if uri in announce else None
             rows.append((uri, node_id, state, uptime, tasks, created,
-                         drain_s, rejected, age))
+                         drain_s, rejected, age, "worker", None, None))
+        # coordinator rows (multi-coordinator HA): every statement
+        # frontend over this engine registers in statement_frontends;
+        # a fleet revive replaces the instance, so dedupe by base with
+        # the LATEST registration winning
+        fronts: Dict[str, object] = {}
+        for f in getattr(cl, "statement_frontends", None) or []:
+            fronts[f.base] = f
+        for base, f in sorted(fronts.items()):
+            state = "ACTIVE"
+            uptime = lag = None
+            owned = len(getattr(f, "queries", {}) or {})
+            try:
+                st = cl.http.get_json(f"{base}/v1/status",
+                                      request_class="control",
+                                      timeout=5.0)
+                uptime = st.get("uptimeSeconds")
+                owned = st.get("queryCount", owned)
+                j = st.get("journal") or {}
+                lag = j.get("lastAppendAgeS")
+                if (st.get("ha") or {}).get("draining"):
+                    state = "DRAINING"
+            except Exception:   # noqa: BLE001 — probe verdict: unreachable
+                state = "DEAD"
+            rows.append((base, f.coordinator_id, state, uptime, None,
+                         None, None, None, None, "coordinator", owned,
+                         lag))
         return rows
 
     def _profile_rows(self) -> List[tuple]:

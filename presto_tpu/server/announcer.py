@@ -3,7 +3,12 @@
 Reference: presto_cpp/main/Announcer.cpp:64 — the worker announces itself
 to the coordinator's embedded discovery service with its services payload;
 DiscoveryNodeManager (presto-main/.../metadata/DiscoveryNodeManager.java:88)
-turns announcements into the active worker set."""
+turns announcements into the active worker set.
+
+Multi-coordinator HA: ``coordinator_uri`` may be a single URI or a
+sequence of peer coordinator URIs — every announcement round PUTs to
+ALL of them so membership converges on every peer (an unreachable peer
+is skipped that round; its view catches up on the next one)."""
 
 from __future__ import annotations
 
@@ -18,11 +23,15 @@ log = logging.getLogger("presto_tpu.announcer")
 
 
 class Announcer:
-    def __init__(self, coordinator_uri: str, self_uri: str, node_id: str,
+    def __init__(self, coordinator_uri, self_uri: str, node_id: str,
                  environment: str = "tpu", interval_s: float = 5.0,
                  connector_ids: str = "tpch,tpcds,memory,parquet",
                  client: HttpClient = None):
-        self.coordinator_uri = coordinator_uri.rstrip("/")
+        uris = ([coordinator_uri] if isinstance(coordinator_uri, str)
+                else list(coordinator_uri))
+        self.coordinator_uris = [u.rstrip("/") for u in uris]
+        # single-URI compat alias (existing callers/tests read this)
+        self.coordinator_uri = self.coordinator_uris[0]
         self.client = client or get_client()
         self.self_uri = self_uri
         self.node_id = node_id
@@ -53,18 +62,23 @@ class Announcer:
         }
 
     def announce_once(self) -> bool:
-        url = f"{self.coordinator_uri}/v1/announcement/{self.node_id}"
+        """One announcement round: PUT to every coordinator peer.
+        True when at least one accepted (membership can converge);
+        per-peer failures are recorded and retried next round."""
         body = json.dumps(self.payload()).encode()
-        try:
-            self.client.request(
-                url, method="PUT", body=body,
-                headers={"Content-Type": "application/json"},
-                request_class="announce")
-            self.announcements += 1
-            return True
-        except Exception as e:               # noqa: BLE001 — keep retrying
-            self.last_error = str(e)
-            return False
+        ok = False
+        for uri in self.coordinator_uris:
+            url = f"{uri}/v1/announcement/{self.node_id}"
+            try:
+                self.client.request(
+                    url, method="PUT", body=body,
+                    headers={"Content-Type": "application/json"},
+                    request_class="announce")
+                self.announcements += 1
+                ok = True
+            except Exception as e:           # noqa: BLE001 — keep retrying
+                self.last_error = str(e)
+        return ok
 
     def _loop(self):
         while not self._stop.is_set():
@@ -77,15 +91,18 @@ class Announcer:
     def retract(self) -> bool:
         """Best-effort final DELETE /v1/announcement/{nodeId}: the
         coordinator learns of departure immediately instead of waiting
-        out announcement staleness (DiscoveryNodeManager's expiry)."""
-        url = f"{self.coordinator_uri}/v1/announcement/{self.node_id}"
-        try:
-            self.client.request(url, method="DELETE",
-                                request_class="announce")
-            return True
-        except Exception as e:   # noqa: BLE001 — departure is advisory
-            self.last_error = str(e)
-            return False
+        out announcement staleness (DiscoveryNodeManager's expiry).
+        DELETEs from every peer; True when all acknowledged."""
+        ok = True
+        for uri in self.coordinator_uris:
+            url = f"{uri}/v1/announcement/{self.node_id}"
+            try:
+                self.client.request(url, method="DELETE",
+                                    request_class="announce")
+            except Exception as e:  # noqa: BLE001 — departure is advisory
+                self.last_error = str(e)
+                ok = False
+        return ok
 
     def start(self):
         self._thread.start()

@@ -1,0 +1,132 @@
+"""Multi-coordinator HA: the peer admission gossip (resource-manager
+view).
+
+Reference: the disaggregated coordinator of Presto's L1 split
+(QueuedStatementResource dispatchers in front of a ResourceManager
+holding cluster-wide admission state) — here collapsed to symmetric
+peers: every coordinator serves ``GET /v1/ha/admission`` with its own
+stride-WFQ totals (admission/groups.py already exposes per-group
+running/queued), and every coordinator polls its peers on the
+heartbeat/announce path. The folded view makes the LoadShedder's
+queue-depth signal act on CLUSTER totals instead of this
+coordinator's slice.
+
+Failure handling is purely freshness-based, the same passive discipline
+as announcement expiry in discovery.py: an unreachable peer simply ages
+out of the view; coordinator death needs no extra failure detector.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Sequence
+
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+from presto_tpu.utils.threads import spawn
+
+log = logging.getLogger("presto_tpu.ha")
+
+_M_GOSSIP_ROUNDS = _counter(
+    "presto_tpu_coordinator_ha_gossip_rounds_total",
+    "Completed admission-gossip polling rounds against peer "
+    "coordinators")
+_M_PEER_QUEUED = _gauge(
+    "presto_tpu_coordinator_ha_peer_queued",
+    "Queued statements reported by fresh peer coordinators (summed; "
+    "the remote half of the cluster-wide shed signal)")
+
+
+class AdmissionGossip:
+    """Background exchange of per-coordinator admission totals.
+
+    One instance per ``StatementServer`` with peers configured; the
+    loop pulls every peer's ``/v1/ha/admission`` on ``interval_s`` and
+    keeps a freshness-bounded view.  ``cluster_queued()`` is wired into
+    the LoadShedder so shedding/quotas see the cluster-wide backlog.
+    """
+
+    def __init__(self, coordinator_id: str, groups,
+                 peers: Sequence[str], interval_s: float = 0.5,
+                 freshness_s: float = 5.0, client=None):
+        from presto_tpu.protocol.transport import get_client
+        self.coordinator_id = coordinator_id
+        self.groups = groups
+        self.peers = [p.rstrip("/") for p in peers]
+        self.interval_s = interval_s
+        self.freshness_s = freshness_s
+        self.client = client or get_client()
+        self.rounds = 0
+        self._view: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._started = False
+        self._thread = spawn("coordinator", "ha-gossip", self._loop,
+                             start=False)
+
+    # ------------------------------------------------------------ rounds
+    def poll_once(self) -> int:
+        """One gossip round; returns how many peers answered. Errors
+        are absorbed — a dead peer's entry just goes stale."""
+        ok = 0
+        for peer in self.peers:
+            try:
+                doc = self.client.get_json(f"{peer}/v1/ha/admission",
+                                           request_class="announce",
+                                           timeout=2.0)
+            except Exception:   # noqa: BLE001 — dead peers age out
+                continue
+            cid = doc.get("coordinatorId") or peer
+            with self._lock:
+                self._view[cid] = {
+                    "uri": peer,
+                    "queued": int(doc.get("queued") or 0),
+                    "running": int(doc.get("running") or 0),
+                    "draining": bool(doc.get("draining")),
+                    "ts": time.time()}
+            ok += 1
+        self.rounds += 1
+        _M_GOSSIP_ROUNDS.inc()
+        _M_PEER_QUEUED.set(self.peer_queued())
+        return ok
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:   # noqa: BLE001 — the loop must survive
+                log.warning("gossip round failed; continuing",
+                            exc_info=True)
+
+    # -------------------------------------------------------------- view
+    def _fresh(self) -> Dict[str, dict]:
+        now = time.time()
+        with self._lock:
+            return {cid: dict(v) for cid, v in self._view.items()
+                    if now - v["ts"] <= self.freshness_s
+                    and cid != self.coordinator_id}
+
+    def peer_queued(self) -> int:
+        return sum(v["queued"] for v in self._fresh().values())
+
+    def peer_running(self) -> int:
+        return sum(v["running"] for v in self._fresh().values())
+
+    def cluster_queued(self) -> int:
+        """The REMOTE queued total; the LoadShedder adds its own local
+        count, making the queue-depth shed signal cluster-wide."""
+        return self.peer_queued()
+
+    def snapshot(self) -> dict:
+        return {"rounds": self.rounds, "peers": self._fresh()}
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "AdmissionGossip":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

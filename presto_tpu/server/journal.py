@@ -13,14 +13,25 @@ re-queue them through the admission front door; under
 committed instead of redoing that work.
 
 Format: append-only JSONL — one ``{"qid", "sql", "user", "source",
-"state", "ts"}`` object per line; later lines for the same qid merge
-over earlier ones (state transitions append, never rewrite). Appends
-are flushed per record; compaction rewrites the file atomically with
-the same tmp-file + ``os.replace`` discipline as
+"state", "owner", "ts"}`` object per line; later lines for the same
+qid merge over earlier ones (state transitions append, never rewrite).
+Appends are flushed per record; compaction rewrites the file
+atomically with the same tmp-file + ``os.replace`` discipline as
 ``plan/stats.HistoryStore.save`` and drops terminal (FINISHED/FAILED)
 queries. A journal that fails to parse is moved aside to
 ``<path>.corrupt`` and the coordinator starts fresh — a torn journal
-must never wedge startup."""
+must never wedge startup.
+
+Multi-coordinator HA shares ONE journal file between N peer
+coordinators: each record carries the ``owner`` coordinator id, a
+restart only re-queues its own records (:meth:`pending` +
+owner-filtering in ``StatementServer.recover``), and a surviving peer
+adopting a dead owner's query first calls :meth:`refresh` to fold the
+peers' appends — which its in-memory view never saw — back in from
+disk. Appends are single flushed ``write`` calls of one line, so
+interleaved appenders produce a valid JSONL merge; compaction folds
+the disk state in first so a peer's records are never dropped by a
+rewrite."""
 
 from __future__ import annotations
 
@@ -60,6 +71,9 @@ class QueryJournal:
         #: True when the on-disk journal failed to parse at load time
         #: and was moved aside (observability for the corruption tests)
         self.started_fresh = False
+        #: wall-clock of the last successful append — journal lag for
+        #: the HA coordinator rows in system.runtime.nodes
+        self.last_append_ts: Optional[float] = None
         self.records: Dict[str, dict] = self._load()
 
     # ------------------------------------------------------------- load
@@ -74,17 +88,8 @@ class QueryJournal:
                         self.path, exc_info=True)
             self.started_fresh = True
             return {}
-        records: Dict[str, dict] = {}
         try:
-            for line in text.splitlines():
-                if not line.strip():
-                    continue
-                rec = json.loads(line)
-                qid = rec["qid"]
-                merged = dict(records.get(qid, {}))
-                merged.update({k: v for k, v in rec.items()
-                               if v is not None})
-                records[qid] = merged
+            return self._parse(text)
         except (ValueError, KeyError, TypeError):
             # corruption / partial write beyond a clean prefix: the
             # journal is not trustworthy — preserve the evidence and
@@ -97,19 +102,68 @@ class QueryJournal:
             except OSError:
                 pass
             return {}
+
+    @staticmethod
+    def _parse(text: str) -> Dict[str, dict]:
+        """JSONL lines -> per-qid merged records; raises on any
+        unparsable line (callers decide: move aside vs keep memory)."""
+        records: Dict[str, dict] = {}
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            qid = rec["qid"]
+            merged = dict(records.get(qid, {}))
+            merged.update({k: v for k, v in rec.items()
+                           if v is not None})
+            records[qid] = merged
         return records
+
+    def refresh(self) -> None:
+        """Fold records appended by PEER coordinators (which this
+        instance's in-memory view never saw) back in from disk — the
+        adoption path of multi-coordinator HA. The file is the shared
+        truth: disk records merge over memory. An unreadable or
+        unparsable file leaves the in-memory view untouched (a torn
+        tail is the dying peer's problem; adoption just sees less)."""
+        try:
+            with open(self.path) as f:
+                text = f.read()
+        except OSError:
+            return
+        try:
+            disk = self._parse(text)
+        except (ValueError, KeyError, TypeError):
+            return
+        with self._lock:
+            self._merge_locked(disk)
+
+    def _merge_locked(self, disk: Dict[str, dict]) -> None:
+        for qid, rec in disk.items():
+            merged = dict(self.records.get(qid, {}))
+            merged.update({k: v for k, v in rec.items()
+                           if v is not None})
+            self.records[qid] = merged
+
+    def get(self, qid: str) -> Optional[dict]:
+        with self._lock:
+            rec = self.records.get(qid)
+            return dict(rec) if rec is not None else None
 
     # ----------------------------------------------------------- append
     def append(self, qid: str, sql: Optional[str] = None,
                user: Optional[str] = None, source: Optional[str] = None,
                group: Optional[str] = None,
-               state: Optional[str] = None) -> None:
+               state: Optional[str] = None,
+               owner: Optional[str] = None,
+               recoveries: Optional[int] = None) -> None:
         """Append one record. Fields left None are inherited from the
         qid's earlier records at merge time. A torn append makes the
         journal unparsable, which the next load treats as corruption
         (move aside + start fresh) — never as partial truth."""
         rec = {"qid": qid, "sql": sql, "user": user, "source": source,
-               "group": group, "state": state, "ts": time.time()}
+               "group": group, "state": state, "owner": owner,
+               "recoveries": recoveries, "ts": time.time()}
         line = json.dumps({k: v for k, v in rec.items()
                            if v is not None})
         with self._lock:
@@ -127,6 +181,7 @@ class QueryJournal:
                             exc_info=True)
                 return
             self.appends += 1
+            self.last_append_ts = rec["ts"]
             _M_APPENDS.inc()
             if self.appends % self.compact_threshold == 0:
                 self._compact_locked()
@@ -134,7 +189,14 @@ class QueryJournal:
     def _compact_locked(self) -> None:
         """Rewrite the journal atomically keeping only non-terminal
         queries (same tmp + os.replace discipline as HistoryStore —
-        a crash mid-compaction leaves the old journal intact)."""
+        a crash mid-compaction leaves the old journal intact). Disk
+        state is folded in first so peer coordinators' records survive
+        this writer's rewrite."""
+        try:
+            with open(self.path) as f:
+                self._merge_locked(self._parse(f.read()))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass   # compact from memory alone; disk merge is best-effort
         live = {qid: r for qid, r in self.records.items()
                 if r.get("state") not in TERMINAL_STATES}
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -177,7 +239,10 @@ class QueryJournal:
         with self._lock:
             pending = sum(1 for r in self.records.values()
                           if r.get("state") not in TERMINAL_STATES)
+            lag = (time.time() - self.last_append_ts
+                   if self.last_append_ts is not None else None)
             return {"path": self.path, "appends": self.appends,
                     "compactions": self.compactions,
                     "pending": pending, "recovered": self.recovered,
+                    "lastAppendAgeS": lag,
                     "startedFresh": self.started_fresh}

@@ -46,6 +46,10 @@ _M_QUERIES = _counter("presto_tpu_coordinator_queries_total",
 _M_COORD_UPTIME = _gauge(
     "presto_tpu_coordinator_uptime_seconds",
     "Seconds since this coordinator process started serving")
+_M_ADOPTIONS = _counter(
+    "presto_tpu_coordinator_ha_adoptions_total",
+    "Journaled queries adopted from a dead peer coordinator under "
+    "their original query id")
 
 _COORD_START = _time.time()
 
@@ -183,6 +187,18 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
+    def _dead(self) -> bool:
+        """Crash-simulation check (StatementServer.kill): a killed
+        coordinator's in-flight handler threads must NOT answer — a
+        dying process tears its connections, it does not serve one
+        last response. Returning without writing closes the socket
+        with no status line, which the client transport classifies as
+        a connection error and fails over."""
+        if getattr(self.server, "dead", False):
+            self.close_connection = True
+            return True
+        return False
+
     def _json(self, code: int, obj: dict):
         body = json.dumps(obj).encode()
         self.send_response(code)
@@ -192,6 +208,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self):
+        if self._dead():
+            return
         path = self.path.split("?")[0]
         m = _INGEST.match(path)
         if m:
@@ -251,14 +269,24 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(200, receipt)
 
     def do_GET(self):
+        if self._dead():
+            return
         path = self.path.split("?")[0]
         m = _EXECUTING.match(path) or _QUEUED.match(path)
         if m:
             q = self.server.coordinator.queries.get(m.group(1))
             if q is None:
+                # multi-coordinator failover: a client re-resolving a
+                # dead peer's nextUri here may be asking about a query
+                # this coordinator never saw — adopt it from the shared
+                # journal under its ORIGINAL qid before giving up
+                q = self.server.coordinator.adopt(m.group(1))
+            if q is None:
                 return self._json(404, {"error": "no query"})
             # long-poll briefly while the query runs
             q.done.wait(timeout=1.0)
+            if self._dead():    # killed mid-poll: die silently
+                return
             return self._json(200, q.results_json(self.server.base,
                                                   int(m.group(2))))
         if path == "/v1/query":
@@ -298,6 +326,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if path == "/v1/ha/admission":
+            # the peer-gossip surface: this coordinator's stride-WFQ
+            # admission totals, polled by every peer's AdmissionGossip
+            # so shedding/quotas act on cluster totals
+            co = self.server.coordinator
+            rgs = co.resource_groups
+            return self._json(200, {
+                "coordinatorId": co.coordinator_id,
+                "queued": rgs.total_queued(),
+                "running": rgs.total_running(),
+                "draining": co.draining,
+                "ts": _time.time()})
         if path == "/v1/status":
             # coordinator NodeStatus: uptime, role, query counts, and
             # the engine memory pool as the heap proxy
@@ -307,7 +347,7 @@ class _Handler(BaseHTTPRequestHandler):
             pool = getattr(eng, "memory_pool", None)
             rgs = co.resource_groups
             return self._json(200, {
-                "nodeId": "tpu-coordinator", "role": "coordinator",
+                "nodeId": co.coordinator_id, "role": "coordinator",
                 "environment": "tpu",
                 "uptime": f"{_time.time() - _COORD_START:.2f}s",
                 "uptimeSeconds": _time.time() - _COORD_START,
@@ -332,7 +372,15 @@ class _Handler(BaseHTTPRequestHandler):
                             if co.journal is not None else None),
                 "membership": (eng.membership_snapshot()
                                if hasattr(eng, "membership_snapshot")
-                               else None)})
+                               else None),
+                # multi-coordinator HA view: peers, drain state,
+                # adoption count, and the gossip round snapshot
+                "ha": {"coordinatorId": co.coordinator_id,
+                       "peers": list(co.peers),
+                       "draining": co.draining,
+                       "adoptions": co.adoptions,
+                       "gossip": (co.gossip.snapshot()
+                                  if co.gossip is not None else None)}})
         m = _TRACE.match(path)
         if m:
             # stitched cross-node span dump for one query id (worker
@@ -371,6 +419,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(404, {"error": f"no route {path}"})
 
     def do_DELETE(self):
+        if self._dead():
+            return
         m = _CANCEL.match(self.path.split("?")[0])
         if m:
             co = self.server.coordinator
@@ -395,11 +445,27 @@ class _StatementHTTPServer(ThreadingHTTPServer):
 
 class StatementServer:
     """The coordinator's client-facing HTTP surface over any engine with
-    execute_sql/plan_sql (TpuCluster or LocalEngine)."""
+    execute_sql/plan_sql (TpuCluster or LocalEngine).
+
+    Multi-coordinator HA: N StatementServers run as symmetric peers
+    over one shared ``QueryJournal`` file (pass the same
+    ``elastic.journal_path`` and distinct ``coordinator_id``s, then
+    wire the peer sets with :meth:`set_peers`). Every accepted
+    statement is journaled with its owner; a peer that receives a
+    nextUri poll for a query it never saw adopts it from the journal
+    under the ORIGINAL qid (:meth:`adopt`), and peers gossip their
+    stride-WFQ admission totals so shedding acts on cluster totals."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 admission=None, resource_groups=None, elastic=None):
+                 admission=None, resource_groups=None, elastic=None,
+                 coordinator_id: str = "tpu-coordinator", peers=()):
         self.engine = engine
+        self.coordinator_id = coordinator_id
+        self.peers: List[str] = []
+        self.draining = False
+        self.adoptions = 0
+        self.gossip = None
+        self._started = False
         # coordinator crash recovery: with a journal path configured
         # (ElasticConfig.journal_path) every accepted statement is
         # write-ahead journaled and re-queued by recover() on restart
@@ -437,12 +503,44 @@ class StatementServer:
         # introspection plane: the system connector unions this front
         # door's live dispatcher states into system.runtime.queries via
         # this back-reference; the wide-event sink and profiler start
-        # here too so a statement-only deployment still gets both
+        # here too so a statement-only deployment still gets both.
+        # With multiple peer coordinators over one engine every
+        # instance also registers in statement_frontends, so
+        # system.runtime.nodes can list coordinator rows per peer.
         setattr(engine, "statement_frontend", self)
+        fronts = getattr(engine, "statement_frontends", None)
+        if fronts is None:
+            fronts = []
+            setattr(engine, "statement_frontends", fronts)
+        fronts.append(self)
+        if peers:
+            self.set_peers(peers)
         from presto_tpu.obs.profiler import PROFILER
         from presto_tpu.obs.wide_events import install_event_log_sink
         install_event_log_sink()
         PROFILER.ensure_started()
+
+    def set_peers(self, peers) -> None:
+        """Declare the peer coordinator set (base URIs; this server's
+        own base is filtered out, so the full fleet list can be passed
+        symmetrically to every member). Rewires the admission gossip
+        and points the LoadShedder's queue-depth signal at cluster
+        totals."""
+        from presto_tpu.server.ha import AdmissionGossip
+        self.peers = [p.rstrip("/") for p in peers
+                      if p.rstrip("/") != self.base]
+        if self.gossip is not None:
+            self.gossip.stop()
+            self.gossip = None
+        if self.peers:
+            self.gossip = AdmissionGossip(
+                self.coordinator_id, self.resource_groups, self.peers)
+            self.dispatcher.shedder.cluster_queued = \
+                self.gossip.cluster_queued
+            if self._started:
+                self.gossip.start()
+        else:
+            self.dispatcher.shedder.cluster_queued = None
 
     #: completed queries kept for /v1/query info (QueryTracker role)
     MAX_TRACKED = 200
@@ -455,6 +553,13 @@ class StatementServer:
                 dup = self.queries.get(known) if known else None
                 if dup is not None:
                     return dup          # retried POST: do NOT re-execute
+            if self.draining:
+                # graceful shutdown: refuse new work with the standard
+                # 503 + Retry-After so the client's failover loop moves
+                # to a peer coordinator instead of erroring out
+                raise OverloadedError(
+                    "coordinator draining",
+                    self.admission_config.retry_after_s)
             # shed BEFORE registering: a refused statement must leave
             # no trace (the client retries with the same idempotency
             # key and must get a fresh admission decision)
@@ -482,7 +587,8 @@ class StatementServer:
         if self.journal is not None:
             self.journal.append(qid, sql=sql, user=user, source=source,
                                 group=self._group_path(user, source),
-                                state="QUEUED")
+                                state="QUEUED",
+                                owner=self.coordinator_id)
         try:
             self._dispatch(q, user=user, source=source)
         except OverloadedError:
@@ -567,11 +673,34 @@ class StatementServer:
             qid, sql = rec.get("qid"), rec.get("sql")
             if not qid or not sql or qid in self.queries:
                 continue
+            # a shared journal holds every peer's records: a restart
+            # only re-queues its OWN (ownerless legacy records too);
+            # a live peer's in-flight queries are not ours to re-run
+            if rec.get("owner") not in (None, self.coordinator_id):
+                continue
             user = rec.get("user", "") or ""
+            requeues = int(rec.get("recoveries", 0) or 0)
+            cap = int(getattr(self.elastic, "recover_max_requeues", 3))
+            if requeues >= cap:
+                # repeated crashes keep orphaning this query; abandon
+                # it with a terminal record instead of letting an
+                # unbounded recovery storm clog the admission queue
+                q = _Query(qid, sql, user=user)
+                q.error = (f"abandoned after {requeues} crash-recovery "
+                           f"re-queues")
+                q.state = "FAILED"
+                q.done.set()
+                with self._submit_lock:
+                    self.queries[qid] = q
+                self.journal.append(qid, state="FAILED",
+                                    owner=self.coordinator_id)
+                continue
             q = _Query(qid, sql, user=user)
             with self._submit_lock:
                 self.queries[qid] = q
-            self.journal.append(qid, state="QUEUED")
+            self.journal.append(qid, state="QUEUED",
+                                owner=self.coordinator_id,
+                                recoveries=requeues + 1)
             try:
                 self._dispatch(q, user=user,
                                source=rec.get("source", "") or "")
@@ -587,6 +716,60 @@ class StatementServer:
             n += 1
         return n
 
+    def adopt(self, qid: str) -> Optional[_Query]:
+        """Multi-coordinator failover: take over a dead peer's
+        journaled query under its ORIGINAL qid. Called when a client's
+        nextUri poll lands here for a query this coordinator never
+        registered — refresh the shared journal from disk (the peer's
+        appends were never in our memory view), and if the record is
+        live, re-queue it through our own admission front door.
+
+        Terminal records are adoptable too: results live only in the
+        owner's memory, so a query that FINISHED just before its owner
+        died — with the client's poll still in flight — must be re-run
+        here or the client can never fetch it. That re-execution is
+        safe because adoption only triggers from an unanswered poll
+        (the results were never delivered) and this statement surface
+        is read-only analytics; a journaled FAILED query deterministic-
+        ally re-delivers its error. Returns None when there is nothing
+        adoptable (no journal, unknown qid, no recorded sql, or we are
+        draining)."""
+        if self.journal is None or self.draining:
+            return None
+        self.journal.refresh()
+        rec = self.journal.get(qid)
+        if rec is None or not rec.get("sql"):
+            return None
+        user = rec.get("user", "") or ""
+        with self._submit_lock:
+            dup = self.queries.get(qid)
+            if dup is not None:
+                return dup      # raced with another poll: one adoption
+            q = _Query(qid, rec["sql"], user=user)
+            self.queries[qid] = q
+        # adoption is never capped (a live client is polling this qid)
+        # but still counts toward the crash-recovery re-queue budget an
+        # UNATTENDED restart honors in recover()
+        self.journal.append(qid, state="QUEUED",
+                            owner=self.coordinator_id,
+                            recoveries=int(rec.get("recoveries", 0)
+                                           or 0) + 1)
+        try:
+            self._dispatch(q, user=user,
+                           source=rec.get("source", "") or "")
+        except OverloadedError as e:
+            # adoption never sheds silently — the client is already
+            # polling this qid, so close it with the rejection
+            q.error = f"{type(e).__name__}: {e}"[:500]
+            q.state = "FAILED"
+            q.done.set()
+            self.journal.append(qid, state="FAILED")
+            return q
+        self.adoptions += 1
+        _M_ADOPTIONS.inc()
+        self.journal.mark_recovered()
+        return q
+
     def cancel(self, q: _Query) -> bool:
         """Withdraw a statement still waiting for admission; running
         queries are only flagged (the engine call is uninterruptible,
@@ -596,17 +779,70 @@ class StatementServer:
 
     def start(self) -> "StatementServer":
         self._thread.start()
+        self._started = True
         # crash recovery before the first client request lands: any
         # journaled non-terminal queries from a previous process are
         # back in the admission queue by the time start() returns
         if self.journal is not None:
             self.recover()
+        if self.gossip is not None:
+            self.gossip.start()
         return self
 
-    def stop(self):
-        self.httpd.shutdown()
+    def stop(self, drain_timeout_s: Optional[float] = None):
+        """Graceful coordinator shutdown: stop accepting (draining
+        submits shed with Retry-After so clients fail over), then
+        bounded-wait for in-flight dispatch-pool queries to finish —
+        the same drain discipline as the PR 10 worker drain — so a
+        deliberately stopped coordinator journals/finishes what it can
+        instead of abandoning in-flight queries."""
+        self.draining = True
+        timeout = (drain_timeout_s if drain_timeout_s is not None
+                   else float(getattr(self.elastic, "drain_timeout_s",
+                                      0) or 0))
+        poll = float(getattr(self.elastic, "drain_poll_s", 0.05)
+                     or 0.05)
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            with self._submit_lock:
+                inflight = [q for q in self.queries.values()
+                            if not q.done.is_set()]
+            if not inflight:
+                break
+            _time.sleep(poll)
+        if self.gossip is not None:
+            self.gossip.stop()
+        if self._thread.is_alive():     # shutdown() blocks forever
+            self.httpd.shutdown()       # unless serve_forever runs
         self.httpd.server_close()
         self.dispatcher.stop()
+        # deliberate decommission leaves the fleet registry; a KILLED
+        # coordinator stays registered so system.runtime.nodes shows
+        # the DEAD row
+        fronts = getattr(self.engine, "statement_frontends", None)
+        if fronts is not None:
+            try:
+                fronts.remove(self)
+            except ValueError:
+                pass
+
+    def kill(self):
+        """Crash simulation for chaos tests: no drain, no terminal
+        journal appends. The journal handle is dropped FIRST so any
+        still-running dispatch threads of this \"dead\" process cannot
+        journal their outcomes — exactly the window a real crash
+        leaves, which a surviving peer must repair by adoption."""
+        self.draining = True
+        self.journal = None
+        # in-flight handler threads check this and tear their
+        # connections instead of serving one last response
+        self.httpd.dead = True
+        if self.gossip is not None:
+            self.gossip.stop()
+        if self._thread.is_alive():
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        self.dispatcher.stop(timeout_s=0.0)
 
 
 def run_statement(base_uri: str, sql: str, timeout_s: float = 600,

@@ -5,7 +5,8 @@ continuous-churn driver for elastic membership (testing/churn.py)."""
 
 from presto_tpu.testing.churn import ChurnDriver
 from presto_tpu.testing.faults import FaultInjector, FaultSpec
+from presto_tpu.testing.fleet import CoordinatorFleet
 from presto_tpu.testing.load import LoadHarness, LoadReport
 
-__all__ = ["ChurnDriver", "FaultInjector", "FaultSpec", "LoadHarness",
-           "LoadReport"]
+__all__ = ["ChurnDriver", "CoordinatorFleet", "FaultInjector",
+           "FaultSpec", "LoadHarness", "LoadReport"]

@@ -16,6 +16,11 @@ driver exercises all three membership transitions:
   process sends no goodbye), the HTTP server and task manager are torn
   down mid-flight; failure detection + ``retry_policy=TASK`` recovery
   must absorb it.
+- **coord_kill** (only with a ``CoordinatorFleet`` attached): hard-kill
+  a COORDINATOR mid-query — the surviving peer must adopt the victim's
+  journaled queries and dbapi clients must fail over. Every coord_kill
+  first revives previously killed coordinators, so the fleet never
+  dwindles below "one dead at a time".
 
 Determinism follows the faults.py discipline: every decision draws
 from ``random.Random(f"{seed}:{kind}:{ordinal}")`` so a churn schedule
@@ -37,7 +42,7 @@ from presto_tpu.utils.threads import spawn
 
 log = logging.getLogger("presto_tpu.churn")
 
-ACTIONS = ("join", "drain", "kill")
+ACTIONS = ("join", "drain", "kill", "coord_kill")
 
 
 class ChurnDriver:
@@ -51,7 +56,7 @@ class ChurnDriver:
 
     def __init__(self, cluster, seed: int = 0, max_dynamic: int = 2,
                  announce_interval_s: float = 0.5,
-                 drain_timeout_s: float = 10.0):
+                 drain_timeout_s: float = 10.0, coordinators=None):
         if cluster.discovery is None:
             raise ValueError(
                 "ChurnDriver needs a cluster with a discovery service: "
@@ -61,9 +66,14 @@ class ChurnDriver:
         self.max_dynamic = max(int(max_dynamic), 1)
         self.announce_interval_s = announce_interval_s
         self.drain_timeout_s = drain_timeout_s
+        #: CoordinatorFleet (testing/fleet.py) — enables the seeded
+        #: coord_kill action; None keeps the worker-only schedule
+        #: (and its exact per-seed action sequence) unchanged
+        self.coordinators = coordinators
         #: node_id -> live dynamic TpuWorkerServer
         self.dynamic: Dict[str, TpuWorkerServer] = {}
-        self.counts = {"joins": 0, "drains": 0, "kills": 0}
+        self.counts = {"joins": 0, "drains": 0, "kills": 0,
+                       "coord_kills": 0}
         self.events: List[dict] = []
         self._ordinal = 0
         self._joined = 0
@@ -88,6 +98,19 @@ class ChurnDriver:
             ordinal = self._ordinal
             if not self.dynamic:
                 action = "join"
+            elif self.coordinators is not None:
+                # coordinator-kill lane: reweighted schedule (still a
+                # pure function of (seed, ordinal) — a fleet-enabled
+                # run replays exactly from its seed)
+                r = self._rng("action", ordinal).random()
+                if len(self.dynamic) < self.max_dynamic and r < 0.35:
+                    action = "join"
+                elif r < 0.60:
+                    action = "drain"
+                elif r < 0.80:
+                    action = "kill"
+                else:
+                    action = "coord_kill"
             else:
                 r = self._rng("action", ordinal).random()
                 if len(self.dynamic) < self.max_dynamic and r < 0.45:
@@ -149,6 +172,18 @@ class ChurnDriver:
         w.httpd.server_close()
         w.task_manager.shutdown()
         return {"node": nid, "uri": uri}
+
+    def _coord_kill(self, ordinal: int) -> dict:
+        fleet = self.coordinators
+        # restore the fleet first so at most one coordinator is dead at
+        # a time; the victim draw is seeded over the post-revive set
+        revived = fleet.revive_all()
+        victim = self._rng("coord", ordinal).choice(
+            sorted(fleet.alive_indices()))
+        detail = fleet.kill(victim)
+        return {"coordinator": fleet.ids[victim],
+                "uri": fleet.bases[victim], "revived": revived,
+                "detail": detail}
 
     # -------------------------------------------------- background mode
     def start(self, interval_s: float = 0.5) -> "ChurnDriver":

@@ -1,0 +1,124 @@
+"""Multi-coordinator fleet harness for HA tests and the bench churn
+lane.
+
+Builds N peer ``StatementServer`` coordinators over ONE engine and ONE
+shared write-ahead query journal (the HA topology of
+server/statement.py), wires their peer sets symmetrically, and exposes
+the seeded kill/revive verbs the coordinator-chaos tests and
+``testing/churn.py``'s ``coord_kill`` action drive:
+
+- :meth:`kill` hard-kills one coordinator (no drain, journal handle
+  dropped first — the real-crash window a surviving peer repairs by
+  adoption), refusing to kill the last one alive;
+- :meth:`revive` restarts a killed coordinator on its ORIGINAL port
+  (``allow_reuse_address`` makes the same-address rebind safe) with the
+  same coordinator id, so its restart ``recover()`` re-queues its own
+  journaled queries and clients' cached URIs work again.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from presto_tpu.config import DEFAULT_ELASTIC
+from presto_tpu.server.statement import StatementServer
+
+
+class CoordinatorFleet:
+    def __init__(self, engine, n: int = 2,
+                 journal_path: Optional[str] = None, admission=None,
+                 host: str = "127.0.0.1",
+                 drain_timeout_s: float = 5.0):
+        if n < 1:
+            raise ValueError("fleet needs at least one coordinator")
+        self.engine = engine
+        self.admission = admission
+        self.host = host
+        self.elastic = dataclasses.replace(
+            DEFAULT_ELASTIC, journal_path=journal_path,
+            drain_timeout_s=drain_timeout_s)
+        self.kills = 0
+        self.revives = 0
+        self.servers: List[StatementServer] = []
+        for i in range(n):
+            self.servers.append(self._make(f"coord-{i}", port=0))
+        self.ids = [s.coordinator_id for s in self.servers]
+        self.ports = [s.port for s in self.servers]
+        self.bases = [s.base for s in self.servers]
+        self._dead = [False] * n
+        for s in self.servers:
+            s.set_peers([b for b in self.bases if b != s.base])
+
+    def _make(self, coordinator_id: str, port: int) -> StatementServer:
+        return StatementServer(self.engine, host=self.host, port=port,
+                               admission=self.admission,
+                               elastic=self.elastic,
+                               coordinator_id=coordinator_id)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "CoordinatorFleet":
+        for s in self.servers:
+            s.start()
+        return self
+
+    def alive_indices(self) -> List[int]:
+        return [i for i, dead in enumerate(self._dead) if not dead]
+
+    def kill(self, i: int) -> str:
+        """Hard-kill coordinator ``i`` (crash simulation — see
+        ``StatementServer.kill``). Refuses to take down the last
+        surviving coordinator: the fleet invariant under chaos is
+        'at least one peer answers'."""
+        alive = self.alive_indices()
+        if self._dead[i]:
+            return f"{self.ids[i]} already dead"
+        if alive == [i]:
+            raise RuntimeError("refusing to kill the last live "
+                               "coordinator")
+        self.servers[i].kill()
+        self._dead[i] = True
+        self.kills += 1
+        return f"killed {self.ids[i]} at {self.bases[i]}"
+
+    def revive(self, i: int) -> str:
+        """Restart a killed coordinator on its original port with its
+        original id; its ``start()``-time ``recover()`` re-queues the
+        queries it owned when it died."""
+        if not self._dead[i]:
+            return f"{self.ids[i]} already alive"
+        srv = self._make(self.ids[i], port=self.ports[i])
+        srv.set_peers([b for b in self.bases if b != srv.base])
+        srv.start()
+        self.servers[i] = srv
+        self._dead[i] = False
+        self.revives += 1
+        return f"revived {self.ids[i]} at {self.bases[i]}"
+
+    def revive_all(self) -> int:
+        n = 0
+        for i, dead in enumerate(list(self._dead)):
+            if dead:
+                self.revive(i)
+                n += 1
+        return n
+
+    def snapshot(self) -> dict:
+        return {"coordinators": len(self.servers),
+                "alive": self.alive_indices(), "kills": self.kills,
+                "revives": self.revives,
+                "adoptions": sum(s.adoptions for s in self.servers)}
+
+    def close(self) -> None:
+        for i in self.alive_indices():
+            self.servers[i].stop(drain_timeout_s=1.0)
+        # killed coordinators stay in the engine's frontend registry
+        # (their DEAD row is the point); a fleet teardown purges them
+        # so later tests over the same engine start clean
+        fronts = getattr(self.engine, "statement_frontends", None)
+        if fronts is not None:
+            for s in self.servers:
+                try:
+                    fronts.remove(s)
+                except ValueError:
+                    pass

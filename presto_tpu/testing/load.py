@@ -123,9 +123,15 @@ class LoadReport:
 
 
 class LoadHarness:
-    """Drive a statement server with concurrent dbapi clients."""
+    """Drive a statement server with concurrent dbapi clients.
 
-    def __init__(self, base_uri: str, tenants: Dict[str, int],
+    ``base_uri`` may also be a list of peer coordinator URIs (a
+    ``CoordinatorFleet``'s ``bases``): dbapi's rendezvous routing
+    spreads the clients over the fleet and fails over on coordinator
+    death, so the zero-dropped invariant can be asserted under
+    coordinator-kill chaos."""
+
+    def __init__(self, base_uri, tenants: Dict[str, int],
                  clients: int = 32, statements: int = 200,
                  sql: str = "select 1", zipf_s: float = 1.1,
                  seed: int = 0, timeout_s: float = 120.0,

@@ -51,6 +51,7 @@ def test_rule_catalog_complete():
             "no-jax-in-control-plane",
             "no-spawn-in-request-handler",
             "no-planner-in-data-plane", "membership-chokepoint",
+            "journal-chokepoint",
             "metric-docs-sync", "mv-cache-chokepoint"} <= names
 
 
@@ -110,6 +111,30 @@ def test_membership_chokepoint_honesty():
         "presto_tpu/server/cluster.py": "x = 1\n"},
         planted="presto_tpu/server/cluster.py")
     assert fs and "membership chokepoint" in fs[0].message
+
+
+def test_journal_chokepoint_fires():
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("journal-chokepoint", {
+        bad: 'f.write(json.dumps(rec) + "\\n")\n'}, planted=bad)
+    assert fs and "QueryJournal" in fs[0].message
+    fs = _findings("journal-chokepoint", {
+        bad: 'f.write(line + "\\n")\n'}, planted=bad)
+    assert fs and fs[0].line == 1
+    # only server/ is in scope: other packages keep their own logs
+    # (mv/journal.py has its own chokepoint rule)
+    assert not _findings("journal-chokepoint", {
+        "presto_tpu/mv/journal.py": 'f.write(line + "\\n")\n'},
+        planted="presto_tpu/mv/journal.py")
+
+
+def test_journal_chokepoint_allowlist_honesty():
+    # journal.py present but no longer writing JSONL => the allowlist
+    # went vacuous and the rule must say so instead of silently passing
+    fs = _findings("journal-chokepoint", {
+        "presto_tpu/server/journal.py": "x = 1\n"},
+        planted="presto_tpu/server/journal.py")
+    assert fs and "journal" in fs[0].message.lower()
 
 
 def test_mv_cache_chokepoint_fires():

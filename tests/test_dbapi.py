@@ -1,15 +1,20 @@
 """DBAPI 2.0 driver over the statement REST protocol (round-4; the
 python-ecosystem analog of presto-jdbc — PrestoDriver/PrestoStatement
-over StatementClientV1)."""
+over StatementClientV1), plus the multi-coordinator failover surface
+(round-14: multi-URI connect, rendezvous session routing, dead-first
+connect, mid-query nextUri failover with journal adoption)."""
 
+import threading
 from decimal import Decimal
 
 import pytest
 
 import presto_tpu.client as client
+from presto_tpu.client.dbapi import _rendezvous_order
 from presto_tpu.connectors import TpchConnector
 from presto_tpu.server.cluster import TpuCluster
 from presto_tpu.server.statement import StatementServer
+from presto_tpu.testing.fleet import CoordinatorFleet
 
 
 @pytest.fixture(scope="module")
@@ -63,3 +68,99 @@ def test_errors_and_iteration(server):
     conn.close()
     with pytest.raises(client.InterfaceError):
         conn.cursor()
+
+
+# ------------------------------------------------- multi-coordinator HA
+
+def test_rendezvous_order_deterministic_and_spreading():
+    bases = [f"http://127.0.0.1:{p}" for p in (8001, 8002, 8003)]
+    assert _rendezvous_order(bases, "k1") == \
+        _rendezvous_order(list(reversed(bases)), "k1")
+    # enough distinct keys land on more than one head
+    heads = {_rendezvous_order(bases, f"key-{i}")[0] for i in range(64)}
+    assert len(heads) > 1
+    with pytest.raises(client.InterfaceError):
+        client.connect([])
+
+
+def test_connect_multi_uri_dead_first_coordinator(server):
+    # nothing listens on port 1: the rendezvous head may be dead at
+    # connect time and the first execute must walk to the live peer
+    dead = "http://127.0.0.1:1"
+    conn = client.connect([dead, server.base], timeout_s=60)
+    conn.bases = [dead, server.base]    # force the dead head
+    conn.base = dead
+    cur = conn.cursor()
+    cur.execute("select count(*) from nation")
+    assert cur.fetchall() == [(25,)]
+    # the live peer got promoted and the switch was counted
+    assert conn.base == server.base
+    assert conn.bases[0] == server.base
+    assert conn.failovers == 1
+
+
+class _GateEngine:
+    """Engine whose execute blocks on a release event — pins a query
+    in RUNNING so a coordinator can be killed mid-flight."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def execute_sql(self, sql):
+        if sql == "select gated":
+            self.release.wait(timeout=30.0)
+        return [(7,)]
+
+    def plan_sql(self, sql):
+        raise RuntimeError("no plan for the stub engine")
+
+
+def test_mid_query_nexturi_failover(tmp_path):
+    eng = _GateEngine()
+    fleet = CoordinatorFleet(eng, n=2,
+                             journal_path=str(tmp_path / "j.jsonl"))
+    fleet.start()
+    try:
+        conn = client.connect(fleet.bases, timeout_s=60)
+        conn.bases = list(fleet.bases)  # owner = coordinator 0
+        conn.base = conn.bases[0]
+        cur = conn.cursor()
+        done, err = {}, []
+
+        def run():
+            try:
+                cur.execute("select gated")
+                done["rows"] = cur.fetchall()
+            except Exception as e:      # noqa: BLE001 — asserted below
+                err.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait until coordinator 0 journals the query RUNNING
+        journal = fleet.servers[1].journal
+        qid = None
+        for _ in range(200):
+            journal.refresh()
+            running = [r for r in journal.records.values()
+                       if r.get("state") == "RUNNING"]
+            if running:
+                qid = running[0]["qid"]
+                break
+            threading.Event().wait(0.02)
+        assert qid is not None, "query never reached RUNNING"
+        fleet.kill(0)
+        eng.release.set()
+        t.join(timeout=30.0)
+        assert not t.is_alive() and not err, f"client died: {err}"
+        assert done["rows"] == [(7,)]
+        # the surviving peer adopted the journaled query under its
+        # ORIGINAL qid and the connection recorded the failover
+        survivor = fleet.servers[1]
+        assert cur.query_id == qid
+        assert qid in survivor.queries
+        assert survivor.adoptions == 1
+        assert conn.failovers >= 1
+        assert conn.base == survivor.base
+    finally:
+        eng.release.set()
+        fleet.close()

@@ -41,7 +41,7 @@ from presto_tpu.server.http import TpuWorkerServer
 from presto_tpu.server.journal import QueryJournal
 from presto_tpu.server.statement import StatementServer
 from presto_tpu.server.task_manager import TpuTaskManager
-from presto_tpu.testing import ChurnDriver
+from presto_tpu.testing import ChurnDriver, CoordinatorFleet, LoadHarness
 
 SF = 0.01
 
@@ -116,6 +116,19 @@ def _assert_rows_match(got, want, ctx=""):
                     f"{ctx}: {g} vs oracle {w}"
             else:
                 assert gc == wc, f"{ctx}: {g} vs oracle {w}"
+
+
+@pytest.fixture()
+def chaos_client():
+    """dbapi rides the process-global transport client, whose default
+    breaker cooldown (5 s) dwarfs the coordinator-chaos timescale — a
+    revived coordinator would sit breaker-blocked for seconds. Swap in
+    a chaos-tuned client (fast backoff, 0.3 s breaker cooldown) for
+    the duration of the test."""
+    orig = _transport._DEFAULT_CLIENT
+    _transport._DEFAULT_CLIENT = _transport.HttpClient(CHAOS_TRANSPORT)
+    yield _transport._DEFAULT_CLIENT
+    _transport._DEFAULT_CLIENT = orig
 
 
 @pytest.fixture()
@@ -348,6 +361,33 @@ def test_coordinator_restart_recovers_journaled_queries(
         srv1.dispatcher.stop()
 
 
+def test_recovery_requeue_cap_abandons_storming_query(tmp_path):
+    """A journaled query that already burned its crash-recovery
+    re-queue budget (ElasticConfig.recover_max_requeues) is closed
+    with a terminal FAILED record instead of re-executing — repeated
+    coordinator crashes must not grow an unbounded orphan
+    re-execution storm that clogs the admission queue."""
+    jpath = str(tmp_path / "j.jsonl")
+    j = QueryJournal(jpath)
+    j.append("storm", sql="select 1", state="QUEUED", recoveries=3)
+    j.append("fresh", sql="select 1", state="QUEUED")
+    ecfg = ElasticConfig(journal_path=jpath, recover_max_requeues=3)
+    srv = StatementServer(_LoadStubEngine(), elastic=ecfg)
+    try:
+        assert srv.recover() == 1      # only "fresh" re-queues
+        storm = srv.queries["storm"]
+        assert storm.state == "FAILED"
+        assert "abandoned" in (storm.error or "")
+        assert srv.journal.get("storm")["state"] == "FAILED"
+        # the re-queued query carries its incremented budget
+        assert srv.journal.get("fresh")["recoveries"] == 1
+        fresh = srv.queries["fresh"]
+        assert fresh.done.wait(timeout=DEADLINE_S)
+        assert fresh.state == "FINISHED"
+    finally:
+        srv.dispatcher.stop()
+
+
 def test_journal_corruption_starts_fresh(tmp_path):
     p = str(tmp_path / "j.jsonl")
     with open(p, "w") as f:
@@ -377,6 +417,147 @@ def test_journal_compaction_drops_terminal(tmp_path):
         lines = [ln for ln in f.read().splitlines() if ln.strip()]
     assert len(lines) == 1, "compaction must drop terminal queries"
     assert [r["qid"] for r in QueryJournal(p).pending()] == ["live"]
+
+
+def test_coordinator_stop_drains_inflight(tmp_path):
+    """Regression (round-14 bugfix): StatementServer.stop() used to
+    abandon the dispatch pool's in-flight queries. A deliberate stop
+    must (a) shed new submits with Retry-After so clients fail over
+    and (b) give running queries a bounded window to finish and
+    journal their terminal state."""
+    from presto_tpu.admission import OverloadedError
+
+    release = threading.Event()
+    ecfg = ElasticConfig(journal_path=str(tmp_path / "j.jsonl"),
+                         drain_timeout_s=20.0)
+    srv = StatementServer(_BlockingEngine(release), elastic=ecfg).start()
+    q = srv.submit("select 1", user="alice")
+    # release the engine shortly after the drain begins: stop() must
+    # WAIT for the query, not race past it
+    threading.Timer(0.3, release.set).start()
+    srv.stop()
+    assert q.done.is_set(), "stop() returned with the query in flight"
+    assert q.state == "FINISHED", q.error
+    assert srv.journal.get(q.qid)["state"] == "FINISHED", \
+        "drained query never journaled its terminal state"
+    # draining refuses new work with the standard overload shape
+    with pytest.raises(OverloadedError):
+        srv.submit("select 2", user="alice")
+
+
+class _GatedCluster:
+    """Delegating engine proxy over the module cluster whose
+    execute_sql blocks until released — pins a statement-server query
+    in RUNNING over a REAL cluster so the owning coordinator can be
+    killed mid-flight."""
+
+    def __init__(self, cluster, release: threading.Event):
+        self._cluster = cluster
+        self._release = release
+
+    def execute_sql(self, sql):
+        self._release.wait(timeout=60)
+        return self._cluster.execute_sql(sql)
+
+    def __getattr__(self, name):
+        return getattr(self._cluster, name)
+
+
+def test_coordinator_failover_adopts_under_original_qid(
+        cluster, oracle, tmp_path):
+    """The HA tentpole contract: 2 peer coordinators over 2 live
+    workers and one shared journal; hard-kill the coordinator that
+    owns a RUNNING query. The dbapi client re-resolves the nextUri
+    against the surviving peer, which adopts the journaled query under
+    its ORIGINAL qid, re-runs it on the cluster, and serves
+    oracle-exact rows."""
+    import presto_tpu.client as client
+
+    release = threading.Event()
+    engine = _GatedCluster(cluster, release)
+    fleet = CoordinatorFleet(engine, n=2,
+                             journal_path=str(tmp_path / "j.jsonl"))
+    fleet.start()
+    sql = QUERIES[2]
+    got, errors = [], []
+    try:
+        conn = client.connect(fleet.bases, timeout_s=DEADLINE_S)
+        conn.bases = list(fleet.bases)  # owner = coordinator 0
+        conn.base = conn.bases[0]
+        cur = conn.cursor()
+
+        def run():
+            try:
+                cur.execute(sql)
+                got.extend(cur.fetchall())
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(e)
+
+        t = threading.Thread(target=run, name="ha-failover",
+                             daemon=True)
+        t.start()
+        journal = fleet.servers[1].journal
+        qid = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            journal.refresh()
+            running = [r for r in journal.records.values()
+                       if r.get("state") == "RUNNING"]
+            if running:
+                qid = running[0]["qid"]
+                break
+            time.sleep(0.02)
+        assert qid is not None, "query never reached RUNNING"
+        assert journal.get(qid)["owner"] == "coord-0"
+        fleet.kill(0)
+        release.set()
+        t.join(timeout=DEADLINE_S)
+        assert not t.is_alive(), "client wedged across the kill"
+        assert not errors, f"failover failed: {errors}"
+        _assert_rows_match(got, oracle[sql], ctx="ha failover")
+        survivor = fleet.servers[1]
+        assert cur.query_id == qid, "client lost its original qid"
+        assert qid in survivor.queries, "peer never adopted the query"
+        assert survivor.adoptions == 1
+        assert survivor.journal.get(qid)["owner"] == "coord-1"
+        assert survivor.journal.get(qid)["state"] == "FINISHED"
+        assert conn.failovers >= 1
+    finally:
+        release.set()
+        fleet.close()
+
+
+def test_nodes_table_lists_coordinator_rows(cluster, tmp_path):
+    """system.runtime.nodes carries one row per peer coordinator
+    (role/queries_owned/journal_lag_s), DEAD after a kill."""
+    fleet = CoordinatorFleet(cluster, n=2,
+                             journal_path=str(tmp_path / "j.jsonl"))
+    fleet.start()
+    try:
+        import presto_tpu.client as client
+        conn = client.connect(fleet.bases, timeout_s=DEADLINE_S)
+        cur = conn.cursor()
+        cur.execute("select count(*) from region")
+        assert cur.fetchall() == [(5,)]
+        rows = cluster.execute_sql(
+            "select uri, node_id, state, role, queries_owned, "
+            "journal_lag_s from system.runtime.nodes "
+            "where role = 'coordinator'")
+        by_id = {r[1]: r for r in rows}
+        assert set(by_id) == {"coord-0", "coord-1"}
+        assert all(r[2] == "ACTIVE" for r in rows), rows
+        served = by_id[f"coord-{fleet.bases.index(conn.base)}"]
+        assert served[4] >= 1, "owned-query count missing"
+        assert served[5] is not None, "journal lag missing"
+        fleet.kill(1)
+        rows = cluster.execute_sql(
+            "select node_id, state from system.runtime.nodes "
+            "where role = 'coordinator'")
+        states = dict(rows)
+        assert states["coord-1"] == "DEAD", states
+        assert states["coord-0"] == "ACTIVE", states
+    finally:
+        fleet.close()
 
 
 def test_closed_buffer_refuses_instead_of_fake_complete():
@@ -440,6 +621,133 @@ def test_continuous_churn_matrix(cluster, oracle, probe, seed):
     assert driver.report()["steps"] >= 1
     assert os.listdir(cluster.spool.base_dir) == [], \
         f"seed {seed}: spool not GC'd after churn"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_churn_matrix_with_coordinator_kills(cluster, oracle, tmp_path,
+                                             chaos_client, seed):
+    """The full-chaos matrix: seeded worker join/drain/kill PLUS
+    coordinator kills (ChurnDriver coord_kill) while the chaos query
+    set runs through the dbapi failover client against a 2-coordinator
+    fleet. Rows stay oracle-exact; every query either completes or
+    surfaces a clean retryable overload the client absorbs."""
+    import presto_tpu.client as client
+
+    fleet = CoordinatorFleet(
+        cluster, n=2, journal_path=str(tmp_path / f"j{seed}.jsonl"))
+    fleet.start()
+    driver = ChurnDriver(cluster, seed=seed, max_dynamic=2,
+                         drain_timeout_s=30.0, coordinators=fleet)
+    driver.start(interval_s=0.3)
+    try:
+        for round_no in range(2):
+            for sql in QUERIES:
+                conn = client.connect(fleet.bases,
+                                      timeout_s=DEADLINE_S)
+                cur = conn.cursor()
+                got, attempts = None, 0
+                while got is None:
+                    attempts += 1
+                    try:
+                        cur.execute(sql)
+                        got = cur.fetchall()
+                    except (client.OverloadedError,
+                            client.OperationalError):
+                        # clean retryable errors: a cluster-wide shed,
+                        # or a kill window where BOTH coordinators were
+                        # momentarily unreachable (one dead, the other
+                        # freshly revived behind its breaker); bounded
+                        # patience either way
+                        assert attempts < 50, \
+                            f"seed {seed}: never recovered on {sql!r}"
+                        time.sleep(0.1)
+                    except client.DatabaseError as e:
+                        # revived coordinators re-queue journaled
+                        # orphans (crash recovery), which can
+                        # transiently fill the admission queue — a
+                        # clean QUEUE_FULL rejection is retryable;
+                        # anything else is a real failure
+                        if "QueryQueueFull" not in str(e) \
+                                and "QUEUE" not in str(e):
+                            raise
+                        assert attempts < 50, \
+                            f"seed {seed}: queue never drained on " \
+                            f"{sql!r}"
+                        time.sleep(0.1)
+                _assert_rows_match(
+                    got, oracle[sql],
+                    ctx=f"coord-churn seed {seed} round {round_no} "
+                        f"{sql!r}")
+    finally:
+        driver.close()
+        fleet.close()
+        _settle(cluster)
+    report = driver.report()
+    assert report["steps"] >= 1
+    assert os.listdir(cluster.spool.base_dir) == [], \
+        f"seed {seed}: spool not GC'd after coordinator churn"
+
+
+# ===================================================================
+# acceptance: load harness vs a coordinator killed every round
+# ===================================================================
+
+class _LoadStubEngine:
+    """Constant-service-time engine for the HA load-harness gate (the
+    PR 8 stub idiom — the contract under test is the front door +
+    failover, not execution)."""
+
+    def execute_sql(self, sql):
+        time.sleep(0.03)
+        return [[1]]
+
+    def plan_sql(self, sql):
+        raise RuntimeError("no plan for the stub engine")
+
+
+@pytest.mark.slow
+def test_load_harness_with_coordinator_kill_per_round(tmp_path,
+                                                      chaos_client):
+    """Acceptance gate: the PR 8 closed-loop load harness runs against
+    a 3-coordinator fleet while one coordinator is hard-killed (and
+    the previous victim revived) every ~0.25 s. Zero dropped queries:
+    every statement completes, is cleanly rejected, or surfaces a
+    retryable overload the dbapi client recovers from."""
+    fleet = CoordinatorFleet(_LoadStubEngine(), n=3,
+                             journal_path=str(tmp_path / "j.jsonl"))
+    fleet.start()
+    stop = threading.Event()
+    round_no = [0]
+
+    def chaos():
+        while not stop.wait(0.25):
+            try:
+                fleet.revive_all()
+                victims = fleet.alive_indices()
+                fleet.kill(victims[round_no[0] % len(victims)])
+                round_no[0] += 1
+            except Exception:   # noqa: BLE001 — harness is the oracle
+                pass
+
+    chaos_t = threading.Thread(target=chaos, name="coord-chaos",
+                               daemon=True)
+    chaos_t.start()
+    try:
+        harness = LoadHarness(fleet.bases,
+                              tenants={"alpha": 2, "beta": 1},
+                              clients=16, statements=240,
+                              timeout_s=90.0)
+        report = harness.run()
+        assert report.submitted == 240
+        assert report.completed + report.rejected + report.shed == 240
+        report.assert_zero_dropped()
+        assert round_no[0] >= 1, "the chaos loop never killed anyone"
+    finally:
+        stop.set()
+        chaos_t.join(timeout=5.0)
+        fleet.revive_all()
+        fleet.close()
 
 
 # ===================================================================
