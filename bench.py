@@ -265,6 +265,8 @@ def main() -> None:
         return _churn_child()
     if os.environ.get("BENCH_MV_ONE"):
         return _mv_child()
+    if os.environ.get("BENCH_MEMORY_ONE"):
+        return _memory_child()
     if ds_one:
         return _ds_child(int(ds_one), runs, warmup)
     if pq_one:
@@ -627,6 +629,18 @@ def _main_orchestrator(sf, qids) -> None:
         else:
             detail["mv"] = _run_mv_child(
                 float(os.environ.get("BENCH_MV_TIMEOUT_S", "240"))
+                + 120.0)
+
+    # memory-arbitration round (one JSON `memory` entry: constrained-
+    # budget wall vs unconstrained for the lifespan-fallback and
+    # build-side-spill-join shapes, spill/revocation counters, killer
+    # demo, exactness bit); BENCH_MEMORY=0 disables
+    if os.environ.get("BENCH_MEMORY", "1") != "0":
+        if wedged is not None:
+            detail["memory"] = {"error": f"infra: {wedged}"}
+        else:
+            detail["memory"] = _run_memory_child(
+                float(os.environ.get("BENCH_MEMORY_TIMEOUT_S", "240"))
                 + 120.0)
 
     if wedged is not None:
@@ -1217,6 +1231,152 @@ def _run_mv_child(timeout_s: float):
                          f"{tail[:120]}"[:200]}
     return json.loads(line).get("detail", {}).get(
         "mv", {"error": "child produced no mv entry"})
+
+
+def _memory_child() -> None:
+    """Memory-arbitration round: the same query is run unconstrained
+    and then under a pool budget its static footprint cannot fit, so
+    the engine must take a degraded-but-exact path — lifespan-batched
+    fallback for the grouped aggregation, the Grace build-side spill
+    join for the join-rooted shape. Emits per-lane wall costs (the
+    price of surviving), spill/revocation counters proving the
+    machinery actually fired, an exactness bit per lane, and a
+    low-memory-killer demo (cluster budget blown -> biggest query dies
+    with the EXCEEDED_MEMORY_LIMIT-class error)."""
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    import math
+    import shutil
+    import tempfile
+
+    from presto_tpu.config import Session
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.exec.memory import (
+        ClusterMemoryManager, ExceededMemoryLimitError, MemoryPool,
+    )
+
+    sf = float(os.environ.get("BENCH_MEMORY_SF", "0.05"))
+    conn = TpchConnector(sf)
+    spill_dir = tempfile.mkdtemp(prefix="bench_memory_spill_")
+
+    def _rows_close(got, want):
+        if len(got) != len(want):
+            return False
+        for g, w in zip(sorted(got), sorted(want)):
+            for gc, wc in zip(g, w):
+                if isinstance(wc, float) or isinstance(gc, float):
+                    if not math.isclose(gc, wc, rel_tol=1e-6,
+                                        abs_tol=1e-9):
+                        return False
+                elif gc != wc:
+                    return False
+        return True
+
+    #: (lane, sql, pool budget the footprint cannot fit)
+    lanes = (
+        ("fallback_agg",
+         "select l_returnflag, l_linestatus, count(*), "
+         "sum(l_quantity), sum(l_extendedprice) from lineitem "
+         "group by l_returnflag, l_linestatus "
+         "order by l_returnflag, l_linestatus",
+         2 * 1024 * 1024),
+        ("spill_join",
+         "select n_name, r_name from nation, region "
+         "where n_regionkey = r_regionkey order by 1, 2",
+         6000),
+    )
+    out = {"sf": sf, "lanes": {}, "exact": True}
+    try:
+        for key, sql, budget in lanes:
+            free_eng = LocalEngine(conn)
+            free_eng.execute_sql(sql)              # compile warmup
+            t0 = time.perf_counter()
+            want = free_eng.execute_sql(sql)
+            free_s = time.perf_counter() - t0
+
+            pool = MemoryPool(budget)
+            eng = LocalEngine(
+                conn,
+                session=Session({"spill_enabled": "true",
+                                 "spill_path": spill_dir}),
+                memory_pool=pool)
+            eng.execute_sql(sql)                   # compile warmup
+            t0 = time.perf_counter()
+            got = eng.execute_sql(sql)
+            pooled_s = time.perf_counter() - t0
+
+            exact = _rows_close(got, want)
+            out["exact"] = out["exact"] and exact
+            entry = {
+                "budget_bytes": budget,
+                "rows": len(got),
+                "wall_free_s": round(free_s, 4),
+                "wall_pooled_s": round(pooled_s, 4),
+                "slowdown": round(pooled_s / max(free_s, 1e-9), 2),
+                "exact": exact,
+                "pool": {"revocations": pool.revocations,
+                         "revoked_bytes": pool.revoked_bytes,
+                         "reserved_after": pool.reserved},
+            }
+            if eng.last_spill_join_stats is not None:
+                entry["spill_join"] = eng.last_spill_join_stats
+            if eng.last_memory_fallback_batches:
+                entry["fallback_batches"] = \
+                    eng.last_memory_fallback_batches
+            out["lanes"][key] = entry
+
+        # low-memory killer: node pool has headroom, the CLUSTER
+        # budget is tiny; the bench query is the biggest over-budget
+        # query and must die with the classified error
+        pool = MemoryPool(1 << 40, revoke_threshold=1.0)
+        mgr = ClusterMemoryManager([pool], budget_bytes=1000)
+        eng = LocalEngine(conn, memory_pool=pool, cluster_memory=mgr)
+        pool.reserve("bench_sentinel", 10)
+        try:
+            eng.execute_sql("select count(*) from region")
+            out["killer"] = {"killed": False}
+        except ExceededMemoryLimitError as e:
+            out["killer"] = {"killed": True, "kills": mgr.kills,
+                             "error": str(e)[:160]}
+        finally:
+            pool.free("bench_sentinel")
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+    slowdowns = [v["slowdown"] for v in out["lanes"].values()
+                 if v.get("slowdown", 0) > 0]
+    geo = (math.exp(sum(math.log(s) for s in slowdowns)
+                    / len(slowdowns)) if slowdowns else 0.0)
+    out["constrained_slowdown_geomean"] = round(geo, 2)
+    print(json.dumps({"metric": "memory_constrained_slowdown",
+                      "value": out["constrained_slowdown_geomean"],
+                      "unit": "x", "detail": {"memory": out}}))
+
+
+def _run_memory_child(timeout_s: float):
+    """Run the memory-arbitration round in a subprocess; returns the
+    `memory` detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_MEMORY_ONE="1", BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "memory", {"error": "child produced no memory entry"})
 
 
 def _hbo_probe(conn, sql):

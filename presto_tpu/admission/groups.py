@@ -177,6 +177,11 @@ class ResourceGroup:
         # root-only state (shared by the whole tree via _root())
         self._lock = threading.Lock()
         self._memory_pool = None
+        # cluster-wide reservations provider (callable -> {qid: bytes})
+        # fed from the coordinator's heartbeat scrape of worker pools —
+        # when attached, memory quotas gate on CLUSTER usage, not just
+        # the coordinator-local pool
+        self._cluster_reservations = None
         self.grant_log: Deque[Tuple[str, Tuple[str, ...]]] = \
             collections.deque(maxlen=_GRANT_LOG_MAX)
         for c in self.children:
@@ -213,6 +218,15 @@ class ResourceGroup:
         """Wire the tree to a :class:`~presto_tpu.exec.memory.MemoryPool`
         so per-group ``memory_quota_bytes`` gates admission."""
         self._root()._memory_pool = pool
+
+    def attach_cluster_reservations(self, provider) -> None:
+        """Wire the tree to a cluster-reservations provider — a
+        callable returning ``{query_id: reserved_bytes}`` aggregated
+        over every worker pool (the coordinator's heartbeat scrape).
+        Quotas then gate on cluster-wide usage; the local pool (if any)
+        remains a same-process floor for queries the scrape has not
+        seen yet."""
+        self._root()._cluster_reservations = provider
 
     # -- admission ----------------------------------------------------
 
@@ -310,11 +324,25 @@ class ResourceGroup:
     def _over_memory_quota_locked(self) -> bool:
         if self.memory_quota_bytes is None:
             return False
-        pool = self._root()._memory_pool
-        if pool is None:
+        root = self._root()
+        pool = root._memory_pool
+        provider = root._cluster_reservations
+        if pool is None and provider is None:
             return False
-        reserved = sum(pool.query_reserved(q)
-                       for q in self._running_qids if q is not None)
+        cluster: dict = {}
+        if provider is not None:
+            try:
+                cluster = provider() or {}
+            except Exception:    # noqa: BLE001 — a failed scrape must
+                cluster = {}     # never wedge admission
+        reserved = 0
+        for q in self._running_qids:
+            if q is None:
+                continue
+            local = pool.query_reserved(q) if pool is not None else 0
+            # the scrape lags task admission by one heartbeat — take
+            # the larger of the gossiped and same-process views
+            reserved += max(int(cluster.get(q, 0)), local)
         return reserved >= self.memory_quota_bytes
 
     def _enqueue_locked(self, w: _Waiter) -> None:
@@ -512,6 +540,10 @@ class ResourceGroupManager:
     def attach_memory_pool(self, pool) -> None:
         for r in self.roots:
             r.attach_memory_pool(pool)
+
+    def attach_cluster_reservations(self, provider) -> None:
+        for r in self.roots:
+            r.attach_cluster_reservations(provider)
 
     def evict_expired(self) -> None:
         now = time.monotonic()

@@ -921,3 +921,38 @@ class MvCacheChokepointRule(Rule):
 
 
 register(MvCacheChokepointRule())
+
+# =====================================================================
+# 15. spill-chokepoint — exec/spill.py is the only spill-file writer
+#     in the execution layers (exec/, ops/)
+# =====================================================================
+
+_SPILL = "presto_tpu/exec/spill.py"
+
+
+class SpillChokepointRule(Rule):
+    name = "spill-chokepoint"
+    description = (
+        "exec/ and ops/ open spill files for write only through "
+        "exec/spill.FileSpiller — one spill write path means one "
+        "partial-file cleanup story under ENOSPC, one SpillError "
+        "classification, one stray-dir GC prefix and one "
+        "spilled-bytes metric; a bare file write inside an operator "
+        "would leak torn run files past every one of them")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, _WRITE_PATTERNS,
+            "file-writing call site in the execution layer — spill "
+            "through exec/spill.FileSpiller",
+            allowed=(_SPILL,),
+            prefixes=("presto_tpu/exec/", "presto_tpu/ops/"))
+        # honesty: the spiller itself must still match the write
+        # idioms this rule polices
+        out.extend(honesty_finding(
+            self, pkg, _SPILL, _WRITE_PATTERNS,
+            "the spill-file writer"))
+        return out
+
+
+register(SpillChokepointRule())

@@ -73,6 +73,23 @@ class OverloadedError(OperationalError):
         self.retry_after_s = retry_after_s
 
 
+class ExceededMemoryLimitError(OperationalError):
+    """EXCEEDED_MEMORY_LIMIT class: the query was refused admission or
+    killed by the memory arbiter / low-memory killer. The query itself
+    is over budget — retrying unchanged will fail the same way; raise
+    the session memory limits or reduce the query instead."""
+
+
+def _classify_server_error(message: str) -> DatabaseError:
+    """Map a server error payload to the most specific DBAPI class.
+    The wire carries only a message string, so classification keys on
+    the stable phrases the engine's error classes emit."""
+    low = (message or "").lower()
+    if "memory limit" in low or "spill failed" in low:
+        return ExceededMemoryLimitError(message)
+    return DatabaseError(message)
+
+
 def _rendezvous_order(bases: Sequence[str], key: str) -> List[str]:
     """Highest-random-weight ordering of coordinator URIs for one
     session key: every client computes the same preference list for
@@ -217,7 +234,8 @@ class Cursor:
         deadline = time.time() + self._conn.timeout_s
         while True:
             if "error" in payload:
-                raise DatabaseError(payload["error"]["message"])
+                raise _classify_server_error(
+                    payload["error"]["message"])
             if payload.get("columns"):
                 columns = payload["columns"]
             rows.extend(payload.get("data", []))

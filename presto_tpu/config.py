@@ -407,6 +407,41 @@ DEFAULT_ELASTIC = ElasticConfig()
 
 
 @dataclasses.dataclass(frozen=True)
+class MemoryConfig:
+    """Memory-arbitration knobs (reference: NodeMemoryConfig +
+    MemoryManagerConfig — query.max-memory-per-node and
+    query.max-memory — plus the MemoryRevokingScheduler's
+    revoking-threshold). One per process; each worker's
+    `TaskManager` builds its node `MemoryPool` from this and the
+    coordinator derives the cluster budget for the low-memory
+    killer."""
+
+    #: per-node pool budget (query.max-memory-per-node role): the sum
+    #: of static plan footprints admitted on one worker; 0 disables
+    #: arbitration (tasks run unpooled, the pre-PR-14 behavior)
+    pool_bytes: int = 0
+    #: fraction of the pool at which revocation hooks fire BEFORE a
+    #: reservation can fail (memory-revoking-threshold role)
+    revoke_threshold: float = 0.8
+    #: cluster-wide query-memory budget for the low-memory killer
+    #: (query.max-memory role); 0 derives it from the sum of worker
+    #: pool budgets
+    cluster_bytes: int = 0
+    #: master switch for the coordinator's low-memory killer sweep —
+    #: with it off an over-budget cluster only refuses new admissions
+    kill_enabled: bool = True
+
+    def cluster_budget(self, n_workers: int) -> int:
+        if self.cluster_bytes:
+            return self.cluster_bytes
+        return self.pool_bytes * max(n_workers, 1)
+
+
+#: process defaults — arbitration off: tests and benches opt in
+DEFAULT_MEMORY = MemoryConfig()
+
+
+@dataclasses.dataclass(frozen=True)
 class MVConfig:
     """Materialized-view maintenance knobs (presto_tpu/mv/; reference:
     the incrementally maintained MV half of Presto@Meta's VLDB'23

@@ -42,6 +42,10 @@ class LocalEngine:
         # recorded after execution, consulted by the next planning
         self.history = history
         self.last_join_reorders = 0
+        self.last_memory_fallback_batches = 0
+        # stats dict from the spillable-join fallback of the last query
+        # that took it (exec/spill_join.py), None otherwise
+        self.last_spill_join_stats = None
 
     @property
     def session(self):
@@ -100,6 +104,7 @@ class LocalEngine:
             except ExceededMemoryLimitError:
                 if self.cluster_memory is not None:
                     self.cluster_memory.check_killed(qid)
+                from presto_tpu.exec.executor import MemoryLimitExceeded
                 from presto_tpu.exec.lifespan import execute_bounded
                 plan = self.plan_sql(sql)
                 # the aborted attempt's buffers are unwound — release
@@ -107,10 +112,23 @@ class LocalEngine:
                 self.memory_pool.free(qid)
                 headroom = max(self.memory_pool.budget
                                - self.memory_pool.reserved, 1)
-                page, batches = execute_bounded(
-                    self.connector, plan, headroom,
-                    session=self.session)
-                self.last_memory_fallback_batches = batches
+                try:
+                    page, batches = execute_bounded(
+                        self.connector, plan, headroom,
+                        session=self.session)
+                    self.last_memory_fallback_batches = batches
+                except MemoryLimitExceeded as mle:
+                    # join-rooted plans are unbatchable — partition both
+                    # sides through the spiller instead (Grace hash join)
+                    from presto_tpu.exec.spill_join import (
+                        SpillJoinUnsupported, execute_spill_join)
+                    try:
+                        page, sj_stats = execute_spill_join(
+                            self.connector, plan, headroom,
+                            session=self.session)
+                    except SpillJoinUnsupported:
+                        raise mle
+                    self.last_spill_join_stats = sj_stats
                 out = page.to_pylist()
             if self.cluster_memory is not None:
                 # kill sweep runs while this query's reservations are

@@ -515,6 +515,7 @@ class Executor:
                 for k in list(data)[:len(data) - 512]:
                     data.pop(k, None)
             tmp = f"{path}.{os.getpid()}.tmp"
+            # lint: disable=spill-chokepoint — caps cache, not a spill
             with open(tmp, "w") as f:
                 json.dump(data, f)
             os.replace(tmp, path)           # atomic vs concurrent writers

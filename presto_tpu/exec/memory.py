@@ -21,6 +21,21 @@ revocation hook drives the existing lifespan spill machinery
 import threading
 from typing import Callable, Dict, List, Optional
 
+from presto_tpu.obs.metrics import counter as _counter
+
+_M_REVOCATIONS = _counter(
+    "presto_tpu_memory_revocations_total",
+    "Revoke-hook firings that actually freed bytes (spill-before-fail "
+    "under memory pressure)")
+_M_REVOKED = _counter(
+    "presto_tpu_memory_revoked_bytes_total",
+    "Bytes freed by revocation hooks (spilled out of pool-accounted "
+    "memory)")
+_M_KILLED = _counter(
+    "presto_tpu_memory_killed_queries_total",
+    "Queries killed by the cluster low-memory killer "
+    "(EXCEEDED_MEMORY_LIMIT class)")
+
 
 class ExceededMemoryLimitError(RuntimeError):
     """PrestoException(EXCEEDED_GLOBAL_MEMORY_LIMIT) analog."""
@@ -59,8 +74,13 @@ class MemoryPool:
             return sum(self._by_query.values())
 
     def query_reserved(self, query_id: str) -> int:
+        """Bytes reserved for a query. Workers key reservations by task
+        id (`{qid}.{stage}.{...}`), so a query's total is the exact key
+        plus every dotted-prefix task key."""
+        pfx = query_id + "."
         with self._lock:
-            return self._by_query.get(query_id, 0)
+            return sum(b for k, b in self._by_query.items()
+                       if k == query_id or k.startswith(pfx))
 
     def add_revoke_hook(self, hook: Callable[[str, int], int]) -> None:
         self._revoke_hooks.append(hook)
@@ -101,15 +121,22 @@ class MemoryPool:
                     freed += got
                     self.revocations += 1
                     self.revoked_bytes += got
+                    _M_REVOCATIONS.inc()
+                    _M_REVOKED.inc(got)
                     with self._lock:
                         self._by_query[qid] = max(
                             0, self._by_query.get(qid, 0) - got)
         return freed
 
     def free(self, query_id: str, nbytes: Optional[int] = None) -> None:
+        pfx = query_id + "."
         with self._lock:
             if nbytes is None:
-                self._by_query.pop(query_id, None)
+                # full release drops the query's task-keyed
+                # reservations too (worker pools key by task id)
+                for k in [k for k in self._by_query
+                          if k == query_id or k.startswith(pfx)]:
+                    self._by_query.pop(k, None)
             else:
                 cur = self._by_query.get(query_id, 0)
                 nxt = max(0, cur - int(nbytes))
@@ -133,6 +160,7 @@ class ClusterMemoryManager:
         self.pools = pools
         self._budget = budget_bytes
         self.killed: Dict[str, ExceededMemoryLimitError] = {}
+        self.kills = 0      # lifetime victim count (observability)
 
     def cluster_reserved(self) -> int:
         return sum(p.reserved for p in self.pools)
@@ -146,7 +174,10 @@ class ClusterMemoryManager:
         totals: Dict[str, int] = {}
         for p in self.pools:
             with p._lock:
-                for qid, b in p._by_query.items():
+                for key, b in p._by_query.items():
+                    # task-keyed worker reservations roll up to the
+                    # owning query (task id = `{qid}.{stage}.{...}`)
+                    qid = key.split(".", 1)[0]
                     totals[qid] = totals.get(qid, 0) + b
         if not totals:
             return None
@@ -165,6 +196,8 @@ class ClusterMemoryManager:
             victim, reserved, self.cluster_budget(), killed_by="cluster")
         for p in self.pools:
             p.free(victim)
+        self.kills += 1
+        _M_KILLED.inc()
         return victim
 
     def check_killed(self, query_id: str) -> None:

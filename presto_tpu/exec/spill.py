@@ -19,11 +19,40 @@ from __future__ import annotations
 
 import heapq
 import os
+import sys
 import tempfile
 import uuid
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 from presto_tpu.data.column import Page
+from presto_tpu.obs.metrics import counter as _counter
+
+_M_SPILLED = _counter(
+    "presto_tpu_spilled_bytes_total",
+    "Bytes written to disk spill files (sort runs, revoked "
+    "aggregation partials, partitioned join builds)")
+_M_SPILL_FAILURES = _counter(
+    "presto_tpu_spill_failures_total",
+    "Spill writes that failed on a disk error (ENOSPC / torn write); "
+    "each one unlinked its partial run file and raised SpillError")
+
+
+class SpillError(RuntimeError):
+    """Classified spill-write failure (ENOSPC / torn write / EIO on a
+    spill file). Carries the classification the client protocol needs:
+    a query that dies here fails cleanly instead of surfacing a bare
+    OSError from deep inside an operator."""
+
+    def __init__(self, message: str):
+        super().__init__(f"Spill failed: {message}")
+
+
+def _disk_faults():
+    """The installed testing.faults disk injector, without importing
+    the testing package (no injector can exist if it was never
+    imported, and production pays one dict lookup)."""
+    mod = sys.modules.get("presto_tpu.testing.faults")
+    return getattr(mod, "_DISK", None) if mod is not None else None
 
 
 class SpillHandle:
@@ -62,22 +91,28 @@ class FileSpiller:
             compression=self.codec)
         path = os.path.join(self.directory,
                             f"run_{len(self.handles)}_{uuid.uuid4().hex[:8]}")
+        inj = _disk_faults()
         try:
             with open(path, "wb") as f:
-                f.write(frame)
-        except OSError:
+                if inj is None:
+                    f.write(frame)
+                else:
+                    inj.write("spill", f, frame)
+        except OSError as e:
             # a partial run file is unreadable garbage — it must not
             # outlive the failure (close() only knows recorded handles)
             try:
                 os.unlink(path)
             except OSError:
                 pass
-            raise
+            _M_SPILL_FAILURES.inc()
+            raise SpillError(f"spill write failed: {e}") from e
         h = SpillHandle(path, int(page.num_rows),
                         [c.type for c in page.columns],
                         tuple(page.names), len(frame))
         self.handles.append(h)
         self.total_spilled_bytes += len(frame)
+        _M_SPILLED.inc(len(frame))
         return h
 
     def read(self, handle: SpillHandle) -> Page:

@@ -26,11 +26,30 @@ from __future__ import annotations
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional
 
 log = logging.getLogger("presto_tpu.mv")
+
+
+def _disk_faults():
+    """The installed testing.faults disk injector (None when the
+    testing package was never imported)."""
+    mod = sys.modules.get("presto_tpu.testing.faults")
+    return getattr(mod, "_DISK", None) if mod is not None else None
+
+
+def _truncate_back(path: str, size: int) -> None:
+    """Cut a torn append back off so the on-disk journal stays the
+    clean prefix it was before the failed write (same discipline as
+    server/journal.truncate_back)."""
+    try:
+        with open(path, "rb+") as f:
+            f.truncate(size)
+    except OSError:
+        pass
 
 
 class MVJournal:
@@ -89,24 +108,36 @@ class MVJournal:
                versions: Optional[Dict[str, int]] = None,
                last_kind: Optional[str] = None) -> None:
         """Append one record; None fields inherit from the name's
-        earlier records at merge time."""
+        earlier records at merge time. A failed append (ENOSPC, torn
+        write) truncates any partial line back off so the previous
+        on-disk state stays readable — the .corrupt quarantine never
+        triggers on a clean short-write."""
         rec = {"name": name, "sql": sql, "state": state,
                "versions": versions, "last_kind": last_kind,
                "last_ts": time.time()}
         line = json.dumps({k: v for k, v in rec.items()
                            if v is not None})
+        inj = _disk_faults()
         with self._lock:
             merged = dict(self.records.get(name, {}))
             merged.update({k: v for k, v in rec.items()
                            if v is not None})
             self.records[name] = merged
             try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                size = 0
+            try:
                 with open(self.path, "a") as f:
-                    f.write(line + "\n")
+                    if inj is None:
+                        f.write(line + "\n")
+                    else:
+                        inj.write("mv-journal", f, line + "\n")
                     f.flush()
             except OSError:
                 log.warning("mv journal append failed for %s", name,
                             exc_info=True)
+                _truncate_back(self.path, size)
                 return
             self.appends += 1
             if self.appends % self.compact_threshold == 0:

@@ -266,6 +266,13 @@ class ClusterQueryError(RuntimeError):
     pass
 
 
+class ClusterMemoryKillError(ClusterQueryError):
+    """EXCEEDED_MEMORY_LIMIT class: the cluster low-memory killer chose
+    this query (ClusterMemoryManager.maybe_kill). Terminal — recovery
+    paths must NEVER retry or re-execute a killed query, even under
+    retry_policy=TASK."""
+
+
 class _ClusterSubqueryExec:
     """Adapter exposing Executor._resolve_subqueries over the cluster:
     `execute` routes nested plans through the cluster and returns rows."""
@@ -295,7 +302,8 @@ class TpuCluster:
                  transport_config: Optional[TransportConfig] = None,
                  cache_config=None, spool_config=None,
                  exchange_config=None, mv_config=None,
-                 mv_journal_path: Optional[str] = None):
+                 mv_journal_path: Optional[str] = None,
+                 memory_config=None):
         import dataclasses as _dc
 
         from presto_tpu.cache import AffinityRouter
@@ -364,13 +372,39 @@ class TpuCluster:
             self.spool_config = _dc.replace(
                 scfg, enabled=True, base_dir=self.spool.base_dir,
                 sweep_on_start=False)
+        # worker memory arbitration (exec/memory.py): every in-process
+        # worker gets a real MemoryPool sized from MemoryConfig; the
+        # coordinator holds the cluster view over those pools for the
+        # low-memory killer, and gossips per-query reservations to
+        # admission on the heartbeat path
+        from presto_tpu.config import DEFAULT_MEMORY
+        mcfg = memory_config if memory_config is not None \
+            else DEFAULT_MEMORY
+        self.memory_config = mcfg
         self.workers: List[TpuWorkerServer] = [
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}",
                             shared_secret=shared_secret,
                             cache_config=cache_config,
                             spool_config=self.spool_config,
-                            exchange_config=exchange_config).start()
+                            exchange_config=exchange_config,
+                            memory_config=memory_config).start()
             for i in range(n_workers)]
+        self.cluster_memory = None
+        if mcfg.pool_bytes:
+            from presto_tpu.exec.memory import ClusterMemoryManager
+            pools = [w.task_manager.memory_pool for w in self.workers
+                     if w.task_manager.memory_pool is not None]
+            if pools:
+                self.cluster_memory = ClusterMemoryManager(
+                    pools,
+                    budget_bytes=mcfg.cluster_budget(len(self.workers)))
+        # heartbeat-gossiped cluster reservations ({qid: bytes} summed
+        # over worker pools) — consumed by resource-group memory quotas
+        self.cluster_reservations: Dict[str, int] = {}
+        attach = getattr(self.resource_groups,
+                         "attach_cluster_reservations", None)
+        if attach is not None:
+            attach(lambda: dict(self.cluster_reservations))
         # cache-affinity placement memory (reference: the coordinator's
         # fragment-result-cache-aware NetworkLocationCache / soft
         # affinity SplitPlacementPolicy): remembers which worker holds a
@@ -538,9 +572,34 @@ class TpuCluster:
                     log.info("worker %s recovered; re-admitting", uri)
                 dead_remove.append(uri)
                 drained_remove.append(uri)
-        return self._membership(
+        live = self._membership(
             dead_add=dead_add, dead_remove=dead_remove,
             drained_add=drained_add, drained_remove=drained_remove)
+        if self.memory_config.pool_bytes:
+            self._scrape_memory(live)
+        return live
+
+    def _scrape_memory(self, live: List[str]) -> None:
+        """Heartbeat-path memory gossip: pull every live worker's
+        /v1/memory pool snapshot and aggregate per-query reservations
+        into the cluster view that admission quotas consult. A failed
+        scrape keeps the previous view — stale beats empty (an empty
+        view would wave oversized queries through)."""
+        agg: Dict[str, int] = {}
+        ok = False
+        for uri in live:
+            try:
+                mem = self.http.get_json(f"{uri}/v1/memory",
+                                         request_class="probe")
+            except Exception:   # noqa: BLE001 — dead node, next sweep
+                continue
+            ok = True
+            by_query = (mem.get("memoryPool") or {}).get(
+                "queryReservations") or {}
+            for qid, b in by_query.items():
+                agg[qid] = agg.get(qid, 0) + int(b)
+        if ok or not live:
+            self.cluster_reservations = agg
 
     def decommission(self, worker_uri: str,
                      timeout_s: Optional[float] = None) -> dict:
@@ -913,6 +972,15 @@ class TpuCluster:
             lines.append(
                 f"Admission: group={adm['group']} "
                 f"queue_wait={adm['queue_wait_s']:.3f}s")
+        if self.cluster_memory is not None:
+            cm = self.cluster_memory
+            pools = cm.pools
+            lines.append(
+                f"Memory: reserved={cm.cluster_reserved()} "
+                f"budget={cm.cluster_budget()} "
+                f"revocations={sum(p.revocations for p in pools)} "
+                f"revoked_bytes={sum(p.revoked_bytes for p in pools)} "
+                f"kills={cm.kills}")
         mem = getattr(self, "last_membership", None)
         if mem is not None:
             lines.append(
@@ -1005,6 +1073,8 @@ class TpuCluster:
         try:
             return self._execute_plan_once(plan, capture=capture,
                                            cancel_event=cancel_event)
+        except ClusterMemoryKillError:
+            raise                   # terminal: killed queries never retry
         except (ClusterQueryError, OSError) as e:
             if cancel_event is not None and cancel_event.is_set():
                 raise
@@ -1220,8 +1290,14 @@ class TpuCluster:
                                 schedule(0)
                                 need_schedule = False
                             self._await_all(stages,
-                                            cancel_event=cancel_event)
+                                            cancel_event=cancel_event,
+                                            query_id=qid)
                             break
+                        except ClusterMemoryKillError:
+                            # the low-memory killer is terminal: a
+                            # killed query must never re-execute, even
+                            # though its spools could replay
+                            raise
                         except (ClusterQueryError, OSError):
                             # recovery finishes any partial scheduling
                             # itself; re-running schedule() would
@@ -1239,7 +1315,10 @@ class TpuCluster:
                     schedule(0)
                     try:
                         self._await_all(stages,
-                                        cancel_event=cancel_event)
+                                        cancel_event=cancel_event,
+                                        query_id=qid)
+                    except ClusterMemoryKillError:
+                        raise       # terminal: killed queries never retry
                     except (ClusterQueryError, OSError):
                         if cancel_event is not None \
                                 and cancel_event.is_set():
@@ -1256,7 +1335,8 @@ class TpuCluster:
                                                         by_id):
                             raise
                         self._await_all(stages,
-                                        cancel_event=cancel_event)
+                                        cancel_event=cancel_event,
+                                        query_id=qid)
                 if capture or self.history is not None:
                     self._capture_task_infos(stages)
                     self._record_history(stages, by_id)
@@ -1324,8 +1404,11 @@ class TpuCluster:
                         self._start_stage(qid, fid, stages, by_id,
                                           live_placement)
                     self._await_all({fid: stages[fid]},
-                                    cancel_event=cancel_event)
+                                    cancel_event=cancel_event,
+                                    query_id=qid)
                     break
+                except ClusterMemoryKillError:
+                    raise           # terminal: killed queries never retry
                 except (ClusterQueryError, OSError):
                     if cancel_event is not None \
                             and cancel_event.is_set():
@@ -1347,7 +1430,8 @@ class TpuCluster:
                                                   by_id):
                             recovered = True
                             self._await_all({up: stages[up]},
-                                            cancel_event=cancel_event)
+                                            cancel_event=cancel_event,
+                                            query_id=qid)
                     if self._reschedule_stage(qid, fid, stages, by_id,
                                               force_all=recovered):
                         recovered = True
@@ -1923,7 +2007,8 @@ class TpuCluster:
                               request_class="task_post").json()
 
     def _await_all(self, stages: Dict[int, _Stage],
-                   timeout_s: float = 1800, cancel_event=None):
+                   timeout_s: float = 1800, cancel_event=None,
+                   query_id: Optional[str] = None):
         """Long-poll every task CONCURRENTLY (reference: one
         ContinuousTaskStatusFetcher per task) — a straggler in one stage
         no longer hides a failure in another, and N tasks cost one
@@ -1991,13 +2076,36 @@ class TpuCluster:
         while not wake.is_set() and time.time() < end:
             if cancel_event is not None and cancel_event.is_set():
                 raise ClusterQueryError("Query was canceled by the user")
+            self._memory_kill_sweep(query_id)
             wake.wait(0.25)
+        self._memory_kill_sweep(query_id)
         for uri, e in errs.items():
             raise e if isinstance(e, (ClusterQueryError, OSError)) \
                 else ClusterQueryError(f"task {uri}: {e}")
         for uri in uris:
             if results.get(uri) is None:
                 raise ClusterQueryError(f"no status from {uri}")
+
+    def _memory_kill_sweep(self, query_id: Optional[str]) -> None:
+        """Cluster low-memory killer (ClusterMemoryManager.java:106 +
+        LowMemoryKiller): when aggregate reservations exceed the
+        cluster budget, mark the single biggest query killed; when THIS
+        query is the victim, surface the terminal
+        EXCEEDED_MEMORY_LIMIT-class error (never retried — see
+        ClusterMemoryKillError)."""
+        cm = self.cluster_memory
+        if cm is None or not self.memory_config.kill_enabled:
+            return
+        from presto_tpu.exec.memory import ExceededMemoryLimitError
+        victim = cm.maybe_kill()
+        if victim is not None:
+            log.warning("low-memory killer chose query %s", victim)
+        if query_id is None:
+            return
+        try:
+            cm.check_killed(query_id)
+        except ExceededMemoryLimitError as e:
+            raise ClusterMemoryKillError(str(e)) from e
 
     def _collect_root(self, root: _Stage, out_types,
                       merge_keys=None) -> List[tuple]:

@@ -295,7 +295,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "memoryInfo": {"availableProcessors": 1},
                 "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
                 "heapUsed": self.tm.memory_bytes(),
-                "heapAvailable": 16 << 30, "nonHeapUsed": 0})
+                "heapAvailable": 16 << 30, "nonHeapUsed": 0,
+                # worker pool reservations (exec/memory.MemoryPool) —
+                # the coordinator's heartbeat scrape aggregates these
+                # into the cluster memory view for admission quotas
+                "memoryPool": self.tm.pool_stats()})
         if path == "/v1/tasks":
             # per-task summary rows — the worker-side feed of
             # system.runtime.tasks (fanned out by the system connector)
@@ -334,14 +338,19 @@ class _Handler(BaseHTTPRequestHandler):
             # stitch the cross-node timeline
             return self._json(200, TRACER.to_json(m.group(1)))
         if path == "/v1/memory":
+            # MemoryResource role (/v1/memory): the REAL worker pool —
+            # budget, total reserved, and per-query reservations from
+            # task-admission static footprints (no fake 16GB heap)
+            ps = self.tm.pool_stats()
             return self._json(200, {
                 "pools": {"general": {
-                    "maxBytes": 16 << 30,
-                    "reservedBytes": self.tm.memory_bytes(),
-                    "reservedRevocableBytes": 0,
-                    "queryMemoryReservations": {},
+                    "maxBytes": ps["budgetBytes"] or (16 << 30),
+                    "reservedBytes": ps["reservedBytes"],
+                    "reservedRevocableBytes": ps["revokedBytes"],
+                    "queryMemoryReservations": ps["queryReservations"],
                     "queryMemoryAllocations": {},
-                    "queryMemoryRevocableReservations": {}}}})
+                    "queryMemoryRevocableReservations": {}}},
+                "memoryPool": ps})
         self._json(404, {"error": f"no route {path}"})
 
     def _spool_for(self, task_id: str):
@@ -456,7 +465,8 @@ class TpuWorkerServer:
                  node_id: str = "tpu-worker-0",
                  shared_secret: Optional[str] = None,
                  cache_config=None, spool_config=None,
-                 exchange_config=None, elastic_config=None):
+                 exchange_config=None, elastic_config=None,
+                 memory_config=None):
         from presto_tpu.config import DEFAULT_ELASTIC
         self.elastic_config = (elastic_config
                                if elastic_config is not None
@@ -468,7 +478,8 @@ class TpuWorkerServer:
                                            cache_config=cache_config,
                                            node_id=node_id,
                                            spool_config=spool_config,
-                                           exchange_config=exchange_config)
+                                           exchange_config=exchange_config,
+                                           memory_config=memory_config)
         self.httpd.task_manager = self.task_manager
         # internal JWT auth (InternalAuthenticationManager role): with a
         # shared secret every /v1/* request must carry a valid

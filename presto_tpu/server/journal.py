@@ -38,11 +38,31 @@ from __future__ import annotations
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional
 
 from presto_tpu.obs.metrics import counter as _counter
+
+
+def _disk_faults():
+    """The installed testing.faults disk injector (None when the
+    testing package was never imported)."""
+    mod = sys.modules.get("presto_tpu.testing.faults")
+    return getattr(mod, "_DISK", None) if mod is not None else None
+
+
+def truncate_back(path: str, size: int) -> None:
+    """Cut a torn append back off so the on-disk journal stays the
+    clean prefix it was before the failed write — a short-write under
+    ENOSPC must degrade to 'append lost', never to 'journal corrupt'
+    (the .corrupt quarantine is for real corruption only)."""
+    try:
+        with open(path, "rb+") as f:
+            f.truncate(size)
+    except OSError:
+        pass
 
 log = logging.getLogger("presto_tpu.journal")
 
@@ -158,27 +178,38 @@ class QueryJournal:
                owner: Optional[str] = None,
                recoveries: Optional[int] = None) -> None:
         """Append one record. Fields left None are inherited from the
-        qid's earlier records at merge time. A torn append makes the
-        journal unparsable, which the next load treats as corruption
-        (move aside + start fresh) — never as partial truth."""
+        qid's earlier records at merge time. A failed append (ENOSPC,
+        torn write) truncates any partial line back off, so the
+        previous on-disk state stays readable — the record survives in
+        memory and reaches disk with the next compaction; the
+        .corrupt quarantine never triggers on a clean short-write."""
         rec = {"qid": qid, "sql": sql, "user": user, "source": source,
                "group": group, "state": state, "owner": owner,
                "recoveries": recoveries, "ts": time.time()}
         line = json.dumps({k: v for k, v in rec.items()
                            if v is not None})
+        inj = _disk_faults()
         with self._lock:
             merged = dict(self.records.get(qid, {}))
             merged.update({k: v for k, v in rec.items()
                            if v is not None})
             self.records[qid] = merged
             try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                size = 0
+            try:
                 # lint: disable=spool-chokepoint
                 with open(self.path, "a") as f:
-                    f.write(line + "\n")
+                    if inj is None:
+                        f.write(line + "\n")
+                    else:
+                        inj.write("journal", f, line + "\n")
                     f.flush()
             except OSError:
                 log.warning("journal append failed for %s", qid,
                             exc_info=True)
+                truncate_back(self.path, size)
                 return
             self.appends += 1
             self.last_append_ts = rec["ts"]

@@ -370,13 +370,27 @@ class TpuTaskManager:
 
     def __init__(self, connector, base_uri: str = "",
                  cache_config=None, node_id: str = "tpu-worker-0",
-                 spool_config=None, exchange_config=None):
+                 spool_config=None, exchange_config=None,
+                 memory_config=None):
         from presto_tpu.cache import FragmentResultCache
         from presto_tpu.config import (
-            DEFAULT_CACHE, DEFAULT_EXCHANGE, DEFAULT_SPOOL,
+            DEFAULT_CACHE, DEFAULT_EXCHANGE, DEFAULT_MEMORY, DEFAULT_SPOOL,
         )
 
         self.connector = connector
+        # worker memory pool (exec/memory.MemoryPool; reference:
+        # MemoryPool.java): tasks reserve their static lowering
+        # footprints at admission, keyed by task id so concurrent tasks
+        # of one query account independently and roll up by prefix
+        mcfg = memory_config if memory_config is not None \
+            else DEFAULT_MEMORY
+        self.memory_config = mcfg
+        if mcfg.pool_bytes:
+            from presto_tpu.exec.memory import MemoryPool
+            self.memory_pool: Optional["MemoryPool"] = MemoryPool(
+                mcfg.pool_bytes, mcfg.revoke_threshold)
+        else:
+            self.memory_pool = None
         self.base_uri = base_uri
         self.node_id = node_id
         # concurrent-exchange knobs for every upstream pull this worker
@@ -578,6 +592,12 @@ class TpuTaskManager:
             # coordinator renders (OperatorStats role) — on by default
             props.setdefault("collect_stats", "true")
             ex = SplitExecutor(self.connector, session=Session(props))
+            if self.memory_pool is not None:
+                # static footprints reserve against the worker pool as
+                # programs dispatch; the unique task-id key lets
+                # concurrent tasks of one query account independently
+                ex.memory_pool = self.memory_pool
+                ex.pool_query_id = task.task_id
             ex.set_splits(task.splits)
             task.total_splits = sum(len(v) for v in task.splits.values())
             task.start_time = time.time()
@@ -630,10 +650,16 @@ class TpuTaskManager:
                 writer.commit(str(task.instance_id))
             task.set_state("FINISHED")
         except Exception as e:
+            from presto_tpu.exec.memory import ExceededMemoryLimitError
             from presto_tpu.protocol.validator import UnsupportedPlanError
             if isinstance(e, UnsupportedPlanError):
                 # precise, coordinator-renderable reasons — no traceback
                 task.failures.extend(e.reasons)
+            elif isinstance(e, ExceededMemoryLimitError):
+                # EXCEEDED_MEMORY_LIMIT class: the message alone is the
+                # client contract (dbapi classifies on it) — a traceback
+                # would bury it
+                task.failures.append(str(e))
             else:
                 task.failures.append(traceback.format_exc())
             if task.buffers is not None:
@@ -642,6 +668,9 @@ class TpuTaskManager:
                 if writer is not None:
                     writer.discard()   # never publish a failed attempt
             task.set_state("FAILED")
+        finally:
+            if self.memory_pool is not None:
+                self.memory_pool.free(task.task_id)
 
     def _cache_key(self, task: Task, plan) -> Optional[str]:
         """Cache key for this task's execution, or None when the
@@ -1327,6 +1356,27 @@ class TpuTaskManager:
 
     def memory_bytes(self) -> int:
         return sum(t.bytes_out for t in self.tasks.values())
+
+    def pool_stats(self) -> dict:
+        """Worker memory-pool snapshot for /v1/memory and the
+        coordinator's heartbeat scrape: budget, reserved, and per-QUERY
+        reservations (task-id keys rolled up by their query prefix)."""
+        pool = self.memory_pool
+        if pool is None:
+            return {"budgetBytes": 0, "reservedBytes": 0,
+                    "revocations": 0, "revokedBytes": 0,
+                    "queryReservations": {}}
+        with pool._lock:
+            by_key = dict(pool._by_query)
+        by_query: Dict[str, int] = {}
+        for key, b in by_key.items():
+            qid = key.split(".", 1)[0]
+            by_query[qid] = by_query.get(qid, 0) + b
+        return {"budgetBytes": pool.budget,
+                "reservedBytes": sum(by_key.values()),
+                "revocations": pool.revocations,
+                "revokedBytes": pool.revoked_bytes,
+                "queryReservations": by_query}
 
     def record_gauges(self) -> None:
         """Refresh scrape-time gauges (tasks by state, queue depths).

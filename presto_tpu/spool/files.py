@@ -17,10 +17,19 @@ from __future__ import annotations
 
 import os
 import struct
+import sys
 import tempfile
 import threading
 import zlib
 from typing import List, Optional, Tuple
+
+
+def _disk_faults():
+    """The installed testing.faults disk injector (None when the
+    testing package was never imported — production pays one dict
+    lookup and no import)."""
+    mod = sys.modules.get("presto_tpu.testing.faults")
+    return getattr(mod, "_DISK", None) if mod is not None else None
 
 #: SerializedPage frame header (protocol/serde layout); payload size is
 #: field index 3 — kept in sync with protocol/exchange_client
@@ -72,12 +81,27 @@ class FrameFile:
     def append(self, frame: bytes) -> bool:
         """Append one frame; False when the file was already closed
         (an aborted task still emitting)."""
+        inj = _disk_faults()
         with self._lock:
             if self._closed:
                 return False
             off = self._f.tell()
-            self._f.write(frame)
-            self._f.flush()
+            try:
+                if inj is None:
+                    self._f.write(frame)
+                else:
+                    inj.write("spool", self._f, frame)
+                self._f.flush()
+            except OSError:
+                # a torn frame at `off` would corrupt every later
+                # append's offset accounting — truncate back so the
+                # file stays a clean prefix of whole frames
+                try:
+                    self._f.truncate(off)
+                    self._f.seek(off)
+                except OSError:
+                    pass
+                raise
             self._index.append((off, len(frame)))
             self.crc32 = zlib.crc32(frame, self.crc32)
             self.bytes += len(frame)
@@ -131,11 +155,26 @@ class FrameFile:
 
 def write_bytes(path: str, data: bytes) -> None:
     """Plain whole-file write (manifests); lives here so the spool
-    package stays the only task-output writer."""
-    with open(path, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
+    package stays the only task-output writer. A failed write never
+    leaves a partial manifest behind — commit protocols upstream treat
+    manifest existence as the commit marker."""
+    inj = _disk_faults()
+    try:
+        with open(path, "wb") as f:
+            if inj is None:
+                f.write(data)
+            else:
+                inj.write("spool", f, data)
+            f.flush()
+            if inj is not None:
+                inj.fsync_check("spool")
+            os.fsync(f.fileno())
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        raise
 
 
 def read_bytes(path: str) -> bytes:

@@ -4,9 +4,14 @@ the admission front door (testing/load.py), and the seeded
 continuous-churn driver for elastic membership (testing/churn.py)."""
 
 from presto_tpu.testing.churn import ChurnDriver
-from presto_tpu.testing.faults import FaultInjector, FaultSpec
+from presto_tpu.testing.faults import (
+    DiskFaultInjector, DiskFaultSpec, FaultInjector, FaultSpec,
+    clear_disk_faults, install_disk_faults,
+)
 from presto_tpu.testing.fleet import CoordinatorFleet
 from presto_tpu.testing.load import LoadHarness, LoadReport
 
-__all__ = ["ChurnDriver", "CoordinatorFleet", "FaultInjector",
-           "FaultSpec", "LoadHarness", "LoadReport"]
+__all__ = ["ChurnDriver", "CoordinatorFleet", "DiskFaultInjector",
+           "DiskFaultSpec", "FaultInjector", "FaultSpec",
+           "LoadHarness", "LoadReport", "clear_disk_faults",
+           "install_disk_faults"]

@@ -25,12 +25,13 @@ single transport chokepoint.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import random
 import threading
 import time
 import urllib.error
 import urllib.parse
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,3 +143,130 @@ class FaultInjector:
                 self._count("truncate")
                 return body[:max(len(body) // 2, 1)]
         return body
+
+
+# =====================================================================
+# Disk faults — ENOSPC / short-write / fsync-fail on the four
+# disk-writing subsystems (spill, spool, query journal, MV journal)
+# =====================================================================
+
+#: the four sanctioned write targets; a DiskFaultSpec with an empty
+#: `targets` tuple hits all of them
+DISK_TARGETS = ("spill", "spool", "journal", "mv-journal")
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskFaultSpec:
+    """Per-kind disk-fault rates (0..1) and the targets they apply to.
+
+    `enospc` raises before any byte is written (a full device refusing
+    the write outright); `short_write` flushes a torn prefix to disk
+    and THEN raises (the classic run-out-mid-write tear every
+    append-only format must survive); `fsync_fail` raises EIO at the
+    durability barrier after the data was buffered."""
+
+    enospc_rate: float = 0.0
+    short_write_rate: float = 0.0
+    fsync_fail_rate: float = 0.0
+    #: restrict to these DISK_TARGETS (empty = every target)
+    targets: Tuple[str, ...] = ()
+
+
+class DiskFaultInjector:
+    """Seeded, installable fault source for the disk-write chokepoints.
+
+    Same determinism discipline as FaultInjector, minus the host
+    dimension: each decision is a pure function of
+    (seed, fault kind, per-kind write ordinal) — `random.Random`
+    seeded per decision, counter-based ordinals under a lock — so a
+    write sequence replays identically for a given seed."""
+
+    def __init__(self, seed: int = 0,
+                 spec: Optional[DiskFaultSpec] = None):
+        self.seed = seed
+        self.spec = spec or DiskFaultSpec()
+        self._lock = threading.Lock()
+        self._ordinals: Dict[str, int] = {}
+        #: injected-fault counters by kind, for tests to assert the
+        #: schedule actually fired
+        self.injected: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- helpers
+    def _ordinal(self, kind: str) -> int:
+        with self._lock:
+            n = self._ordinals.get(kind, 0)
+            self._ordinals[kind] = n + 1
+            return n
+
+    def _roll(self, kind: str, ordinal: int) -> float:
+        # decision = pure function of (seed, kind, ordinal)
+        return random.Random(f"{self.seed}:{kind}:{ordinal}").random()
+
+    def _count(self, kind: str):
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def _applies(self, target: str) -> bool:
+        return not self.spec.targets or target in self.spec.targets
+
+    # --------------------------------------------------------------- hooks
+    def write(self, target: str, f, data: bytes) -> None:
+        """Perform (or sabotage) one write of `data` to file object
+        `f` on behalf of disk-writing subsystem `target`."""
+        if not self._applies(target):
+            f.write(data)
+            return
+        spec = self.spec
+        if spec.enospc_rate:
+            ordinal = self._ordinal("enospc")
+            if self._roll("enospc", ordinal) < spec.enospc_rate:
+                self._count("enospc")
+                raise OSError(
+                    errno.ENOSPC,
+                    f"[disk fault seed={self.seed}] injected ENOSPC "
+                    f"on {target} write")
+        if spec.short_write_rate and len(data) > 1:
+            ordinal = self._ordinal("short-write")
+            if self._roll("short-write",
+                          ordinal) < spec.short_write_rate:
+                self._count("short-write")
+                f.write(data[:len(data) // 2])
+                f.flush()           # the torn prefix reaches disk
+                raise OSError(
+                    errno.ENOSPC,
+                    f"[disk fault seed={self.seed}] injected device-"
+                    f"full mid-write on {target} "
+                    f"({len(data) // 2}/{len(data)} bytes)")
+        f.write(data)
+
+    def fsync_check(self, target: str) -> None:
+        """Raise EIO at a durability barrier (consulted just before
+        the real os.fsync)."""
+        if not self._applies(target) or not self.spec.fsync_fail_rate:
+            return
+        ordinal = self._ordinal("fsync")
+        if self._roll("fsync", ordinal) < self.spec.fsync_fail_rate:
+            self._count("fsync")
+            raise OSError(
+                errno.EIO,
+                f"[disk fault seed={self.seed}] injected fsync "
+                f"failure on {target}")
+
+
+#: the installed injector, consulted by the four write chokepoints via
+#: `sys.modules` (so production paths that never import the testing
+#: package pay nothing and create no import cycle)
+_DISK: Optional[DiskFaultInjector] = None
+
+
+def install_disk_faults(inj: Optional[DiskFaultInjector]) -> None:
+    global _DISK
+    _DISK = inj
+
+
+def clear_disk_faults() -> None:
+    install_disk_faults(None)
+
+
+def active_disk_faults() -> Optional[DiskFaultInjector]:
+    return _DISK

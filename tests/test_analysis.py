@@ -52,7 +52,8 @@ def test_rule_catalog_complete():
             "no-spawn-in-request-handler",
             "no-planner-in-data-plane", "membership-chokepoint",
             "journal-chokepoint",
-            "metric-docs-sync", "mv-cache-chokepoint"} <= names
+            "metric-docs-sync", "mv-cache-chokepoint",
+            "spill-chokepoint"} <= names
 
 
 # ===================================================================
@@ -91,6 +92,35 @@ def test_spool_chokepoint_fires():
     assert not _findings("spool-chokepoint", {
         "presto_tpu/exec/spill.py": 'fh = open(path, "wb")\n'},
         planted="presto_tpu/exec/spill.py")
+
+
+def test_spill_chokepoint_fires():
+    # a rogue spill writer anywhere in exec/ or ops/ is a violation
+    for bad in ("presto_tpu/exec/evil.py", "presto_tpu/ops/evil.py"):
+        fs = _findings("spill-chokepoint", {
+            bad: 'fh = open(path, "wb")\n'}, planted=bad)
+        assert fs and "spill" in fs[0].message, bad
+    # tempfile idiom counts as file writing too
+    bad = "presto_tpu/exec/evil.py"
+    fs = _findings("spill-chokepoint", {
+        bad: "import tempfile\nd = tempfile.mkdtemp()\n"}, planted=bad)
+    assert fs
+    # spill.py itself is the allowlisted chokepoint
+    assert not _findings("spill-chokepoint", {
+        "presto_tpu/exec/spill.py": 'fh = open(path, "wb")\n'},
+        planted="presto_tpu/exec/spill.py")
+    # out of scope: server/ writes are the spool/journal rules' problem
+    assert not _findings("spill-chokepoint", {
+        "presto_tpu/server/evil.py": 'fh = open(path, "wb")\n'},
+        planted="presto_tpu/server/evil.py")
+
+
+def test_spill_chokepoint_allowlist_honesty():
+    # spill.py present but no longer opening files for write => the
+    # allowlist is vacuous and the rule must say so
+    fs = _findings("spill-chokepoint", {
+        "presto_tpu/exec/spill.py": "x = 1\n"})
+    assert fs and "vacuous" in fs[0].message
 
 
 def test_membership_chokepoint_fires():

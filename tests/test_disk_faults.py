@@ -1,0 +1,260 @@
+"""Disk-full behavior of every disk-writing subsystem, one at a time.
+
+The seeded DiskFaultInjector (testing/faults.py) sabotages the four
+sanctioned write chokepoints — spill, spool, query journal, MV
+journal — with ENOSPC (refused outright), short-write (torn prefix
+reaches disk, then the device fills), and fsync failure (EIO at the
+durability barrier). Contract per subsystem:
+
+  - spill: the partial run file is unlinked, SpillError raised,
+    presto_tpu_spill_failures_total incremented; an external sort or
+    lifespan-batched aggregation dies CLEANLY with its spill
+    directory empty — no torn run file survives to poison a re-read;
+  - journals (query + MV): a failed append truncates the torn line
+    back off, the PREVIOUS on-disk state stays readable on reload,
+    and the .corrupt quarantine never triggers on a clean short-write;
+  - spool: a torn frame is truncated back so the file stays a clean
+    prefix of whole frames; a failed manifest write never leaves a
+    partial manifest (its existence is the commit marker)."""
+
+import os
+
+import pytest
+
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec.spill import FileSpiller, SpillError, external_sort
+from presto_tpu.obs.metrics import counter as _counter
+from presto_tpu.testing import (
+    DiskFaultInjector, DiskFaultSpec, clear_disk_faults,
+    install_disk_faults,
+)
+
+SF = 0.01
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    clear_disk_faults()
+
+
+def _install(seed=0, **rates):
+    targets = rates.pop("targets", ())
+    inj = DiskFaultInjector(
+        seed=seed, spec=DiskFaultSpec(targets=targets, **rates))
+    install_disk_faults(inj)
+    return inj
+
+
+def _small_page():
+    return TpchConnector(SF).table("region").page()
+
+
+# =====================================================================
+# spill target
+# =====================================================================
+
+def test_spiller_enospc_unlinks_partial_and_classifies(tmp_path):
+    inj = _install(enospc_rate=1.0, targets=("spill",))
+    failures = _counter("presto_tpu_spill_failures_total")
+    before = failures.value()
+    sp = FileSpiller(str(tmp_path))
+    try:
+        with pytest.raises(SpillError, match="Spill failed"):
+            sp.spill(_small_page())
+    finally:
+        sp.close()
+    assert inj.injected["enospc"] == 1
+    assert os.listdir(str(tmp_path)) == []     # partial unlinked
+    assert failures.value() == before + 1
+
+
+def test_spiller_short_write_unlinks_torn_prefix(tmp_path):
+    """The torn prefix REACHES disk before the failure — it must not
+    survive (a half-frame is unreadable garbage to the merge)."""
+    inj = _install(short_write_rate=1.0, targets=("spill",))
+    sp = FileSpiller(str(tmp_path))
+    try:
+        with pytest.raises(SpillError):
+            sp.spill(_small_page())
+    finally:
+        sp.close()
+    assert inj.injected["short-write"] == 1
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_external_sort_enospc_fails_clean(tmp_path):
+    """Run-file spill hits ENOSPC mid-sort: clean SpillError, every
+    already-written run file removed with the spiller."""
+    from presto_tpu.exec.split_executor import SplitExecutor
+    from presto_tpu.sql.analyzer import Planner
+    from presto_tpu.sql.parser import parse_sql
+
+    conn = TpchConnector(SF)
+    sql = ("select l_orderkey, l_linenumber from lineitem "
+           "order by l_orderkey, l_linenumber")
+    sort = Planner(conn).plan_query(parse_sql(sql)).source
+    ex = SplitExecutor(conn)
+    failures = _counter("presto_tpu_spill_failures_total")
+    before = failures.value()
+    # seed 0 rate 0.5: some runs spill before the schedule refuses one
+    inj = _install(seed=0, enospc_rate=0.5, targets=("spill",))
+    with pytest.raises(SpillError):
+        external_sort(ex, sort, "lineitem", 6, str(tmp_path))
+    assert inj.injected.get("enospc", 0) >= 1
+    assert failures.value() == before + 1
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_lifespan_spill_enospc_fails_clean(tmp_path):
+    """Aggregation-partial revocation hits ENOSPC: the batched run
+    dies with SpillError (classified) and leaves no spill files."""
+    from presto_tpu.config import Session
+    from presto_tpu.exec.lifespan import BatchedRunner
+    from presto_tpu.sql.analyzer import Planner
+    from presto_tpu.sql.parser import parse_sql
+
+    conn = TpchConnector(SF)
+    sql = ("select l_returnflag, count(*), sum(l_extendedprice) "
+           "from lineitem group by l_returnflag")
+    plan = Planner(conn).plan_query(parse_sql(sql))
+    runner = BatchedRunner(
+        conn, plan, 4,
+        session=Session({"spill_enabled": "true",
+                         "spill_path": str(tmp_path),
+                         "dynamic_filtering_enabled": "false"}))
+    assert runner.batchable
+    _install(enospc_rate=1.0, targets=("spill",))
+    with pytest.raises(SpillError):
+        runner.run({})
+    assert os.listdir(str(tmp_path)) == []
+
+
+# =====================================================================
+# journal targets
+# =====================================================================
+
+def test_query_journal_append_survives_short_write(tmp_path):
+    from presto_tpu.server.journal import QueryJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = QueryJournal(path)
+    j.append("q1", sql="select 1", state="FINISHED")
+    size = os.path.getsize(path)
+
+    inj = _install(short_write_rate=1.0, targets=("journal",))
+    j.append("q2", sql="select 2", state="RUNNING")   # torn on disk
+    assert inj.injected["short-write"] == 1
+    clear_disk_faults()
+
+    # torn line truncated back: previous on-disk state intact
+    assert os.path.getsize(path) == size
+    j2 = QueryJournal(path)
+    assert not j2.started_fresh
+    assert not os.path.exists(path + ".corrupt")
+    assert j2.get("q1")["state"] == "FINISHED"
+    assert j2.get("q2") is None          # lost append, not corruption
+    # the record survived in MEMORY and reaches disk via compaction
+    assert j.get("q2")["state"] == "RUNNING"
+    j.compact()
+    j3 = QueryJournal(path)
+    assert j3.get("q2")["state"] == "RUNNING"
+
+
+def test_query_journal_append_survives_enospc(tmp_path):
+    from presto_tpu.server.journal import QueryJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = QueryJournal(path)
+    j.append("q1", state="FINISHED")
+    size = os.path.getsize(path)
+    _install(enospc_rate=1.0, targets=("journal",))
+    j.append("q2", state="RUNNING")      # refused outright
+    clear_disk_faults()
+    assert os.path.getsize(path) == size
+    j2 = QueryJournal(path)
+    assert not j2.started_fresh and j2.get("q1") is not None
+
+
+def test_mv_journal_append_survives_short_write(tmp_path):
+    from presto_tpu.mv.journal import MVJournal
+
+    path = str(tmp_path / "mv.jsonl")
+    j = MVJournal(path)
+    j.append("mv1", sql="select 1", state="FRESH")
+    size = os.path.getsize(path)
+    inj = _install(short_write_rate=1.0, targets=("mv-journal",))
+    j.append("mv2", sql="select 2", state="STALE")
+    assert inj.injected["short-write"] == 1
+    clear_disk_faults()
+    assert os.path.getsize(path) == size
+    j2 = MVJournal(path)
+    assert not j2.started_fresh
+    assert not os.path.exists(path + ".corrupt")
+    assert j2.records.get("mv1", {}).get("state") == "FRESH"
+    assert "mv2" not in j2.records
+
+
+# =====================================================================
+# spool target
+# =====================================================================
+
+def test_spool_frame_file_truncates_torn_frame(tmp_path):
+    from presto_tpu.spool.files import FrameFile
+
+    ff = FrameFile(path=str(tmp_path / "frames"))
+    try:
+        assert ff.append(b"frame-one-bytes")
+        _install(short_write_rate=1.0, targets=("spool",))
+        with pytest.raises(OSError):
+            ff.append(b"frame-two-bytes")
+        clear_disk_faults()
+        # torn frame truncated back off: clean prefix of whole frames,
+        # and the writer keeps working once space returns
+        assert ff.frame_count == 1
+        assert ff.bytes == len(b"frame-one-bytes")
+        assert ff.append(b"frame-two-bytes")
+        assert ff.frame_count == 2
+    finally:
+        ff.close()
+
+
+def test_spool_manifest_write_never_leaves_partial(tmp_path):
+    from presto_tpu.spool.files import write_bytes
+
+    p = str(tmp_path / "manifest.json")
+    _install(short_write_rate=1.0, targets=("spool",))
+    with pytest.raises(OSError):
+        write_bytes(p, b'{"pages": 3, "bytes": 12345}')
+    assert not os.path.exists(p)
+    clear_disk_faults()
+    write_bytes(p, b'{"pages": 3, "bytes": 12345}')
+    assert os.path.exists(p)
+
+
+def test_spool_manifest_fsync_failure_unlinks(tmp_path):
+    from presto_tpu.spool.files import write_bytes
+
+    p = str(tmp_path / "manifest.json")
+    inj = _install(fsync_fail_rate=1.0, targets=("spool",))
+    with pytest.raises(OSError):
+        write_bytes(p, b"payload")
+    assert inj.injected["fsync"] == 1
+    assert not os.path.exists(p)
+
+
+def test_targets_scope_faults_to_one_subsystem(tmp_path):
+    """A spill-targeted injector must never sabotage journal writes
+    (and vice versa) — the matrix relies on target isolation."""
+    from presto_tpu.server.journal import QueryJournal
+
+    _install(enospc_rate=1.0, targets=("spill",))
+    j = QueryJournal(str(tmp_path / "j.jsonl"))
+    j.append("q1", state="FINISHED")     # unaffected
+    assert QueryJournal(str(tmp_path / "j.jsonl")).get("q1") is not None
+    sp = FileSpiller(str(tmp_path / "sp"))
+    try:
+        with pytest.raises(SpillError):
+            sp.spill(_small_page())
+    finally:
+        sp.close()

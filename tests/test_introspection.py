@@ -249,13 +249,18 @@ def test_wide_event_exactly_once_under_task_recovery(monkeypatch):
         orig = TpuTaskManager._run_inner
         executed = []
         on_victim = threading.Event()
+        killed = threading.Event()
 
         def spy(self, task):
             executed.append(
                 (self.node_id, int(task.task_id.rsplit(".", 1)[1])))
             if self.node_id == victim:
                 on_victim.set()
-                time.sleep(0.5)   # hold the victim's work for the kill
+                # hold the victim's work until the kill has actually
+                # landed (a fixed sleep races the kill on a loaded
+                # machine: the task commits first and no recovery is
+                # ever needed); capped so a broken kill can't wedge
+                killed.wait(timeout=10)
             return orig(self, task)
 
         monkeypatch.setattr(TpuTaskManager, "_run_inner", spy)
@@ -276,6 +281,7 @@ def test_wide_event_exactly_once_under_task_recovery(monkeypatch):
             "victim never executed a task"
         from tests.test_elastic import _hard_kill
         _hard_kill(c.workers[1])
+        killed.set()
         t.join(timeout=120)
         assert not t.is_alive(), "query wedged across the kill"
         assert not errors, f"query failed despite recovery: {errors}"

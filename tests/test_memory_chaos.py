@@ -1,0 +1,290 @@
+"""Memory-pressure chaos matrix: resource-exhaustion survival.
+
+The PR-14 contract under test — the reproduction of why the
+reference's memory arbitration survives real clusters (MemoryPool +
+MemoryRevokingScheduler + ClusterMemoryManager/LowMemoryKiller) —
+is:
+
+  under tiny pool budgets and seeded disk faults on the spill path,
+  every query either returns rows identical to an independent sqlite
+  oracle (admitted: straight, lifespan-batched, or via the Grace
+  spill join) or raises a clean CLASSIFIED error
+  (ExceededMemoryLimitError / MemoryLimitExceeded / SpillError) —
+  never a hang, never a crash, never silent row loss —
+
+and afterward the pool is fully released and no spill directory
+outlives its query."""
+
+import glob
+import math
+import os
+import sqlite3
+import tempfile
+
+import pytest
+
+from presto_tpu.config import MemoryConfig, Session
+from presto_tpu.connectors import TpchConnector
+from presto_tpu.exec import LocalEngine
+from presto_tpu.exec.executor import MemoryLimitExceeded
+from presto_tpu.exec.memory import ExceededMemoryLimitError, MemoryPool
+from presto_tpu.exec.spill import SpillError
+from presto_tpu.testing import (
+    DiskFaultInjector, DiskFaultSpec, clear_disk_faults,
+    install_disk_faults,
+)
+
+SF = 0.01
+
+#: execution-shape coverage: streamable scan-agg; grouped aggregation
+#: with ordering (lifespan-batched under a tiny pool); join + grouped
+#: aggregation
+QUERIES = (
+    "select count(*) from lineitem",
+    "select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus",
+    "select r_name, count(*) from nation, region "
+    "where n_regionkey = r_regionkey group by r_name order by r_name",
+)
+
+#: join-ROOTED plan: unbatchable by execute_bounded, so a tiny pool
+#: forces the build-side spill path (Grace hash join)
+JOIN_SQL = ("select n_name, r_name from nation, region "
+            "where n_regionkey = r_regionkey order by 1, 2")
+
+#: errors the engine is ALLOWED to raise under memory pressure and
+#: disk faults — anything else (bare OSError, KeyError, hang) is a
+#: survival failure
+CLASSIFIED = (ExceededMemoryLimitError, MemoryLimitExceeded, SpillError)
+
+#: 2 MiB admits the trio only through the lifespan-batched fallback
+#: (matches tests/test_memory_pool.py) — small enough to exercise the
+#: spill machinery, large enough that fault-free runs complete
+POOL_BYTES = 2 * 1024 * 1024
+
+#: disk-fault lanes on the spill target: refuse-the-write and
+#: torn-prefix-then-fail; rates < 1 so some writes succeed and the
+#: partial-progress cleanup paths run too
+SPECS = (
+    DiskFaultSpec(enospc_rate=0.3, targets=("spill",)),
+    DiskFaultSpec(short_write_rate=0.5, targets=("spill",)),
+)
+
+
+def _spill_dirs():
+    return set(glob.glob(os.path.join(tempfile.gettempdir(),
+                                      "presto_tpu_spill_*")))
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle(conn):
+    """Independent sqlite oracle over the same connector data."""
+    db = sqlite3.connect(":memory:")
+    for name in ("lineitem", "nation", "region"):
+        page = conn.table(name).page()
+        cols = list(page.names)
+        db.execute(f"create table {name} ({', '.join(cols)})")
+        db.executemany(
+            f"insert into {name} values "
+            f"({', '.join('?' * len(cols))})", page.to_pylist())
+    db.commit()
+    want = {sql: db.execute(sql).fetchall()
+            for sql in QUERIES + (JOIN_SQL,)}
+    db.close()
+    return want
+
+
+def _assert_rows_match(got, want, ctx=""):
+    assert len(got) == len(want), \
+        f"{ctx}: {len(got)} rows, oracle has {len(want)}"
+    for g, w in zip(sorted(got), sorted(want)):
+        assert len(g) == len(w), f"{ctx}: row arity {g} vs {w}"
+        for gc, wc in zip(g, w):
+            if isinstance(wc, float) or isinstance(gc, float):
+                assert math.isclose(gc, wc, rel_tol=1e-6,
+                                    abs_tol=1e-9), \
+                    f"{ctx}: {g} vs oracle {w}"
+            else:
+                assert gc == wc, f"{ctx}: {g} vs oracle {w}"
+
+
+def _pooled_engine(conn, budget, spill_dir):
+    return LocalEngine(
+        conn,
+        session=Session({"spill_enabled": "true",
+                         "spill_path": str(spill_dir)}),
+        memory_pool=MemoryPool(budget))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_memory_pressure_matrix(seed, conn, oracle, tmp_path,
+                                monkeypatch):
+    """Tiny budgets x disk faults x seeds: oracle-exact rows when
+    admitted, a clean classified error when not; pool released and no
+    spill directory leaked either way."""
+    # pin a fresh capacity store: learned (annealed) capacities from
+    # earlier tests could shrink static footprints below the budget
+    # and bypass the very machinery under test
+    monkeypatch.setenv("PRESTO_TPU_CAPS_CACHE",
+                       str(tmp_path / "caps.json"))
+    dirs_before = _spill_dirs()
+    for spec_i, spec in enumerate(SPECS):
+        install_disk_faults(DiskFaultInjector(seed=seed, spec=spec))
+        try:
+            for sql in QUERIES:
+                ctx = f"seed={seed} spec={spec_i} sql={sql!r}"
+                eng = _pooled_engine(conn, POOL_BYTES, tmp_path)
+                try:
+                    rows = eng.execute_sql(sql)
+                except CLASSIFIED:
+                    pass            # clean, classified refusal
+                else:
+                    _assert_rows_match(rows, oracle[sql], ctx)
+                assert eng.memory_pool.reserved == 0, ctx
+            # the join-rooted shape under a budget too small for the
+            # build: MUST go through the spiller (or fail classified
+            # when the fault schedule refuses every write)
+            ctx = f"seed={seed} spec={spec_i} sql=join"
+            eng = _pooled_engine(conn, 6000, tmp_path)
+            try:
+                rows = eng.execute_sql(JOIN_SQL)
+            except CLASSIFIED:
+                pass
+            else:
+                _assert_rows_match(rows, oracle[JOIN_SQL], ctx)
+                assert eng.last_spill_join_stats is not None, ctx
+            assert eng.memory_pool.reserved == 0, ctx
+        finally:
+            clear_disk_faults()
+    assert _spill_dirs() == dirs_before, "spill directory leaked"
+
+
+def test_join_build_spill_matches_unconstrained(conn, oracle,
+                                                tmp_path, monkeypatch):
+    """Acceptance: a hash join whose build side exceeds the pool
+    budget completes via build-side spill with rows identical to the
+    unconstrained run — and the spill provably fired."""
+    monkeypatch.setenv("PRESTO_TPU_CAPS_CACHE",
+                       str(tmp_path / "caps.json"))
+    dirs_before = _spill_dirs()
+    baseline = LocalEngine(conn).execute_sql(JOIN_SQL)
+    _assert_rows_match(baseline, oracle[JOIN_SQL], "baseline")
+
+    eng = LocalEngine(conn, memory_pool=MemoryPool(6000))
+    rows = eng.execute_sql(JOIN_SQL)
+    assert rows == baseline
+    st = eng.last_spill_join_stats
+    assert st is not None, "spill join never ran"
+    assert st["spilled_bytes"] > 0 and st["spill_files"] >= 2
+    assert st["partitions"] >= 2
+    assert eng.memory_pool.reserved == 0
+    # the spiller's own temp directory must not outlive the query
+    assert _spill_dirs() == dirs_before
+
+
+# =====================================================================
+# cluster-side arbitration: worker pools, heartbeat scrape, low-memory
+# killer terminality, client classification
+# =====================================================================
+
+def test_dbapi_classifies_memory_and_spill_errors():
+    """The wire carries only a message string; the client must map the
+    arbiter's stable phrases to ExceededMemoryLimitError and leave
+    everything else as plain DatabaseError."""
+    from presto_tpu.client.dbapi import (
+        DatabaseError, ExceededMemoryLimitError as DbMemErr,
+        _classify_server_error,
+    )
+    kill = _classify_server_error(
+        "Query q1 exceeded cluster memory limit: reserved 2000 bytes, "
+        "budget 1000 bytes")
+    node = _classify_server_error(
+        "Query q2 exceeded node memory limit: reserved 9 bytes, "
+        "budget 8 bytes")
+    spill = _classify_server_error("Spill failed: spill write failed")
+    other = _classify_server_error("table 'nope' not found")
+    assert isinstance(kill, DbMemErr)
+    assert isinstance(node, DbMemErr)
+    assert isinstance(spill, DbMemErr)
+    assert isinstance(other, DatabaseError)
+    assert not isinstance(other, DbMemErr)
+
+
+@pytest.fixture(scope="module")
+def kill_cluster():
+    """Node pools with headroom; the CLUSTER budget (query_max_memory
+    role) is tiny, so any real query becomes the biggest over-budget
+    query and the low-memory killer's victim."""
+    from presto_tpu.server.cluster import TpuCluster
+    c = TpuCluster(
+        TpchConnector(SF), n_workers=2,
+        memory_config=MemoryConfig(pool_bytes=64 << 20,
+                                   cluster_bytes=1000),
+        session_properties={"retry_policy": "TASK"})
+    yield c
+    c.stop()
+
+
+def test_worker_memory_endpoints_and_heartbeat_scrape(kill_cluster):
+    """Reservations surface on /v1/status and /v1/memory and the
+    coordinator's heartbeat scrape aggregates them into
+    cluster_reservations (the per-tenant quota input)."""
+    c = kill_cluster
+    pool = c.workers[0].task_manager.memory_pool
+    assert pool is not None and pool.budget == 64 << 20
+    pool.reserve("qscrape.0.0.0.0", 2048)
+    try:
+        uri = c.all_worker_uris[0]
+        st = c.http.get_json(f"{uri}/v1/status")
+        assert st["memoryPool"]["budgetBytes"] == 64 << 20
+        assert st["memoryPool"]["queryReservations"]["qscrape"] == 2048
+        mem = c.http.get_json(f"{uri}/v1/memory")
+        gen = mem["pools"]["general"]
+        assert gen["maxBytes"] == 64 << 20
+        assert gen["queryMemoryReservations"]["qscrape"] == 2048
+        # heartbeat path: check_workers scrapes every live worker
+        assert len(c.check_workers()) == 2
+        assert c.cluster_reservations.get("qscrape") == 2048
+    finally:
+        pool.free("qscrape")
+    assert c.check_workers() and \
+        c.cluster_reservations.get("qscrape") is None
+
+
+def test_cluster_low_memory_killer_is_terminal_under_task_retry(
+        kill_cluster):
+    """The killer fires mid-flight with an EXCEEDED_MEMORY_LIMIT-class
+    error that retry_policy=TASK must treat as TERMINAL: one clean
+    classified failure, never a hang or re-execution."""
+    from presto_tpu.server.cluster import ClusterMemoryKillError
+    c = kill_cluster
+    with pytest.raises(ClusterMemoryKillError,
+                       match="cluster memory limit"):
+        c.execute_sql(QUERIES[1])
+    assert c.cluster_memory is not None and c.cluster_memory.kills >= 1
+    # every reservation was torn down with the victim
+    for w in c.workers:
+        assert w.task_manager.memory_pool.reserved == 0
+
+
+def test_cluster_node_pool_refuses_oversized_query():
+    """A query whose static footprint exceeds the per-node pool is
+    refused at task admission with the classified node-limit error —
+    propagated as a clean ClusterQueryError, not a wedge."""
+    from presto_tpu.server.cluster import ClusterQueryError, TpuCluster
+    c = TpuCluster(TpchConnector(SF), n_workers=2,
+                   memory_config=MemoryConfig(pool_bytes=2000))
+    try:
+        with pytest.raises(ClusterQueryError,
+                           match="exceeded node memory limit"):
+            c.execute_sql("select count(*) from lineitem")
+        for w in c.workers:
+            assert w.task_manager.memory_pool.reserved == 0
+    finally:
+        c.stop()
