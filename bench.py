@@ -57,6 +57,13 @@ BENCH_DS_QUERIES to a comma list (or "default" for a 10-query
 scan/agg/join subset) to append ds_qNN entries to detail; BENCH_DS_SF
 (default 0.1) scales the DS dataset. DS entries join the suite geomean
 alongside the TPC-H ones.
+
+Serving-tier lane: BENCH_SERVE=0 disables the `detail.serve` round
+(event-loop front door driven by the closed-loop harness at
+BENCH_SERVE_CLIENTS scales, default 200,600,1000, each scale
+submitting BENCH_SERVE_STATEMENTS statements — default = the client
+count — plus an aio-vs-threaded shell A/B sized by
+BENCH_SERVE_AB_CLIENTS / BENCH_SERVE_AB_REQUESTS).
 """
 
 import json
@@ -267,6 +274,8 @@ def main() -> None:
         return _mv_child()
     if os.environ.get("BENCH_MEMORY_ONE"):
         return _memory_child()
+    if os.environ.get("BENCH_SERVE_ONE"):
+        return _serve_child()
     if ds_one:
         return _ds_child(int(ds_one), runs, warmup)
     if pq_one:
@@ -643,6 +652,17 @@ def _main_orchestrator(sf, qids) -> None:
                 float(os.environ.get("BENCH_MEMORY_TIMEOUT_S", "240"))
                 + 120.0)
 
+    # serving-tier round (one JSON `serve` entry: event-loop front
+    # door at 200 -> 1000 concurrent long-polling clients — p99,
+    # server-side threads, keep-alive reuse — plus a shell A/B of the
+    # aio loop vs the retired thread-per-connection shell). The engine
+    # is a constant-time stub, so this lane runs even when the device
+    # probe is wedged; BENCH_SERVE=0 disables
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        detail["serve"] = _run_serve_child(
+            float(os.environ.get("BENCH_SERVE_TIMEOUT_S", "300"))
+            + 120.0)
+
     if wedged is not None:
         detail["infra_error"] = wedged
         detail["probe_log"] = probe_log
@@ -854,6 +874,189 @@ def _load_child() -> None:
     print(json.dumps({"metric": "admission_load_round", "value":
                       out["statements_per_sec"], "unit": "stmt/s",
                       "detail": {"admission": out}}))
+
+
+def _serve_child() -> None:
+    """Serving-tier round. Two parts:
+
+    1. The real event-loop front door (StatementServer on
+       AioHttpServer) under the closed-loop harness at increasing
+       client counts (BENCH_SERVE_CLIENTS, default 200,600,1000) — a
+       constant-time stub engine isolates the HTTP path: loop
+       dispatch, keep-alive pooling, long-poll parks. Reports p99,
+       server-side peak threads, and pooled-transport reuse per scale.
+    2. A shell A/B: the same trivial App served by the aio loop and
+       by the retired thread-per-connection shell, same client count —
+       the thread-population contrast is the tentpole number.
+    """
+    import threading as _threading
+
+    from presto_tpu.admission import (ResourceGroup,
+                                      ResourceGroupManager, Selector)
+    from presto_tpu.config import AdmissionConfig
+    from presto_tpu.net import M_KEEPALIVE_REUSE
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.testing.load import LoadHarness, percentile
+
+    scales = [int(c) for c in os.environ.get(
+        "BENCH_SERVE_CLIENTS", "200,600,1000").split(",") if c]
+    stmts_env = os.environ.get("BENCH_SERVE_STATEMENTS", "")
+    tenants = {"alpha": 2, "beta": 1, "gamma": 1}
+
+    class _StubEngine:
+        def execute_sql(self, sql):
+            time.sleep(0.005)
+            return [(1,)]
+
+        def plan_sql(self, sql):
+            raise ValueError("stub has no planner")
+
+    rows = []
+    for clients in scales:
+        statements = int(stmts_env) if stmts_env else clients
+        leaves = [ResourceGroup(n, hard_concurrency=32,
+                                max_queued=statements + 100,
+                                scheduling_weight=w)
+                  for n, w in tenants.items()]
+        root = ResourceGroup("front", hard_concurrency=32,
+                             max_queued=0, children=leaves)
+        mgr = ResourceGroupManager(
+            [root],
+            [Selector(n, user_regex=n) for n in tenants]
+            + [Selector("alpha")])
+        srv = StatementServer(
+            _StubEngine(), resource_groups=mgr,
+            admission=AdmissionConfig(max_dispatch_threads=8))
+        srv.start()
+        try:
+            reuse0 = M_KEEPALIVE_REUSE.value(role="client-pool")
+            t0 = time.perf_counter()
+            report = LoadHarness(
+                srv.base, tenants, clients=clients,
+                statements=statements, seed=17,
+                timeout_s=float(os.environ.get(
+                    "BENCH_SERVE_TIMEOUT_S", "300"))).run()
+            wall = time.perf_counter() - t0
+            net = srv.httpd.stats()
+            rows.append({
+                "clients": clients, "statements": statements,
+                "completed": report.completed,
+                "dropped": report.dropped,
+                "wall_s": round(wall, 3),
+                "statements_per_sec":
+                    round(report.completed / wall, 1) if wall else 0.0,
+                "e2e_p50_s": round(report.latency()["e2e_p50_s"], 4),
+                "e2e_p99_s": round(report.latency()["e2e_p99_s"], 4),
+                "peak_server_threads": report.peak_server_threads,
+                "keepalive_reuse":
+                    int(M_KEEPALIVE_REUSE.value(role="client-pool")
+                        - reuse0),
+                "net": net,
+            })
+        finally:
+            srv.stop()
+
+    # ---- shell A/B: aio loop vs thread-per-connection ----------------
+    from presto_tpu.net.aio_server import AioHttpServer, json_response
+    from presto_tpu.net.threaded import ThreadedAppServer
+
+    class _PingApp:
+        def handle(self, req):
+            return json_response(200, {"ok": True})
+
+    ab_clients = int(os.environ.get("BENCH_SERVE_AB_CLIENTS", "200"))
+    ab_requests = int(os.environ.get("BENCH_SERVE_AB_REQUESTS", "10"))
+
+    def _shell_round(shell) -> dict:
+        import socket as _socket
+        lat, errs = [], [0]
+        peak = [_threading.active_count()]
+        stop = _threading.Event()
+
+        def _sample():
+            while not stop.is_set():
+                peak[0] = max(peak[0], _threading.active_count())
+                stop.wait(0.02)
+
+        def _client():
+            try:
+                s = _socket.create_connection(
+                    ("127.0.0.1", shell.port), timeout=30)
+                s.settimeout(30)
+                msg = b"GET /ping HTTP/1.1\r\nHost: b\r\n\r\n"
+                for _ in range(ab_requests):
+                    t0 = time.perf_counter()
+                    s.sendall(msg)
+                    buf = b""
+                    while b"}" not in buf:
+                        chunk = s.recv(4096)
+                        if not chunk:
+                            raise ConnectionError("torn")
+                        buf += chunk
+                    lat.append(time.perf_counter() - t0)
+                s.close()
+            except Exception:   # noqa: BLE001 — counted, not raised
+                errs[0] += 1
+
+        sampler = _threading.Thread(target=_sample, daemon=True)
+        sampler.start()
+        threads = [_threading.Thread(target=_client, daemon=True)
+                   for _ in range(ab_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        wall = time.perf_counter() - t0
+        stop.set()
+        sampler.join(timeout=1)
+        return {"impl": shell.stats()["impl"],
+                "clients": ab_clients,
+                "requests": ab_clients * ab_requests,
+                "errors": errs[0],
+                "wall_s": round(wall, 3),
+                "rps": round(len(lat) / wall, 1) if wall else 0.0,
+                "p50_ms": round(percentile(lat, 0.50) * 1e3, 2),
+                "p99_ms": round(percentile(lat, 0.99) * 1e3, 2),
+                "peak_threads": peak[0]}
+
+    ab = {}
+    for name, cls in (("aio", AioHttpServer),
+                      ("threaded", ThreadedAppServer)):
+        shell = cls(_PingApp(), "127.0.0.1", 0, role="bench").start()
+        try:
+            ab[name] = _shell_round(shell)
+        finally:
+            shell.shutdown()
+            shell.server_close()
+
+    out = {"scales": rows, "shell_ab": ab}
+    headline = rows[-1]["statements_per_sec"] if rows else 0.0
+    print(json.dumps({"metric": "serve_longpoll_round",
+                      "value": headline, "unit": "stmt/s",
+                      "detail": {"serve": out}}))
+
+
+def _run_serve_child(timeout_s: float):
+    """Run the serving-tier round in a subprocess; returns the `serve`
+    detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_SERVE_ONE="1", BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "serve", {"error": "child produced no serve entry"})
 
 
 def _run_load_child(timeout_s: float):

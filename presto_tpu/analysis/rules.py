@@ -24,12 +24,21 @@ from presto_tpu.analysis.framework import (
 )
 
 # =====================================================================
-# 1. rpc-chokepoint — protocol/transport.py is the only urlopen site
+# 1. rpc-chokepoint — protocol/transport.py is the only place that
+#    opens an outbound HTTP connection (urlopen OR http.client dials)
 # =====================================================================
 
 _URLOPEN_DIRECT = re.compile(r"urllib\s*\.\s*request\s*\.\s*urlopen")
 _URLOPEN_IMPORT = re.compile(
     r"from\s+urllib\s*\.\s*request\s+import\s+[^\n]*\burlopen\b")
+#: dialing http.client directly (the pooled transport's own idiom)
+#: bypasses the pool, retry classification, breakers, fault injection
+#: AND the header providers that sign internal requests
+_HTTPCONN_DIRECT = re.compile(
+    r"http\s*\.\s*client\s*\.\s*HTTPS?Connection\s*\(")
+_HTTPCONN_IMPORT = re.compile(
+    r"from\s+http\s*\.\s*client\s+import\s+[^\n]*"
+    r"\bHTTPS?Connection\b")
 
 _TRANSPORT = "presto_tpu/protocol/transport.py"
 
@@ -38,19 +47,26 @@ class RpcChokepointRule(Rule):
     name = "rpc-chokepoint"
     description = (
         "every HTTP request rides protocol/transport.HttpClient so "
-        "retry policies, error classification, circuit breakers and "
-        "fault injection apply uniformly; a raw urlopen anywhere else "
-        "opts that call site out of all of it")
+        "retry policies, error classification, circuit breakers, "
+        "keep-alive pooling, request signing and fault injection "
+        "apply uniformly; a raw urlopen or http.client dial anywhere "
+        "else opts that call site out of all of it")
 
     def run(self, pkg: Package) -> Iterable[Finding]:
         out = regex_findings(
-            self, pkg, (_URLOPEN_DIRECT, _URLOPEN_IMPORT),
-            "raw urlopen outside protocol/transport.py — route this "
+            self, pkg,
+            (_URLOPEN_DIRECT, _URLOPEN_IMPORT,
+             _HTTPCONN_DIRECT, _HTTPCONN_IMPORT),
+            "raw HTTP dial outside protocol/transport.py — route this "
             "through transport.HttpClient",
             allowed=(_TRANSPORT,))
+        # honesty: the allowlisted file must still contain the policed
+        # dial idiom (today the pooled HTTPConnection transport; the
+        # urlopen form also counts so the check spans both eras)
         out.extend(honesty_finding(
-            self, pkg, _TRANSPORT, (_URLOPEN_DIRECT,),
-            "the urlopen transport"))
+            self, pkg, _TRANSPORT,
+            (_HTTPCONN_DIRECT, _URLOPEN_DIRECT),
+            "the pooled-connection transport"))
         return out
 
 
@@ -500,7 +516,8 @@ register(LockLeakRule())
 # =====================================================================
 
 _CONTROL_PLANE = ("presto_tpu/server/", "presto_tpu/protocol/",
-                  "presto_tpu/spool/", "presto_tpu/obs/")
+                  "presto_tpu/spool/", "presto_tpu/obs/",
+                  "presto_tpu/net/")
 
 
 def _module_level_stmts(tree: ast.Module) -> Iterable[ast.stmt]:
@@ -556,8 +573,11 @@ register(NoJaxInControlPlaneRule())
 #     admission dispatcher's bounded pool
 # =====================================================================
 
+#: `handle` is the App-contract router (net/aio_server.py shells); the
+#: do_* names are the http.server handler surface the threaded shell
+#: and test doubles still use
 _HANDLER_METHODS = ("do_GET", "do_POST", "do_DELETE", "do_PUT",
-                    "do_HEAD")
+                    "do_HEAD", "handle")
 
 
 def _is_spawn_call(call: ast.Call) -> bool:
@@ -617,6 +637,80 @@ class NoSpawnInRequestHandlerRule(Rule):
 
 
 register(NoSpawnInRequestHandlerRule())
+
+# =====================================================================
+# 10b. no-blocking-in-event-loop — async def bodies never block the
+#      loop thread (sleep via asyncio, blocking work via run_blocking)
+# =====================================================================
+
+
+def _loop_blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why `call` would stall the event loop, or None. One blocked
+    coroutine freezes EVERY parked long-poll on the server."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            return "time.sleep on the event loop — await " \
+                   "asyncio.sleep instead"
+        if fn.attr in _RPC_METHODS:
+            return (f".{fn.attr}() blocking RPC on the event loop — "
+                    f"dispatch it through server.run_blocking")
+    if isinstance(fn, ast.Name) and fn.id == "urlopen":
+        return "urlopen on the event loop — dispatch it through " \
+               "server.run_blocking"
+    if _is_thread_join(call):
+        return ".join() on the event loop — a thread join parks the " \
+               "loop and every coroutine on it"
+    return None
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walk an async def's LEXICAL body without descending into nested
+    defs/lambdas — an inline sync helper handed to run_blocking runs on
+    the executor, not the loop."""
+
+    def __init__(self, rule: Rule, f: SourceFile, out: List[Finding]):
+        self.rule, self.f, self.out = rule, f, out
+
+    def visit_FunctionDef(self, node):   # noqa: N802 — ast API
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):          # noqa: N802 — ast API
+        reason = _loop_blocking_reason(node)
+        if reason is not None:
+            self.out.append(self.rule.finding(self.f, node.lineno,
+                                              reason))
+        self.generic_visit(node)
+
+
+class NoBlockingInEventLoopRule(Rule):
+    name = "no-blocking-in-event-loop"
+    description = (
+        "async def bodies must not call time.sleep, a blocking "
+        "transport RPC/urlopen, or a thread join — the event loop "
+        "serves every connection on one thread, so one blocking call "
+        "stalls all of them; sleep with asyncio.sleep and push "
+        "blocking work through the server's bounded executor")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for f in pkg.walk("presto_tpu/"):
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.AsyncFunctionDef):
+                    continue
+                v = _AsyncBodyVisitor(self, f, out)
+                for stmt in node.body:
+                    v.visit(stmt)
+        return out
+
+
+register(NoBlockingInEventLoopRule())
 
 # =====================================================================
 # 11. no-planner-in-data-plane — ops/ and parallel/ never consult the

@@ -193,6 +193,50 @@ DEFAULT_TRANSPORT = TransportConfig()
 
 
 @dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """Serving-tier knobs (reference: the reference engine's
+    HttpServerConfig — acceptor/selector threads, max request header
+    size, idle connection timeout — plus HttpClientConfig's connection
+    pool sizing). One per process; `net/aio_server.AioHttpServer` and
+    the keep-alive pool in `protocol/transport.py` are built from
+    this."""
+
+    # -- server (event-loop front door) ------------------------------
+    #: bounded executor threads for CPU/blocking handler dispatch —
+    #: the only per-server thread growth (no thread-per-connection)
+    executor_workers: int = 8
+    #: slowloris guard: a connection that has not delivered complete
+    #: request headers within this window is closed
+    header_timeout_s: float = 10.0
+    #: close a keep-alive connection idle (between requests) this long
+    idle_timeout_s: float = 60.0
+    #: cap on concurrently open server connections; beyond it new
+    #: accepts are closed immediately (pool exhaustion is load-shed at
+    #: the door, not queued into memory)
+    max_connections: int = 4096
+    #: event-loop lag heartbeat cadence: a timer fires at this interval
+    #: and the observed overshoot lands in
+    #: `net_event_loop_lag_seconds` — blocked-loop detection
+    loop_lag_tick_s: float = 0.25
+    #: spooled result ranges at least this large go out via
+    #: `os.sendfile` instead of read+write (small ranges aren't worth
+    #: the extra syscalls)
+    sendfile_min_bytes: int = 4096
+
+    # -- client (keep-alive connection pool) -------------------------
+    #: idle pooled connections kept per destination host:port
+    pool_per_host: int = 8
+    #: evict a pooled connection idle longer than this (must stay
+    #: under typical server idle_timeout_s so we rarely pick up a
+    #: connection the server is about to close)
+    pool_idle_ttl_s: float = 30.0
+
+
+#: process defaults; tests construct their own with tighter windows
+DEFAULT_NET = NetConfig()
+
+
+@dataclasses.dataclass(frozen=True)
 class CacheConfig:
     """Fragment-result-cache knobs (reference: FragmentCacheStats +
     fragment-result-cache config in the native worker; Presto@Meta

@@ -1,0 +1,138 @@
+"""Thread-per-connection baseline over the same App contract.
+
+Kept for two reasons: the `detail.serve` bench lane measures the
+event-loop front door against this shell (the before/after the ISSUE
+asks for), and operators get a one-line fallback if an asyncio bug
+ever takes the loop down in production. It serves EXACTLY the same
+App objects as `net/aio_server.AioHttpServer` — handle(request) ->
+Response | None — so switching shells changes the threading model and
+nothing on the wire.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from presto_tpu.config import DEFAULT_NET, NetConfig
+from presto_tpu.net import M_CONNECTIONS_OPENED, M_OPEN_CONNECTIONS
+from presto_tpu.net.aio_server import (
+    Headers, Request, Response, SendFile, render_head,
+)
+from presto_tpu.utils.threads import spawn
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):   # noqa: D102 — quiet
+        pass
+
+    def _serve(self) -> None:
+        srv: "ThreadedAppServer" = self.server   # type: ignore
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(n) if n else b""
+        req = Request(self.command, self.path,
+                      Headers(self.headers.items()), body)
+        try:
+            resp: Optional[Response] = srv.app.handle(req)
+        except Exception as e:  # noqa: BLE001 — match the aio shell's
+            # handler-bug containment: plain 500, connection survives
+            resp = Response(
+                500, f'{{"error": "{type(e).__name__}"}}'.encode())
+        if resp is None:
+            # kill simulation: tear the connection with no response
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return
+        srv.requests_served += 1
+        keep = (self.headers.get("Connection", "") or "").lower() \
+            != "close"
+        self.close_connection = not keep
+        try:
+            self.wfile.write(render_head(resp, keep, srv.name))
+            body = resp.body
+            if resp.status in (204, 304):
+                pass
+            elif isinstance(body, SendFile):
+                with open(body.path, "rb") as f:
+                    f.seek(body.offset)
+                    left = body.count
+                    while left > 0:
+                        chunk = f.read(min(left, 1 << 20))
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        left -= len(chunk)
+            elif isinstance(body, (list, tuple)):
+                for frame in body:
+                    self.wfile.write(frame)
+            elif body:
+                self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+
+    do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+
+class ThreadedAppServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer shell for an App; same start/stop surface as
+    AioHttpServer so call sites can swap shells freely."""
+
+    daemon_threads = True
+    request_queue_size = 256
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
+                 role: str = "server",
+                 net_config: Optional[NetConfig] = None):
+        super().__init__((host, port), _Handler)
+        self.app = app
+        self.role = role
+        self.cfg = net_config if net_config is not None else DEFAULT_NET
+        self.dead = False
+        self.port = self.server_address[1]
+        self.requests_served = 0
+        self._open = 0
+        self._open_lock = threading.Lock()
+        self._thread = spawn("net", f"{role}-threaded", self._run,
+                             start=False)
+
+    def process_request(self, request, client_address):
+        with self._open_lock:
+            self._open += 1
+        M_OPEN_CONNECTIONS.set(self._open, role=self.role)
+        M_CONNECTIONS_OPENED.inc(role=self.role)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._open_lock:
+            self._open = max(0, self._open - 1)
+        M_OPEN_CONNECTIONS.set(self._open, role=self.role)
+        super().shutdown_request(request)
+
+    @property
+    def name(self) -> str:
+        return f"presto-tpu-{self.role}"
+
+    # --------- AioHttpServer-shaped lifecycle -------------------------
+    def _run(self) -> None:
+        self.serve_forever(poll_interval=0.05)
+
+    def start(self) -> "ThreadedAppServer":
+        self._thread.start()
+        return self
+
+    def run_blocking(self, fn, *args):
+        raise RuntimeError("threaded shell has no loop executor")
+
+    def stats(self) -> dict:
+        return {
+            "impl": "threaded",
+            "openConnections": self._open,
+            "requestsServed": self.requests_served,
+        }

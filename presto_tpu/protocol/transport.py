@@ -31,27 +31,40 @@ A deterministic `FaultInjector` (testing/faults.py) can be installed on
 any client; its hooks run inside `request` so injected faults exercise
 the real retry/classification/breaker paths.
 
-The module still calls `urllib.request.urlopen` internally — the ONLY
-place in presto_tpu that may (tests/test_rpc_chokepoint.py enforces
-this) — so the internal-JWT opener installed by server/auth.py keeps
-signing every request.
+Connections are keep-alive POOLED (PR 17): each logical request runs on
+a per-host `http.client.HTTPConnection` drawn from `ConnectionPool`
+instead of a one-shot urlopen — the hot coordinator->worker paths
+(status long-polls, page fetches) reuse a warm socket per round trip.
+The pool preserves every wire contract above it: responses with
+status >= 400 are re-raised as `urllib.error.HTTPError`, so the retry
+classification, overload handling, and breaker accounting are
+byte-for-byte the pre-pool logic. This module remains the ONLY place in
+presto_tpu that may open an intra-cluster HTTP connection
+(tests/test_rpc_chokepoint.py enforces this); outbound request signing
+now happens through `register_header_provider` — server/auth.py
+registers the internal-JWT signer there.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import http.client
+import io
 import json as _json
 import logging
 import random
+import select
 import threading
 import time
 import urllib.error
 import urllib.parse
-import urllib.request
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
-from presto_tpu.config import DEFAULT_TRANSPORT, TransportConfig
+from presto_tpu.config import DEFAULT_NET, DEFAULT_TRANSPORT, \
+    NetConfig, TransportConfig
+from presto_tpu.net import (
+    M_CONNECTIONS_OPENED, M_KEEPALIVE_REUSE, M_OPEN_CONNECTIONS,
+)
 from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
 from presto_tpu.utils.tracing import TRACE_HEADER, current_trace
 
@@ -314,13 +327,226 @@ def _host_of(url: str) -> str:
     return urllib.parse.urlsplit(url).netloc or url
 
 
+# --------------------------------------------------------------------------
+# Outbound header providers: the pooled transport's replacement for the
+# urllib opener hook. Each provider is called with (url, headers) right
+# before the bytes leave the process and returns extra headers (or
+# None). server/auth.py registers the internal-JWT signer here, so the
+# single-RPC-chokepoint property keeps implying "every intra-cluster
+# request is signed".
+_HEADER_PROVIDERS: List[Callable[[str, dict], Optional[dict]]] = []
+_PROVIDER_LOCK = threading.Lock()
+
+
+def register_header_provider(
+        fn: Callable[[str, dict], Optional[dict]]) -> None:
+    with _PROVIDER_LOCK:
+        if fn not in _HEADER_PROVIDERS:
+            _HEADER_PROVIDERS.append(fn)
+
+
+def _apply_header_providers(url: str, headers: dict) -> dict:
+    with _PROVIDER_LOCK:
+        providers = list(_HEADER_PROVIDERS)
+    for fn in providers:
+        extra = fn(url, headers)
+        if extra:
+            headers.update(extra)
+    return headers
+
+
+class _PooledConn:
+    """One keep-alive connection plus the bookkeeping reuse needs."""
+
+    __slots__ = ("conn", "idle_since")
+
+    def __init__(self, conn: http.client.HTTPConnection,
+                 idle_since: float):
+        self.conn = conn
+        self.idle_since = idle_since
+
+
+def _sock_is_dead(sock) -> bool:
+    """An IDLE keep-alive socket must have nothing to read; readable
+    means the peer sent EOF (or stray bytes) while it sat in the pool —
+    either way it cannot carry another request."""
+    try:
+        r, _w, _x = select.select([sock], [], [], 0)
+        return bool(r)
+    except (OSError, ValueError):
+        return True
+
+
+class ConnectionPool:
+    """Per-host keep-alive `http.client.HTTPConnection` pool.
+
+    `perform` is the one method that touches sockets: acquire (reuse a
+    live idle connection or dial), send, read the FULL body, then
+    return the connection to its host's idle list (LIFO, capped at
+    `pool_per_host`, TTL-evicted). A REUSED connection that dies before
+    any response bytes arrive is the standard keep-alive race — the
+    server closed the idle socket as we wrote — and is resent ONCE on a
+    fresh dial, invisibly to the retry policy above. Responses with
+    status >= 400 re-raise as `urllib.error.HTTPError` so the caller's
+    classification logic is unchanged from the urlopen era."""
+
+    def __init__(self, net_config: Optional[NetConfig] = None,
+                 clock=None):
+        self.cfg = net_config if net_config is not None else DEFAULT_NET
+        self._clock = clock or time.monotonic
+        self._idle: Dict[str, List[_PooledConn]] = {}
+        self._lock = threading.Lock()
+        self._open = 0
+        self.opened = 0
+        self.reused = 0
+        self.evicted_dead = 0
+        self.evicted_ttl = 0
+
+    # ----------------------------------------------------------- accounting
+    def _count_open(self, delta: int) -> None:
+        with self._lock:
+            self._open = max(0, self._open + delta)
+            open_now = self._open
+        M_OPEN_CONNECTIONS.set(open_now, role="client-pool")
+
+    def _close(self, conn: http.client.HTTPConnection) -> None:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001 — already torn
+            pass
+        self._count_open(-1)
+
+    # -------------------------------------------------------------- acquire
+    def _acquire(self, scheme: str, netloc: str, timeout: float
+                 ) -> Tuple[http.client.HTTPConnection, bool]:
+        """(connection, reused). Reuse the most recently idled live
+        connection (LIFO keeps the warm socket warm); TTL-expired and
+        peer-closed sockets are evicted on the way."""
+        now = self._clock()
+        while True:
+            with self._lock:
+                bucket = self._idle.get(netloc)
+                pc = bucket.pop() if bucket else None
+            if pc is None:
+                break
+            if now - pc.idle_since > self.cfg.pool_idle_ttl_s:
+                self.evicted_ttl += 1
+                self._close(pc.conn)
+                continue
+            if pc.conn.sock is None or _sock_is_dead(pc.conn.sock):
+                self.evicted_dead += 1
+                self._close(pc.conn)
+                continue
+            pc.conn.timeout = timeout
+            try:
+                pc.conn.sock.settimeout(timeout)
+            except OSError:
+                self.evicted_dead += 1
+                self._close(pc.conn)
+                continue
+            self.reused += 1
+            M_KEEPALIVE_REUSE.inc(role="client-pool")
+            return pc.conn, True
+        host, _, port = netloc.partition(":")
+        portno = int(port) if port else None
+        if scheme == "https":
+            conn = http.client.HTTPSConnection(host, portno,
+                                               timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(host, portno,
+                                              timeout=timeout)
+        self.opened += 1
+        self._count_open(+1)
+        M_CONNECTIONS_OPENED.inc(role="client-pool")
+        return conn, False
+
+    def _release(self, netloc: str, conn: http.client.HTTPConnection
+                 ) -> None:
+        pc = _PooledConn(conn, self._clock())
+        with self._lock:
+            bucket = self._idle.setdefault(netloc, [])
+            if len(bucket) < self.cfg.pool_per_host:
+                bucket.append(pc)
+                return
+        self._close(conn)       # bucket full: newest idles win
+
+    # -------------------------------------------------------------- perform
+    def perform(self, url: str, method: str, body: Optional[bytes],
+                headers: dict, timeout: float
+                ) -> Tuple[int, dict, bytes]:
+        """One HTTP exchange on a pooled connection. Returns (status,
+        headers, body) for < 400; raises urllib.error.HTTPError for
+        >= 400 and the usual OSError/HTTPException family for
+        connection-level failures."""
+        parts = urllib.parse.urlsplit(url)
+        netloc = parts.netloc
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        hdrs = _apply_header_providers(url, dict(headers))
+        resend = False
+        while True:
+            conn, reused = self._acquire(parts.scheme, netloc, timeout)
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                self._close(conn)
+                if reused and not resend \
+                        and not isinstance(e, TimeoutError):
+                    # keep-alive race: the server closed this idle
+                    # socket as we wrote. No response bytes exist, so
+                    # ONE silent resend on a fresh dial is safe for any
+                    # method — the request was never processed.
+                    resend = True
+                    continue
+                raise
+            try:
+                raw = resp.read()
+            except (ConnectionError, OSError,
+                    http.client.HTTPException):
+                # mid-body death is NOT resent here: bytes were
+                # received, so the retry policy above owns the decision
+                self._close(conn)
+                raise
+            if resp.will_close:
+                self._close(conn)
+            else:
+                self._release(netloc, conn)
+            if resp.status >= 400:
+                raise urllib.error.HTTPError(
+                    url, resp.status, resp.reason, resp.headers,
+                    io.BytesIO(raw))
+            return resp.status, dict(resp.headers), raw
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            idle = sum(len(b) for b in self._idle.values())
+        return {"open": self._open, "idle": idle,
+                "opened": self.opened, "reused": self.reused,
+                "evictedDead": self.evicted_dead,
+                "evictedTtl": self.evicted_ttl}
+
+    def close(self) -> None:
+        with self._lock:
+            buckets = list(self._idle.values())
+            self._idle.clear()
+        for bucket in buckets:
+            for pc in bucket:
+                self._close(pc.conn)
+
+
 class HttpClient:
     """One fault-tolerant HTTP client; breakers are keyed per host so a
     coordinator-side instance tracks each worker independently."""
 
     def __init__(self, config: Optional[TransportConfig] = None,
                  fault_injector=None, rng: Optional[random.Random] = None,
-                 clock=None, sleep=None):
+                 clock=None, sleep=None,
+                 net_config: Optional[NetConfig] = None,
+                 pool: Optional[ConnectionPool] = None):
         self.config = config or DEFAULT_TRANSPORT
         self.policies = _build_policies(self.config)
         self.fault_injector = fault_injector
@@ -329,6 +555,8 @@ class HttpClient:
         self._sleep = sleep or time.sleep
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._lock = threading.Lock()
+        self.pool = pool if pool is not None \
+            else ConnectionPool(net_config)
 
     # ------------------------------------------------------------ breakers
     def breaker(self, url_or_host: str) -> CircuitBreaker:
@@ -380,12 +608,8 @@ class HttpClient:
             try:
                 if injector is not None:
                     injector.before_request(url, method)
-                req = urllib.request.Request(
-                    url, data=body, method=method, headers=hdrs)
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    raw = resp.read()
-                    resp_headers = dict(resp.headers)
-                    status = resp.status
+                status, resp_headers, raw = self.pool.perform(
+                    url, method, body, hdrs, timeout)
                 if injector is not None:
                     raw = injector.after_response(url, method, raw)
                 breaker.record_success()

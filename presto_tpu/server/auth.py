@@ -96,32 +96,26 @@ class InternalAuthenticator:
 
 
 #: process-wide client-side authenticator (None = auth disabled). The
-#: coordinator/worker startup configures it; a urllib opener handler
-#: then signs EVERY outbound /v1/* request in this process (announcer
-#: PUTs, task POSTs, status polls, exchange pulls) — the reference
-#: installs the equivalent as an HttpClient request filter.
+#: coordinator/worker startup configures it; a transport header
+#: provider (protocol/transport.register_header_provider) then signs
+#: EVERY outbound /v1/* request in this process (announcer PUTs, task
+#: POSTs, status polls, exchange pulls) — possible because the pooled
+#: transport is the single RPC chokepoint; the reference installs the
+#: equivalent as an HttpClient request filter.
 _CLIENT: Optional[InternalAuthenticator] = None
-_OPENER_INSTALLED = [False]
+_PROVIDER_INSTALLED = [False]
 
 
-import urllib.request as _urllib_request
-
-
-class _InternalAuthHandler(_urllib_request.BaseHandler):
-    """urllib handler signing internal requests (http_request hook)."""
-
-    handler_order = 100
-
-    def http_request(self, req):
-        # requests marked X-Presto-External cross a trust boundary
-        # (remote-function sidecars): never leak the cluster JWT there
-        if (_CLIENT is not None and "/v1/" in req.full_url
-                and not req.has_header("X-presto-external")):
-            req.add_unredirected_header(PRESTO_INTERNAL_BEARER,
-                                        _CLIENT.generate_jwt())
-        return req
-
-    https_request = http_request
+def _sign_internal(url: str, headers: dict) -> Optional[dict]:
+    """Transport header provider: attach the internal bearer to every
+    intra-cluster request. Requests marked X-Presto-External cross a
+    trust boundary (remote-function sidecars): never leak the cluster
+    JWT there."""
+    if _CLIENT is None or "/v1/" not in url:
+        return None
+    if any(k.lower() == "x-presto-external" for k in headers):
+        return None
+    return {PRESTO_INTERNAL_BEARER: _CLIENT.generate_jwt()}
 
 
 def configure(shared_secret: Optional[str],
@@ -129,11 +123,10 @@ def configure(shared_secret: Optional[str],
     global _CLIENT
     _CLIENT = (InternalAuthenticator(shared_secret, node_id)
                if shared_secret else None)
-    if _CLIENT is not None and not _OPENER_INSTALLED[0]:
-        import urllib.request
-        opener = urllib.request.build_opener(_InternalAuthHandler())
-        urllib.request.install_opener(opener)
-        _OPENER_INSTALLED[0] = True
+    if _CLIENT is not None and not _PROVIDER_INSTALLED[0]:
+        from presto_tpu.protocol.transport import register_header_provider
+        register_header_provider(_sign_internal)
+        _PROVIDER_INSTALLED[0] = True
 
 
 def internal_headers() -> dict:

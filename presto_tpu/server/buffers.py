@@ -12,7 +12,7 @@ single task-output file path guarded by tests/test_spool_chokepoint.py."""
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
 from presto_tpu.spool.files import FrameFile
@@ -160,6 +160,53 @@ class OutputBufferManager:
             cls = MaterializedClientBuffer if materialized else ClientBuffer
             self.buffers = {b: cls() for b in buffer_ids}
         self.lock = threading.Lock()
+        # Wake plumbing for long-polling result readers. Its OWN
+        # Condition (not self.lock): producers fire wakes AFTER
+        # releasing the manager lock, so a slow waiter can never stall
+        # add_page. The version counter makes the wait race-free — a
+        # waiter records the version before (re)checking the buffer,
+        # then sleeps only if no wake happened in between.
+        self.cond = threading.Condition()
+        self._wake_version = 0
+        self._wakers: List[Callable[[], None]] = []
+
+    # ------------------------------------------------------------- wakes
+    def _wake(self):
+        """Page arrived / stream ended / buffer closed: wake every
+        parked long-poll (threaded waiters via the Condition, event-loop
+        waiters via their registered threadsafe callbacks)."""
+        with self.cond:
+            self._wake_version += 1
+            self.cond.notify_all()
+            wakers = list(self._wakers)
+        for cb in wakers:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a dead loop's waker
+                pass           # must not break the producer
+
+    def wake_version(self) -> int:
+        with self.cond:
+            return self._wake_version
+
+    def add_waker(self, cb: Callable[[], None]):
+        with self.cond:
+            self._wakers.append(cb)
+
+    def remove_waker(self, cb: Callable[[], None]):
+        with self.cond:
+            try:
+                self._wakers.remove(cb)
+            except ValueError:
+                pass
+
+    def wait_for_wake(self, seen_version: int, timeout_s: float):
+        """Threaded long-poll park: sleep until a wake newer than
+        `seen_version` (or the timeout). Event-driven replacement for
+        the old `time.sleep(0.01)` poll loop."""
+        with self.cond:
+            if self._wake_version == seen_version:
+                self.cond.wait(timeout_s)
 
     def close(self):
         with self.lock:
@@ -168,6 +215,7 @@ class OutputBufferManager:
                     b.close()
             if self.spool_writer is not None:
                 self.spool_writer.close()
+        self._wake()
 
     def buffer(self, buffer_id: str) -> Optional[ClientBuffer]:
         return self.buffers.get(buffer_id)
@@ -179,11 +227,13 @@ class OutputBufferManager:
             _M_PAGES_ADDED.inc()
             _M_DEPTH_HIGH.set_max(len(b.pages))
             _M_BYTES_HIGH.set_max(b.queued_bytes)
+        self._wake()
 
     def set_no_more_pages(self):
         with self.lock:
             for b in self.buffers.values():
                 b.no_more_pages = True
+        self._wake()
 
     def abort(self, buffer_id: str):
         with self.lock:
@@ -192,3 +242,4 @@ class OutputBufferManager:
                 b.aborted = True
                 b.pages = []
                 b.queued_bytes = 0
+        self._wake()

@@ -2,9 +2,13 @@
 
 Reference: presto_cpp/main/TaskResource.cpp:115-180 (regex-routed task
 endpoints), PrestoServer.cpp:497-562 (/v1/info, /v1/info/state,
-/v1/status, /v1/memory), http/HttpServer.cpp. Python stdlib HTTP serves as
-the shell here (threads block on IO only; all compute is inside XLA), with
-the same routes, headers and long-poll semantics:
+/v1/status, /v1/memory), http/HttpServer.cpp. The shell is the
+`net/aio_server` event loop (the same libevent-shaped front door the
+native worker uses): requests parse on the loop, the long-poll hot
+paths (results GET, status GET) run natively async so a parked poll
+costs a coroutine, and every other route dispatches the sync
+`WorkerApp.handle` through the loop's bounded executor. Routes,
+headers and long-poll semantics are byte-for-byte the old ones:
 
   POST   /v1/task/{id}                          TaskUpdateRequest -> TaskInfo
   GET    /v1/task/{id}                          TaskInfo
@@ -22,21 +26,23 @@ Page-stream headers (reference PrestoHeaders.java:51-54):
 
 from __future__ import annotations
 
+import asyncio
 import json
 import re
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
+from presto_tpu.config import DEFAULT_NET
+from presto_tpu.net.aio_server import (
+    AioHttpServer, Request, Response, SendFile,
+)
 from presto_tpu.obs.metrics import gauge as _gauge
 from presto_tpu.protocol import structs as S
 from presto_tpu.server.buffers import BufferClosedError
 from presto_tpu.server.task_manager import (
     TpuTaskManager, WorkerDrainingError,
 )
-from presto_tpu.utils.threads import spawn
 from presto_tpu.utils.tracing import (
     TRACE_HEADER, TRACER, parse_trace_header,
 )
@@ -58,6 +64,11 @@ _REMOTE_SOURCE = re.compile(
 _TRACE = re.compile(r"^/v1/trace/([^/?]+)$")
 
 _SERVER_START = time.time()
+
+#: async status long-poll re-check cadence (state transitions already
+#: fire the task's state_change Condition for threaded waiters; the
+#: loop-side poll keeps the async path lock-free)
+_STATUS_POLL_S = 0.02
 
 
 def _parse_duration(s: Optional[str], default: float) -> float:
@@ -84,153 +95,243 @@ def _parse_size(s: Optional[str], default: int) -> int:
                     "GB": 1 << 30}[unit])
 
 
-class _Handler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-    server_version = "presto-tpu-worker"
+def _json_response(req: Request, code: int, obj, headers=None
+                   ) -> Response:
+    """Protocol-document response. Binary transport negotiation
+    (reference: InternalCommunicationConfig.java:174
+    isBinaryTransportEnabled): a client that Accepts
+    application/x-jackson-smile gets the same document SMILE-encoded."""
+    from presto_tpu.protocol import smile
+    accept = req.headers.get("Accept", "") or ""
+    if smile.CONTENT_TYPE in accept:
+        return Response(code, smile.dumps(obj), headers=headers,
+                        content_type=smile.CONTENT_TYPE)
+    return Response(code, json.dumps(obj).encode(), headers=headers)
 
-    # quiet the default stderr access log
-    def log_message(self, fmt, *args):
-        pass
+
+def _pages_response(code: int, body, headers=None) -> Response:
+    """Page-stream response; `body` may be bytes, a frame list
+    (written without a join copy) or a SendFile spool range."""
+    return Response(code, body, headers=headers,
+                    content_type="application/x-presto-pages")
+
+
+def _read_body_doc(req: Request):
+    """Request body -> JSON-compatible document; SMILE bodies are
+    negotiated via Content-Type, JSON stays the default."""
+    from presto_tpu.protocol import smile
+    ctype = req.headers.get("Content-Type", "") or ""
+    if smile.CONTENT_TYPE in ctype:
+        return smile.loads(req.body)
+    return json.loads(req.body.decode())
+
+
+class WorkerApp:
+    """The worker's request router, served by AioHttpServer. Sync
+    routes run on the loop's bounded executor via `handle`; the
+    long-poll hot paths are served natively async via
+    `dispatch_async` — a parked results/status poll holds no thread."""
+
+    def __init__(self):
+        self.task_manager: Optional[TpuTaskManager] = None
+        self.authenticator = None
+        self.worker_server = None
+        self.httpd: Optional[AioHttpServer] = None
 
     @property
     def tm(self) -> TpuTaskManager:
-        return self.server.task_manager
+        return self.task_manager
 
-    def _authorized(self) -> bool:
+    def _authorized(self, req: Request) -> Optional[Response]:
         """Internal JWT gate (InternalAuthenticationManager.java:
         authenticateInternalRequest) — applies to every route when a
-        shared secret is configured."""
-        auth = getattr(self.server, "authenticator", None)
-        if auth is None:
-            return True
+        shared secret is configured. Returns the 401 to send, or None
+        when the request may proceed."""
+        if self.authenticator is None:
+            return None
         from presto_tpu.server.auth import (
             AuthenticationError, PRESTO_INTERNAL_BEARER,
         )
-        token = self.headers.get(PRESTO_INTERNAL_BEARER)
+        token = req.headers.get(PRESTO_INTERNAL_BEARER)
         if not token:
-            self._json(401, {"error": "missing internal bearer token"})
-            return False
+            return _json_response(
+                req, 401, {"error": "missing internal bearer token"})
         try:
-            auth.authenticate(token)
-            return True
+            self.authenticator.authenticate(token)
+            return None
         except AuthenticationError as e:
-            self._json(401, {"error": str(e)})
-            return False
+            return _json_response(req, 401, {"error": str(e)})
 
-    def _json(self, code: int, obj, headers=None):
-        # binary transport negotiation (reference:
-        # InternalCommunicationConfig.java:174 isBinaryTransportEnabled):
-        # a client that Accepts application/x-jackson-smile gets the
-        # same protocol document SMILE-encoded
-        from presto_tpu.protocol import smile
-        accept = self.headers.get("Accept", "") or ""
-        if smile.CONTENT_TYPE in accept:
-            body = smile.dumps(obj)
-            ctype = smile.CONTENT_TYPE
-        else:
-            body = json.dumps(obj).encode()
-            ctype = "application/json"
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+    # -------------------------------------------------- async hot paths
+    def dispatch_async(self, req: Request, server: AioHttpServer):
+        """Coroutine for the long-poll hot paths, None for everything
+        else (which then rides the executor)."""
+        if req.method != "GET":
+            return None
+        m = _RESULTS.match(req.path)
+        if m:
+            return self._results_async(server, req, *m.groups())
+        m = _STATUS.match(req.path)
+        if m:
+            return self._status_async(server, req, m.group(1))
+        return None
 
-    def _read_body_doc(self):
-        """Request body -> JSON-compatible document; SMILE bodies are
-        negotiated via Content-Type, JSON stays the default."""
-        from presto_tpu.protocol import smile
-        n = int(self.headers.get("Content-Length", 0))
-        raw = self.rfile.read(n)
-        ctype = self.headers.get("Content-Type", "") or ""
-        if smile.CONTENT_TYPE in ctype:
-            return smile.loads(raw)
-        return json.loads(raw.decode())
+    async def _results_async(self, server: AioHttpServer, req: Request,
+                             task_id: str, buffer_id: str, token: str):
+        denied = self._authorized(req)
+        if denied is not None:
+            return denied
+        task = self.tm.get(task_id)
+        if task is None or task.buffers is None:
+            return await server.run_blocking(
+                self._cold_results, req, task_id, buffer_id, token)
+        mgr = task.buffers
+        buf = mgr.buffer(buffer_id)
+        if buf is None:
+            return _json_response(req, 404, {"error": "no buffer"})
+        max_bytes = _parse_size(req.headers.get("X-Presto-Max-Size"),
+                                16 << 20)
+        tok = int(token)
+        deadline = server.loop.time() + _parse_duration(
+            req.headers.get("X-Presto-Max-Wait"), 1.0)
+        evt, wake = server.waiter()
+        mgr.add_waker(wake)
+        try:
+            while True:
+                # arm-then-check: the waker is live before the read, so
+                # a page arriving during the read sets the event and
+                # the wait below returns immediately — no missed wake
+                evt.clear()
+                try:
+                    frames, nxt, complete = await server.run_blocking(
+                        buf.get, tok, max_bytes)
+                except BufferClosedError:
+                    return await server.run_blocking(
+                        self._closed_buffer_results, req, task_id,
+                        buffer_id, token)
+                if frames or complete:
+                    break
+                remaining = deadline - server.loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(evt.wait(),
+                                           timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            mgr.remove_waker(wake)
+        headers = {
+            "X-Presto-Task-Instance-Id": str(task.instance_id),
+            "X-Presto-Page-Sequence-Id": str(tok),
+            "X-Presto-Page-End-Sequence-Id": str(nxt),
+            "X-Presto-Buffer-Complete": "true" if complete else "false",
+        }
+        return _pages_response(200, frames, headers)
 
-    def _bytes(self, code: int, body: bytes, headers=None):
-        self.send_response(code)
-        self.send_header("Content-Type", "application/x-presto-pages")
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+    async def _status_async(self, server: AioHttpServer, req: Request,
+                            task_id: str):
+        denied = self._authorized(req)
+        if denied is not None:
+            return denied
+        cur = req.headers.get("X-Presto-Current-State")
+        deadline = server.loop.time() + _parse_duration(
+            req.headers.get("X-Presto-Max-Wait"), 1.0)
+        while True:
+            st = await server.run_blocking(
+                self.tm.get_status, task_id, None, 0.0)
+            if st is None:
+                return _json_response(req, 404, {"error": "no task"})
+            if cur is None or st.state != cur \
+                    or server.loop.time() >= deadline:
+                return _json_response(req, 200,
+                                      S.TaskStatus.to_json(st))
+            await asyncio.sleep(_STATUS_POLL_S)
 
-    def _draining_reject(self, e: WorkerDrainingError):
-        """410 Gone + X-Presto-Draining: the coordinator reads the
-        marker as 'reschedule elsewhere', not as a worker fault — a
-        4xx already records breaker success, so a draining node takes
-        no availability penalty."""
-        return self._json(410, {"error": str(e), "draining": True},
-                          headers={"X-Presto-Draining": "true"})
+    # ------------------------------------------------------ sync router
+    def handle(self, req: Request) -> Optional[Response]:
+        denied = self._authorized(req)
+        if denied is not None:
+            return denied
+        if req.method == "GET":
+            return self._get(req)
+        if req.method == "POST":
+            return self._post(req)
+        if req.method == "PUT":
+            return self._put(req)
+        if req.method == "DELETE":
+            return self._delete(req)
+        return _json_response(req, 404,
+                              {"error": f"no route {req.path}"})
 
     # ------------------------------------------------------------- POST
-    def do_POST(self):
-        if not self._authorized():
-            return
-        path = self.path.split("?")[0]
-        trace_ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
+    def _post(self, req: Request) -> Response:
+        path = req.path
+        trace_ctx = parse_trace_header(req.headers.get(TRACE_HEADER))
         m = _BATCH.match(path)
         if m:
             # /v1/task/{id}/batch (TaskResource.cpp:115-180): unwrap the
             # BatchTaskUpdateRequest envelope; shuffle descriptors are
             # accepted and ignored (no Spark shuffle backend)
             breq = S.BatchTaskUpdateRequest.from_json(
-                self._read_body_doc())
+                _read_body_doc(req))
             try:
                 info = self.tm.create_or_update(m.group(1),
                                                 breq.taskUpdateRequest,
                                                 trace_ctx=trace_ctx)
             except WorkerDrainingError as e:
-                return self._draining_reject(e)
-            return self._json(200, S.TaskInfo.to_json(info))
+                return self._draining_reject(req, e)
+            return _json_response(req, 200, S.TaskInfo.to_json(info))
         m = _TASK.match(path)
         if m:
-            req = S.TaskUpdateRequest.from_json(self._read_body_doc())
+            ureq = S.TaskUpdateRequest.from_json(_read_body_doc(req))
             try:
-                info = self.tm.create_or_update(m.group(1), req,
+                info = self.tm.create_or_update(m.group(1), ureq,
                                                 trace_ctx=trace_ctx)
             except WorkerDrainingError as e:
-                return self._draining_reject(e)
-            return self._json(200, S.TaskInfo.to_json(info))
-        self._json(404, {"error": f"no route {self.path}"})
+                return self._draining_reject(req, e)
+            return _json_response(req, 200, S.TaskInfo.to_json(info))
+        return _json_response(req, 404,
+                              {"error": f"no route {req.path}"})
+
+    def _draining_reject(self, req: Request,
+                         e: WorkerDrainingError) -> Response:
+        """410 Gone + X-Presto-Draining: the coordinator reads the
+        marker as 'reschedule elsewhere', not as a worker fault — a
+        4xx already records breaker success, so a draining node takes
+        no availability penalty."""
+        return _json_response(req, 410,
+                              {"error": str(e), "draining": True},
+                              headers={"X-Presto-Draining": "true"})
 
     # -------------------------------------------------------------- PUT
-    def do_PUT(self):
+    def _put(self, req: Request) -> Response:
         """PUT /v1/info/state (reference: PrestoServer.cpp's node-state
         endpoint): body "SHUTTING_DOWN" starts a graceful decommission.
-        The drain runs synchronously on this handler thread — new task
+        The drain runs synchronously on this executor thread — new task
         creations are refused from the first instant, running tasks
         finish and commit their spools, then the announcer retracts the
         node before the response returns, so a 200 means the node is
         fully drained (or the drain timeout elapsed)."""
-        if not self._authorized():
-            return
-        path = self.path.split("?")[0]
-        if path != "/v1/info/state":
-            return self._json(404, {"error": f"no route {path}"})
+        if req.path != "/v1/info/state":
+            return _json_response(req, 404,
+                                  {"error": f"no route {req.path}"})
         try:
-            want = self._read_body_doc()
+            want = _read_body_doc(req)
         except Exception:   # noqa: BLE001 — malformed body
-            return self._json(400, {"error": "unparseable state body"})
+            return _json_response(req, 400,
+                                  {"error": "unparseable state body"})
         if want != "SHUTTING_DOWN":
-            return self._json(400, {
+            return _json_response(req, 400, {
                 "error": f"unsupported state {want!r}; only "
                          f"SHUTTING_DOWN is accepted"})
-        ws = getattr(self.server, "worker_server", None)
-        if ws is not None:
-            report = ws.drain()
-        else:
-            report = self.tm.drain()
-        return self._json(200, report)
+        ws = self.worker_server
+        report = ws.drain() if ws is not None else self.tm.drain()
+        return _json_response(req, 200, report)
 
     # -------------------------------------------------------------- GET
-    def do_GET(self):
-        if not self._authorized():
-            return
-        path = self.path.split("?")[0]
+    def _get(self, req: Request) -> Response:
+        path = req.path
         m = _ACK.match(path)
         if m:
             task = self.tm.get(m.group(1))
@@ -239,45 +340,45 @@ class _Handler(BaseHTTPRequestHandler):
                 # token stays replayable) — 200 no-op keeps consumers
                 # of spool-served streams on the normal protocol path
                 if self._spool_for(m.group(1)) is not None:
-                    return self._bytes(200, b"")
-                return self._json(404, {"error": "no task"})
+                    return _pages_response(200, b"")
+                return _json_response(req, 404, {"error": "no task"})
             buf = task.buffers.buffer(m.group(2))
             if buf is not None:
                 buf.acknowledge(int(m.group(3)))
-            return self._bytes(200, b"")
+            return _pages_response(200, b"")
         m = _RESULTS.match(path)
         if m:
-            return self._results(*m.groups())
+            return self._results(req, *m.groups())
         m = _STATUS.match(path)
         if m:
-            cur = self.headers.get("X-Presto-Current-State")
+            cur = req.headers.get("X-Presto-Current-State")
             wait = _parse_duration(
-                self.headers.get("X-Presto-Max-Wait"), 1.0)
+                req.headers.get("X-Presto-Max-Wait"), 1.0)
             st = self.tm.get_status(m.group(1), cur, wait)
             if st is None:
-                return self._json(404, {"error": "no task"})
-            return self._json(200, S.TaskStatus.to_json(st))
+                return _json_response(req, 404, {"error": "no task"})
+            return _json_response(req, 200, S.TaskStatus.to_json(st))
         m = _TASK.match(path)
         if m:
             task = self.tm.get(m.group(1))
             if task is None:
-                return self._json(404, {"error": "no task"})
-            return self._json(200, S.TaskInfo.to_json(
+                return _json_response(req, 404, {"error": "no task"})
+            return _json_response(req, 200, S.TaskInfo.to_json(
                 task.info(self.tm.base_uri)))
         if path == "/v1/info":
-            return self._json(200, {
+            return _json_response(req, 200, {
                 "nodeVersion": {"version": "presto-tpu-0.2"},
                 "environment": "tpu", "coordinator": False,
                 "starting": False,
                 "uptime": f"{time.time() - _SERVER_START:.2f}s"})
         if path == "/v1/info/state":
-            return self._json(200, self.tm.lifecycle_state)
+            return _json_response(req, 200, self.tm.lifecycle_state)
         if path == "/v1/status":
             # NodeStatus role (PrestoServer.cpp /v1/status): JSON node
             # snapshot — identity, role, uptime, task counts, heap-proxy
-            # byte gauges
+            # byte gauges, serving-tier connection + loop stats
             tasks = self.tm.tasks
-            return self._json(200, {
+            return _json_response(req, 200, {
                 "nodeId": self.tm.node_id, "environment": "tpu",
                 "role": "worker",
                 "uptime": f"{time.time() - _SERVER_START:.2f}s",
@@ -292,6 +393,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "rejected": self.tm.drain_rejected,
                     "drainSeconds": self.tm.drain_seconds,
                 },
+                "net": (self.httpd.stats()
+                        if self.httpd is not None else {}),
                 "memoryInfo": {"availableProcessors": 1},
                 "processCpuLoad": 0.0, "systemCpuLoad": 0.0,
                 "heapUsed": self.tm.memory_bytes(),
@@ -303,18 +406,14 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/tasks":
             # per-task summary rows — the worker-side feed of
             # system.runtime.tasks (fanned out by the system connector)
-            return self._json(200, self.tm.task_rows())
+            return _json_response(req, 200, self.tm.task_rows())
         if path == "/v1/profile":
             # collapsed-stack text (flamegraph.pl-ready) from the
             # always-on sampling profiler
             from presto_tpu.obs.profiler import PROFILER
-            body = (PROFILER.collapsed() + "\n").encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+            return Response(
+                200, (PROFILER.collapsed() + "\n").encode(),
+                content_type="text/plain; charset=utf-8")
         if path in ("/v1/metrics", "/v1/info/metrics"):
             # Prometheus text exposition of the process-global registry
             # (reference: presto_cpp/main/runtime-metrics/
@@ -325,24 +424,19 @@ class _Handler(BaseHTTPRequestHandler):
             from presto_tpu.obs.process import render_metrics_payload
             self.tm.record_gauges()
             _M_UPTIME.set(time.time() - _SERVER_START)
-            body = render_metrics_payload().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+            return Response(200, render_metrics_payload().encode(),
+                            content_type=PROMETHEUS_CONTENT_TYPE)
         m = _TRACE.match(path)
         if m:
             # worker span dump the coordinator scrapes at query end to
             # stitch the cross-node timeline
-            return self._json(200, TRACER.to_json(m.group(1)))
+            return _json_response(req, 200, TRACER.to_json(m.group(1)))
         if path == "/v1/memory":
             # MemoryResource role (/v1/memory): the REAL worker pool —
             # budget, total reserved, and per-query reservations from
             # task-admission static footprints (no fake 16GB heap)
             ps = self.tm.pool_stats()
-            return self._json(200, {
+            return _json_response(req, 200, {
                 "pools": {"general": {
                     "maxBytes": ps["budgetBytes"] or (16 << 30),
                     "reservedBytes": ps["reservedBytes"],
@@ -351,7 +445,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "queryMemoryAllocations": {},
                     "queryMemoryRevocableReservations": {}}},
                 "memoryPool": ps})
-        self._json(404, {"error": f"no route {path}"})
+        return _json_response(req, 404, {"error": f"no route {path}"})
 
     def _spool_for(self, task_id: str):
         """Committed spool for a task no longer (or never) held live by
@@ -361,25 +455,27 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         return spool.find_committed_for_task(task_id)
 
-    def _spool_results(self, committed, buffer_id: str, token: str):
+    def _spool_results(self, req: Request, committed, buffer_id: str,
+                       token: str) -> Response:
         """Serve GET .../results/... from a committed spool: the same
         headers and chunking as live buffers, tokens are frame indices
         from 0, instance id comes from the manifest (so a consumer that
         already pulled frames from the live task sees a CONSISTENT
-        stream, not a WorkerRestartedError)."""
+        stream, not a WorkerRestartedError). Committed part files are
+        immutable and frames sit back-to-back, so the range ships
+        zero-copy via sendfile once it clears the size floor."""
         from presto_tpu.spool.store import record_fallback_read
-        max_bytes = _parse_size(self.headers.get("X-Presto-Max-Size"),
+        max_bytes = _parse_size(req.headers.get("X-Presto-Max-Size"),
                                 16 << 20)
         tok = int(token)
-        frames = committed.frames(buffer_id, start=tok)
-        out, size = [], 0
-        for f in frames:
-            if out and size + len(f) > max_bytes:
-                break
-            out.append(f)
-            size += len(f)
-        nxt = tok + len(out)
-        complete = nxt >= committed.frame_count(buffer_id)
+        rng = committed.range_for(buffer_id, tok, max_bytes)
+        if rng is None:
+            # unknown buffer id in this manifest: same answer the live
+            # path's exhausted buffer gives — empty and complete (the
+            # pre-pool frames() behavior; a 404 here would surface as a
+            # fatal response on a healthy recovery path)
+            rng = ("", 0, 0, tok, True)
+        path, offset, length, nxt, complete = rng
         record_fallback_read()
         headers = {
             "X-Presto-Task-Instance-Id": committed.instance_id,
@@ -387,78 +483,99 @@ class _Handler(BaseHTTPRequestHandler):
             "X-Presto-Page-End-Sequence-Id": str(nxt),
             "X-Presto-Buffer-Complete": "true" if complete else "false",
         }
-        return self._bytes(200, b"".join(out), headers)
+        cfg = self.httpd.cfg if self.httpd is not None else DEFAULT_NET
+        if length >= cfg.sendfile_min_bytes:
+            return _pages_response(200, SendFile(path, offset, length),
+                                   headers)
+        if length == 0:
+            return _pages_response(200, b"", headers)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return _pages_response(200, f.read(length), headers)
 
-    def _results(self, task_id: str, buffer_id: str, token: str):
+    def _cold_results(self, req: Request, task_id: str, buffer_id: str,
+                      token: str) -> Response:
+        """Results GET for a task this worker no longer holds live:
+        committed spool or 404."""
+        committed = self._spool_for(task_id)
+        if committed is not None:
+            return self._spool_results(req, committed, buffer_id, token)
+        return _json_response(req, 404, {"error": "no task/buffers"})
+
+    def _closed_buffer_results(self, req: Request, task_id: str,
+                               buffer_id: str, token: str) -> Response:
+        """The task's buffers were closed under a long-poll (worker
+        shutting down, task deleted): a committed spool serves the SAME
+        bytes at the same tokens; otherwise refuse retryably — never
+        answer `complete` for frames this buffer no longer serves."""
+        committed = self._spool_for(task_id)
+        if committed is not None:
+            return self._spool_results(req, committed, buffer_id, token)
+        return _json_response(
+            req, 503, {"error": "output buffer closed (worker "
+                       "shutting down); retry"})
+
+    def _results(self, req: Request, task_id: str, buffer_id: str,
+                 token: str) -> Response:
         task = self.tm.get(task_id)
         if task is None or task.buffers is None:
-            committed = self._spool_for(task_id)
-            if committed is not None:
-                return self._spool_results(committed, buffer_id, token)
-            return self._json(404, {"error": "no task/buffers"})
-        buf = task.buffers.buffer(buffer_id)
+            return self._cold_results(req, task_id, buffer_id, token)
+        mgr = task.buffers
+        buf = mgr.buffer(buffer_id)
         if buf is None:
-            return self._json(404, {"error": "no buffer"})
-        max_bytes = _parse_size(self.headers.get("X-Presto-Max-Size"),
+            return _json_response(req, 404, {"error": "no buffer"})
+        max_bytes = _parse_size(req.headers.get("X-Presto-Max-Size"),
                                 16 << 20)
         tok = int(token)
-        # Long-poll until a page (or completion) is available.
+        # Long-poll until a page (or completion) is available; parked
+        # waiters sleep on the buffer manager's Condition and wake
+        # event-driven on page arrival / stream end / close.
         deadline = time.time() + _parse_duration(
-            self.headers.get("X-Presto-Max-Wait"), 1.0)
+            req.headers.get("X-Presto-Max-Wait"), 1.0)
         while True:
+            seen = mgr.wake_version()
             try:
                 frames, nxt, complete = buf.get(tok, max_bytes)
             except BufferClosedError:
-                # the task's buffers were closed under this long-poll
-                # (worker shutting down, task deleted): a committed
-                # spool serves the SAME bytes at the same tokens;
-                # otherwise refuse retryably — never answer `complete`
-                # for frames this buffer no longer serves
-                committed = self._spool_for(task_id)
-                if committed is not None:
-                    return self._spool_results(committed, buffer_id,
-                                               token)
-                return self._json(
-                    503, {"error": "output buffer closed (worker "
-                          "shutting down); retry"})
-            if frames or complete or time.time() >= deadline:
+                return self._closed_buffer_results(req, task_id,
+                                                   buffer_id, token)
+            remaining = deadline - time.time()
+            if frames or complete or remaining <= 0:
                 break
-            time.sleep(0.01)
+            mgr.wait_for_wake(seen, remaining)
         headers = {
             "X-Presto-Task-Instance-Id": str(task.instance_id),
             "X-Presto-Page-Sequence-Id": str(tok),
             "X-Presto-Page-End-Sequence-Id": str(nxt),
             "X-Presto-Buffer-Complete": "true" if complete else "false",
         }
-        return self._bytes(200, b"".join(frames), headers)
+        return _pages_response(200, frames, headers)
 
     # ----------------------------------------------------------- DELETE
-    def do_DELETE(self):
-        if not self._authorized():
-            return
-        path = self.path.split("?")[0]
+    def _delete(self, req: Request) -> Response:
+        path = req.path
         m = _REMOTE_SOURCE.match(path)
         if m:
             if not self.tm.remove_remote_source(m.group(1), m.group(2)):
-                return self._json(404, {"error": "no task"})
-            return self._json(200, {})
+                return _json_response(req, 404, {"error": "no task"})
+            return _json_response(req, 200, {})
         m = _ABORT.match(path)
         if m:
             task = self.tm.get(m.group(1))
             if task is not None and task.buffers is not None:
                 task.buffers.abort(m.group(2))
-            return self._json(200, {})
+            return _json_response(req, 200, {})
         m = _TASK.match(path)
         if m:
             info = self.tm.delete(m.group(1))
             if info is None:
-                return self._json(404, {"error": "no task"})
-            return self._json(200, S.TaskInfo.to_json(info))
-        self._json(404, {"error": f"no route {path}"})
+                return _json_response(req, 404, {"error": "no task"})
+            return _json_response(req, 200, S.TaskInfo.to_json(info))
+        return _json_response(req, 404, {"error": f"no route {path}"})
 
 
 class TpuWorkerServer:
-    """Bind + serve on a background thread; .port is assigned (0 = any)."""
+    """Bind + serve on the event loop; .port is assigned (0 = any)."""
 
     def __init__(self, connector, host: str = "127.0.0.1", port: int = 0,
                  coordinator_uri: Optional[str] = None,
@@ -466,13 +583,15 @@ class TpuWorkerServer:
                  shared_secret: Optional[str] = None,
                  cache_config=None, spool_config=None,
                  exchange_config=None, elastic_config=None,
-                 memory_config=None):
+                 memory_config=None, net_config=None):
         from presto_tpu.config import DEFAULT_ELASTIC
         self.elastic_config = (elastic_config
                                if elastic_config is not None
                                else DEFAULT_ELASTIC)
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
-        self.port = self.httpd.server_address[1]
+        self.app = WorkerApp()
+        self.httpd = AioHttpServer(self.app, host, port, role="worker",
+                                   net_config=net_config)
+        self.port = self.httpd.port
         base = f"http://{host}:{self.port}"
         self.task_manager = TpuTaskManager(connector, base_uri=base,
                                            cache_config=cache_config,
@@ -480,27 +599,29 @@ class TpuWorkerServer:
                                            spool_config=spool_config,
                                            exchange_config=exchange_config,
                                            memory_config=memory_config)
+        self.app.task_manager = self.task_manager
+        self.app.httpd = self.httpd
         self.httpd.task_manager = self.task_manager
         # internal JWT auth (InternalAuthenticationManager role): with a
         # shared secret every /v1/* request must carry a valid
         # X-Presto-Internal-Bearer token; this node also SENDS signed
         # requests (announcements, exchange pulls)
-        self.httpd.authenticator = None
+        self.app.authenticator = None
         if shared_secret:
             from presto_tpu.server.auth import (
                 InternalAuthenticator, configure,
             )
-            self.httpd.authenticator = InternalAuthenticator(
+            self.app.authenticator = InternalAuthenticator(
                 shared_secret, node_id)
             configure(shared_secret, node_id)
-        self.thread = spawn("worker", "http-server",
-                            self.httpd.serve_forever, start=False)
+        self.httpd.authenticator = self.app.authenticator
         self.announcer = None
         if coordinator_uri:
             from presto_tpu.server.announcer import Announcer
             self.announcer = Announcer(coordinator_uri, base, node_id)
         # back-reference for the PUT /v1/info/state handler: a drain
         # request must also retract the announcement once drained
+        self.app.worker_server = self
         self.httpd.worker_server = self
         # always-on sampling profiler (GET /v1/profile); started from
         # the constructor, never from a request handler
@@ -508,7 +629,7 @@ class TpuWorkerServer:
         PROFILER.ensure_started()
 
     def start(self):
-        self.thread.start()
+        self.httpd.start()
         if self.announcer:
             self.announcer.start()
         return self

@@ -16,19 +16,20 @@ HTTP handler never spawns execution threads itself."""
 
 from __future__ import annotations
 
+import asyncio
 import json
 import re
 import threading
 import time as _time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import presto_tpu.exec.dist_executor  # noqa: F401 — registers mesh metrics
 from presto_tpu.admission import (DispatchManager, OverloadedError,
                                   QueryQueueFull, ResourceGroupManager)
 from presto_tpu.admission import dispatcher as _dispatch
 from presto_tpu.config import DEFAULT_ADMISSION, DEFAULT_ELASTIC
+from presto_tpu.net.aio_server import AioHttpServer, Request, Response
 from presto_tpu.server.journal import QueryJournal
 from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
 from presto_tpu.utils.threads import spawn
@@ -60,6 +61,43 @@ def _type_name(t) -> str:
     return str(t)
 
 
+class _DoneEvent(threading.Event):
+    """threading.Event plus completion callbacks: the async nextUri
+    long-poll registers a loop-threadsafe waker here so a parked poll
+    wakes the instant the query finishes instead of sleeping out its
+    poll window. Callbacks fire exactly once, from whichever thread
+    calls set(); one registered after set() fires immediately."""
+
+    def __init__(self):
+        super().__init__()
+        self._cb_lock = threading.Lock()
+        self._cbs: List[Callable[[], None]] = []
+
+    def add_callback(self, cb: Callable[[], None]) -> None:
+        with self._cb_lock:
+            if not self.is_set():
+                self._cbs.append(cb)
+                return
+        cb()
+
+    def remove_callback(self, cb: Callable[[], None]) -> None:
+        with self._cb_lock:
+            try:
+                self._cbs.remove(cb)
+            except ValueError:
+                pass
+
+    def set(self) -> None:
+        super().set()
+        with self._cb_lock:
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:   # noqa: BLE001 — a dead loop's waker
+                pass            # must not break query completion
+
+
 class _Query:
     def __init__(self, qid: str, sql: str, user: str = ""):
         self.qid = qid
@@ -72,7 +110,7 @@ class _Query:
         self.error_type = "INTERNAL_ERROR"
         self.columns: Optional[List[dict]] = None
         self.rows: List[tuple] = []
-        self.done = threading.Event()
+        self.done = _DoneEvent()
         self.cancelled = False
         # final-batch cache: clients auto-retry nextUri GETs, so the
         # last data batch must survive serving it once — a replayed GET
@@ -80,6 +118,11 @@ class _Query:
         # returning FINISHED with no data
         self._final_token: Optional[int] = None
         self._final_batch: List = []
+        # set once a terminal payload (final batch or error) has been
+        # rendered to a client — only then is FIFO eviction safe; an
+        # undelivered finished query evicted early 404s its owner's
+        # next poll
+        self.delivered = False
 
     def run(self, engine):
         self.state = "RUNNING"
@@ -141,6 +184,7 @@ class _Query:
             out["error"] = {"message": self.error,
                             "errorName": self.error_name,
                             "errorType": self.error_type}
+            self.delivered = True
             return out
         if self.state != "FINISHED":
             out["nextUri"] = \
@@ -172,6 +216,7 @@ class _Query:
             self._final_token = token
             self._final_batch = batch
             self.rows = []
+            self.delivered = True
         return out
 
 
@@ -183,67 +228,142 @@ def _query_info(q) -> dict:
             "error": q.error}
 
 
-class _Handler(BaseHTTPRequestHandler):
-    def log_message(self, *a):
-        pass
+class StatementApp:
+    """The coordinator's request router, served by AioHttpServer. The
+    two client hot paths — POST /v1/statement and the nextUri GET
+    long-poll — run natively async (a parked poll is a coroutine
+    waiting on the query's done event); every other route rides the
+    loop's bounded executor via `handle`."""
 
-    def _dead(self) -> bool:
+    def __init__(self, coordinator: "StatementServer"):
+        self.coordinator = coordinator
+
+    @property
+    def base(self) -> str:
+        return self.coordinator.base
+
+    def _dead(self, server) -> bool:
         """Crash-simulation check (StatementServer.kill): a killed
-        coordinator's in-flight handler threads must NOT answer — a
-        dying process tears its connections, it does not serve one
-        last response. Returning without writing closes the socket
+        coordinator's in-flight handlers must NOT answer — a dying
+        process tears its connections, it does not serve one last
+        response. A None response makes the server close the socket
         with no status line, which the client transport classifies as
         a connection error and fails over."""
-        if getattr(self.server, "dead", False):
-            self.close_connection = True
-            return True
-        return False
+        return bool(getattr(server, "dead", False))
 
-    def _json(self, code: int, obj: dict):
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    @staticmethod
+    def _json(code: int, obj) -> Response:
+        return Response(code, json.dumps(obj).encode())
 
-    def do_POST(self):
-        if self._dead():
-            return
-        path = self.path.split("?")[0]
+    # -------------------------------------------------- async hot paths
+    def dispatch_async(self, req: Request, server: AioHttpServer):
+        if req.method == "POST" and req.path == "/v1/statement":
+            return self._submit_async(server, req)
+        if req.method == "GET":
+            m = _EXECUTING.match(req.path) or _QUEUED.match(req.path)
+            if m:
+                return self._poll_async(server, req, m.group(1),
+                                        int(m.group(2)))
+        return None
+
+    async def _submit_async(self, server: AioHttpServer, req: Request):
+        if self._dead(server):
+            return None
+        sql = req.body.decode()
+        try:
+            # admission + journal append touch locks and disk — run
+            # them on the executor, never on the loop
+            q = await server.run_blocking(
+                self._do_submit, sql, req.headers.get(
+                    "X-Presto-User", "") or "",
+                req.headers.get("X-Presto-Source", "") or "",
+                req.headers.get("X-Presto-Idempotency-Key"))
+        except OverloadedError as e:
+            return self._overloaded(e)
+        return self._json(200, q.results_json(self.base, 0))
+
+    def _do_submit(self, sql, user, source, idem) -> "_Query":
+        return self.coordinator.submit(sql, user=user, source=source,
+                                       idempotency_key=idem)
+
+    def _overloaded(self, e: OverloadedError) -> Response:
+        """Load shed: refuse at the door with the advised back-off; the
+        transport layer treats 503 + Retry-After as its own retry class
+        and sleeps exactly this interval."""
+        body = json.dumps({"error": {
+            "message": str(e),
+            "errorName": "SERVER_OVERLOADED",
+            "errorType": "INSUFFICIENT_RESOURCES",
+            "retryAfterSeconds": e.retry_after_s}}).encode()
+        return Response(503, body,
+                        headers={"Retry-After": f"{e.retry_after_s:g}"})
+
+    async def _poll_async(self, server: AioHttpServer, req: Request,
+                          qid: str, token: int):
+        if self._dead(server):
+            return None
+        co = self.coordinator
+        q = co.queries.get(qid)
+        if q is None:
+            # multi-coordinator failover: a client re-resolving a dead
+            # peer's nextUri here may be asking about a query this
+            # coordinator never saw — adopt it from the shared journal
+            # (disk I/O -> executor) under its ORIGINAL qid
+            q = await server.run_blocking(co.adopt, qid)
+        if q is None:
+            return self._json(404, {"error": "no query"})
+        # long-poll briefly while the query runs: park on the done
+        # event's callback, zero threads held
+        if not q.done.is_set():
+            evt, wake = server.waiter()
+            q.done.add_callback(wake)
+            try:
+                await asyncio.wait_for(evt.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                q.done.remove_callback(wake)
+        if self._dead(server):   # killed mid-poll: die silently
+            return None
+        return self._json(200, q.results_json(self.base, token))
+
+    # ------------------------------------------------------ sync router
+    def handle(self, req: Request) -> Optional[Response]:
+        server = self.coordinator.httpd
+        if self._dead(server):
+            return None
+        if req.method == "POST":
+            return self._post(req)
+        if req.method == "GET":
+            resp = self._get(req)
+            if resp is None and self._dead(server):
+                return None
+            return resp
+        if req.method == "DELETE":
+            return self._delete(req)
+        return self._json(404, {"error": "no route"})
+
+    def _post(self, req: Request) -> Response:
+        path = req.path
         m = _INGEST.match(path)
         if m:
-            return self._do_ingest(*m.groups())
+            return self._do_ingest(req, *m.groups())
         if path != "/v1/statement":
             return self._json(404, {"error": "no route"})
-        length = int(self.headers.get("Content-Length", 0))
-        sql = self.rfile.read(length).decode()
+        sql = req.body.decode()
         try:
-            q = self.server.coordinator.submit(
+            q = self.coordinator.submit(
                 sql,
-                user=self.headers.get("X-Presto-User", "") or "",
-                source=self.headers.get("X-Presto-Source", "") or "",
-                idempotency_key=self.headers.get(
+                user=req.headers.get("X-Presto-User", "") or "",
+                source=req.headers.get("X-Presto-Source", "") or "",
+                idempotency_key=req.headers.get(
                     "X-Presto-Idempotency-Key"))
         except OverloadedError as e:
-            # load shed: refuse at the door with the advised back-off;
-            # the transport layer treats 503 + Retry-After as its own
-            # retry class and sleeps exactly this interval
-            body = json.dumps({"error": {
-                "message": str(e),
-                "errorName": "SERVER_OVERLOADED",
-                "errorType": "INSUFFICIENT_RESOURCES",
-                "retryAfterSeconds": e.retry_after_s}}).encode()
-            self.send_response(503)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Retry-After", f"{e.retry_after_s:g}")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
-        return self._json(200, q.results_json(self.server.base, 0))
+            return self._overloaded(e)
+        return self._json(200, q.results_json(self.base, 0))
 
-    def _do_ingest(self, catalog: str, schema: str, table: str):
+    def _do_ingest(self, req: Request, catalog: str, schema: str,
+                   table: str) -> Response:
         """Streaming-append batch: JSON ``{"rows": [[...], ...]}`` in,
         commit receipt (rows, post-append version, cumulative row
         count) out. The append itself is admitted through the ingest
@@ -251,16 +371,15 @@ class _Handler(BaseHTTPRequestHandler):
         neither executes nor schedules anything itself."""
         from presto_tpu.stream.ingest import IngestError
 
-        length = int(self.headers.get("Content-Length", 0))
         try:
-            body = json.loads(self.rfile.read(length).decode() or "{}")
+            body = json.loads(req.body.decode() or "{}")
             rows = body["rows"]
             if not isinstance(rows, list):
                 raise IngestError("'rows' must be a list of rows")
         except (ValueError, KeyError) as e:
             return self._json(400, {"error": f"bad ingest body: {e}"})
         try:
-            receipt = self.server.coordinator.ingest(
+            receipt = self.coordinator.ingest(
                 catalog, schema, table, rows)
         except IngestError as e:
             return self._json(400, {"error": str(e)})
@@ -268,35 +387,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(429, {"error": str(e)})
         return self._json(200, receipt)
 
-    def do_GET(self):
-        if self._dead():
-            return
-        path = self.path.split("?")[0]
+    def _get(self, req: Request) -> Optional[Response]:
+        path = req.path
         m = _EXECUTING.match(path) or _QUEUED.match(path)
         if m:
-            q = self.server.coordinator.queries.get(m.group(1))
+            # threaded fallback for the nextUri poll (normally served
+            # async): same adopt + bounded wait semantics
+            q = self.coordinator.queries.get(m.group(1))
             if q is None:
-                # multi-coordinator failover: a client re-resolving a
-                # dead peer's nextUri here may be asking about a query
-                # this coordinator never saw — adopt it from the shared
-                # journal under its ORIGINAL qid before giving up
-                q = self.server.coordinator.adopt(m.group(1))
+                q = self.coordinator.adopt(m.group(1))
             if q is None:
                 return self._json(404, {"error": "no query"})
-            # long-poll briefly while the query runs
             q.done.wait(timeout=1.0)
-            if self._dead():    # killed mid-poll: die silently
-                return
-            return self._json(200, q.results_json(self.server.base,
+            if self._dead(self.coordinator.httpd):
+                return None     # killed mid-poll: die silently
+            return self._json(200, q.results_json(self.base,
                                                   int(m.group(2))))
         if path == "/v1/query":
             # the query list (QueryResource.getAllQueryInfo role —
             # the UI's landing data)
-            co = self.server.coordinator
+            co = self.coordinator
             return self._json(200, [_query_info(q)
                                     for q in list(co.queries.values())])
         if path.startswith("/v1/query/"):
-            q = self.server.coordinator.queries.get(path.rsplit("/", 1)[-1])
+            q = self.coordinator.queries.get(path.rsplit("/", 1)[-1])
             if q is None:
                 return self._json(404, {"error": "no query"})
             return self._json(200, _query_info(q))
@@ -307,30 +421,19 @@ class _Handler(BaseHTTPRequestHandler):
             # gauges + scrape histogram via the shared scrape path
             from presto_tpu.obs.process import render_metrics_payload
             _M_COORD_UPTIME.set(_time.time() - _COORD_START)
-            body = render_metrics_payload().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+            return Response(200, render_metrics_payload().encode(),
+                            content_type="text/plain; version=0.0.4")
         if path == "/v1/profile":
             # coordinator-side collapsed stacks (the profiler is
             # process-global, so in-process workers show here too)
             from presto_tpu.obs.profiler import PROFILER
-            body = (PROFILER.collapsed() + "\n").encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+            return Response(200, (PROFILER.collapsed() + "\n").encode(),
+                            content_type="text/plain; charset=utf-8")
         if path == "/v1/ha/admission":
             # the peer-gossip surface: this coordinator's stride-WFQ
             # admission totals, polled by every peer's AdmissionGossip
             # so shedding/quotas act on cluster totals
-            co = self.server.coordinator
+            co = self.coordinator
             rgs = co.resource_groups
             return self._json(200, {
                 "coordinatorId": co.coordinator_id,
@@ -341,7 +444,7 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/status":
             # coordinator NodeStatus: uptime, role, query counts, and
             # the engine memory pool as the heap proxy
-            co = self.server.coordinator
+            co = self.coordinator
             qs = list(co.queries.values())
             eng = co.engine
             pool = getattr(eng, "memory_pool", None)
@@ -357,6 +460,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "taskCount": 0,
                 "heapUsed": pool.reserved if pool is not None else 0,
                 "heapAvailable": 16 << 30, "nonHeapUsed": 0,
+                # serving-tier snapshot: event-loop connection counts,
+                # async vs executor route split, loop lag ticks
+                "net": co.httpd.stats(),
                 # per-group admission stats (reference:
                 # ResourceGroupInfo on the cluster resource): live
                 # queue depth / running plus lifetime counters per row
@@ -390,7 +496,7 @@ class _Handler(BaseHTTPRequestHandler):
             # ClusterStatsResource role: the cluster-overview numbers
             # the reference UI polls (running/queued/finished counts,
             # worker membership, memory reservation)
-            co = self.server.coordinator
+            co = self.coordinator
             qs = list(co.queries.values())
             queued = sum(1 for q in qs if q.state == "QUEUED")
             running = sum(1 for q in qs
@@ -418,29 +524,16 @@ class _Handler(BaseHTTPRequestHandler):
             })
         return self._json(404, {"error": f"no route {path}"})
 
-    def do_DELETE(self):
-        if self._dead():
-            return
-        m = _CANCEL.match(self.path.split("?")[0])
+    def _delete(self, req: Request) -> Response:
+        m = _CANCEL.match(req.path)
         if m:
-            co = self.server.coordinator
+            co = self.coordinator
             q = co.queries.get(m.group(1))
             if q is not None:
                 q.cancelled = True
                 co.cancel(q)
-            self.send_response(204)      # no body with 204
-            self.end_headers()
-            return
+            return Response(204)         # no body with 204
         return self._json(404, {"error": "no route"})
-
-
-class _StatementHTTPServer(ThreadingHTTPServer):
-    #: default socketserver backlog is 5 — a burst of concurrent
-    #: clients gets connection-reset at the ACCEPT queue before
-    #: admission control can even answer; the front door must be able
-    #: to say no itself (shed/reject) instead of the kernel dropping
-    #: connections
-    request_queue_size = 256
 
 
 class StatementServer:
@@ -493,9 +586,15 @@ class StatementServer:
         # duplicate rows)
         self._idempotency: Dict[str, str] = {}
         self._submit_lock = threading.Lock()
-        self.httpd = _StatementHTTPServer((host, port), _Handler)
+        # the front door: asyncio event loop + bounded executor (see
+        # presto_tpu/net/aio_server.py) — POST /v1/statement and the
+        # nextUri long-poll are async-native, everything else dispatches
+        # through the executor. Port is bound in the ctor.
+        self.app = StatementApp(self)
+        self.httpd = AioHttpServer(self.app, host, port,
+                                   role="coordinator")
         self.httpd.coordinator = self
-        self.port = self.httpd.server_address[1]
+        self.port = self.httpd.port
         self.base = f"http://{host}:{self.port}"
         self.httpd.base = self.base
         self._thread = spawn("coordinator", "statement-http",
@@ -571,12 +670,26 @@ class StatementServer:
                 self._idempotency[idempotency_key] = qid
             if len(self.queries) > self.MAX_TRACKED:
                 # FIFO-evict finished queries (dict preserves insertion
-                # order), and drop idempotency entries with them
+                # order), and drop idempotency entries with them.
+                # Delivered queries go first: evicting a finished query
+                # whose owner hasn't fetched the final batch yet 404s
+                # its next poll — under a 1000-client storm that's a
+                # dropped query. Undelivered ones are only reclaimed
+                # past a 10x hard cap (memory bound beats the SLO only
+                # when the registry is genuinely blowing up).
                 for old_id in list(self.queries):
                     if len(self.queries) <= self.MAX_TRACKED:
                         break
-                    if self.queries[old_id].done.is_set():
+                    old = self.queries[old_id]
+                    if old.done.is_set() and old.delivered:
                         del self.queries[old_id]
+                hard_cap = self.MAX_TRACKED * 10
+                if len(self.queries) > hard_cap:
+                    for old_id in list(self.queries):
+                        if len(self.queries) <= hard_cap:
+                            break
+                        if self.queries[old_id].done.is_set():
+                            del self.queries[old_id]
                 self._idempotency = {
                     k: v for k, v in self._idempotency.items()
                     if v in self.queries}

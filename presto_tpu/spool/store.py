@@ -193,17 +193,28 @@ class CommittedTaskSpool:
         self.task_id: str = doc["taskId"]
         self.instance_id: str = doc.get("instanceId", "")
         self.buffers: Dict[str, dict] = doc.get("buffers", {})
+        # per-buffer validated (offset, length) frame index; a committed
+        # spool is immutable, so crc + frame-count validation runs once
+        # and every later read serves straight off the cached index
+        self._slices: Dict[str, List] = {}
 
     def frame_count(self, buffer_id: str) -> int:
         return int(self.buffers.get(buffer_id, {}).get("frames", 0))
 
-    def frames(self, buffer_id: str, start: int = 0) -> List[bytes]:
-        """All frames of `buffer_id` from token `start` onward."""
+    def part_path(self, buffer_id: str) -> str:
+        return os.path.join(self.path, f"part_{buffer_id}.bin")
+
+    def _validated_slices(self, buffer_id: str) -> Optional[List]:
+        """The (offset, length) index of `buffer_id`'s part file,
+        validated against the manifest — frame count AND checksum —
+        exactly once per spool handle."""
+        cached = self._slices.get(buffer_id)
+        if cached is not None:
+            return cached
         meta = self.buffers.get(buffer_id)
         if meta is None:
-            return []
-        data = read_bytes(os.path.join(self.path,
-                                       f"part_{buffer_id}.bin"))
+            return None
+        data = read_bytes(self.part_path(buffer_id))
         import zlib
         if zlib.crc32(data) != int(meta.get("crc32", 0)):
             raise SpoolIntegrityError(
@@ -214,7 +225,41 @@ class CommittedTaskSpool:
             raise SpoolIntegrityError(
                 f"spool {self.path} part {buffer_id}: {got} frame(s) "
                 f"on disk, manifest claims {meta['frames']}")
+        self._slices[buffer_id] = slices
+        return slices
+
+    def frames(self, buffer_id: str, start: int = 0) -> List[bytes]:
+        """All frames of `buffer_id` from token `start` onward."""
+        slices = self._validated_slices(buffer_id)
+        if slices is None:
+            return []
+        data = read_bytes(self.part_path(buffer_id))
         return [data[o:o + ln] for o, ln in slices[start:]]
+
+    def range_for(self, buffer_id: str, start: int, max_bytes: int):
+        """Zero-copy read plan: the CONTIGUOUS byte range of the part
+        file holding frames [start, next) capped at `max_bytes` (always
+        at least one frame, matching ClientBuffer.get chunking), as
+        (path, offset, length, next_token, complete). Frames are
+        appended back-to-back, so any token range is one file span —
+        the HTTP layer ships it with os.sendfile instead of reading and
+        joining the frames. None when the buffer is unknown."""
+        slices = self._validated_slices(buffer_id)
+        if slices is None:
+            return None
+        t = max(start, 0)
+        if t >= len(slices):
+            return (self.part_path(buffer_id), 0, 0, t, True)
+        offset = slices[t][0]
+        length = 0
+        while t < len(slices):
+            ln = slices[t][1]
+            if length and length + ln > max_bytes:
+                break
+            length += ln
+            t += 1
+        return (self.part_path(buffer_id), offset, length, t,
+                t >= len(slices))
 
 
 class SpoolStore:

@@ -67,6 +67,11 @@ class LoadReport:
         self.grant_counts: Dict[str, int] = {}
         self.saturated_grants: Dict[str, int] = {}
         self.peak_threads = 0
+        #: peak thread count EXCLUDING the harness's own loadgen
+        #: clients — the server-side population. With the event-loop
+        #: serving tier this must stay flat as client count scales
+        #: (no thread-per-connection).
+        self.peak_server_threads = 0
 
     # -- summaries ----------------------------------------------------
 
@@ -86,7 +91,8 @@ class LoadReport:
         return {"ledger": self.ledger(), "latency": self.latency(),
                 "per_tenant": self.per_tenant,
                 "saturated_grants": self.saturated_grants,
-                "peak_threads": self.peak_threads}
+                "peak_threads": self.peak_threads,
+                "peak_server_threads": self.peak_server_threads}
 
     # -- SLO gates ----------------------------------------------------
 
@@ -238,8 +244,13 @@ class LoadHarness:
 
         def _sample_threads() -> None:
             while not sampler_stop.is_set():
+                alive = threading.enumerate()
                 report.peak_threads = max(report.peak_threads,
-                                          threading.active_count())
+                                          len(alive))
+                report.peak_server_threads = max(
+                    report.peak_server_threads,
+                    sum(1 for t in alive
+                        if "-loadgen-" not in t.name))
                 sampler_stop.wait(0.05)
 
         sampler = spawn("loadgen", "thread-sampler", _sample_threads)

@@ -50,6 +50,7 @@ def test_rule_catalog_complete():
             "no-blocking-under-lock", "lock-leak",
             "no-jax-in-control-plane",
             "no-spawn-in-request-handler",
+            "no-blocking-in-event-loop",
             "no-planner-in-data-plane", "membership-chokepoint",
             "journal-chokepoint",
             "metric-docs-sync", "mv-cache-chokepoint",
@@ -373,6 +374,56 @@ def test_no_spawn_in_request_handler_fires():
              "            spawn('coordinator', 'x', print)\n"
              "        return later\n"},
         planted=bad)
+
+
+def test_no_blocking_in_event_loop_fires():
+    bad = "presto_tpu/net/evil.py"
+    # time.sleep inside an async handler freezes every parked poll
+    fs = _findings("no-blocking-in-event-loop", {
+        bad: "import time\n"
+             "async def handler(req):\n"
+             "    time.sleep(0.01)\n"}, planted=bad)
+    assert fs and fs[0].line == 3 and "asyncio.sleep" in fs[0].message
+    # a blocking transport RPC on the loop fires too
+    fs = _findings("no-blocking-in-event-loop", {
+        bad: "async def handler(req, client):\n"
+             "    return client.get_json('http://w/v1/status')\n"},
+        planted=bad)
+    assert fs and "run_blocking" in fs[0].message
+    # so does a thread join
+    fs = _findings("no-blocking-in-event-loop", {
+        bad: "async def handler(req, t):\n"
+             "    t.join(1.0)\n"}, planted=bad)
+    assert fs and "join" in fs[0].message
+    # awaiting asyncio.sleep is the sanctioned idiom
+    assert not _findings("no-blocking-in-event-loop", {
+        bad: "import asyncio\n"
+             "async def handler(req):\n"
+             "    await asyncio.sleep(0.01)\n"}, planted=bad)
+    # a nested sync def runs on the executor, not the loop
+    assert not _findings("no-blocking-in-event-loop", {
+        bad: "import time\n"
+             "async def handler(req, server):\n"
+             "    def work():\n"
+             "        time.sleep(0.01)\n"
+             "    return await server.run_blocking(work)\n"},
+        planted=bad)
+    # sync defs are out of scope (no loop to block)
+    assert not _findings("no-blocking-in-event-loop", {
+        bad: "import time\n"
+             "def handler(req):\n"
+             "    time.sleep(0.01)\n"}, planted=bad)
+
+
+def test_no_spawn_in_handle_method_fires():
+    # the App-contract router (`handle`) is a request handler too
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("no-spawn-in-request-handler", {
+        bad: "from presto_tpu.utils.threads import spawn\n"
+             "class App:\n"
+             "    def handle(self, req):\n"
+             "        spawn('worker', 'q-1', print)\n"}, planted=bad)
+    assert fs and "admission dispatcher" in fs[0].message
 
 
 def test_no_planner_in_data_plane_fires():

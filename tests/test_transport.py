@@ -47,6 +47,12 @@ class _ScriptedHandler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self):
+        # drain the request body like the real servers do — with the
+        # pooled keep-alive transport, unread body bytes would be
+        # parsed as the NEXT request's start line
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        if n:
+            self.rfile.read(n)
         self.server.requests.append((self.command, self.path))
         step = self.server.script[
             min(len(self.server.requests) - 1,
@@ -274,6 +280,107 @@ def test_plain_503_keeps_generic_retry_class(scripted):
         HttpClient(FAST, sleep=lambda s: None).request(
             f"{base}/v1/info", request_class="probe")
     assert not isinstance(ei.value, ServerOverloadedError)
+
+
+# ------------------------------------------------------- keep-alive pool
+def test_pool_reuses_keepalive_socket(scripted):
+    """Sequential requests to one host ride ONE socket: the second
+    request is a pool reuse, not a fresh dial."""
+    srv, base = scripted([(200, b"one", None), (200, b"two", None)])
+    client = HttpClient(FAST)
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"one"
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"two"
+    s = client.pool.stats()
+    assert s["opened"] == 1 and s["reused"] == 1
+    assert s["idle"] == 1               # parked again, warm
+    assert len(srv.requests) == 2
+
+
+def test_pool_evicts_dead_socket_and_redials(scripted):
+    """A pooled socket the server closed while idle is detected at
+    acquire time (readable-while-idle == EOF), evicted, and replaced
+    with a fresh dial — the request never sees the corpse."""
+    import time as _time
+
+    def reply_then_hangup(handler):
+        body = b"one"
+        handler.send_response(200)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        handler.close_connection = True     # no Connection: close header
+
+    srv, base = scripted([reply_then_hangup, (200, b"two", None)])
+    client = HttpClient(FAST)
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"one"
+    assert client.pool.stats()["idle"] == 1     # pooled: header said keep-alive
+    _time.sleep(0.1)                            # let the server FIN land
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"two"
+    s = client.pool.stats()
+    assert s["evictedDead"] == 1
+    assert s["opened"] == 2 and s["reused"] == 0
+    assert len(srv.requests) == 2               # no duplicate request
+
+
+def test_pool_silently_resends_keepalive_race(scripted):
+    """The standard keep-alive race: the server closes the idle socket
+    just as we write the next request. The pool resends ONCE on a
+    fresh dial, invisibly to the retry policy — no backoff sleep, no
+    breaker penalty."""
+
+    def eat_and_hangup(handler):
+        handler.close_connection = True     # read request, reply nothing
+
+    srv, base = scripted([(200, b"one", None), eat_and_hangup,
+                          (200, b"two", None)])
+    sleeps = []
+    client = HttpClient(FAST, sleep=sleeps.append)
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"one"
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"two"
+    assert sleeps == []                 # resend, not a policy retry
+    assert client.breaker(base).state == CircuitBreaker.CLOSED
+    assert len(srv.requests) == 3       # ok, eaten, resent
+    assert client.pool.stats()["opened"] == 2
+
+
+def test_pool_honors_connection_close(scripted):
+    """A response carrying Connection: close is not returned to the
+    pool — the next request dials fresh."""
+    srv, base = scripted([(200, b"one", {"Connection": "close"}),
+                          (200, b"two", None)])
+    client = HttpClient(FAST)
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"one"
+    assert client.pool.stats()["idle"] == 0
+    assert client.request(f"{base}/v1/info",
+                          request_class="status_poll").body == b"two"
+    s = client.pool.stats()
+    assert s["opened"] == 2 and s["reused"] == 0
+
+
+def test_pool_ttl_evicts_stale_idle_socket(scripted):
+    """An idle socket past pool_idle_ttl_s is retired at acquire even
+    if the peer never closed it."""
+    from presto_tpu.config import NetConfig
+    from presto_tpu.protocol.transport import ConnectionPool
+
+    now = [0.0]
+    pool = ConnectionPool(NetConfig(pool_idle_ttl_s=30.0),
+                          clock=lambda: now[0])
+    srv, base = scripted([(200, b"one", None), (200, b"two", None)])
+    client = HttpClient(FAST, pool=pool)
+    client.request(f"{base}/v1/info", request_class="status_poll")
+    now[0] = 31.0                       # past the TTL
+    client.request(f"{base}/v1/info", request_class="status_poll")
+    s = pool.stats()
+    assert s["evictedTtl"] == 1
+    assert s["opened"] == 2 and s["reused"] == 0
 
 
 # ---------------------------------------------------------- fault injector
