@@ -673,13 +673,28 @@ def _main_orchestrator(sf, qids) -> None:
 
     head_name, head = _headline(detail)
     lane = "tpch_cpu_fallback" if fallback_reason is not None else "tpch"
-    print(json.dumps({
+    summary = {
         "metric": f"{lane}_{head_name}_sf{sf:g}_rows_per_sec",
         "value": head["rows_per_sec"],
         "unit": "rows/s",
         "vs_baseline": head["vs_baseline"],
         "detail": detail,
-    }))
+    }
+    # Regression gate: compare this run against the newest landed
+    # BENCH round and self-report the verdict (advisory here; the
+    # `python -m presto_tpu.obs.bench_check` CLI is the hard gate).
+    try:
+        from presto_tpu.obs.bench_check import compare_rounds, \
+            find_rounds
+        rounds = find_rounds(os.path.dirname(os.path.abspath(__file__)))
+        if rounds:
+            with open(rounds[-1], "r", encoding="utf-8") as f:
+                landed = json.load(f)
+            summary["detail"]["bench_check"] = compare_rounds(
+                landed, {"parsed": summary})
+    except Exception as e:  # noqa: BLE001 — the gate must never
+        summary["detail"]["bench_check"] = {"error": str(e)[:200]}
+    print(json.dumps(summary))
 
 
 def _ds_sqlite_baseline(conn, sf, qid) -> float:

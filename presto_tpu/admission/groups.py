@@ -56,9 +56,17 @@ _M_QUEUE_DEPTH = _gauge("presto_tpu_admission_queue_depth",
 _M_RUNNING = _gauge("presto_tpu_admission_running",
                     "Live running-query count per resource group",
                     ("group",))
+#: multi-second-skewed buckets: queue waits under load run seconds to
+#: minutes, and the default set's 2.5s..120s tail was too coarse to
+#: resolve the shed threshold region (shed_queue_wait_p99_s ~ 20s) —
+#: these keep sub-second resolution for the healthy case and add real
+#: resolution where the SLO lives
 _M_QUEUE_WAIT = _histogram("presto_tpu_admission_queue_wait_seconds",
                            "Seconds a query waited in the admission "
-                           "queue before dispatch", ("group",))
+                           "queue before dispatch", ("group",),
+                           buckets=(0.005, 0.025, 0.1, 0.5, 1.0, 2.5,
+                                    5.0, 10.0, 20.0, 45.0, 120.0,
+                                    300.0))
 
 #: stride-scheduler constant: per-grant pass advance is K / weight
 _STRIDE_K = float(1 << 16)

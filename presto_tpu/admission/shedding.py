@@ -17,6 +17,13 @@ Three signals, each with a configured threshold (see
 - recent p99 admission queue wait (``shed_queue_wait_p99_s``) — the
   closed-loop signal: when dispatch latency blows up, admitting more
   work only makes it worse.
+
+The queue-wait signal has two sources: when a telemetry history is
+attached (``attach_history``), the shedder reads the same windowed
+delta-p99 series the alert engine and ``system.runtime
+.metrics_history`` see (obs/tsdb.py — one definition of "recent p99"
+everywhere); without one, or while the history has no fresh sample
+yet, it falls back to its private sliding window of raw waits.
 """
 
 from __future__ import annotations
@@ -58,8 +65,35 @@ class LoadShedder:
         #: queued total so the queue-depth signal sheds on the
         #: cluster-wide backlog, not this coordinator's slice
         self.cluster_queued: Optional[Callable[[], int]] = None
+        #: telemetry-history p99 feed (attach_history) — preferred
+        #: over the private sliding window when it has a fresh value
+        self._history_p99: Optional[Callable[[], Optional[float]]] \
+            = None
         self.shed_counts = {"queue_depth": 0, "heap": 0,
                             "queue_wait": 0}
+
+    def attach_history(self,
+                       p99: Callable[[], Optional[float]]) -> None:
+        """Point the queue-wait signal at the telemetry history's
+        windowed delta-p99 (obs/tsdb.py). The callable returns None
+        when no fresh sample exists — the shedder then falls back to
+        its private sliding window, so attaching history can only
+        improve the signal, never blind it."""
+        self._history_p99 = p99
+
+    def _queue_wait_p99(self) -> Optional[float]:
+        if self._history_p99 is not None:
+            try:
+                p99 = self._history_p99()
+            except Exception:   # noqa: BLE001 — a broken history
+                p99 = None      # feed must not block admission
+            if p99 is not None:
+                return float(p99)
+        waits = list(self._recent_waits())
+        if len(waits) < _MIN_WAIT_SAMPLES:
+            return None
+        waits.sort()
+        return waits[min(len(waits) - 1, int(0.99 * len(waits)))]
 
     def _trip(self, reason: str, detail: str) -> None:
         self.shed_counts[reason] += 1
@@ -91,14 +125,11 @@ class LoadShedder:
                 self._trip("heap",
                            f"heap {frac:.2f} >= "
                            f"{cfg.shed_heap_fraction:.2f}")
-        waits = list(self._recent_waits())
-        if len(waits) >= _MIN_WAIT_SAMPLES:
-            waits.sort()
-            p99 = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
-            if p99 >= cfg.shed_queue_wait_p99_s:
-                self._trip("queue_wait",
-                           f"p99 queue wait {p99:.3f}s >= "
-                           f"{cfg.shed_queue_wait_p99_s:g}s")
+        p99 = self._queue_wait_p99()
+        if p99 is not None and p99 >= cfg.shed_queue_wait_p99_s:
+            self._trip("queue_wait",
+                       f"p99 queue wait {p99:.3f}s >= "
+                       f"{cfg.shed_queue_wait_p99_s:g}s")
 
     def snapshot(self) -> dict:
         return {"shed": dict(self.shed_counts),

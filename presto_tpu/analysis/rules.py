@@ -1050,3 +1050,76 @@ class SpillChokepointRule(Rule):
 
 
 register(SpillChokepointRule())
+
+# =====================================================================
+# 16. alert-rule-metric-exists — every metric a declarative alert rule
+#     references is a registered metric, and obs/tsdb.py is the only
+#     telemetry-history writer
+# =====================================================================
+
+#: a `metric="..."` literal inside an AlertRule construction — the
+#: name an alert evaluates against the telemetry history
+_ALERT_METRIC_REF = re.compile(r"\bmetric\s*=\s*[\"']([^\"']+)[\"']")
+#: the TimeSeriesStore write chokepoint: the scraper is the ONLY
+#: legitimate history writer — a second writer could plant points the
+#: alert engine fires on without any scrape having observed them
+_TSDB_WRITE = re.compile(r"\.\s*write_points\s*\(")
+
+_ALERTS_FILE = "presto_tpu/obs/alerts.py"
+_TSDB_FILE = "presto_tpu/obs/tsdb.py"
+
+
+class AlertRuleMetricExistsRule(Rule):
+    name = "alert-rule-metric-exists"
+    description = (
+        "every metric name referenced by an alert rule in "
+        "obs/alerts.py must be registered somewhere in the package — "
+        "a rule over a metric nobody registers silently never fires, "
+        "which is worse than no rule at all; and obs/tsdb.py is the "
+        "only caller of the TSDB write chokepoint, so alert "
+        "evaluations can only ever see history the scraper wrote")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        registered = set()
+        for f in pkg.walk("presto_tpu/"):
+            if f.relpath in _METRIC_EXCLUDED:
+                continue
+            for m in _METRIC_CALL.finditer(f.text):
+                registered.add(m.group(1))
+        out: List[Finding] = []
+        alerts = pkg.get(_ALERTS_FILE)
+        if alerts is None:
+            out.append(Finding(
+                self.name, _ALERTS_FILE, 1,
+                "the alert-rule module is missing — the catalog "
+                "moved? update the rule"))
+        else:
+            refs = list(_ALERT_METRIC_REF.finditer(alerts.text))
+            for m in refs:
+                if m.group(1) not in registered:
+                    out.append(Finding(
+                        self.name, _ALERTS_FILE,
+                        alerts.line_at(m.start()),
+                        f"alert rule references metric "
+                        f"{m.group(1)!r}, which no call site "
+                        f"registers — the rule can never fire"))
+            # honesty: the catalog must still spell rule metrics with
+            # the metric="..." idiom this rule scans for
+            if not refs:
+                out.append(Finding(
+                    self.name, _ALERTS_FILE, 1,
+                    "no metric=\"...\" references found in the alert "
+                    "catalog — the rule idiom changed? update the "
+                    "rule's pattern"))
+        out.extend(regex_findings(
+            self, pkg, (_TSDB_WRITE,),
+            "telemetry-history write outside obs/tsdb.py — all "
+            "history enters through the scraper's write chokepoint",
+            allowed=(_TSDB_FILE,)))
+        out.extend(honesty_finding(
+            self, pkg, _TSDB_FILE, (_TSDB_WRITE,),
+            "the telemetry-history write chokepoint"))
+        return out
+
+
+register(AlertRuleMetricExistsRule())

@@ -310,6 +310,49 @@ class ObsConfig:
     #: sampler doubles its sleep until it is back under budget
     profiler_max_overhead: float = 0.01
 
+    # -- telemetry history (obs/tsdb.py) -----------------------------
+    #: master switch for the in-process time-series store + scraper
+    tsdb_enabled: bool = True
+    #: history retention window (seconds): points older than this are
+    #: dropped from every series (ring-buffer bound, per series)
+    tsdb_retention_s: float = 900.0
+    #: minimum spacing between stored points per series (the write
+    #: chokepoint drops anything closer than this to the series'
+    #: newest point)
+    tsdb_resolution_s: float = 0.05
+    #: minimum spacing between heartbeat-path scrape SWEEPS — pump
+    #: loops and probers may call check_workers() at tens of Hz, but a
+    #: full sweep (registry render + one HTTP fetch per live worker +
+    #: parse) runs at most this often; query-bracket sweeps bypass
+    #: this throttle (force=True) but fetch no workers
+    tsdb_sweep_interval_s: float = 2.0
+    #: series cap: beyond it new series are dropped (counted in
+    #: `obs_scrape_points_dropped_total`) instead of growing unbounded
+    tsdb_max_series: int = 16384
+    #: hard cap on retained points per series (rings are bounded by
+    #: BOTH retention_s and this count)
+    tsdb_max_points: int = 2048
+    #: scraper self-time budget as a fraction of wall time — the same
+    #: methodology as profiler_max_overhead: when cumulative scrape
+    #: self-time exceeds this fraction, scrapes are skipped until the
+    #: ratio is back under budget (<1% overhead by construction)
+    tsdb_max_overhead: float = 0.01
+
+    # -- alerting (obs/alerts.py) ------------------------------------
+    #: master switch for alert-rule evaluation (rules stay registered,
+    #: evaluation is skipped when off)
+    alerts_enabled: bool = True
+    #: default evaluation window (seconds) for rules that do not set
+    #: their own — thresholds look at the latest sample in the window,
+    #: burn-rate rules at the counter increase across it
+    alert_window_s: float = 60.0
+    #: default pending->firing dwell (seconds) for rules that do not
+    #: set their own `for_s`
+    alert_for_s: float = 10.0
+    #: alert-transition history ring capacity (system.runtime.alerts
+    #: and the wide-event sink both read from it)
+    alert_history_cap: int = 256
+
     def sampled(self, rng_value: float) -> bool:
         """Decide sampling from a caller-supplied uniform [0,1) draw
         (kept injectable for deterministic tests)."""

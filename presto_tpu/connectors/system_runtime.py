@@ -28,6 +28,10 @@ Tables (schemas frozen in README "Introspection"):
   system.runtime.profile — sampling profiler buckets (obs/profiler.py)
   system.runtime.materialized_views — MV registry: fingerprint,
       refreshed versions, staleness, pinned state bytes (presto_tpu/mv/)
+  system.runtime.metrics_history — the telemetry TSDB (obs/tsdb.py):
+      every retained (name, labels, timestamp, value) point, joinable
+      against system.runtime.queries by time
+  system.runtime.alerts  — alert-transition history (obs/alerts.py)
   system.metrics         — every registry series as rows
 """
 
@@ -52,6 +56,8 @@ TASKS = "system.runtime.tasks"
 NODES = "system.runtime.nodes"
 PROFILE = "system.runtime.profile"
 MATERIALIZED_VIEWS = "system.runtime.materialized_views"
+METRICS_HISTORY = "system.runtime.metrics_history"
+ALERTS = "system.runtime.alerts"
 METRICS = "system.metrics"
 
 SYSTEM_SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
@@ -85,6 +91,13 @@ SYSTEM_SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("last_refresh_duration_s", DOUBLE),
         ("last_delta_rows", BIGINT), ("staleness_seconds", DOUBLE),
         ("pinned_bytes", BIGINT), ("refreshes", BIGINT)],
+    METRICS_HISTORY: [
+        ("name", VARCHAR), ("labels", VARCHAR),
+        ("timestamp", DOUBLE), ("value", DOUBLE)],
+    ALERTS: [
+        ("rule", VARCHAR), ("state", VARCHAR), ("severity", VARCHAR),
+        ("metric", VARCHAR), ("value", DOUBLE),
+        ("threshold", DOUBLE), ("timestamp", DOUBLE)],
     METRICS: [
         ("name", VARCHAR), ("kind", VARCHAR), ("labels", VARCHAR),
         ("value", DOUBLE)],
@@ -205,6 +218,10 @@ class SystemTablesConnector(SplitSource):
             return self._profile_rows()
         if name == MATERIALIZED_VIEWS:
             return self._mv_rows()
+        if name == METRICS_HISTORY:
+            return self._metrics_history_rows()
+        if name == ALERTS:
+            return self._alert_rows()
         return self._metric_rows()
 
     def _query_rows(self) -> List[tuple]:
@@ -347,6 +364,20 @@ class SystemTablesConnector(SplitSource):
                 s["last_delta_rows"], s["staleness_seconds"],
                 s["pinned_bytes"], s["refreshes"]))
         return rows
+
+    def _metrics_history_rows(self) -> List[tuple]:
+        tel = getattr(self._cluster, "telemetry", None) \
+            if self._cluster is not None else None
+        if tel is None:
+            return []
+        return list(tel.store.rows())
+
+    def _alert_rows(self) -> List[tuple]:
+        eng = getattr(self._cluster, "alerts", None) \
+            if self._cluster is not None else None
+        if eng is None:
+            return []
+        return list(eng.rows())
 
     def _metric_rows(self) -> List[tuple]:
         from presto_tpu.obs.metrics import REGISTRY

@@ -21,7 +21,15 @@ revocation hook drives the existing lifespan spill machinery
 import threading
 from typing import Callable, Dict, List, Optional
 
-from presto_tpu.obs.metrics import counter as _counter
+from presto_tpu.obs.metrics import (counter as _counter,
+                                    gauge as _gauge)
+
+#: pool pressure as a fraction so one alert threshold works for every
+#: budget size; set on reserve/free, scraped into telemetry history
+_M_POOL_FRACTION = _gauge(
+    "presto_tpu_memory_pool_reserved_fraction",
+    "Reserved bytes over budget for the node memory pool (1.0 = "
+    "exhausted; crossing revoke_threshold starts spill-before-fail)")
 
 _M_REVOCATIONS = _counter(
     "presto_tpu_memory_revocations_total",
@@ -104,6 +112,12 @@ class MemoryPool:
                     self.budget)
             self._by_query[query_id] = \
                 self._by_query.get(query_id, 0) + nbytes
+            self._set_fraction_locked()
+
+    def _set_fraction_locked(self) -> None:
+        if self.budget > 0:
+            _M_POOL_FRACTION.set(
+                sum(self._by_query.values()) / self.budget)
 
     def _try_revoke(self, need: int) -> int:
         freed = 0
@@ -144,6 +158,7 @@ class MemoryPool:
                     self._by_query[query_id] = nxt
                 else:
                     self._by_query.pop(query_id, None)
+            self._set_fraction_locked()
 
 
 class ClusterMemoryManager:

@@ -44,15 +44,18 @@ M_KEEPALIVE_REUSE = _counter(
     "presto_tpu_net_keepalive_reuse_total",
     "Requests served or sent over an already-open keep-alive "
     "connection instead of a fresh dial, by role", ("role",))
-#: sub-second buckets: loop lag is a blocked-event-loop detector, not a
-#: latency SLO — anything past ~100ms means something blocking ran on
-#: the loop
+#: sub-MILLISECOND-resolved buckets: a healthy loop overshoots its
+#: timer by tens of microseconds, so the default 1ms-floor bucket set
+#: collapsed every healthy tick into one bin and the p99 could not
+#: distinguish "idle loop" from "1ms of blocking per tick". Anything
+#: past ~100ms still means blocking work ran on the loop.
 M_LOOP_LAG = _histogram(
     "presto_tpu_net_event_loop_lag_seconds",
     "Observed event-loop timer overshoot per heartbeat tick (a "
     "blocked-loop detector: large values mean blocking work ran on "
     "the loop)",
-    buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 2.5))
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.025,
+             0.1, 0.5, 2.5))
 M_SENDFILE_BYTES = _counter(
     "presto_tpu_net_sendfile_bytes_total",
     "Result bytes served zero-copy from committed spool files via "

@@ -1,0 +1,329 @@
+"""Declarative SLO/alert-rule engine over the telemetry history.
+
+Reference role: the automated health signals half of the Presto@Meta
+operability story (VLDB'23) — instead of a human watching dashboards,
+a rule catalog declares what "unhealthy" means (static thresholds and
+burn rates over windows) and a Prometheus-Alertmanager-style
+pending -> firing -> resolved state machine turns breaches into
+exactly-once transition events.
+
+Evaluation rides the scrape cadence: `TpuCluster.check_workers()`
+runs one `AlertEngine.evaluate()` after each telemetry sweep, reading
+ONLY the `TimeSeriesStore` the sweep just wrote (never the live
+registry) so alerts and `system.runtime.metrics_history` can never
+disagree about what the cluster looked like.
+
+State machine (per rule):
+
+  inactive --breach--> pending --sustained for_s--> firing
+  pending --clear--> inactive            (silent: never really fired)
+  firing --clear--> resolved             (transition event emitted)
+  resolved --clear--> inactive           (one-sweep annunciator state)
+  resolved/firing --breach--> pending/still-firing
+
+Transition events (`firing` and `resolved` only) go three places at
+once: a bounded in-memory ring (feeds `system.runtime.alerts` and
+`GET /v1/alerts`), the metrics registry (`presto_tpu_alerts_*`), and
+the EventListener bus as kind="alert" records, which the JSONL
+wide-event sink persists next to the per-query wide events.
+
+Every `metric=` name referenced by a rule in this module must be a
+registered metric — the `alert-rule-metric-exists` analysis rule
+cross-checks the literals below against the registry call sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.config import ObsConfig
+from presto_tpu.obs.metrics import counter as _counter, gauge as _gauge
+from presto_tpu.obs.tsdb import TimeSeriesStore
+from presto_tpu.utils.tracing import EVENTS, QueryEvent
+
+log = logging.getLogger("presto_tpu.obs.alerts")
+
+_M_EVALS = _counter(
+    "presto_tpu_alerts_evaluations_total",
+    "Alert-rule evaluation rounds (one per telemetry sweep)")
+_M_TRANSITIONS = _counter(
+    "presto_tpu_alerts_transitions_total",
+    "Alert state transitions that emitted an event, by rule and "
+    "destination state (firing or resolved)", ("rule", "to"))
+_M_FIRING = _gauge(
+    "presto_tpu_alerts_firing",
+    "Alert rules currently in the firing state")
+
+#: schema version for alert records in the wide-event JSONL sink
+ALERT_EVENT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule over a history series.
+
+    kind="threshold" compares the newest point of every matching
+    series (max across label sets) against `threshold`;
+    kind="burn_rate" compares the per-second increase rate of a
+    counter over the trailing `window_s` (reset-tolerant). `labels`
+    is a subset match against stored series labels — leave it None to
+    match every instance. `for_s` is the Alertmanager-style sustain
+    requirement before pending escalates to firing."""
+    name: str
+    metric: str
+    threshold: float
+    kind: str = "threshold"          # "threshold" | "burn_rate"
+    op: str = ">="                   # ">=" | "<="
+    labels: Optional[Dict[str, str]] = None
+    window_s: Optional[float] = None   # None -> ObsConfig.alert_window_s
+    for_s: Optional[float] = None      # None -> ObsConfig.alert_for_s
+    severity: str = "warning"        # "page" | "warning" | "info"
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("threshold", "burn_rate"):
+            raise ValueError(f"alert rule {self.name}: unknown kind "
+                             f"{self.kind!r}")
+        if self.op not in (">=", "<="):
+            raise ValueError(f"alert rule {self.name}: unknown op "
+                             f"{self.op!r}")
+
+
+#: the default catalog — kept in metric-docs-sync-style parity with
+#: the README "Default alert catalog" table (tests/test_alerts.py
+#: asserts the parity both ways)
+DEFAULT_ALERT_RULES: Tuple[AlertRule, ...] = (
+    AlertRule(
+        name="AdmissionQueueWaitP99High",
+        metric="presto_tpu_admission_queue_wait_seconds",
+        labels={"quantile": "0.99"},
+        threshold=20.0, severity="page",
+        description="Admission queue-wait p99 over the shed "
+                    "threshold: queries are waiting ~forever before "
+                    "dispatch."),
+    AlertRule(
+        name="EventLoopLagP99High",
+        metric="presto_tpu_net_event_loop_lag_seconds",
+        labels={"quantile": "0.99"},
+        threshold=0.25, severity="page",
+        description="Serving event loop blocked: long-poll clients "
+                    "and probes are stalling behind on-loop work."),
+    AlertRule(
+        name="TransportBreakerOpen",
+        metric="presto_tpu_transport_breaker_state",
+        threshold=2.0, for_s=0.0, severity="page",
+        description="A worker circuit breaker is OPEN (state=2): the "
+                    "coordinator is fast-failing RPCs to a dead or "
+                    "unreachable worker."),
+    AlertRule(
+        name="MemoryPoolPressure",
+        metric="presto_tpu_memory_pool_reserved_fraction",
+        threshold=0.95, severity="warning",
+        description="Memory pool nearly exhausted: revocation/spill "
+                    "churn and shed-on-admission are imminent."),
+    AlertRule(
+        name="JournalAppendStalled",
+        metric="presto_tpu_coordinator_journal_last_append_age_seconds",
+        threshold=300.0, severity="warning",
+        description="Coordinator journal has not appended for 5 "
+                    "minutes on an active cluster: HA failover would "
+                    "lose recent history."),
+    AlertRule(
+        name="QueriesBeingShed",
+        metric="presto_tpu_admission_shed_total",
+        kind="burn_rate", threshold=0.5, severity="page",
+        description="Sustained query shedding (>0.5 rejects/s over "
+                    "the window): the cluster is refusing work."),
+    AlertRule(
+        name="WorkerChurn",
+        metric="presto_tpu_membership_departures_total",
+        kind="burn_rate", threshold=0.1, severity="warning",
+        description="Workers departing faster than 1 per 10s over "
+                    "the window: membership is churning."),
+)
+
+
+class AlertEngine:
+    """Evaluates a rule catalog against the TSDB on every scrape and
+    runs the pending/firing/resolved state machine."""
+
+    def __init__(self, store: TimeSeriesStore,
+                 rules: Sequence[AlertRule] = DEFAULT_ALERT_RULES,
+                 config: Optional[ObsConfig] = None,
+                 clock: Callable[[], float] = time.time,
+                 emit: Callable[[QueryEvent], None] = EVENTS.emit):
+        self.store = store
+        self.config = config or ObsConfig()
+        self.rules = tuple(rules)
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate alert rule names: {names}")
+        self._clock = clock
+        self._emit = emit
+        self._lock = threading.Lock()
+        self._states: Dict[str, Dict] = {
+            r.name: {"state": "inactive", "since": None,
+                     "value": None} for r in self.rules}
+        self._transitions: "List[Dict]" = []
+
+    # ----------------------------------------------------- evaluation
+    def _rule_value(self, rule: AlertRule,
+                    now: float) -> Optional[float]:
+        window = (rule.window_s if rule.window_s is not None
+                  else self.config.alert_window_s)
+        if rule.kind == "threshold":
+            rows = self.store.latest(rule.metric, rule.labels,
+                                     max_age_s=window, now=now)
+            if not rows:
+                return None
+            vals = [v for _, _, v in rows]
+            return min(vals) if rule.op == "<=" else max(vals)
+        # burn_rate: per-second increase over the trailing window,
+        # reset-tolerant (a counter that shrank restarted — count the
+        # post-restart value as the whole increase)
+        series = self.store.window(rule.metric, rule.labels,
+                                   since=now - window)
+        best: Optional[float] = None
+        for _, pts in series:
+            if len(pts) < 2:
+                continue
+            (t0, v0), (t1, v1) = pts[0], pts[-1]
+            if t1 <= t0:
+                continue
+            rise = (v1 - v0) if v1 >= v0 else v1
+            rate = max(0.0, rise) / (t1 - t0)
+            if best is None or rate > best:
+                best = rate
+        return best
+
+    @staticmethod
+    def _breached(rule: AlertRule, value: Optional[float]) -> bool:
+        if value is None:
+            return False
+        if rule.op == "<=":
+            return value <= rule.threshold
+        return value >= rule.threshold
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """One evaluation round over every rule. Never raises — a
+        broken rule must not cost the heartbeat sweep."""
+        if not self.config.alerts_enabled:
+            return
+        now = self._clock() if now is None else now
+        _M_EVALS.inc()
+        for rule in self.rules:
+            try:
+                self._evaluate_rule(rule, now)
+            except Exception:   # noqa: BLE001 — alerting is advisory
+                log.exception("alert rule %s evaluation failed",
+                              rule.name)
+        with self._lock:
+            firing = sum(1 for s in self._states.values()
+                         if s["state"] == "firing")
+        _M_FIRING.set(float(firing))
+
+    def _evaluate_rule(self, rule: AlertRule, now: float) -> None:
+        value = self._rule_value(rule, now)
+        breach = self._breached(rule, value)
+        for_s = (rule.for_s if rule.for_s is not None
+                 else self.config.alert_for_s)
+        with self._lock:
+            st = self._states[rule.name]
+            st["value"] = value
+            state = st["state"]
+            if breach:
+                if state in ("inactive", "resolved"):
+                    st["state"], st["since"] = "pending", now
+                elif state == "pending" and now - st["since"] >= for_s:
+                    # firing requires a LATER evaluation than the one
+                    # that opened pending — even with for_s=0 a rule
+                    # is visibly pending for one sweep first
+                    st["state"] = "firing"
+                    self._record(rule, "firing", value, now)
+            else:
+                if state == "pending":
+                    st["state"], st["since"] = "inactive", None
+                elif state == "firing":
+                    st["state"], st["since"] = "resolved", now
+                    self._record(rule, "resolved", value, now)
+                elif state == "resolved":
+                    # resolved is a one-sweep annunciator state; the
+                    # next clear evaluation retires it
+                    st["state"], st["since"] = "inactive", None
+
+    # ---------------------------------------------------- transitions
+    def _record(self, rule: AlertRule, to_state: str,
+                value: Optional[float], now: float) -> None:
+        """Called under self._lock: ring + registry + event bus."""
+        rec = {"rule": rule.name, "state": to_state,
+               "severity": rule.severity, "metric": rule.metric,
+               "value": value, "threshold": rule.threshold,
+               "timestamp": now, "description": rule.description}
+        self._transitions.append(rec)
+        cap = max(1, self.config.alert_history_cap)
+        if len(self._transitions) > cap:
+            del self._transitions[:len(self._transitions) - cap]
+        _M_TRANSITIONS.inc(rule=rule.name, to=to_state)
+        detail = dict(rec, alertEventVersion=ALERT_EVENT_VERSION)
+        self._emit(QueryEvent("alert", query_id="", sql="",
+                              detail=detail))
+
+    # ------------------------------------------------------- surfaces
+    def snapshot(self) -> List[Dict]:
+        """Current state of every rule — `GET /v1/alerts` and the
+        `alerts` block of `GET /v1/status`."""
+        out = []
+        with self._lock:
+            for rule in self.rules:
+                st = self._states[rule.name]
+                out.append({"rule": rule.name,
+                            "severity": rule.severity,
+                            "metric": rule.metric,
+                            "kind": rule.kind,
+                            "op": rule.op,
+                            "threshold": rule.threshold,
+                            "labels": dict(rule.labels or {}),
+                            "state": st["state"],
+                            "since": st["since"],
+                            "value": st["value"],
+                            "description": rule.description})
+        return out
+
+    def transitions(self) -> List[Dict]:
+        """Transition history ring, oldest first — the
+        system.runtime.alerts table rows."""
+        with self._lock:
+            return [dict(r) for r in self._transitions]
+
+    def firing(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, s in self._states.items()
+                          if s["state"] == "firing")
+
+    def rows(self) -> List[Tuple[str, str, str, str, float, float,
+                                 float]]:
+        """(rule, state, severity, metric, value, threshold,
+        timestamp) rows for system.runtime.alerts."""
+        out = []
+        for r in self.transitions():
+            out.append((r["rule"], r["state"], r["severity"],
+                        r["metric"],
+                        float(r["value"] if r["value"] is not None
+                              else float("nan")),
+                        float(r["threshold"]),
+                        float(r["timestamp"])))
+        return out
+
+
+def rules_from_json(text: str) -> Tuple[AlertRule, ...]:
+    """Parse an operator-supplied rule catalog (JSON list of objects
+    mirroring AlertRule fields) — the README documents the syntax."""
+    out = []
+    for obj in json.loads(text):
+        out.append(AlertRule(**obj))
+    return tuple(out)

@@ -1,0 +1,188 @@
+"""Automated bench-regression detection over landed BENCH rounds.
+
+Every bench round lands a ``BENCH_r<NN>.json`` at the repo root with a
+headline ``(metric, value, unit)`` triple plus per-lane sub-dicts under
+``parsed.detail`` (each carrying a ``rows_per_sec`` throughput figure).
+This module turns that history into a gate:
+
+    python -m presto_tpu.obs.bench_check [dir]
+
+compares the two newest rounds lane-by-lane and exits nonzero on a
+regression. The comparison is deliberately humble about what bench
+history can prove:
+
+- **direction-aware** — ``rows/s`` and ``stmt/s`` lanes are
+  higher-is-better; wall-clock seconds and slowdown-``x`` lanes are
+  lower-is-better. A direction we cannot infer is not compared.
+- **noise-tolerant** — rounds run on whatever machine was handy, so a
+  lane only counts as regressed when it moves beyond
+  ``DEFAULT_TOLERANCE`` (20%) in the bad direction.
+- **missing-lane-tolerant** — rounds benchmark different subsystems
+  (round 9 measured memory pressure, round 10 the serving tier); lanes
+  present in only one round are reported as skipped, never failed.
+  Fewer than two comparable rounds → exit 0 with
+  ``status: insufficient_history``.
+
+``bench.py`` calls :func:`compare_rounds` directly to stamp a
+``bench_check`` verdict into its final summary JSON, so every run
+self-reports whether it regressed against the newest landed round.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+#: fractional move in the bad direction a lane tolerates before it
+#: counts as a regression (bench rounds are single-shot, noisy runs)
+DEFAULT_TOLERANCE = 0.20
+
+#: units where a larger value is better
+_HIGHER_BETTER = ("rows/s", "rows/sec", "stmt/s", "q/s", "qps")
+#: units where a smaller value is better ("x" = slowdown multiple)
+_LOWER_BETTER = ("s", "sec", "seconds", "x", "ms")
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _direction(unit: str) -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = unknown."""
+    u = (unit or "").strip().lower()
+    if u in _HIGHER_BETTER:
+        return 1
+    if u in _LOWER_BETTER:
+        return -1
+    return None
+
+
+def extract_lanes(doc: dict) -> Dict[str, dict]:
+    """Pull comparable lanes out of one BENCH round document.
+
+    Returns ``{lane_name: {"value": float, "unit": str}}``. The
+    headline triple becomes one lane under its own metric name; every
+    ``parsed.detail`` sub-dict with a numeric ``rows_per_sec`` becomes
+    a throughput lane named after its key.
+    """
+    lanes: Dict[str, dict] = {}
+    parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+        else {}
+    # Headline: prefer the parsed block, fall back to top level
+    # (early rounds wrote the triple unnested).
+    for src in (parsed, doc):
+        metric = src.get("metric")
+        value = src.get("value")
+        unit = src.get("unit")
+        if metric and isinstance(value, (int, float)):
+            lanes[str(metric)] = {"value": float(value),
+                                  "unit": str(unit or "")}
+            break
+    detail = parsed.get("detail")
+    if isinstance(detail, dict):
+        for key, sub in sorted(detail.items()):
+            if not isinstance(sub, dict):
+                continue
+            rps = sub.get("rows_per_sec")
+            if isinstance(rps, (int, float)) and rps > 0:
+                lanes[f"{key}_rows_per_sec"] = {"value": float(rps),
+                                                "unit": "rows/s"}
+    return lanes
+
+
+def compare_rounds(baseline: dict, current: dict,
+                   tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Compare two BENCH round documents lane-by-lane.
+
+    Returns a verdict dict: ``status`` is ``"ok"``, ``"regression"``,
+    or ``"insufficient_history"`` (no lane present in both rounds);
+    ``lanes`` lists every compared lane with its ratio and per-lane
+    verdict; ``skipped`` names lanes present in only one round or with
+    an unknown direction.
+    """
+    base_lanes = extract_lanes(baseline)
+    cur_lanes = extract_lanes(current)
+    compared: List[dict] = []
+    regressions: List[str] = []
+    skipped: List[str] = []
+    for name in sorted(set(base_lanes) | set(cur_lanes)):
+        if name not in base_lanes or name not in cur_lanes:
+            skipped.append(name)
+            continue
+        base, cur = base_lanes[name], cur_lanes[name]
+        direction = _direction(cur["unit"]) or _direction(base["unit"])
+        if direction is None or base["value"] == 0:
+            skipped.append(name)
+            continue
+        ratio = cur["value"] / base["value"]
+        if direction > 0:
+            regressed = ratio < 1.0 - tolerance
+        else:
+            regressed = ratio > 1.0 + tolerance
+        compared.append({
+            "lane": name,
+            "baseline": base["value"],
+            "current": cur["value"],
+            "unit": cur["unit"],
+            "ratio": round(ratio, 4),
+            "higherIsBetter": direction > 0,
+            "verdict": "regression" if regressed else "ok",
+        })
+        if regressed:
+            regressions.append(name)
+    if not compared:
+        status = "insufficient_history"
+    elif regressions:
+        status = "regression"
+    else:
+        status = "ok"
+    return {"status": status,
+            "tolerance": tolerance,
+            "baselineRound": baseline.get("n"),
+            "currentRound": current.get("n"),
+            "lanes": compared,
+            "regressions": regressions,
+            "skipped": skipped}
+
+
+def find_rounds(bench_dir: str) -> List[str]:
+    """Landed round files in ``bench_dir``, oldest → newest by round
+    number (filename order lies once rounds pass r09 → r10)."""
+    paths = []
+    for p in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _ROUND_RE.search(p)
+        if m:
+            paths.append((int(m.group(1)), p))
+    return [p for _, p in sorted(paths)]
+
+
+def check_dir(bench_dir: str,
+              tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Verdict for the two newest landed rounds in ``bench_dir``."""
+    rounds = find_rounds(bench_dir)
+    if len(rounds) < 2:
+        return {"status": "insufficient_history", "lanes": [],
+                "regressions": [], "skipped": [],
+                "rounds_found": len(rounds)}
+    with open(rounds[-2], "r", encoding="utf-8") as f:
+        baseline = json.load(f)
+    with open(rounds[-1], "r", encoding="utf-8") as f:
+        current = json.load(f)
+    verdict = compare_rounds(baseline, current, tolerance)
+    verdict["baselinePath"] = os.path.basename(rounds[-2])
+    verdict["currentPath"] = os.path.basename(rounds[-1])
+    return verdict
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    bench_dir = args[0] if args else os.getcwd()
+    verdict = check_dir(bench_dir)
+    print(json.dumps(verdict, indent=1, sort_keys=True))
+    return 1 if verdict["status"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

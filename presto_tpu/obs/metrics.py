@@ -248,6 +248,23 @@ class MetricsRegistry:
                         f"{type(existing).__name__}"
                         f"{existing.labelnames}, conflicting with "
                         f"{cls.__name__}{lnames}")
+                # per-histogram bucket overrides are part of the
+                # registration contract: silently returning the
+                # existing instrument under a DIFFERENT bucket layout
+                # would hide the override the second call site asked
+                # for, so an explicit bucket mismatch is the same
+                # programming error a kind/label conflict is. A call
+                # passing the DEFAULT set carries no opinion and stays
+                # idempotent against any existing layout.
+                want = kwargs.get("buckets")
+                if want is not None and isinstance(existing, Histogram):
+                    wb = tuple(sorted(float(b) for b in want))
+                    if wb != existing.buckets \
+                            and wb != tuple(DEFAULT_TIME_BUCKETS_S):
+                        raise ValueError(
+                            f"histogram {name} already registered "
+                            f"with buckets {existing.buckets}, "
+                            f"conflicting with {wb}")
                 return existing
             m = cls(name, help, lnames, **kwargs)
             self._metrics[name] = m

@@ -1,0 +1,530 @@
+"""Telemetry history — a bounded in-process time-series store.
+
+Reference role: the historical side of the Presto@Meta operability
+story (VLDB'23) — the Java coordinator ships JMX counters to an
+external TSDB (ODS) and the resource manager keeps cluster-wide,
+time-windowed accounting; here both collapse into one in-process
+ring-buffer store so a single-binary cluster can answer "when did
+queue-wait p99 start climbing" without external infrastructure.
+
+Two pieces:
+
+  TimeSeriesStore   per-series ring buffers (bounded by retention
+                    seconds AND a point cap), with ONE write
+                    chokepoint (`write_points`) so history can only
+                    enter through the scraper — the
+                    alert-rule-metric-exists analysis rule enforces
+                    that no other module writes history.
+  Telemetry         the scraper: on each heartbeat sweep it snapshots
+                    the coordinator's own registry plus each live
+                    worker's `/v1/metrics` exposition text, collapses
+                    histograms into windowed DELTA quantiles
+                    (p50/p95/p99 of what happened since the previous
+                    scrape, not since process start), and writes the
+                    lot through the chokepoint.
+
+Throttling: the scraper self-limits on BOTH a minimum inter-sweep
+spacing (`ObsConfig.tsdb_sweep_interval_s` — pump loops may call
+check_workers() at tens of Hz, a full sweep runs at most this often)
+and a cumulative self-time budget (`ObsConfig.tsdb_max_overhead`,
+the PR 11 profiler methodology: observed scrape seconds divided by
+wall seconds since the first sweep) — so the <1% overhead acceptance
+holds by construction, and a pathologically slow scrape degrades
+history resolution instead of query latency. Query-bracket sweeps
+pass force=True to bypass the spacing throttle (so one query always
+yields a before/after pair) but snapshot only the local registry —
+never per-query worker HTTP fetches.
+
+SQL access: `system.runtime.metrics_history` is a straight dump of
+`TimeSeriesStore.rows()`; the shedder and the alert engine read the
+same windowed series through `latest()` / `window()`.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
+
+from presto_tpu.config import ObsConfig
+from presto_tpu.obs.metrics import (
+    REGISTRY, MetricsRegistry, counter as _counter, gauge as _gauge,
+    histogram as _histogram,
+)
+log = logging.getLogger("presto_tpu.obs.tsdb")
+
+#: scraper metrics — all registered here (one call site per name) and
+#: documented in the README metric catalog
+_M_SWEEPS = _counter(
+    "presto_tpu_obs_scrape_sweeps_total",
+    "Telemetry scrape sweeps that ran to completion (coordinator "
+    "registry + every live worker)")
+_M_SKIPPED = _counter(
+    "presto_tpu_obs_scrape_skipped_total",
+    "Telemetry scrape sweeps skipped by a throttle, by reason "
+    "(resolution: inside the min inter-sweep spacing or a sweep is "
+    "already running; overhead: cumulative self-time over the "
+    "tsdb_max_overhead budget)",
+    ("reason",))
+_M_SCRAPE_ERRORS = _counter(
+    "presto_tpu_obs_scrape_errors_total",
+    "Per-instance telemetry scrape failures (worker fetch or parse "
+    "errors; the sweep continues past them)", ("instance",))
+_M_SCRAPE_SECONDS = _histogram(
+    "presto_tpu_obs_scrape_sweep_seconds",
+    "Wall seconds per telemetry scrape sweep (snapshot + parse + "
+    "store write, all instances)")
+_M_SERIES = _gauge(
+    "presto_tpu_obs_tsdb_series",
+    "Distinct (name, labels) series currently held in the telemetry "
+    "history store")
+_M_POINTS = _gauge(
+    "presto_tpu_obs_tsdb_points",
+    "Total points currently held across all telemetry history series")
+_M_DROPPED = _counter(
+    "presto_tpu_obs_tsdb_dropped_total",
+    "History points dropped at the write chokepoint, by reason "
+    "(series_cap: store at tsdb_max_series; resolution: closer than "
+    "tsdb_resolution_s to the series' newest point)", ("reason",))
+
+#: delta-quantiles emitted for every histogram each sweep
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def canonical_labels(labels: Dict[str, str]) -> str:
+    """One JSON spelling per label set, so (name, labels) keys are
+    stable across scrapes and joinable from SQL."""
+    return json.dumps({k: str(v) for k, v in labels.items()},
+                      sort_keys=True, separators=(",", ":"))
+
+
+def parse_prometheus_text(text: str) -> List[Tuple[str, Dict[str, str],
+                                                   float]]:
+    """Parse Prometheus exposition format 0.0.4 into
+    (sample_name, labels, value) rows. Tolerant: unparseable lines are
+    skipped (a worker mid-restart may truncate its payload)."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labelpart, valuepart = rest.rsplit("}", 1)
+                labels = _parse_labels(labelpart)
+            else:
+                name, valuepart = line.split(None, 1)
+                labels = {}
+            out.append((name.strip(), labels,
+                        float(valuepart.strip().split()[0])))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def _parse_labels(s: str) -> Dict[str, str]:
+    """Parse `a="x",b="y"` with exposition-format escapes."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        eq = s.index("=", i)
+        key = s[i:eq].strip().lstrip(",").strip()
+        i = s.index('"', eq) + 1
+        buf: List[str] = []
+        while i < n:
+            c = s[i]
+            if c == "\\" and i + 1 < n:
+                nxt = s[i + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}
+                           .get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                i += 1
+                break
+            buf.append(c)
+            i += 1
+        labels[key] = "".join(buf)
+        while i < n and s[i] in ", ":
+            i += 1
+    return labels
+
+
+def registry_rows(registry: "MetricsRegistry"
+                  ) -> List[Tuple[str, Dict[str, str], float]]:
+    """Snapshot a live registry into (sample_name, labels, value)
+    rows directly from `samples()` — semantically identical to
+    `parse_prometheus_text(registry.render())` but without the text
+    round-trip, because the query-bracket sweeps run twice per query
+    and the render+parse pair dominates their cost."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for name in registry.names():
+        m = registry.get(name)
+        if m is None:
+            continue
+        for sname, lnames, lvalues, value in m.samples():
+            out.append((sname, dict(zip(lnames, lvalues)),
+                        float(value)))
+    return out
+
+
+class TimeSeriesStore:
+    """Bounded ring-buffer history: per-series deques capped at
+    `tsdb_max_points`, pruned to `tsdb_retention_s`, at most
+    `tsdb_max_series` series. All mutation goes through
+    `write_points` — the single write chokepoint."""
+
+    def __init__(self, config: Optional[ObsConfig] = None):
+        self.config = config or ObsConfig()
+        self._lock = threading.Lock()
+        # (name, labels_json) -> deque[(ts, value)]
+        self._series: Dict[Tuple[str, str],
+                           "collections.deque"] = {}
+        # parsed label dicts, parallel to _series (parse once)
+        self._labels: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._points = 0
+
+    # -------------------------------------------------------- write
+    def write_points(self,
+                     points: Iterable[Tuple[str, Dict[str, str], float,
+                                            float]]) -> int:
+        """THE write chokepoint: append (name, labels, ts, value)
+        rows, enforcing the series cap, per-series minimum spacing
+        (tsdb_resolution_s) and retention. Returns points kept."""
+        cfg = self.config
+        kept = 0
+        with self._lock:
+            for name, labels, ts, value in points:
+                key = (name, canonical_labels(labels))
+                ring = self._series.get(key)
+                if ring is None:
+                    if len(self._series) >= cfg.tsdb_max_series:
+                        _M_DROPPED.inc(reason="series_cap")
+                        continue
+                    ring = collections.deque(
+                        maxlen=max(1, cfg.tsdb_max_points))
+                    self._series[key] = ring
+                    self._labels[key] = dict(labels)
+                if ring and ts - ring[-1][0] < cfg.tsdb_resolution_s:
+                    _M_DROPPED.inc(reason="resolution")
+                    continue
+                if ring and ring[-1][0] >= ts:
+                    # never let history run backwards (clock skew
+                    # between instances is the scraper's problem; one
+                    # series is always this process's clock)
+                    _M_DROPPED.inc(reason="resolution")
+                    continue
+                before = len(ring)
+                ring.append((ts, float(value)))
+                self._points += len(ring) - before
+                kept += 1
+                horizon = ts - cfg.tsdb_retention_s
+                while ring and ring[0][0] < horizon:
+                    ring.popleft()
+                    self._points -= 1
+            _M_SERIES.set(float(len(self._series)))
+            _M_POINTS.set(float(self._points))
+        return kept
+
+    # ------------------------------------------------------- readers
+    @staticmethod
+    def _matches(have: Dict[str, str],
+                 want: Optional[Dict[str, str]]) -> bool:
+        if not want:
+            return True
+        return all(have.get(k) == str(v) for k, v in want.items())
+
+    def latest(self, name: str,
+               labels: Optional[Dict[str, str]] = None,
+               max_age_s: Optional[float] = None,
+               now: Optional[float] = None
+               ) -> List[Tuple[Dict[str, str], float, float]]:
+        """Newest point of every series matching `name` and the label
+        SUBSET `labels`, as (labels, ts, value); optionally only
+        points younger than max_age_s."""
+        now = time.time() if now is None else now
+        out = []
+        with self._lock:
+            for key, ring in self._series.items():
+                if key[0] != name or not ring:
+                    continue
+                have = self._labels[key]
+                if not self._matches(have, labels):
+                    continue
+                ts, v = ring[-1]
+                if max_age_s is not None and now - ts > max_age_s:
+                    continue
+                out.append((dict(have), ts, v))
+        return out
+
+    def window(self, name: str,
+               labels: Optional[Dict[str, str]] = None,
+               since: float = 0.0
+               ) -> List[Tuple[Dict[str, str],
+                               List[Tuple[float, float]]]]:
+        """All points newer than `since` for every matching series,
+        as (labels, [(ts, value), ...]) — the alert engine's
+        burn-rate read path."""
+        out = []
+        with self._lock:
+            for key, ring in self._series.items():
+                if key[0] != name or not ring:
+                    continue
+                have = self._labels[key]
+                if not self._matches(have, labels):
+                    continue
+                pts = [(ts, v) for ts, v in ring if ts >= since]
+                if pts:
+                    out.append((dict(have), pts))
+        return out
+
+    def rows(self) -> List[Tuple[str, str, float, float]]:
+        """(name, labels_json, timestamp, value) dump for the
+        system.runtime.metrics_history table."""
+        with self._lock:
+            out = []
+            for (name, labels_json), ring in self._series.items():
+                for ts, v in ring:
+                    out.append((name, labels_json, ts, v))
+        out.sort(key=lambda r: (r[0], r[1], r[2]))
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {"series": len(self._series),
+                    "points": self._points}
+
+
+def _delta_quantiles(buckets: List[Tuple[float, float]],
+                     prev: Optional[Dict[float, float]],
+                     qs: Sequence[float] = QUANTILES
+                     ) -> Tuple[Dict[float, float],
+                                Dict[float, float]]:
+    """Windowed histogram quantiles: given this scrape's cumulative
+    (le, count) rows and the previous scrape's, estimate quantiles of
+    the observations that arrived IN BETWEEN (linear interpolation
+    within the bucket, Prometheus histogram_quantile style). Returns
+    (quantile -> value, le -> cumulative count state for next time);
+    the quantile dict is empty when nothing arrived in the window."""
+    cur = {le: c for le, c in buckets}
+    state = dict(cur)
+    if prev:
+        # counter reset (process restart) shows as a shrink: treat the
+        # current cumulative counts as the window
+        if any(cur.get(le, 0.0) < c for le, c in prev.items()):
+            prev = None
+    deltas: List[Tuple[float, float]] = []
+    for le in sorted(cur):
+        base = prev.get(le, 0.0) if prev else 0.0
+        deltas.append((le, max(0.0, cur[le] - base)))
+    total = deltas[-1][1] if deltas else 0.0
+    if total <= 0:
+        return {}, state
+    out: Dict[float, float] = {}
+    for q in qs:
+        target = q * total
+        lo_edge, lo_count = 0.0, 0.0
+        val = deltas[-1][0]
+        for le, c in deltas:
+            if c >= target:
+                span = c - lo_count
+                if le == float("inf"):
+                    val = lo_edge   # open-ended bucket: clamp to edge
+                elif span <= 0:
+                    val = le
+                else:
+                    val = lo_edge + (le - lo_edge) \
+                        * (target - lo_count) / span
+                break
+            lo_edge, lo_count = le, c
+        out[q] = val
+    return out, state
+
+
+class Telemetry:
+    """The cluster scraper. Driven from TpuCluster.check_workers()
+    (the existing heartbeat cadence) — one sweep snapshots the
+    coordinator's own registry plus each live worker's /v1/metrics
+    and writes everything through the store's single chokepoint."""
+
+    LOCAL_INSTANCE = "coordinator"
+    #: seconds of wall before the tsdb_max_overhead budget is enforced
+    OVERHEAD_GRACE_S = 30.0
+
+    def __init__(self, config: Optional[ObsConfig] = None,
+                 registry: MetricsRegistry = REGISTRY,
+                 clock: Callable[[], float] = time.time):
+        self.config = config or ObsConfig()
+        self.registry = registry
+        self.store = TimeSeriesStore(self.config)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._refreshers: List[Callable[[], None]] = []
+        self._last_sweep = 0.0
+        self._first_sweep = 0.0
+        self._self_time = 0.0
+        self._sweeping = False
+        # (instance, base_name, labels_json) -> {le: cumulative count}
+        self._hist_state: Dict[Tuple[str, str, str],
+                               Dict[float, float]] = {}
+
+    def add_refresher(self, fn: Callable[[], None]) -> None:
+        """Register a pre-snapshot hook that pushes derived gauges
+        (journal append age, pool fraction) into the registry so the
+        history sees them at scrape time."""
+        with self._lock:
+            self._refreshers.append(fn)
+
+    # ------------------------------------------------------- scraping
+    def scrape(self, workers: Sequence[str] = (),
+               fetch: Optional[Callable[[str], str]] = None,
+               now: Optional[float] = None,
+               force: bool = False) -> bool:
+        """One sweep. Returns False when a throttle skipped it.
+        `force` bypasses the inter-sweep spacing (query brackets need
+        a before/after pair regardless of when the heartbeat last
+        swept) but never the one-at-a-time or overhead guards."""
+        if not self.config.tsdb_enabled:
+            return False
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self._sweeping:
+                # check_workers runs from the heartbeat thread AND
+                # from query execution; one sweep at a time keeps the
+                # delta-quantile state consistent
+                _M_SKIPPED.inc(reason="resolution")
+                return False
+            if (not force and now - self._last_sweep
+                    < self.config.tsdb_sweep_interval_s):
+                _M_SKIPPED.inc(reason="resolution")
+                return False
+            wall = now - self._first_sweep if self._first_sweep else 0.0
+            # the budget bounds STEADY-STATE overhead: a young process
+            # has burned a few sweeps against almost no wall, so the
+            # fraction starts absurdly high and would starve history
+            # exactly when a short-lived test needs it — enforce only
+            # once enough wall has passed for the ratio to mean
+            # anything (3 sweeps / 30s still converges under 1%)
+            if (wall > self.OVERHEAD_GRACE_S
+                    and self.config.tsdb_max_overhead > 0
+                    and self._self_time / wall
+                    > self.config.tsdb_max_overhead):
+                _M_SKIPPED.inc(reason="overhead")
+                return False
+            self._last_sweep = now
+            if not self._first_sweep:
+                self._first_sweep = now
+            self._sweeping = True
+            refreshers = list(self._refreshers)
+        t0 = time.monotonic()
+        try:
+            for fn in refreshers:
+                try:
+                    fn()
+                except Exception:   # noqa: BLE001 — a broken gauge
+                    # refresher must not cost the sweep
+                    log.exception("telemetry refresher failed")
+            points: List[Tuple[str, Dict[str, str], float, float]] = []
+            # workers BEFORE the local registry: the worker fetches
+            # are themselves RPCs through the transport chokepoint,
+            # so snapshotting the coordinator last means every sweep
+            # sees the transport counters its own fetches just moved
+            # (a fresh cluster's first bracketed query then yields two
+            # history points per transport series, not one)
+            for uri in workers:
+                if fetch is None:
+                    break
+                instance = uri.split("//")[-1].rstrip("/")
+                try:
+                    self._collect(instance, fetch(uri), now, points)
+                except Exception:   # noqa: BLE001 — one dead worker
+                    # must not cost the rest of the sweep its history
+                    _M_SCRAPE_ERRORS.inc(instance=instance)
+                    log.warning("telemetry scrape of %s failed",
+                                instance, exc_info=True)
+            self._collect_rows(self.LOCAL_INSTANCE,
+                               registry_rows(self.registry), now,
+                               points)
+            self.store.write_points(points)
+            _M_SWEEPS.inc()
+        finally:
+            dt = time.monotonic() - t0
+            _M_SCRAPE_SECONDS.observe(dt)
+            with self._lock:
+                self._self_time += dt
+                self._sweeping = False
+        return True
+
+    def _collect(self, instance: str, text: str, now: float,
+                 out: List[Tuple[str, Dict[str, str], float, float]]
+                 ) -> None:
+        """Turn one instance's exposition text into history points."""
+        self._collect_rows(instance, parse_prometheus_text(text),
+                           now, out)
+
+    def _collect_rows(self, instance: str,
+                      rows: Iterable[Tuple[str, Dict[str, str], float]],
+                      now: float,
+                      out: List[Tuple[str, Dict[str, str], float, float]]
+                      ) -> None:
+        """Turn one instance's (name, labels, value) samples into
+        history points: plain samples as-is (plus an `instance`
+        label), histogram bucket series collapsed into windowed delta
+        quantiles."""
+        hists: Dict[Tuple[str, str],
+                    List[Tuple[float, float]]] = {}
+        hist_labels: Dict[Tuple[str, str], Dict[str, str]] = {}
+        for name, labels, value in rows:
+            if name.endswith("_bucket") and "le" in labels:
+                base = name[:-len("_bucket")]
+                rest = {k: v for k, v in labels.items() if k != "le"}
+                key = (base, canonical_labels(rest))
+                le = (float("inf") if labels["le"] == "+Inf"
+                      else float(labels["le"]))
+                hists.setdefault(key, []).append((le, value))
+                hist_labels[key] = rest
+                continue
+            pl = dict(labels)
+            pl["instance"] = instance
+            out.append((name, pl, now, value))
+        for key, buckets in hists.items():
+            base, labels_json = key
+            skey = (instance, base, labels_json)
+            qvals, state = _delta_quantiles(
+                sorted(buckets), self._hist_state.get(skey))
+            self._hist_state[skey] = state
+            for q, v in qvals.items():
+                ql = dict(hist_labels[key])
+                ql["instance"] = instance
+                ql["quantile"] = f"{q:g}"
+                out.append((base, ql, now, v))
+
+    # ---------------------------------------------------- convenience
+    def windowed_quantile(self, name: str, quantile: float = 0.99,
+                          labels: Optional[Dict[str, str]] = None,
+                          max_age_s: float = 60.0) -> Optional[float]:
+        """Newest delta-quantile across matching series (max over
+        label sets) — the shedder's replacement for its private
+        sliding window. None when no fresh series exists."""
+        want = dict(labels or {})
+        want["quantile"] = f"{quantile:g}"
+        rows = self.store.latest(name, want, max_age_s=max_age_s,
+                                 now=self._clock())
+        if not rows:
+            return None
+        return max(v for _, _, v in rows)
+
+    def stats(self) -> Dict[str, float]:
+        st = self.store.stats()
+        with self._lock:
+            st["selfTimeS"] = round(self._self_time, 6)
+            wall = ((self._clock() - self._first_sweep)
+                    if self._first_sweep else 0.0)
+            st["overheadFraction"] = round(
+                self._self_time / wall, 6) if wall > 0 else 0.0
+        return st

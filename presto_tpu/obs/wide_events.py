@@ -116,7 +116,12 @@ class JsonlEventSink:
         self._lock = threading.Lock()
 
     def __call__(self, event: QueryEvent) -> None:
-        if event.kind != "wide" or event.detail is None:
+        # the sink persists the two structured event kinds side by
+        # side: per-query wide events and alert transitions
+        # (obs/alerts.py, kind="alert", schema alertEventVersion) —
+        # the ledger above stays wide-only so system.runtime.queries
+        # never grows alert rows
+        if event.kind not in ("wide", "alert") or event.detail is None:
             return
         line = (json.dumps(event.detail, sort_keys=True,
                            default=str) + "\n").encode("utf-8")

@@ -303,7 +303,7 @@ class TpuCluster:
                  cache_config=None, spool_config=None,
                  exchange_config=None, mv_config=None,
                  mv_journal_path: Optional[str] = None,
-                 memory_config=None):
+                 memory_config=None, obs_config=None):
         import dataclasses as _dc
 
         from presto_tpu.cache import AffinityRouter
@@ -448,6 +448,25 @@ class TpuCluster:
         from presto_tpu.obs.wide_events import install_event_log_sink
         install_event_log_sink()
         PROFILER.ensure_started()
+        # telemetry history + alerting (obs/tsdb.py, obs/alerts.py):
+        # the scraper rides check_workers' heartbeat cadence — every
+        # sweep snapshots the coordinator registry plus each live
+        # worker's /v1/metrics into the TSDB, then the alert engine
+        # evaluates its catalog against the history just written
+        from presto_tpu.config import DEFAULT_OBS
+        from presto_tpu.obs.alerts import AlertEngine
+        from presto_tpu.obs.tsdb import Telemetry
+        self.obs_config = (obs_config if obs_config is not None
+                           else DEFAULT_OBS)
+        self.telemetry = Telemetry(self.obs_config)
+        self.alerts = AlertEngine(self.telemetry.store,
+                                  config=self.obs_config)
+        # first history point at t=0 via one real probe round: the
+        # probes dial the client pool, so the coordinator's transport
+        # series exist BEFORE the first query and its bracket pair can
+        # show the query's delta (a bare local sweep here would miss
+        # every counter that is born on first use)
+        self.check_workers()
 
     @property
     def worker_uris(self) -> List[str]:
@@ -577,6 +596,7 @@ class TpuCluster:
             drained_add=drained_add, drained_remove=drained_remove)
         if self.memory_config.pool_bytes:
             self._scrape_memory(live)
+        self._scrape_telemetry(live)
         return live
 
     def _scrape_memory(self, live: List[str]) -> None:
@@ -600,6 +620,29 @@ class TpuCluster:
                 agg[qid] = agg.get(qid, 0) + int(b)
         if ok or not live:
             self.cluster_reservations = agg
+
+    def _scrape_telemetry(self, live: List[str],
+                          force: bool = False) -> None:
+        """Heartbeat-path telemetry sweep: coordinator registry plus
+        every live worker's /v1/metrics into the history store, then
+        one alert-evaluation round over what was just written. The
+        scraper self-throttles (sweep spacing + overhead budget) and
+        never raises — history is advisory, probing is not. `force`
+        (the query brackets) bypasses the spacing throttle; bracket
+        callers pass no workers, so a forced sweep never adds
+        per-query worker HTTP fetches."""
+        try:
+            swept = self.telemetry.scrape(
+                workers=live,
+                fetch=lambda uri: self.http.request(
+                    f"{uri}/v1/metrics",
+                    request_class="probe").body.decode(
+                        "utf-8", "replace"),
+                force=force)
+            if swept:
+                self.alerts.evaluate()
+        except Exception:   # noqa: BLE001 — advisory plane only
+            log.exception("telemetry sweep failed; continuing")
 
     def decommission(self, worker_uri: str,
                      timeout_s: Optional[float] = None) -> dict:
@@ -692,6 +735,14 @@ class TpuCluster:
         # so they can never duplicate it (obs/wide_events.py)
         from presto_tpu.obs import wide_events as _wide
         pre = _wide.pre_query_snapshot(self)
+        # bracket the query with LOCAL-ONLY telemetry sweeps so
+        # metrics_history holds a before/after pair for every
+        # coordinator-side counter the query moved (transport,
+        # admission, memory) even when the background heartbeat is not
+        # running; worker registries ride the heartbeat cadence —
+        # fetching them here would add one HTTP round-trip per worker
+        # to every query
+        self._scrape_telemetry((), force=True)
         try:
             with query_lifecycle(qid, sql) as box:
                 group = self.resource_groups.select(
@@ -729,6 +780,7 @@ class TpuCluster:
             raise
         _wide.emit_wide_event(self, qid, sql, rows=box[0], error=None,
                               pre=pre)
+        self._scrape_telemetry((), force=True)
         return box[0]
 
     @property

@@ -174,7 +174,22 @@ class WorkerApp:
         m = _STATUS.match(req.path)
         if m:
             return self._status_async(server, req, m.group(1))
+        if req.path in ("/v1/metrics", "/v1/status"):
+            return self._snapshot_async(server, req)
         return None
+
+    async def _snapshot_async(self, server: AioHttpServer,
+                              req: Request):
+        """Scrape-time gauge computation (process gauges, registry
+        render, pool/spool snapshots) off the event loop: the
+        coordinator's telemetry sweep hits /v1/metrics on the
+        heartbeat cadence, and a slow scrape must degrade only the
+        scrape — never the long-polls parked on the same loop
+        (tests/test_aio_server.py asserts this)."""
+        denied = self._authorized(req)
+        if denied is not None:
+            return denied
+        return await server.run_blocking(self._get, req)
 
     async def _results_async(self, server: AioHttpServer, req: Request,
                              task_id: str, buffer_id: str, token: str):

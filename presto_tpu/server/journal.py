@@ -43,7 +43,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from presto_tpu.obs.metrics import counter as _counter
+from presto_tpu.obs.metrics import (counter as _counter,
+                                    gauge as _gauge)
 
 
 def _disk_faults():
@@ -73,6 +74,13 @@ _M_RECOVERED = _counter(
     "presto_tpu_coordinator_journal_recovered_queries_total",
     "Journaled queries re-queued through admission after a "
     "coordinator restart")
+#: refreshed via stats() on every telemetry sweep (the Telemetry
+#: refresher hook registered in server/statement.py), so the alert
+#: engine sees a live append age rather than a stale last-write value
+_M_APPEND_AGE = _gauge(
+    "presto_tpu_coordinator_journal_last_append_age_seconds",
+    "Seconds since the coordinator journal last appended a record "
+    "(0 before the first append)")
 
 #: states that need no recovery — compaction drops them
 TERMINAL_STATES = ("FINISHED", "FAILED")
@@ -272,6 +280,7 @@ class QueryJournal:
                           if r.get("state") not in TERMINAL_STATES)
             lag = (time.time() - self.last_append_ts
                    if self.last_append_ts is not None else None)
+            _M_APPEND_AGE.set(lag if lag is not None else 0.0)
             return {"path": self.path, "appends": self.appends,
                     "compactions": self.compactions,
                     "pending": pending, "recovered": self.recovered,

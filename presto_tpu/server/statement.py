@@ -264,7 +264,19 @@ class StatementApp:
             if m:
                 return self._poll_async(server, req, m.group(1),
                                         int(m.group(2)))
+            if req.path in ("/v1/metrics", "/v1/status", "/v1/alerts"):
+                return self._snapshot_async(server, req)
         return None
+
+    async def _snapshot_async(self, server: AioHttpServer,
+                              req: Request):
+        """Scrape-time computation (registry render, process gauges,
+        admission/journal/alert snapshots) runs on the executor —
+        never on the loop, where one slow scrape would stall every
+        parked long-poll (tests/test_aio_server.py asserts this)."""
+        if self._dead(server):
+            return None
+        return await server.run_blocking(self._get, req)
 
     async def _submit_async(self, server: AioHttpServer, req: Request):
         if self._dead(server):
@@ -423,6 +435,16 @@ class StatementApp:
             _M_COORD_UPTIME.set(_time.time() - _COORD_START)
             return Response(200, render_metrics_payload().encode(),
                             content_type="text/plain; version=0.0.4")
+        if path == "/v1/alerts":
+            # the alert engine's full state: every rule with its
+            # current state machine position, plus the transition
+            # history ring (matches system.runtime.alerts rows)
+            eng = getattr(self.coordinator.engine, "alerts", None)
+            if eng is None:
+                return self._json(200, {"alerts": [],
+                                        "transitions": []})
+            return self._json(200, {"alerts": eng.snapshot(),
+                                    "transitions": eng.transitions()})
         if path == "/v1/profile":
             # coordinator-side collapsed stacks (the profiler is
             # process-global, so in-process workers show here too)
@@ -486,7 +508,10 @@ class StatementApp:
                        "draining": co.draining,
                        "adoptions": co.adoptions,
                        "gossip": (co.gossip.snapshot()
-                                  if co.gossip is not None else None)}})
+                                  if co.gossip is not None else None)},
+                # alert-engine summary (full detail at /v1/alerts):
+                # which rules are firing and every rule's state
+                "alerts": self._alerts_block()})
         m = _TRACE.match(path)
         if m:
             # stitched cross-node span dump for one query id (worker
@@ -523,6 +548,14 @@ class StatementApp:
                 "reservedMemoryBytes": mem,
             })
         return self._json(404, {"error": f"no route {path}"})
+
+    def _alerts_block(self) -> Optional[dict]:
+        eng = getattr(self.coordinator.engine, "alerts", None)
+        if eng is None:
+            return None
+        return {"firing": eng.firing(),
+                "states": {a["rule"]: a["state"]
+                           for a in eng.snapshot()}}
 
     def _delete(self, req: Request) -> Response:
         m = _CANCEL.match(req.path)
@@ -578,6 +611,20 @@ class StatementServer:
         self.dispatcher = DispatchManager(
             self.resource_groups, self.admission_config,
             memory_pool=getattr(engine, "memory_pool", None))
+        # observability time-dimension wiring (engines without a
+        # telemetry plane — LocalEngine — skip both): the shedder
+        # reads the cluster-wide windowed queue-wait p99 from the
+        # telemetry history instead of its private sliding window,
+        # and the journal append-age gauge refreshes on every scrape
+        # so the JournalAppendStalled alert evaluates a live value
+        telemetry = getattr(engine, "telemetry", None)
+        if telemetry is not None:
+            self.dispatcher.shedder.attach_history(
+                lambda: telemetry.windowed_quantile(
+                    "presto_tpu_admission_queue_wait_seconds"))
+            if self.journal is not None:
+                telemetry.add_refresher(
+                    lambda: self.journal.stats())
         self.queries: Dict[str, _Query] = {}
         # client idempotency key -> qid: POST /v1/statement is
         # auto-retried by the transport, and a retry after a LOST

@@ -262,7 +262,7 @@ class Tracer:
 class QueryEvent:
     """QueryCreated/QueryCompleted payload subset (reference:
     spi/eventlistener/QueryCompletedEvent.java)."""
-    kind: str                 # "created" | "completed" | "failed" | "wide"
+    kind: str   # "created" | "completed" | "failed" | "wide" | "alert"
     query_id: str
     sql: str
     wall_s: Optional[float] = None

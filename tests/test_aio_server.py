@@ -47,6 +47,8 @@ class EchoApp:
     def dispatch_async(self, req, server):
         if req.path == "/park":
             return self._park(server)
+        if req.path == "/slow-snapshot":
+            return self._slow_snapshot(server)
         return None
 
     async def _park(self, server):
@@ -54,6 +56,15 @@ class EchoApp:
         self.wake = wake
         await evt.wait()
         return json_response(200, {"woke": True})
+
+    async def _slow_snapshot(self, server):
+        # the statement/worker servers dispatch their /v1/metrics and
+        # /v1/status renders this same way: one blocking render step
+        # pushed to the executor so the loop stays free
+        def render():
+            time.sleep(0.8)
+            return json_response(200, {"scrape": "done"})
+        return await server.run_blocking(render)
 
 
 @pytest.fixture
@@ -209,6 +220,44 @@ def test_async_dispatch_parks_on_loop_until_woken(served):
     assert results == [{"woke": True}]
     assert srv.stats()["asyncServed"] == 1
     assert srv.stats()["executorDispatched"] == 0
+
+
+def test_slow_scrape_does_not_stall_concurrent_long_poll(served):
+    """Regression guard for the off-loop snapshot dispatch: a slow
+    /v1/metrics-style render (run_blocking, 0.8s of blocking work)
+    must not stall a concurrent long-poll on the same server — the
+    parked client wakes and completes while the scrape is still
+    rendering on the executor."""
+    app, srv, base = served()
+    slow_done = []
+    poll_done = []
+
+    def slow():
+        with urllib.request.urlopen(f"{base}/slow-snapshot",
+                                    timeout=10) as r:
+            slow_done.append((json.loads(r.read()), time.monotonic()))
+
+    def poll():
+        with urllib.request.urlopen(f"{base}/park", timeout=10) as r:
+            poll_done.append((json.loads(r.read()), time.monotonic()))
+
+    ts = threading.Thread(target=slow, daemon=True)
+    ts.start()
+    tp = threading.Thread(target=poll, daemon=True)
+    tp.start()
+    deadline = time.monotonic() + 5
+    while app.wake is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert app.wake is not None, \
+        "long-poll never reached the loop — scrape blocked it"
+    app.wake()
+    tp.join(timeout=5)
+    assert poll_done and poll_done[0][0] == {"woke": True}
+    assert not slow_done, \
+        "long-poll should complete while the scrape still renders"
+    ts.join(timeout=5)
+    assert slow_done and slow_done[0][0] == {"scrape": "done"}
+    assert poll_done[0][1] < slow_done[0][1]
 
 
 def test_handler_exception_surfaces_as_500(served):

@@ -1,0 +1,255 @@
+"""Alert engine (obs/alerts.py): the pending/firing/resolved state
+machine over telemetry history, exactly-once transition events,
+burn-rate evaluation, JSON rule loading, and README-catalog parity."""
+
+import json
+import os
+import re
+
+import pytest
+
+from presto_tpu.config import ObsConfig
+from presto_tpu.obs.alerts import (ALERT_EVENT_VERSION,
+                                   DEFAULT_ALERT_RULES, AlertEngine,
+                                   AlertRule, rules_from_json)
+from presto_tpu.obs.tsdb import TimeSeriesStore
+
+README = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "README.md")
+
+
+def _cfg(**kw):
+    base = dict(tsdb_resolution_s=0.0, tsdb_retention_s=1e9,
+                alert_window_s=60.0, alert_for_s=0.0)
+    base.update(kw)
+    return ObsConfig(**base)
+
+
+def _engine(rules, **cfg):
+    config = _cfg(**cfg)
+    store = TimeSeriesStore(config)
+    events = []
+    eng = AlertEngine(store, rules=rules, config=config,
+                      clock=lambda: 0.0, emit=events.append)
+    return store, eng, events
+
+
+def _state(eng, rule):
+    return {s["rule"]: s for s in eng.snapshot()}[rule]["state"]
+
+
+RULE = AlertRule(name="High", metric="m", threshold=10.0, for_s=5.0,
+                 severity="page", description="m too high")
+
+
+# ------------------------------------------------------ state machine
+def test_threshold_walks_pending_firing_resolved_exactly_once():
+    store, eng, events = _engine([RULE])
+    store.write_points([("m", {}, 1.0, 50.0)])
+    eng.evaluate(now=1.0)
+    assert _state(eng, "High") == "pending"   # breach opens pending
+    eng.evaluate(now=3.0)
+    assert _state(eng, "High") == "pending"   # for_s=5 not sustained
+    eng.evaluate(now=7.0)
+    assert _state(eng, "High") == "firing"
+    eng.evaluate(now=8.0)                     # still firing: no re-emit
+    store.write_points([("m", {}, 9.0, 1.0)])
+    eng.evaluate(now=9.0)
+    assert _state(eng, "High") == "resolved"
+    eng.evaluate(now=10.0)                    # clear again: back to
+    assert _state(eng, "High") == "inactive"  # inactive, silently
+    assert [e.detail["state"] for e in events] == ["firing",
+                                                   "resolved"]
+    assert all(e.kind == "alert" for e in events)
+    assert all(e.detail["alertEventVersion"] == ALERT_EVENT_VERSION
+               for e in events)
+    assert [t["state"] for t in eng.transitions()] == ["firing",
+                                                       "resolved"]
+
+
+def test_pending_that_clears_never_emits():
+    store, eng, events = _engine([RULE])
+    store.write_points([("m", {}, 1.0, 50.0)])
+    eng.evaluate(now=1.0)
+    assert _state(eng, "High") == "pending"
+    store.write_points([("m", {}, 2.0, 1.0)])
+    eng.evaluate(now=2.0)
+    assert _state(eng, "High") == "inactive"
+    assert events == [] and eng.transitions() == []
+
+
+def test_for_s_zero_still_requires_a_second_evaluation():
+    rule = AlertRule(name="Now", metric="m", threshold=10.0, for_s=0.0)
+    store, eng, events = _engine([rule])
+    store.write_points([("m", {}, 1.0, 50.0)])
+    eng.evaluate(now=1.0)
+    assert _state(eng, "Now") == "pending" and events == []
+    eng.evaluate(now=1.1)
+    assert _state(eng, "Now") == "firing"
+
+
+def test_threshold_stale_points_outside_window_do_not_breach():
+    rule = AlertRule(name="High", metric="m", threshold=10.0,
+                     window_s=5.0, for_s=0.0)
+    store, eng, _ = _engine([rule])
+    store.write_points([("m", {}, 1.0, 50.0)])
+    eng.evaluate(now=100.0)                   # point is 99s old
+    assert _state(eng, "High") == "inactive"
+
+
+def test_threshold_label_subset_and_max_across_series():
+    rule = AlertRule(name="High", metric="m", threshold=10.0,
+                     labels={"h": "a"}, for_s=0.0)
+    store, eng, _ = _engine([rule])
+    store.write_points([("m", {"h": "a"}, 1.0, 5.0),
+                        ("m", {"h": "b"}, 1.0, 99.0)])
+    eng.evaluate(now=1.0)
+    assert _state(eng, "High") == "inactive"  # h=b is filtered out
+    store.write_points([("m", {"h": "a", "x": "y"}, 2.0, 50.0)])
+    eng.evaluate(now=2.0)                     # subset match still hits
+    assert _state(eng, "High") == "pending"
+
+
+def test_le_operator_fires_on_low_values():
+    rule = AlertRule(name="Low", metric="m", threshold=2.0, op="<=",
+                     for_s=0.0)
+    store, eng, _ = _engine([rule])
+    store.write_points([("m", {}, 1.0, 1.0)])
+    eng.evaluate(now=1.0)
+    eng.evaluate(now=1.1)
+    assert _state(eng, "Low") == "firing"
+
+
+# ----------------------------------------------------------- burn rate
+def test_burn_rate_computed_from_window_endpoints():
+    rule = AlertRule(name="Shed", metric="c", kind="burn_rate",
+                     threshold=0.5, for_s=0.0)
+    store, eng, _ = _engine([rule])
+    store.write_points([("c", {}, 0.0, 0.0), ("c", {}, 10.0, 20.0)])
+    eng.evaluate(now=10.0)                    # 20 rises / 10 s = 2/s
+    assert _state(eng, "Shed") == "pending"
+    snap = {s["rule"]: s for s in eng.snapshot()}["Shed"]
+    assert snap["value"] == pytest.approx(2.0)
+
+
+def test_burn_rate_flat_counter_does_not_breach():
+    rule = AlertRule(name="Shed", metric="c", kind="burn_rate",
+                     threshold=0.5, for_s=0.0)
+    store, eng, _ = _engine([rule])
+    store.write_points([("c", {}, 0.0, 7.0), ("c", {}, 10.0, 7.0)])
+    eng.evaluate(now=10.0)
+    assert _state(eng, "Shed") == "inactive"
+
+
+def test_burn_rate_counter_reset_tolerated():
+    rule = AlertRule(name="Shed", metric="c", kind="burn_rate",
+                     threshold=0.5, for_s=0.0)
+    store, eng, _ = _engine([rule])
+    # restart: 100 -> 3. The post-restart value IS the window's rise.
+    store.write_points([("c", {}, 0.0, 100.0), ("c", {}, 10.0, 3.0)])
+    eng.evaluate(now=10.0)
+    snap = {s["rule"]: s for s in eng.snapshot()}["Shed"]
+    assert snap["value"] == pytest.approx(0.3)
+    assert _state(eng, "Shed") == "inactive"
+
+
+# ------------------------------------------------------- construction
+def test_duplicate_rule_names_rejected():
+    with pytest.raises(ValueError):
+        AlertEngine(TimeSeriesStore(_cfg()),
+                    rules=[RULE, RULE], config=_cfg())
+
+
+def test_bad_kind_and_op_rejected():
+    with pytest.raises(ValueError):
+        AlertRule(name="x", metric="m", threshold=1.0, kind="gauge")
+    with pytest.raises(ValueError):
+        AlertRule(name="x", metric="m", threshold=1.0, op=">")
+
+
+def test_rules_from_json_roundtrip():
+    text = json.dumps([
+        {"name": "A", "metric": "m", "threshold": 5.0},
+        {"name": "B", "metric": "c", "threshold": 0.1,
+         "kind": "burn_rate", "severity": "info",
+         "labels": {"h": "x"}, "window_s": 30.0, "for_s": 1.0},
+    ])
+    a, b = rules_from_json(text)
+    assert a == AlertRule(name="A", metric="m", threshold=5.0)
+    assert b.kind == "burn_rate" and b.labels == {"h": "x"}
+
+
+def test_alerts_disabled_by_config():
+    store, eng, events = _engine([RULE], alerts_enabled=False)
+    store.write_points([("m", {}, 1.0, 50.0)])
+    for now in (1.0, 7.0, 8.0):
+        eng.evaluate(now=now)
+    assert _state(eng, "High") == "inactive" and events == []
+
+
+def test_transition_ring_capped():
+    rule = AlertRule(name="Flap", metric="m", threshold=10.0,
+                     for_s=0.0)
+    store, eng, _ = _engine([rule], alert_history_cap=4)
+    for i in range(10):
+        t = float(10 * i)
+        store.write_points([("m", {}, t + 1, 50.0)])
+        eng.evaluate(now=t + 1)               # -> pending
+        eng.evaluate(now=t + 1.5)             # -> firing
+        store.write_points([("m", {}, t + 2, 1.0)])
+        eng.evaluate(now=t + 2)               # -> resolved
+    assert len(eng.transitions()) == 4
+
+
+def test_rows_surface_matches_transitions():
+    store, eng, _ = _engine([RULE])
+    store.write_points([("m", {}, 1.0, 50.0)])
+    eng.evaluate(now=1.0)
+    eng.evaluate(now=7.0)
+    [(rule, state, severity, metric, value, threshold, ts)] = \
+        eng.rows()
+    assert (rule, state, severity, metric) == ("High", "firing",
+                                               "page", "m")
+    assert value == 50.0 and threshold == 10.0 and ts == 7.0
+
+
+def test_broken_rule_never_costs_the_sweep():
+    store, eng, _ = _engine([RULE])
+
+    def boom(*a, **k):
+        raise RuntimeError("bad read")
+
+    store.latest = boom
+    eng.evaluate(now=1.0)                     # must not raise
+    assert _state(eng, "High") == "inactive"
+
+
+# ------------------------------------------------- README catalog parity
+def _readme_catalog_rules():
+    with open(README, encoding="utf-8") as f:
+        text = f.read()
+    section = text.split("## Telemetry history, SLOs & alerting", 1)[1]
+    section = section.split("## ", 1)[0]
+    return dict(re.findall(
+        r"^\| `([A-Za-z0-9]+)` \| (threshold|burn_rate) \|",
+        section, re.MULTILINE))
+
+
+def test_default_catalog_matches_readme_both_ways():
+    documented = _readme_catalog_rules()
+    coded = {r.name: r.kind for r in DEFAULT_ALERT_RULES}
+    assert documented == coded, (
+        "README default-alert-catalog table and DEFAULT_ALERT_RULES "
+        f"disagree: doc-only={set(documented) - set(coded)}, "
+        f"code-only={set(coded) - set(documented)}")
+
+
+def test_default_rules_reference_plausible_series():
+    # the static half lives in the alert-rule-metric-exists analysis
+    # rule; here: every quantile-labeled rule targets a histogram-style
+    # seconds metric, and every burn-rate rule targets a _total counter
+    for r in DEFAULT_ALERT_RULES:
+        if r.labels and "quantile" in r.labels:
+            assert r.metric.endswith("_seconds"), r.name
+        if r.kind == "burn_rate":
+            assert r.metric.endswith("_total"), r.name

@@ -54,7 +54,8 @@ def test_rule_catalog_complete():
             "no-planner-in-data-plane", "membership-chokepoint",
             "journal-chokepoint",
             "metric-docs-sync", "mv-cache-chokepoint",
-            "spill-chokepoint"} <= names
+            "spill-chokepoint",
+            "alert-rule-metric-exists"} <= names
 
 
 # ===================================================================
@@ -256,6 +257,67 @@ def test_metric_docs_sync_flags_missing_catalog_section():
         "README.md": "# engine\n\nno catalog here\n"},
         planted="README.md")
     assert fs and "no 'Metric catalog" in fs[0].message
+
+
+_ALERT_SOURCES = {
+    "presto_tpu/obs/m.py":
+        'A = counter("presto_tpu_real_total", "h")\n',
+    "presto_tpu/obs/alerts.py":
+        'R = AlertRule(name="X", metric="presto_tpu_real_total",\n'
+        "              threshold=1.0)\n",
+    "presto_tpu/obs/tsdb.py":
+        "def scrape(self):\n"
+        "    self.store.write_points(points)\n",
+}
+
+
+def test_alert_rule_metric_exists_clean_when_registered():
+    assert not _findings("alert-rule-metric-exists", _ALERT_SOURCES)
+
+
+def test_alert_rule_metric_exists_flags_unregistered_metric():
+    srcs = dict(_ALERT_SOURCES)
+    srcs["presto_tpu/obs/alerts.py"] += \
+        'B = AlertRule(name="Y", metric="presto_tpu_ghost_total",\n' \
+        "              threshold=2.0)\n"
+    fs = _findings("alert-rule-metric-exists", srcs,
+                   planted="presto_tpu/obs/alerts.py")
+    assert fs and fs[0].line == 3
+    assert "presto_tpu_ghost_total" in fs[0].message
+    assert "never fire" in fs[0].message
+
+
+def test_alert_rule_metric_exists_flags_rogue_tsdb_writer():
+    srcs = dict(_ALERT_SOURCES)
+    bad = "presto_tpu/server/evil.py"
+    srcs[bad] = "store.write_points([(1, 2, 3, 4)])\n"
+    fs = _findings("alert-rule-metric-exists", srcs, planted=bad)
+    assert fs and fs[0].line == 1
+    assert "write chokepoint" in fs[0].message
+
+
+def test_alert_rule_metric_exists_honesty_no_metric_refs():
+    # the catalog stopped spelling rules with metric="..." => the rule
+    # must report itself vacuous instead of passing silently
+    srcs = dict(_ALERT_SOURCES)
+    srcs["presto_tpu/obs/alerts.py"] = "RULES = ()\n"
+    fs = _findings("alert-rule-metric-exists", srcs,
+                   planted="presto_tpu/obs/alerts.py")
+    assert fs and "idiom changed" in fs[0].message
+
+
+def test_alert_rule_metric_exists_honesty_missing_files():
+    srcs = dict(_ALERT_SOURCES)
+    del srcs["presto_tpu/obs/alerts.py"]
+    fs = _findings("alert-rule-metric-exists", srcs,
+                   planted="presto_tpu/obs/alerts.py")
+    assert fs and "missing" in fs[0].message
+    # and the allowlisted chokepoint file must still contain the call
+    srcs = dict(_ALERT_SOURCES)
+    srcs["presto_tpu/obs/tsdb.py"] = "x = 1\n"
+    fs = _findings("alert-rule-metric-exists", srcs,
+                   planted="presto_tpu/obs/tsdb.py")
+    assert fs and "vacuous" in fs[0].message
 
 
 def test_thread_discipline_fires():

@@ -165,6 +165,111 @@ def test_killed_then_restarted_worker_readmitted():
         c.stop()
 
 
+def test_worker_kill_fires_breaker_alert_revival_resolves(tmp_path):
+    """Alerting chaos loop (obs/alerts.py): hard-killing a worker
+    drives the TransportBreakerOpen rule to `firing` via the telemetry
+    sweep in check_workers(); reviving the worker resolves it. Both
+    transitions appear exactly once in the wide-event JSONL sink and
+    agree with `GET /v1/alerts` and system.runtime.alerts."""
+    import json as _json
+    import urllib.request
+
+    from presto_tpu.obs.metrics import REGISTRY
+    from presto_tpu.obs.wide_events import JsonlEventSink
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.utils.tracing import EVENTS
+
+    RULE = "TransportBreakerOpen"
+
+    # earlier chaos tests leave dead clusters' breaker gauges in the
+    # process-global registry — zero them so this cluster's telemetry
+    # starts from a quiet world and the rule can't pre-fire
+    stale = REGISTRY.get("presto_tpu_transport_breaker_state")
+    if stale is not None:
+        for _n, lnames, lvals, v in stale.samples():
+            if v:
+                stale.set(0.0, **dict(zip(lnames, lvals)))
+
+    sink = JsonlEventSink(str(tmp_path / "events.jsonl"),
+                          max_bytes=1 << 20, max_files=2)
+    EVENTS.register(sink)
+    from presto_tpu.config import ObsConfig
+
+    conn = TpchConnector(0.001)
+    # cooldown longer than kill->firing->revive so the breaker stays
+    # OPEN (no half-open flapping) while the alert walks to firing;
+    # sweep interval dropped from the 2s default so the pump loop's
+    # check_workers() calls actually sweep at pump cadence
+    c = TpuCluster(conn, n_workers=2, transport_config=TransportConfig(
+        retry_base_backoff_s=0.01, retry_max_backoff_s=0.1,
+        retry_budget_s=2.0, breaker_failure_threshold=3,
+        breaker_cooldown_s=2.0),
+        obs_config=ObsConfig(tsdb_sweep_interval_s=0.05))
+    srv = StatementServer(c).start()
+
+    def alert_state():
+        return {s["rule"]: s["state"]
+                for s in c.alerts.snapshot()}[RULE]
+
+    def pump_until(pred, what, deadline_s=20.0):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            c.check_workers()
+            if pred():
+                return
+            time.sleep(0.06)
+        raise AssertionError(f"timed out waiting for {what}; "
+                             f"state={alert_state()}")
+
+    try:
+        assert alert_state() == "inactive"
+        port = c.workers[1].port
+        c.workers[1].stop()                         # hard kill
+        pump_until(lambda: alert_state() == "firing",
+                   "breaker alert to fire after worker kill")
+        with urllib.request.urlopen(f"{srv.base}/v1/alerts",
+                                    timeout=10) as r:
+            via_http = _json.loads(r.read())
+        assert {a["rule"]: a["state"]
+                for a in via_http["alerts"]}[RULE] == "firing"
+
+        # revive on the same port with the cluster's (system-table-
+        # wrapped) connector, exactly what the original worker served
+        c.workers[1] = TpuWorkerServer(c.connector, port=port).start()
+        pump_until(lambda: alert_state() in ("resolved", "inactive"),
+                   "breaker alert to resolve after worker revival")
+
+        moved = [t for t in c.alerts.transitions()
+                 if t["rule"] == RULE]
+        assert [t["state"] for t in moved] == ["firing", "resolved"]
+
+        # the three surfaces agree: engine ring == HTTP == SQL
+        with urllib.request.urlopen(f"{srv.base}/v1/alerts",
+                                    timeout=10) as r:
+            via_http = _json.loads(r.read())
+        assert [t["state"] for t in via_http["transitions"]
+                if t["rule"] == RULE] == ["firing", "resolved"]
+        rows = c.execute_sql(
+            "select state, timestamp from system.runtime.alerts "
+            f"where rule = '{RULE}' order by timestamp")
+        assert [r[0] for r in rows] == ["firing", "resolved"]
+
+        # ...and the JSONL sink holds each transition exactly once
+        with open(sink.path, encoding="utf-8") as f:
+            records = [_json.loads(ln) for ln in f if ln.strip()]
+        alerts = [rec for rec in records
+                  if rec.get("alertEventVersion") == 1
+                  and rec.get("rule") == RULE]
+        assert [a["state"] for a in alerts] == ["firing", "resolved"]
+        assert all(a["metric"] ==
+                   "presto_tpu_transport_breaker_state"
+                   for a in alerts)
+    finally:
+        EVENTS.unregister(sink)
+        srv.stop()
+        c.stop()
+
+
 def test_heartbeat_loop_survives_probe_exceptions():
     """The background prober daemon must log-and-continue on an
     unexpected exception, not die silently."""

@@ -186,6 +186,39 @@ def test_queries_table_matches_statement_status(cluster):
         srv.stop()
 
 
+def test_metrics_history_brackets_one_query():
+    """PR acceptance for the telemetry history: after ONE cluster
+    TPC-H query, system.runtime.metrics_history holds >= 2 timestamped
+    samples for a coordinator transport-pool counter — the
+    execute_sql brackets write a before/after pair even when no
+    background heartbeat is running."""
+    c = TpuCluster(TpchConnector(SF), n_workers=2,
+                   transport_config=FAST)
+    try:
+        c.check_workers()       # probes dial the client pool
+        time.sleep(0.06)        # clear the per-series write spacing
+        c.execute_sql(
+            "select l_returnflag, count(*) from lineitem "
+            "group by l_returnflag order by l_returnflag")
+        rows = c.execute_sql(
+            "select labels, timestamp, value "
+            "from system.runtime.metrics_history "
+            "where name = 'presto_tpu_net_keepalive_reuse_total' "
+            "order by timestamp")
+        mine = [(ts, v) for labels, ts, v in rows
+                if json.loads(labels).get("instance") == "coordinator"
+                and json.loads(labels).get("role") == "client-pool"]
+        assert len(mine) >= 2, f"no before/after pair: {rows}"
+        stamps = [ts for ts, _ in mine]
+        assert stamps == sorted(stamps) and len(set(stamps)) == \
+            len(stamps), "history timestamps not strictly increasing"
+        values = [v for _, v in mine]
+        assert values[-1] > values[0], \
+            "the query's RPCs never moved the pool counter"
+    finally:
+        c.stop()
+
+
 def test_metrics_table_rides_engine_path(cluster):
     rows = cluster.execute_sql(
         "select name, kind, value from system.metrics "

@@ -1,0 +1,291 @@
+"""Telemetry history (obs/tsdb.py): the bounded ring-buffer store,
+the tolerant exposition parser, windowed delta quantiles, and the
+scraper's throttles — plus the per-histogram bucket-override contract
+in the registry that the sub-ms loop-lag / multi-second queue-wait
+layouts depend on."""
+
+import math
+
+import pytest
+
+from presto_tpu.config import ObsConfig
+from presto_tpu.obs.metrics import (DEFAULT_TIME_BUCKETS_S,
+                                    MetricsRegistry, REGISTRY)
+from presto_tpu.obs.tsdb import (Telemetry, TimeSeriesStore,
+                                 _delta_quantiles, canonical_labels,
+                                 parse_prometheus_text)
+
+
+def _cfg(**kw):
+    base = dict(tsdb_resolution_s=0.0, tsdb_sweep_interval_s=0.0,
+                tsdb_retention_s=1e9,
+                tsdb_max_series=1000, tsdb_max_points=100)
+    base.update(kw)
+    return ObsConfig(**base)
+
+
+# ------------------------------------------------------------- parser
+def test_parse_plain_and_labeled_samples():
+    text = ("# HELP x help\n# TYPE x counter\n"
+            "x_total 3\n"
+            'y{a="1",b="two"} 4.5\n'
+            "garbage line that is not a sample\n")
+    rows = parse_prometheus_text(text)
+    assert ("x_total", {}, 3.0) in rows
+    assert ("y", {"a": "1", "b": "two"}, 4.5) in rows
+    assert len(rows) == 2                     # garbage skipped
+
+
+def test_parse_label_escapes():
+    text = 'm{q="a\\"b",n="x\\ny",s="c\\\\d"} 1\n'
+    [(name, labels, value)] = parse_prometheus_text(text)
+    assert name == "m" and value == 1.0
+    assert labels == {"q": 'a"b', "n": "x\ny", "s": "c\\d"}
+
+
+def test_parse_roundtrips_registry_render():
+    reg = MetricsRegistry()
+    reg.counter("t_total", "h", ("k",)).inc(2, k='we"ird')
+    reg.gauge("g", "h").set(7)
+    rows = parse_prometheus_text(reg.render())
+    assert ("t_total", {"k": 'we"ird'}, 2.0) in rows
+    assert ("g", {}, 7.0) in rows
+
+
+def test_canonical_labels_order_independent():
+    assert canonical_labels({"b": "2", "a": "1"}) \
+        == canonical_labels({"a": "1", "b": "2"})
+
+
+# -------------------------------------------------------------- store
+def test_store_write_read_window_subset_match():
+    st = TimeSeriesStore(_cfg())
+    st.write_points([("m", {"h": "a"}, 1.0, 10.0),
+                     ("m", {"h": "a"}, 2.0, 20.0),
+                     ("m", {"h": "b"}, 2.0, 5.0)])
+    latest = st.latest("m", {"h": "a"})
+    assert latest == [({"h": "a"}, 2.0, 20.0)]
+    # subset match: no labels matches every series
+    assert len(st.latest("m")) == 2
+    [(labels, pts)] = st.window("m", {"h": "a"}, since=1.5)
+    assert pts == [(2.0, 20.0)]
+
+
+def test_store_resolution_and_monotonicity_drops():
+    st = TimeSeriesStore(_cfg(tsdb_resolution_s=0.5))
+    assert st.write_points([("m", {}, 1.0, 1.0)]) == 1
+    # closer than resolution to the newest point -> dropped
+    assert st.write_points([("m", {}, 1.2, 2.0)]) == 0
+    # history never runs backwards
+    assert st.write_points([("m", {}, 0.5, 3.0)]) == 0
+    assert st.write_points([("m", {}, 2.0, 4.0)]) == 1
+    assert [v for _, _, v in st.latest("m")] == [4.0]
+
+
+def test_store_series_cap():
+    st = TimeSeriesStore(_cfg(tsdb_max_series=2))
+    st.write_points([("a", {}, 1.0, 1.0), ("b", {}, 1.0, 1.0),
+                     ("c", {}, 1.0, 1.0)])
+    assert st.stats()["series"] == 2
+    assert st.latest("c") == []
+
+
+def test_store_retention_prune_and_point_cap():
+    st = TimeSeriesStore(_cfg(tsdb_retention_s=10.0,
+                              tsdb_max_points=4))
+    st.write_points([("m", {}, float(t), float(t))
+                     for t in (1, 2, 3, 14)])
+    # t=1..3 fell off the 10s retention horizon measured from t=14
+    [(_, pts)] = st.window("m")
+    assert pts == [(14.0, 14.0)]
+    st2 = TimeSeriesStore(_cfg(tsdb_max_points=3))
+    st2.write_points([("m", {}, float(t), float(t))
+                      for t in range(1, 8)])
+    [(_, pts)] = st2.window("m")
+    assert len(pts) == 3 and pts[-1] == (7.0, 7.0)
+    assert st2.stats()["points"] == 3
+
+
+def test_store_rows_dump_shape():
+    st = TimeSeriesStore(_cfg())
+    st.write_points([("m", {"x": "1"}, 1.0, 2.0)])
+    assert st.rows() == [("m", '{"x":"1"}', 1.0, 2.0)]
+
+
+# ----------------------------------------------------- delta quantiles
+def test_delta_quantiles_interpolation_from_scratch():
+    buckets = [(0.1, 5.0), (1.0, 10.0), (float("inf"), 10.0)]
+    q, state = _delta_quantiles(buckets, None)
+    assert q[0.5] == pytest.approx(0.1)
+    assert q[0.95] == pytest.approx(0.91)
+    assert q[0.99] == pytest.approx(0.982)
+    assert state[0.1] == 5.0
+
+
+def test_delta_quantiles_window_is_the_delta():
+    first = [(0.1, 5.0), (1.0, 10.0), (float("inf"), 10.0)]
+    _, state = _delta_quantiles(first, None)
+    # nothing new arrived -> empty quantile dict
+    q, state = _delta_quantiles(first, state)
+    assert q == {}
+    # 4 new observations, all in the (0.1, 1.0] bucket
+    second = [(0.1, 5.0), (1.0, 14.0), (float("inf"), 14.0)]
+    q, _ = _delta_quantiles(second, state)
+    assert 0.1 < q[0.5] <= 1.0
+    assert q[0.99] <= 1.0
+
+
+def test_delta_quantiles_counter_reset_tolerated():
+    _, state = _delta_quantiles([(1.0, 50.0), (float("inf"), 50.0)],
+                                None)
+    # process restart: cumulative counts shrank — treat current counts
+    # as the whole window rather than emitting negative deltas
+    q, _ = _delta_quantiles([(1.0, 3.0), (float("inf"), 3.0)], state)
+    assert q and 0.0 <= q[0.99] <= 1.0
+
+
+def test_delta_quantiles_inf_clamps_to_last_finite_edge():
+    q, _ = _delta_quantiles([(1.0, 0.0), (float("inf"), 10.0)], None)
+    assert q[0.99] == 1.0 and not math.isinf(q[0.99])
+
+
+# ------------------------------------------------------------ scraper
+def _fresh_telemetry(now, **cfg):
+    reg = MetricsRegistry()
+    tel = Telemetry(_cfg(**cfg), registry=reg, clock=lambda: now[0])
+    return reg, tel
+
+
+def test_scrape_local_registry_lands_with_instance_label():
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now)
+    reg.counter("presto_tpu_demo_total", "h").inc(3)
+    assert tel.scrape() is True
+    rows = tel.store.latest("presto_tpu_demo_total",
+                            {"instance": "coordinator"})
+    assert [v for _, _, v in rows] == [3.0]
+
+
+def test_scrape_sweep_interval_throttle_skips_sweep():
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now, tsdb_sweep_interval_s=1.0)
+    reg.gauge("g", "h").set(1)
+    assert tel.scrape() is True
+    assert tel.scrape() is False              # inside the min spacing
+    now[0] += 2.0
+    assert tel.scrape() is True
+
+
+def test_scrape_force_bypasses_sweep_interval_but_not_disable():
+    """Query-bracket sweeps (force=True) land even when the heartbeat
+    swept a moment ago — but a disabled TSDB stays disabled."""
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now, tsdb_sweep_interval_s=60.0)
+    reg.gauge("g", "h").set(1)
+    assert tel.scrape() is True
+    now[0] += 0.001
+    assert tel.scrape() is False
+    assert tel.scrape(force=True) is True
+    _, tel_off = _fresh_telemetry(now, tsdb_enabled=False)
+    assert tel_off.scrape(force=True) is False
+
+
+def test_scrape_workers_fetched_and_one_failure_tolerated():
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now)
+
+    def fetch(uri):
+        if "bad" in uri:
+            raise OSError("connection refused")
+        return "w_metric 42\n"
+
+    assert tel.scrape(workers=("http://good:1", "http://bad:2"),
+                      fetch=fetch) is True
+    rows = tel.store.latest("w_metric")
+    assert rows == [({"instance": "good:1"}, 100.0, 42.0)]
+
+
+def test_scrape_histogram_collapsed_to_windowed_quantiles():
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now)
+    h = reg.histogram("presto_tpu_demo_seconds", "h",
+                      buckets=(0.1, 1.0))
+    assert tel.scrape() is True               # baseline: empty window
+    assert tel.windowed_quantile("presto_tpu_demo_seconds") is None
+    for _ in range(10):
+        h.observe(0.5)
+    now[0] += 1.0
+    assert tel.scrape() is True
+    p99 = tel.windowed_quantile("presto_tpu_demo_seconds",
+                                max_age_s=60.0)
+    assert p99 is not None and 0.1 < p99 <= 1.0
+    # raw bucket series are NOT stored — only the quantile collapse
+    assert tel.store.latest("presto_tpu_demo_seconds_bucket") == []
+
+
+def test_scrape_overhead_budget_enforced_after_grace():
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now, tsdb_max_overhead=1e-12)
+    reg.gauge("g", "h").set(1)
+    assert tel.scrape() is True               # first sweep: no wall yet
+    now[0] += 5.0
+    assert tel.scrape() is True               # inside the grace window
+    now[0] += Telemetry.OVERHEAD_GRACE_S + 5.0
+    # past grace, any nonzero self-time busts a 1e-12 budget
+    assert tel.scrape() is False
+    assert tel.stats()["overheadFraction"] >= 0.0
+
+
+def test_scrape_refresher_runs_and_exceptions_tolerated():
+    now = [100.0]
+    reg, tel = _fresh_telemetry(now)
+    g = reg.gauge("derived", "h")
+    calls = []
+
+    def refresher():
+        calls.append(1)
+        g.set(9.0)
+
+    def broken():
+        raise RuntimeError("boom")
+
+    tel.add_refresher(broken)
+    tel.add_refresher(refresher)
+    assert tel.scrape() is True
+    assert calls == [1]
+    assert [v for _, _, v in tel.store.latest("derived")] == [9.0]
+
+
+def test_scrape_disabled_by_config():
+    now = [100.0]
+    _, tel = _fresh_telemetry(now, tsdb_enabled=False)
+    assert tel.scrape() is False
+    assert tel.store.stats()["points"] == 0
+
+
+# -------------------------------------------- bucket-override contract
+def test_histogram_bucket_override_conflict_raises():
+    reg = MetricsRegistry()
+    reg.histogram("h_seconds", "h", buckets=(0.1, 1.0))
+    # same explicit layout -> idempotent
+    assert reg.histogram("h_seconds", "h", buckets=(1.0, 0.1)) \
+        is reg.get("h_seconds")
+    # the DEFAULT layout carries no opinion -> idempotent
+    assert reg.histogram("h_seconds", "h") is reg.get("h_seconds")
+    # an explicit DIFFERENT layout is a programming error
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds", "h", buckets=(0.5, 2.0))
+
+
+def test_loop_lag_and_queue_wait_bucket_overrides_landed():
+    import presto_tpu.net               # noqa: F401 — registers lag
+    import presto_tpu.admission.groups  # noqa: F401 — registers wait
+    lag = REGISTRY.get("presto_tpu_net_event_loop_lag_seconds")
+    assert lag.buckets[0] <= 0.00025, \
+        "loop-lag histogram lost its sub-ms resolution"
+    assert lag.buckets != tuple(sorted(DEFAULT_TIME_BUCKETS_S))
+    wait = REGISTRY.get("presto_tpu_admission_queue_wait_seconds")
+    assert max(wait.buckets) >= 120.0, \
+        "queue-wait histogram cannot resolve multi-second waits"
+    assert any(20.0 <= b <= 45.0 for b in wait.buckets), \
+        "queue-wait histogram has no bucket near the shed threshold"
