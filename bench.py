@@ -276,6 +276,8 @@ def main() -> None:
         return _memory_child()
     if os.environ.get("BENCH_SERVE_ONE"):
         return _serve_child()
+    if os.environ.get("BENCH_CLUSTER_MESH_ONE"):
+        return _cluster_mesh_child()
     if ds_one:
         return _ds_child(int(ds_one), runs, warmup)
     if pq_one:
@@ -662,6 +664,18 @@ def _main_orchestrator(sf, qids) -> None:
         detail["serve"] = _run_serve_child(
             float(os.environ.get("BENCH_SERVE_TIMEOUT_S", "300"))
             + 120.0)
+
+    # cluster-mesh tier round (one JSON `cluster_mesh` entry: q03/q18
+    # through the HTTP cluster with mesh-lowered fused execution —
+    # walls plus the ICI-vs-HTTP exchange byte split);
+    # BENCH_CLUSTER_MESH=0 disables
+    if os.environ.get("BENCH_CLUSTER_MESH", "1") != "0":
+        if wedged is not None:
+            detail["cluster_mesh"] = {"error": f"infra: {wedged}"}
+        else:
+            detail["cluster_mesh"] = _run_cluster_mesh_child(
+                float(os.environ.get("BENCH_CLUSTER_MESH_TIMEOUT_S",
+                                     "300")) + 120.0)
 
     if wedged is not None:
         detail["infra_error"] = wedged
@@ -1263,6 +1277,119 @@ def _churn_child() -> None:
     print(json.dumps({"metric": "elastic_churn_round",
                       "value": out["queries_per_sec"], "unit": "q/s",
                       "detail": {"churn": out}}))
+
+
+def _cluster_mesh_child() -> None:
+    """Cluster-mesh tier round: TPC-H q03/q18 through `TpuCluster`
+    with `cluster_mesh_enabled=true` — the co-locatable plan fuses
+    onto one mesh worker and its inter-stage exchanges ride ICI
+    collectives — against the same queries on the plain HTTP path.
+    Emits per-query walls, the ICI-vs-HTTP exchange byte split, and a
+    rows-match bit between the two paths as one JSON line."""
+    _ensure_host_devices()
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from tpch_queries import QUERIES
+
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.server import mesh_tier
+    from presto_tpu.server.cluster import TpuCluster
+
+    sf = float(os.environ.get("BENCH_CLUSTER_MESH_SF", "0.01"))
+    qids = [int(q) for q in os.environ.get(
+        "BENCH_CLUSTER_MESH_QUERIES", "3,18").split(",") if q]
+    conn = TpchConnector(sf)
+    in_rows = sum(conn.table(t).num_rows
+                  for t in ("customer", "orders", "lineitem"))
+    cluster = TpuCluster(
+        conn, n_workers=3,
+        session_properties={"query_max_execution_time": "300",
+                            "cluster_mesh_enabled": "true"})
+    out = {"sf": sf, "queries": {}}
+    total_wall = 0.0
+    try:
+        for qid in qids:
+            sql = QUERIES[qid]
+            # mesh path: warm (compile), then time; the tier metrics
+            # bracket gives the bytes that moved over ICI collectives
+            cluster.session_properties["cluster_mesh_enabled"] = "true"
+            cluster.execute_sql(sql)
+            ici0 = mesh_tier.ici_bytes_total()
+            t0 = time.perf_counter()
+            mesh_rows = cluster.execute_sql(sql)
+            mesh_wall = time.perf_counter() - t0
+            ici = int(mesh_tier.ici_bytes_total() - ici0)
+            cm = dict(cluster.last_cluster_mesh or {})
+            # HTTP control: identical query, tier off — its exchange
+            # stats are the bytes the fusion replaced
+            cluster.session_properties["cluster_mesh_enabled"] = "false"
+            cluster.execute_sql(sql)
+            t0 = time.perf_counter()
+            http_rows = cluster.execute_sql(sql)
+            http_wall = time.perf_counter() - t0
+            exch = dict(cluster.last_exchange_stats or {})
+            out["queries"][f"q{qid:02d}"] = {
+                "mesh_wall_s": round(mesh_wall, 4),
+                "http_wall_s": round(http_wall, 4),
+                "result_rows": len(mesh_rows),
+                # float tolerance: the two paths sum revenue in
+                # different orders (associativity noise only)
+                "rows_match_http": _mv_rows_match(
+                    [list(r) for r in mesh_rows],
+                    [list(r) for r in http_rows], rel=1e-6,
+                    absol=1e-6),
+                "ici_bytes": ici,
+                "http_exchange_bytes": int(exch.get("bytes", 0)),
+                "colocated_stages": int(cm.get("colocated_stages", 0)),
+                "ndev": int(cm.get("ndev", 0)),
+                "fallbacks": int(cm.get("fallbacks", 0)),
+            }
+            total_wall += mesh_wall
+    finally:
+        cluster.stop()
+    qs = out["queries"].values()
+    out["ici_bytes_total"] = sum(e["ici_bytes"] for e in qs)
+    out["http_exchange_bytes_total"] = sum(
+        e["http_exchange_bytes"] for e in qs)
+    out["all_rows_match_http"] = all(e["rows_match_http"] for e in qs)
+    out["wall_s"] = round(total_wall, 3)
+    # input rows over the mesh-path wall: the lane throughput figure
+    # bench_check compares round-over-round
+    out["rows_per_sec"] = (round(in_rows * len(out["queries"])
+                                 / total_wall, 1)
+                           if total_wall > 0 else 0.0)
+    print(json.dumps({"metric": "cluster_mesh_round",
+                      "value": out["rows_per_sec"], "unit": "rows/s",
+                      "detail": {"cluster_mesh": out}}))
+
+
+def _run_cluster_mesh_child(timeout_s: float):
+    """Run the cluster-mesh round in a subprocess; returns the
+    `cluster_mesh` detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_CLUSTER_MESH_ONE="1",
+                           BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "cluster_mesh", {"error": "child produced no cluster_mesh "
+                                  "entry"})
 
 
 def _run_churn_child(timeout_s: float):

@@ -1123,3 +1123,43 @@ class AlertRuleMetricExistsRule(Rule):
 
 
 register(AlertRuleMetricExistsRule())
+
+# =====================================================================
+# 19. ici-exchange-chokepoint — server/mesh_tier.py is the only place
+#     that decides ICI-vs-HTTP exchange routing
+# =====================================================================
+
+#: the ICI exchange descriptor rides the task session properties under
+#: this key; reading or writing it anywhere else in the control plane
+#: is a routing decision made outside the sanctioned policy
+_ICI_DESCRIPTOR = re.compile(r"[\"']x_ici_exchange[\"']")
+
+_MESH_TIER = "presto_tpu/server/mesh_tier.py"
+
+
+class IciExchangeChokepointRule(Rule):
+    name = "ici-exchange-chokepoint"
+    description = (
+        "only server/mesh_tier.py may decide whether an exchange "
+        "rides ICI collectives or HTTP page pulls — a bare mesh-"
+        "descriptor check elsewhere in server/ or protocol/ forks the "
+        "routing policy, and a fork that disagrees with the "
+        "chokepoint silently double-accounts or drops the fallback "
+        "contract (non-co-located/degraded stages must keep HTTP "
+        "byte-for-byte)")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_ICI_DESCRIPTOR,),
+            "bare ICI exchange-descriptor access outside "
+            "server/mesh_tier.py — route the decision through "
+            "stamp_ici_descriptor/ici_descriptor",
+            allowed=(_MESH_TIER,),
+            prefixes=("presto_tpu/server/", "presto_tpu/protocol/"))
+        out.extend(honesty_finding(
+            self, pkg, _MESH_TIER, (_ICI_DESCRIPTOR,),
+            "the ICI exchange routing chokepoint"))
+        return out
+
+
+register(IciExchangeChokepointRule())

@@ -139,6 +139,15 @@ PROPERTIES = [
              "onto survivors as attempt N+1; reference: retry-policy "
              "TASK, Presto@Meta VLDB'23 §3 / Project Tardigrade)",
              lambda s: s.strip().upper(), "NONE"),
+    Property("cluster_mesh_enabled",
+             "Route eligible cluster task fragments (join/agg-bearing, "
+             "mesh-lowerable) through the worker device-mesh execution "
+             "tier (server/mesh_tier.py), and let the coordinator fuse "
+             "co-locatable stages onto one mesh worker so the "
+             "repartition exchange rides ICI collectives instead of "
+             "HTTP page pulls; any lowering failure falls back to the "
+             "generic executor + HTTP path byte-for-byte",
+             _parse_bool, False),
 ]
 
 _BY_NAME = {p.name: p for p in PROPERTIES}
@@ -419,6 +428,37 @@ class ExchangeConfig:
 
 #: process defaults
 DEFAULT_EXCHANGE = ExchangeConfig()
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTierConfig:
+    """Cluster mesh execution tier knobs (server/mesh_tier.py): the
+    worker-side device-mesh task runner plus the coordinator's
+    co-location policy. Mirrors the reference's native-worker swap
+    (PAPER.md L6a TaskExecutor / L7 exchange): the execution tier
+    changes, the coordinator protocol does not."""
+
+    #: worker side: advertise a mesh slice and accept mesh-lowered
+    #: task fragments (per query still gated by the session property
+    #: `cluster_mesh_enabled`)
+    enabled: bool = True
+    #: devices in this worker's mesh slice; 0 = every visible device
+    ndev: int = 0
+    #: ICI domain id — co-location requires producer and consumer to
+    #: share one group (single-host default: every worker sees the
+    #: same device set, so one group)
+    mesh_group: str = "local"
+    #: coordinator side: fuse co-locatable producer/consumer stages
+    #: onto one mesh worker so the exchange rides ICI collectives
+    colocate: bool = True
+    #: refuse to fuse plans wider than this many HTTP-path fragments
+    #: (a very wide plan concentrated on one worker loses more to lost
+    #: scan parallelism than it gains from ICI exchange)
+    max_colocate_fragments: int = 8
+
+
+#: process defaults
+DEFAULT_MESH_TIER = MeshTierConfig()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -364,10 +364,18 @@ class DistSplitExecutor(DistExecutor):
         parts = self.splits.get(name)
         if parts is None:
             return None
-        (b, n), = parts       # lifespan contract: one split per table
-        return [self.connector.table(name, part=b * self.ndev + d,
-                                     num_parts=n * self.ndev)
-                for d in range(self.ndev)]
+        # each assigned split (b, n) subdivides across the mesh: device
+        # d reads part b*ndev+d of n*ndev. A task holding SEVERAL
+        # lifespan splits (the fused cluster-mesh plan concentrates a
+        # whole stage's splits on one task) gives each device one
+        # subpart per split, merged at fetch time.
+        out = []
+        for d in range(self.ndev):
+            ts = [self.connector.table(name, part=b * self.ndev + d,
+                                       num_parts=n * self.ndev)
+                  for b, n in parts]
+            out.append(ts[0] if len(ts) == 1 else _MultiPartTable(ts))
+        return out
 
     def _scan_rows(self, node) -> int:
         ts = self._split_tables(node.table)
@@ -385,6 +393,20 @@ class DistSplitExecutor(DistExecutor):
         pages = [t.page(columns=list(s.columns), capacity=s.capacity)
                  for t in ts]
         return pages[0] if self.ndev == 1 else stack_pages(pages)
+
+
+class _MultiPartTable:
+    """Several connector part-tables presented as one: a device's view
+    of a task that holds multiple lifespan splits of one table."""
+
+    def __init__(self, tables):
+        self.tables = tables
+        self.num_rows = sum(t.num_rows for t in tables)
+
+    def page(self, columns=None, capacity=None):
+        from presto_tpu.data.column import concat_pages_host
+        pages = [t.page(columns=columns) for t in self.tables]
+        return concat_pages_host(pages, capacity=capacity)
 
 
 class DistEngine:

@@ -35,8 +35,10 @@ from presto_tpu.utils.tracing import EVENTS, QueryEvent
 log = logging.getLogger("presto_tpu.wide_events")
 
 #: bump on any schema change; fields are append-only, never repurposed
-#: (v2: added the `mv` block — materialized-view refresh annotation)
-WIDE_EVENT_VERSION = 2
+#: (v2: added the `mv` block — materialized-view refresh annotation;
+#: v3: cluster-mesh tier — `cluster_mesh` block + cluster_tasks/
+#: ici_bytes/fallbacks deltas inside `mesh`)
+WIDE_EVENT_VERSION = 3
 
 _M_EVENTS = counter("presto_tpu_wide_events_total",
                     "Wide query events emitted", ("state",))
@@ -55,6 +57,10 @@ _MESH_COUNTERS = {
     "collective_launches": "presto_tpu_mesh_collective_launches_total",
     "overflow_retries": "presto_tpu_mesh_exchange_overflow_retries_total",
     "fragment_compiles": "presto_tpu_mesh_fragment_compiles_total",
+    # cluster mesh tier (server/mesh_tier.py, v3)
+    "cluster_tasks": "presto_tpu_mesh_cluster_tasks_total",
+    "ici_bytes": "presto_tpu_mesh_ici_exchange_bytes_total",
+    "fallbacks": "presto_tpu_mesh_exchange_fallback_total",
 }
 
 
@@ -263,6 +269,9 @@ def build_wide_event(cluster, qid: str, sql: str, *,
         "spool": getattr(cluster, "last_spool_stats", None),
         "exchange": getattr(cluster, "last_exchange_stats", None),
         "mesh": mesh_delta,
+        # v3: co-location outcome of the cluster-mesh tier (None when
+        # the query rode the plain HTTP path)
+        "cluster_mesh": getattr(cluster, "last_cluster_mesh", None),
         "mv": mv,
         "membership": membership,
         "trace_id": trace_id,

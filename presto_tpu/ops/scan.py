@@ -192,4 +192,12 @@ def group_starts(flags: jnp.ndarray, gvalid: jnp.ndarray, out_cap: int):
     import jax.lax
     _key, starts_sorted = jax.lax.sort((key, idx), num_keys=1)
     starts = starts_sorted[:out_cap]
+    if cap < out_cap:
+        # the page has fewer rows than the requested group capacity
+        # (per-device shards of a plan whose group estimate was sized
+        # for the whole table): pad with `cap` so the contract
+        # starts[out_cap] holds — padded bins are masked invalid by the
+        # caller's out_valid and their segments are empty
+        starts = jnp.concatenate(
+            [starts, jnp.full((out_cap - cap,), cap, jnp.int32)])
     return starts, gid

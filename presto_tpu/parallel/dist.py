@@ -98,6 +98,12 @@ def dist_aggregate_local(page: Page, group_fields: Sequence[int],
         page, group_fields, partial_specs, partial_capacity)
 
     if n_group == 0:
+        if ndev == 1:
+            # single-device mesh: the partial IS the global state — no
+            # collective, no axis_index (callable outside shard_map)
+            out, _ = grouped_aggregate(part, (), final_specs, 256)
+            zero = jnp.zeros((), jnp.int32)
+            return out, (part_groups, zero, zero)
         # Global aggregation: single row per device; combine via all_gather
         # (tiny — the reference routes this through a SINGLE exchange) and
         # emit the result on device 0 only, honoring the disjoint-shards
@@ -110,6 +116,14 @@ def dist_aggregate_local(page: Page, group_fields: Sequence[int],
         return out, (part_groups, zero, zero)
 
     key_fields = tuple(range(n_group))
+    if ndev == 1:
+        # every key is already local — finalize directly; the final
+        # group count stands in for total_recv so capacity annealing
+        # still retries an out_capacity overflow
+        out, final_groups = grouped_aggregate(
+            part, key_fields, final_specs, out_capacity)
+        zero = jnp.zeros((), jnp.int32)
+        return out, (part_groups, final_groups, zero)
     pid = partition_ids(part, key_fields, ndev)
     recv, total_recv, max_send = repartition_page(
         part, pid, ndev, out_capacity, chunk, axis)
@@ -131,6 +145,25 @@ def dist_hash_join_local(probe: Page, build: Page,
     """Co-partitioned join: rehash both sides on the join keys so equal
     keys land on the same device, then join locally. Equivalent to the
     reference's PARTITIONED join distribution."""
+    if ndev == 1:
+        # no repartition on a single device — join in place. The anti
+        # NULL rule still applies locally (build NULL key empties the
+        # output) without the cross-device pmax.
+        out, pairs = hash_join(probe, build, probe_fields, build_fields,
+                               out_capacity, join_type)
+        if join_type in ("semi", "anti", "anti_exists"):
+            out = _filter_semi_flag(out)
+        if join_type == "anti":
+            b_null = jnp.zeros((), bool)
+            for f in build_fields:
+                c = build.columns[f]
+                b_null = b_null | jnp.any(c.nulls & build.row_valid())
+            out = Page(out.columns,
+                       jnp.where(b_null, 0,
+                                 out.num_rows).astype(jnp.int32),
+                       out.names)
+        zero = jnp.zeros((), jnp.int32)
+        return out, (pairs, zero, zero, zero, zero)
     p_cap = probe_recv_capacity or 2 * probe.capacity
     b_cap = build_recv_capacity or 2 * build.capacity
     # Keys must hash identically on both sides: string codes are only
@@ -175,7 +208,7 @@ def broadcast_hash_join_local(probe: Page, build: Page,
     """Replicated join: build side all_gathered to every device, probe
     stays put. The right choice when |build| << |probe| (the reference's
     REPLICATED distribution, chosen by DetermineJoinDistributionType)."""
-    b_all = all_gather_page(build, ndev, axis)
+    b_all = build if ndev == 1 else all_gather_page(build, ndev, axis)
     out, pairs = hash_join(probe, b_all, probe_fields, build_fields,
                            out_capacity, join_type)
     if join_type in ("semi", "anti", "anti_exists"):
@@ -195,6 +228,8 @@ def _filter_semi_flag(out: Page) -> Page:
 def gather_page_global(page: Page, ndev: int, axis: str = AXIS) -> Page:
     """Collect every device's rows into one replicated page (the root
     fragment's SINGLE-distribution gather that feeds the coordinator)."""
+    if ndev == 1:
+        return page
     return all_gather_page(page, ndev, axis)
 
 

@@ -26,7 +26,7 @@ class Announcer:
     def __init__(self, coordinator_uri, self_uri: str, node_id: str,
                  environment: str = "tpu", interval_s: float = 5.0,
                  connector_ids: str = "tpch,tpcds,memory,parquet",
-                 client: HttpClient = None):
+                 client: HttpClient = None, extra_properties=None):
         uris = ([coordinator_uri] if isinstance(coordinator_uri, str)
                 else list(coordinator_uri))
         self.coordinator_uris = [u.rstrip("/") for u in uris]
@@ -37,6 +37,11 @@ class Announcer:
         self.node_id = node_id
         self.environment = environment
         self.connector_ids = connector_ids
+        # callable returning extra service properties merged into each
+        # announcement round (e.g. the cluster-mesh slice fields from
+        # server/mesh_tier.py — re-evaluated per round so a drained
+        # worker's next announcement withdraws them)
+        self.extra_properties = extra_properties
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._thread = spawn("worker", "announcer", self._loop,
@@ -45,6 +50,17 @@ class Announcer:
         self.last_error = None
 
     def payload(self) -> dict:
+        props = {
+            "node_version": "presto-tpu-0.3",
+            "coordinator": "false",
+            "connectorIds": self.connector_ids,
+            "http": self.self_uri,
+        }
+        if self.extra_properties is not None:
+            try:
+                props.update(self.extra_properties() or {})
+            except Exception as e:  # noqa: BLE001 — extras are advisory
+                self.last_error = str(e)
         return {
             "environment": self.environment,
             "pool": "general",
@@ -52,12 +68,7 @@ class Announcer:
             "services": [{
                 "id": self.node_id,
                 "type": "presto",
-                "properties": {
-                    "node_version": "presto-tpu-0.3",
-                    "coordinator": "false",
-                    "connectorIds": self.connector_ids,
-                    "http": self.self_uri,
-                },
+                "properties": props,
             }],
         }
 

@@ -260,6 +260,13 @@ class _Stage:
     df_publish_channel: Optional[int] = None
     df_spec: Optional[dict] = None
     df_constraint: Optional[dict] = None
+    # cluster mesh tier (server/mesh_tier.py): the mesh worker this
+    # fused stage should land on, and the ICI exchange descriptor its
+    # task properties carry. Kept on the stage so recovery re-posts
+    # re-stamp the SAME descriptor (a survivor re-runs mesh-lowered or
+    # falls back generic — either way oracle-exact).
+    mesh_worker: Optional[str] = None
+    mesh_descriptor: Optional[dict] = None
 
 
 class ClusterQueryError(RuntimeError):
@@ -303,7 +310,8 @@ class TpuCluster:
                  cache_config=None, spool_config=None,
                  exchange_config=None, mv_config=None,
                  mv_journal_path: Optional[str] = None,
-                 memory_config=None, obs_config=None):
+                 memory_config=None, obs_config=None,
+                 mesh_config=None):
         import dataclasses as _dc
 
         from presto_tpu.cache import AffinityRouter
@@ -381,13 +389,21 @@ class TpuCluster:
         mcfg = memory_config if memory_config is not None \
             else DEFAULT_MEMORY
         self.memory_config = mcfg
+        # cluster mesh tier (server/mesh_tier.py): one config governs
+        # the coordinator's co-location policy AND every in-process
+        # worker's slice advertisement
+        from presto_tpu.config import DEFAULT_MESH_TIER
+        self.mesh_config = (mesh_config if mesh_config is not None
+                            else DEFAULT_MESH_TIER)
+        self.last_cluster_mesh = None
         self.workers: List[TpuWorkerServer] = [
             TpuWorkerServer(connector, node_id=f"tpu-worker-{i}",
                             shared_secret=shared_secret,
                             cache_config=cache_config,
                             spool_config=self.spool_config,
                             exchange_config=exchange_config,
-                            memory_config=memory_config).start()
+                            memory_config=memory_config,
+                            mesh_config=self.mesh_config).start()
             for i in range(n_workers)]
         self.cluster_memory = None
         if mcfg.pool_bytes:
@@ -1011,6 +1027,14 @@ class TpuCluster:
                 f"truncations={ex['truncations']} "
                 f"buffered_bytes_hw={ex['buffered_bytes_high_water']} "
                 f"buffer_depth_hw={ex['buffer_depth_high_water']}")
+        cmesh = getattr(self, "last_cluster_mesh", None)
+        if cmesh is not None:
+            lines.append(
+                f"Mesh: cluster=true worker={cmesh['worker']} "
+                f"group={cmesh['group']} ndev={cmesh['ndev']} "
+                f"colocated_stages={cmesh['colocated_stages']} "
+                f"ici_bytes={cmesh['ici_bytes']} "
+                f"fallbacks={cmesh['fallbacks']}")
         spool = getattr(self, "last_spool_stats", None)
         if spool is not None:
             lines.append(
@@ -1172,12 +1196,28 @@ class TpuCluster:
             add_exchanges(_unshare(plan), self.connector, session,
                           self.history))
         frags = create_fragments(ex_plan)
+        # cluster mesh tier (server/mesh_tier.py, THE ICI-vs-HTTP
+        # chokepoint): an eligible multi-stage plan fuses into ONE
+        # single-task fragment on a mesh worker — the worker re-plans
+        # exchanges locally, so every cut that would have been an HTTP
+        # page pull lowers to an ICI collective. None keeps the HTTP
+        # path byte-for-byte.
+        mesh_plan = None
+        if writer_tasks is None:
+            from presto_tpu.server.mesh_tier import plan_cluster_mesh
+            mesh_plan = plan_cluster_mesh(self, plan, len(frags))
+        if mesh_plan is not None:
+            from presto_tpu.plan.fragment import PlanFragment
+            frags = [PlanFragment(0, _unshare(plan),
+                                  Partitioning.SINGLE, ())]
+            merge_keys = None
         try:
             return self._run_fragments(frags, list(plan.output_types),
                                        capture=capture,
                                        merge_keys=merge_keys,
                                        cancel_event=cancel_event,
-                                       writer_tasks=writer_tasks)
+                                       writer_tasks=writer_tasks,
+                                       mesh_plan=mesh_plan)
         finally:
             # planning-time HBO consultation delta for this query
             # (EXPLAIN ANALYZE's "HBO:" line)
@@ -1192,7 +1232,7 @@ class TpuCluster:
     def _run_fragments(self, frags, out_types,
                        capture: bool = False, merge_keys=None,
                        writer_tasks: Optional[int] = None,
-                       cancel_event=None) -> List[tuple]:
+                       cancel_event=None, mesh_plan=None) -> List[tuple]:
         with self._lock:
             self._query_counter += 1
             qid = f"q{self._query_counter}_{int(time.time())}"
@@ -1234,6 +1274,11 @@ class TpuCluster:
 
         def n_tasks(fid: int) -> int:
             spec = specs[fid]
+            if mesh_plan is not None:
+                # the fused cluster-mesh plan runs as ONE task on the
+                # chosen mesh worker — parallelism comes from the mesh
+                # devices inside the program, not from task fan-out
+                return 1
             if fid == 0 and writer_tasks is not None \
                     and spec.scan_nodes:
                 # scaled writers: a SOURCE-partitioned (scan-fed)
@@ -1270,6 +1315,10 @@ class TpuCluster:
             stages[f.fragment_id] = _Stage(
                 specs[f.fragment_id], n_tasks(f.fragment_id), nbuf,
                 offsets)
+
+        if mesh_plan is not None:
+            stages[0].mesh_worker = mesh_plan["worker"]
+            stages[0].mesh_descriptor = mesh_plan["descriptor"]
 
         self._plan_dynamic_filters(stages, by_id)
 
@@ -1316,6 +1365,11 @@ class TpuCluster:
         # registry, so in-process workers' pulls are included) plus the
         # absolute high-water gauges
         exchange_before = exchange_counters()
+        # cluster-mesh activity bracket (same process-global-registry
+        # assumption): ICI exchange bytes + fallback deltas
+        from presto_tpu.server import mesh_tier as _mesh_tier
+        mesh_ici_before = _mesh_tier.ici_bytes_total()
+        mesh_fb_before = _mesh_tier.fallbacks_total()
 
         def run_query() -> List[tuple]:
             try:
@@ -1410,6 +1464,24 @@ class TpuCluster:
                 # post-query membership view: joins/drains that landed
                 # DURING the query show up in EXPLAIN ANALYZE
                 self.last_membership = self.membership_snapshot()
+                # cluster-mesh outcome for EXPLAIN ANALYZE / wide event
+                if mesh_plan is not None:
+                    ici = (_mesh_tier.ici_bytes_total()
+                           - mesh_ici_before)
+                    colocated = (mesh_plan["descriptor"]
+                                 ["colocated_stages"] if ici > 0 else 0)
+                    self.last_cluster_mesh = {
+                        "worker": mesh_plan["worker"],
+                        "group": mesh_plan["group"],
+                        "ndev": mesh_plan["ndev"],
+                        "colocated_stages": colocated,
+                        "ici_bytes": int(ici),
+                        "fallbacks": int(_mesh_tier.fallbacks_total()
+                                         - mesh_fb_before)}
+                    _mesh_tier.set_colocation_gauge(colocated)
+                else:
+                    self.last_cluster_mesh = None
+                    _mesh_tier.set_colocation_gauge(0)
 
         if not DEFAULT_OBS.sampled(random.random()):
             return run_query()
@@ -1904,6 +1976,18 @@ class TpuCluster:
                 affinity_fp = None
         for t in range(stage.n_tasks):
             worker = placement[t % len(placement)]
+            if stage.mesh_worker is not None:
+                if stage.mesh_worker in placement:
+                    # co-location: the fused mesh stage lands on the
+                    # worker whose slice the planner chose
+                    worker = stage.mesh_worker
+                else:
+                    # chosen mesh worker left between planning and
+                    # placement — any survivor runs the same fragment
+                    # (mesh-lowered if it has a slice, else generic)
+                    from presto_tpu.server.mesh_tier import \
+                        note_plan_fallback
+                    note_plan_fallback("placement")
             if affinity_fp is not None:
                 key = f"{affinity_fp}|t{t}/{stage.n_tasks}"
                 picked = self.affinity.pick(key, placement)
@@ -2001,6 +2085,12 @@ class TpuCluster:
             # worker summarizes this output channel's key domain
             props["x_dynamic_filter_channel"] = str(
                 stage.df_publish_channel)
+        if stage.mesh_descriptor is not None:
+            # ICI exchange routing side channel — stamped through the
+            # mesh_tier chokepoint so recovery re-posts (any attempt,
+            # any worker) carry the SAME descriptor
+            from presto_tpu.server.mesh_tier import stamp_ici_descriptor
+            stamp_ici_descriptor(props, stage.mesh_descriptor)
         tur = S.TaskUpdateRequest(
             session=S.SessionRepresentation(
                 queryId=qid, user="cluster",

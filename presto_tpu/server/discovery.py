@@ -54,7 +54,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.startswith("/v1/service"):
             svc = self.server.service
             body = json.dumps({"services": [
-                {"id": nid, "properties": {"http": uri}}
+                {"id": nid,
+                 "properties": dict(svc.properties(nid), http=uri)}
                 for nid, (uri, _ts) in svc.snapshot().items()]}).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -73,6 +74,10 @@ class DiscoveryService:
                  expiry_s: float = 30.0):
         self.expiry_s = expiry_s
         self._nodes: Dict[str, Tuple[str, float]] = {}   # id -> (uri, ts)
+        # full announced service properties per node (mesh slice fields
+        # etc.) — retained alongside the uri/ts view so /v1/service can
+        # republish what workers advertised
+        self._props: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.service = self
@@ -93,18 +98,30 @@ class DiscoveryService:
     # -- announcement state ----------------------------------------------
     def record(self, node_id: str, body: dict):
         uri: Optional[str] = None
+        announced: dict = {}
         for svc in body.get("services", []):
             props = svc.get("properties", {})
             if props.get("coordinator") == "true":
                 continue
-            uri = props.get("http") or uri
+            if props.get("http"):
+                uri = props["http"]
+                announced = dict(props)
         if uri:
             with self._lock:
                 self._nodes[node_id] = (uri, time.time())
+                self._props[node_id] = announced
 
     def remove(self, node_id: str):
         with self._lock:
             self._nodes.pop(node_id, None)
+            self._props.pop(node_id, None)
+
+    def properties(self, node_id: str) -> dict:
+        """Last announced service properties for a node ({} when
+        unknown) — includes the cluster-mesh slice fields when the
+        worker advertises one."""
+        with self._lock:
+            return dict(self._props.get(node_id, {}))
 
     def snapshot(self) -> Dict[str, Tuple[str, float]]:
         with self._lock:
@@ -119,5 +136,6 @@ class DiscoveryService:
                      if now - ts > self.expiry_s]
             for nid in stale:
                 del self._nodes[nid]
+                self._props.pop(nid, None)
             return [uri for uri, _ts in
                     (v for v in self._nodes.values())]

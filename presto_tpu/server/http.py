@@ -388,6 +388,12 @@ class WorkerApp:
                 "uptime": f"{time.time() - _SERVER_START:.2f}s"})
         if path == "/v1/info/state":
             return _json_response(req, 200, self.tm.lifecycle_state)
+        if path == "/v1/mesh":
+            # cluster mesh tier advertisement (server/mesh_tier.py):
+            # probed FRESH by the coordinator per mesh-eligible query —
+            # a draining worker has retracted and is never chosen
+            return _json_response(req, 200,
+                                  self.tm.mesh_tier.advertisement())
         if path == "/v1/status":
             # NodeStatus role (PrestoServer.cpp /v1/status): JSON node
             # snapshot — identity, role, uptime, task counts, heap-proxy
@@ -417,7 +423,10 @@ class WorkerApp:
                 # worker pool reservations (exec/memory.MemoryPool) —
                 # the coordinator's heartbeat scrape aggregates these
                 # into the cluster memory view for admission quotas
-                "memoryPool": self.tm.pool_stats()})
+                "memoryPool": self.tm.pool_stats(),
+                # cluster mesh tier: slice advertisement + mesh-lowered
+                # task / ICI-exchange tallies (server/mesh_tier.py)
+                "clusterMesh": self.tm.mesh_tier.status_block()})
         if path == "/v1/tasks":
             # per-task summary rows — the worker-side feed of
             # system.runtime.tasks (fanned out by the system connector)
@@ -598,7 +607,8 @@ class TpuWorkerServer:
                  shared_secret: Optional[str] = None,
                  cache_config=None, spool_config=None,
                  exchange_config=None, elastic_config=None,
-                 memory_config=None, net_config=None):
+                 memory_config=None, net_config=None,
+                 mesh_config=None):
         from presto_tpu.config import DEFAULT_ELASTIC
         self.elastic_config = (elastic_config
                                if elastic_config is not None
@@ -613,7 +623,8 @@ class TpuWorkerServer:
                                            node_id=node_id,
                                            spool_config=spool_config,
                                            exchange_config=exchange_config,
-                                           memory_config=memory_config)
+                                           memory_config=memory_config,
+                                           mesh_config=mesh_config)
         self.app.task_manager = self.task_manager
         self.app.httpd = self.httpd
         self.httpd.task_manager = self.task_manager
@@ -633,7 +644,13 @@ class TpuWorkerServer:
         self.announcer = None
         if coordinator_uri:
             from presto_tpu.server.announcer import Announcer
-            self.announcer = Announcer(coordinator_uri, base, node_id)
+            # the mesh slice rides the announcement payload so the
+            # discovery surface shows it; a drained worker's next
+            # round (or retraction) withdraws it
+            self.announcer = Announcer(
+                coordinator_uri, base, node_id,
+                extra_properties=(
+                    self.task_manager.mesh_tier.announce_properties))
         # back-reference for the PUT /v1/info/state handler: a drain
         # request must also retract the announcement once drained
         self.app.worker_server = self

@@ -1,0 +1,364 @@
+"""Cluster mesh execution tier: mesh-lowered worker tasks + ICI-backed
+repartition exchange.
+
+This module is the SINGLE sanctioned chokepoint for the ICI-vs-HTTP
+exchange decision (analysis rule `ici-exchange-chokepoint`): only here
+may code read or write the ICI exchange descriptor that rides the task
+session properties, and only `plan_cluster_mesh` may decide that a
+query's inter-stage bytes move over the mesh instead of HTTP.
+
+Three pieces (SNIPPETS.md north-star: a TPU worker lowering operators
+under a device mesh "with the repartition exchange implemented as an
+all_to_all over the TPU ICI mesh"; PAPER.md's L6a TaskExecutor + L7
+exchange layers are the reference analogue — swap the execution tier,
+keep the coordinator protocol fixed):
+
+  1. `MeshTaskRunner` — worker side. Owns this worker's mesh slice,
+     advertises it (announcement properties + GET /v1/mesh), and
+     executes eligible task fragments (join/agg-bearing, mesh-
+     lowerable) on PR 6's `DistSplitExecutor` under shard_map with the
+     packed per-dtype collectives and capacity annealing. ANY lowering
+     failure falls back to the generic executor path byte-for-byte.
+
+  2. ICI exchange descriptor — coordinator side the scheduler fuses a
+     co-locatable multi-stage plan into ONE single-task fragment
+     posted to a mesh worker; the worker's `DistSplitExecutor` re-runs
+     exchange placement locally, so every cut that would have been an
+     HTTP page pull lowers to a genuine `all_to_all`/`all_gather` over
+     the mesh (parallel/shuffle.py). The descriptor stamped into the
+     task's session properties is what marks those bytes as ICI-moved;
+     tasks without it account nothing.
+
+  3. `plan_cluster_mesh` — the placement policy: for an eligible query
+     (session `cluster_mesh_enabled`, join/agg-bearing, 2..N fragments,
+     no writers) probe live workers' mesh advertisements fresh (a
+     draining worker retracts and is never chosen) and pick the widest
+     slice. Non-co-located or degraded queries keep the HTTP path
+     unchanged, so every chaos/recovery contract (spool fallback,
+     retry_policy=TASK, churn) holds as-is.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from presto_tpu.config import DEFAULT_MESH_TIER, MeshTierConfig
+from presto_tpu.obs.metrics import counter, gauge
+from presto_tpu.plan.nodes import (
+    AggregationNode, JoinNode, PlanNode, TableWriterNode,
+)
+
+log = logging.getLogger("presto_tpu.mesh_tier")
+
+_M_CLUSTER_TASKS = counter(
+    "presto_tpu_mesh_cluster_tasks_total",
+    "Cluster task fragments executed on the worker device-mesh tier")
+_M_ICI_BYTES = counter(
+    "presto_tpu_mesh_ici_exchange_bytes_total",
+    "Exchange bytes moved over ICI mesh collectives in lieu of HTTP "
+    "page pulls (descriptor-stamped co-located stages only)")
+_M_FALLBACKS = counter(
+    "presto_tpu_mesh_exchange_fallback_total",
+    "Cluster-mesh decisions that degraded to the generic/HTTP path",
+    ("reason",))
+_M_COLOCATED = gauge(
+    "presto_tpu_mesh_colocated_stages",
+    "Producer/consumer stages the last cluster-mesh query co-located "
+    "onto one mesh (0 when the query rode the HTTP path)")
+
+#: the ONE place the descriptor property name is spelled — it rides the
+#: task session properties like the dynamic-filter side channel and is
+#: filtered out of worker Session construction by the known-property
+#: filter in task_manager
+_ICI_PROP = "x_ici_exchange"
+
+
+# ---------------------------------------------------------------------------
+# descriptor chokepoint
+# ---------------------------------------------------------------------------
+def stamp_ici_descriptor(props: Dict[str, str], desc: dict
+                         ) -> Dict[str, str]:
+    """Coordinator side: mark a stage's task properties as ICI-routed.
+    The descriptor records the chosen mesh (group, ndev) and how many
+    HTTP-path exchanges the fusion replaced."""
+    props[_ICI_PROP] = json.dumps(desc, sort_keys=True)
+    return props
+
+
+def ici_descriptor(props: Optional[Dict[str, str]]) -> Optional[dict]:
+    """Worker side: the stamped descriptor, or None for plain tasks.
+    Garbage never raises — an unreadable descriptor means HTTP."""
+    raw = (props or {}).get(_ICI_PROP)
+    if not raw:
+        return None
+    try:
+        desc = json.loads(raw)
+    except (TypeError, ValueError):
+        return None
+    return desc if isinstance(desc, dict) else None
+
+
+def _truthy(v: Any) -> bool:
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def _mesh_lowerable(plan: PlanNode) -> bool:
+    """Join/agg-bearing and writer-free — the fragment shapes PR 6's
+    dist executor lowers profitably; everything else stays generic."""
+    bearing = False
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TableWriterNode):
+            return False
+        if isinstance(n, (JoinNode, AggregationNode)):
+            bearing = True
+        stack.extend(n.children())
+    return bearing
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+class MeshTaskRunner:
+    """Per-worker mesh slice owner: advertisement + mesh-lowered task
+    execution with generic fallback."""
+
+    def __init__(self, config: Optional[MeshTierConfig] = None):
+        self.config = config if config is not None else DEFAULT_MESH_TIER
+        self._lock = threading.Lock()
+        #: flips False on drain (PR 10 sequence): a SHUTTING_DOWN
+        #: worker must stop advertising so new stages never co-locate
+        #: onto a draining mesh
+        self._advertising = bool(self.config.enabled)
+        self._mesh = None
+        self._ndev: Optional[int] = None
+        # internal tallies mirrored into GET /v1/status (ints, not
+        # registry scrapes — the registry is process-global and shared
+        # across in-process workers)
+        self.cluster_tasks = 0
+        self.ici_bytes = 0
+        self.fallbacks: Dict[str, int] = {}
+        self.last_error: Optional[str] = None
+
+    # -- advertisement ----------------------------------------------------
+    def ndev(self) -> int:
+        """Devices in this worker's slice (0 when jax is unavailable).
+        Lazy: the control plane must not import jax at module load."""
+        if self._ndev is None:
+            n = int(self.config.ndev)
+            if n <= 0:
+                try:
+                    import jax
+                    n = len(jax.devices())
+                except Exception:   # noqa: BLE001 — no devices = no mesh
+                    n = 0
+            self._ndev = n
+        return self._ndev
+
+    def advertising(self) -> bool:
+        with self._lock:
+            return self._advertising and self.ndev() >= 1
+
+    def retract(self) -> None:
+        """Drain hook: stop advertising the slice immediately. Running
+        mesh tasks finish; no new stage may co-locate here."""
+        with self._lock:
+            self._advertising = False
+
+    def advertisement(self) -> dict:
+        """The GET /v1/mesh body — probed FRESH by the coordinator per
+        mesh-eligible query so a draining worker is never chosen."""
+        adv = self.advertising()
+        return {"meshGroup": self.config.mesh_group,
+                "meshDevices": self.ndev() if adv else 0,
+                "advertising": adv}
+
+    def announce_properties(self) -> Dict[str, str]:
+        """Extra announcement properties (server/announcer.py payload):
+        the slice rides the same discovery surface as the http URI."""
+        if not self.advertising():
+            return {}
+        return {"meshGroup": self.config.mesh_group,
+                "meshDevices": str(self.ndev())}
+
+    def status_block(self) -> dict:
+        """The `clusterMesh` block of the worker's GET /v1/status."""
+        with self._lock:
+            return {"advertising": self._advertising,
+                    "meshGroup": self.config.mesh_group,
+                    "meshDevices": self._ndev,
+                    "clusterTasks": self.cluster_tasks,
+                    "iciExchangeBytes": self.ici_bytes,
+                    "fallbacks": dict(self.fallbacks),
+                    "lastError": self.last_error}
+
+    def note_fallback(self, reason: str) -> None:
+        _M_FALLBACKS.inc(reason=reason)
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+
+    # -- execution --------------------------------------------------------
+    def _ensure_mesh(self):
+        with self._lock:
+            if self._mesh is None:
+                from presto_tpu.parallel.mesh import device_mesh
+                self._mesh = device_mesh(self.ndev())
+            return self._mesh
+
+    def try_run(self, tm, task, plan: PlanNode,
+                props: Dict[str, str]) -> Optional[Tuple[Any, Any]]:
+        """Attempt mesh-lowered execution of a task fragment. Returns
+        (host page, executor) on success, None to fall back to the
+        generic path — the caller's ladder then runs unchanged, so a
+        mesh failure can degrade service but never the answer."""
+        desc = ici_descriptor(task.session_properties)
+        if desc is None and not _truthy(props.get(
+                "cluster_mesh_enabled", "false")):
+            return None
+        if not self.config.enabled:
+            if desc is not None:
+                self.note_fallback("disabled")
+            return None
+        if not self.advertising():
+            self.note_fallback("draining")
+            return None
+        if getattr(task, "remote_splits", None):
+            # fragments with remote inputs pull producer pages over
+            # HTTP — the generic path owns that protocol
+            if desc is not None:
+                self.note_fallback("remote_inputs")
+            return None
+        if not _mesh_lowerable(plan):
+            if desc is not None:
+                self.note_fallback("not_lowerable")
+            return None
+        try:
+            mesh = self._ensure_mesh()
+        except Exception as e:      # noqa: BLE001 — no mesh, no tier
+            self.last_error = f"mesh: {e}"
+            self.note_fallback("no_mesh")
+            return None
+        try:
+            from presto_tpu.config import PROPERTIES, Session
+            from presto_tpu.exec.dist_executor import DistSplitExecutor
+            known = {p.name for p in PROPERTIES}
+            sprops = {k: v for k, v in props.items() if k in known}
+            ex = DistSplitExecutor(tm.connector, mesh,
+                                   session=Session(sprops))
+            if getattr(tm, "memory_pool", None) is not None:
+                ex.memory_pool = tm.memory_pool
+                ex.pool_query_id = task.task_id
+            ex.set_splits(task.splits)
+            out = ex.execute(plan)
+            page = self._to_host_page(out, ex.ndev)
+        except Exception as e:      # noqa: BLE001 — degrade, never fail
+            self.last_error = f"{type(e).__name__}: {e}"
+            self.note_fallback("lowering_error")
+            log.debug("mesh lowering failed for %s; generic fallback",
+                      getattr(task, "task_id", "?"), exc_info=True)
+            return None
+        _M_CLUSTER_TASKS.inc()
+        with self._lock:
+            self.cluster_tasks += 1
+        if desc is not None:
+            # these bytes moved over ICI collectives INSTEAD of the
+            # HTTP exchange the unfused plan would have run
+            wire = int((ex.last_mesh_stats or {}).get("wire_bytes", 0))
+            if wire > 0:
+                _M_ICI_BYTES.inc(wire)
+                with self._lock:
+                    self.ici_bytes += wire
+        return page, ex
+
+    @staticmethod
+    def _to_host_page(out, ndev: int):
+        """Collapse a stacked (device-leading) page to one host page;
+        ndev==1 executes unstacked already."""
+        if ndev == 1:
+            return out
+        from presto_tpu.data.column import concat_pages_host
+        from presto_tpu.parallel.mesh import unstack_page
+        return concat_pages_host(unstack_page(out))
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+# ---------------------------------------------------------------------------
+def ici_bytes_total() -> float:
+    """Process-total ICI exchange bytes — the coordinator brackets this
+    around a query for the per-query delta (same process-global-registry
+    assumption the wide-event mesh block already makes)."""
+    return _M_ICI_BYTES.value()
+
+
+def fallbacks_total() -> float:
+    """Label-summed process total of mesh exchange fallbacks."""
+    return sum(v for _n, _ln, _lv, v in _M_FALLBACKS.samples())
+
+
+def set_colocation_gauge(n: int) -> None:
+    _M_COLOCATED.set(float(n))
+
+
+def note_plan_fallback(reason: str) -> None:
+    """Coordinator-side fallback accounting (no runner instance)."""
+    _M_FALLBACKS.inc(reason=reason)
+
+
+def plan_cluster_mesh(cluster, plan: PlanNode, n_fragments: int
+                      ) -> Optional[dict]:
+    """THE ICI-vs-HTTP decision. For an eligible query, pick a mesh
+    worker and return the mesh plan::
+
+        {"worker": uri, "group": g, "ndev": n, "descriptor": {...}}
+
+    The caller (cluster.py) fuses the stage plan into one single-task
+    fragment on that worker and stamps the descriptor; returning None
+    keeps the HTTP path byte-for-byte."""
+    props = cluster.session_properties
+    if not _truthy(props.get("cluster_mesh_enabled", "false")):
+        return None
+    cfg = getattr(cluster, "mesh_config", None) or DEFAULT_MESH_TIER
+    if not cfg.colocate:
+        note_plan_fallback("colocate_disabled")
+        return None
+    if n_fragments < 2:
+        # nothing to co-locate — single-fragment plans still mesh-lower
+        # worker-side, they just have no exchange to re-route
+        return None
+    if n_fragments > cfg.max_colocate_fragments:
+        note_plan_fallback("too_wide")
+        return None
+    if _truthy(props.get("exchange_materialization_enabled", "false")):
+        note_plan_fallback("batch_mode")
+        return None
+    if not _mesh_lowerable(plan):
+        note_plan_fallback("not_lowerable")
+        return None
+    best: Optional[Tuple[str, dict]] = None
+    for uri in cluster.worker_uris:
+        try:
+            adv = cluster.http.request(f"{uri}/v1/mesh",
+                                       request_class="probe").json()
+        except Exception:   # noqa: BLE001 — unreachable = not a candidate
+            continue
+        if not adv.get("advertising") or int(
+                adv.get("meshDevices") or 0) < 1:
+            continue
+        if best is None or (int(adv["meshDevices"])
+                            > int(best[1]["meshDevices"])):
+            best = (uri, adv)
+    if best is None:
+        note_plan_fallback("no_mesh")
+        return None
+    uri, adv = best
+    ndev = int(adv["meshDevices"])
+    desc = {"group": adv.get("meshGroup", cfg.mesh_group),
+            "ndev": ndev,
+            "colocated_stages": n_fragments - 1}
+    return {"worker": uri, "group": desc["group"], "ndev": ndev,
+            "descriptor": desc}

@@ -371,13 +371,18 @@ class TpuTaskManager:
     def __init__(self, connector, base_uri: str = "",
                  cache_config=None, node_id: str = "tpu-worker-0",
                  spool_config=None, exchange_config=None,
-                 memory_config=None):
+                 memory_config=None, mesh_config=None):
         from presto_tpu.cache import FragmentResultCache
         from presto_tpu.config import (
             DEFAULT_CACHE, DEFAULT_EXCHANGE, DEFAULT_MEMORY, DEFAULT_SPOOL,
         )
 
         self.connector = connector
+        # cluster mesh execution tier (server/mesh_tier.py): owns this
+        # worker's mesh slice, advertises it, and runs eligible task
+        # fragments mesh-lowered with generic fallback
+        from presto_tpu.server.mesh_tier import MeshTaskRunner
+        self.mesh_tier = MeshTaskRunner(mesh_config)
         # worker memory pool (exec/memory.MemoryPool; reference:
         # MemoryPool.java): tasks reserve their static lowering
         # footprints at admission, keyed by task id so concurrent tasks
@@ -521,9 +526,17 @@ class TpuTaskManager:
                         if isinstance(cs.get("constraint"), dict):
                             task.scan_constraints[table] = \
                                 cs["constraint"]
-                        task.splits.setdefault(table, []).append(
-                            (int(cs.get("part", 0)),
-                             int(cs.get("numParts", 1))))
+                        # splits collapse BY TABLE: a fragment with two
+                        # scan nodes over one table (fused cluster-mesh
+                        # plans, self-joins) delivers the same split
+                        # set once per node — an identical (part,
+                        # numParts) pair is the same lifespan, and
+                        # appending it again would double-read the scan
+                        entry = (int(cs.get("part", 0)),
+                                 int(cs.get("numParts", 1)))
+                        bucket = task.splits.setdefault(table, [])
+                        if entry not in bucket:
+                            bucket.append(entry)
                 task.pending_splits = []
             # A fragment with NO source nodes (pure VALUES / SELECT
             # without FROM) never receives a TaskSource, so no
@@ -621,7 +634,17 @@ class TpuTaskManager:
             else:
                 if cache_key is not None:
                     task._cache_pages = []
-                if not self._run_streaming(task, plan, ex) \
+                # cluster mesh tier first: an eligible fragment lowers
+                # under the device mesh (server/mesh_tier.py); None
+                # means fall through to the generic ladder unchanged
+                mesh_out = self.mesh_tier.try_run(self, task, plan,
+                                                  props)
+                if mesh_out is not None:
+                    page, mesh_ex = mesh_out
+                    task.output_positions = int(page.num_rows)
+                    self._collect_stats(task, mesh_ex)
+                    self._emit_output(task, page)
+                elif not self._run_streaming(task, plan, ex) \
                         and not self._run_streaming_remote(task, plan,
                                                            ex):
                     remote = self._pull_remote_inputs(task, plan)
@@ -1293,6 +1316,10 @@ class TpuTaskManager:
         with self.lock:
             first = self.lifecycle_state == "ACTIVE"
             self.lifecycle_state = "SHUTTING_DOWN"
+        # a draining worker must stop advertising its mesh slice
+        # IMMEDIATELY — new stages must never co-locate onto a mesh
+        # that is leaving (coordinator probes /v1/mesh fresh per query)
+        self.mesh_tier.retract()
         t0 = time.time()
         deadline = t0 + max(timeout_s, 0.0)
         while True:

@@ -54,7 +54,7 @@ def test_rule_catalog_complete():
             "no-planner-in-data-plane", "membership-chokepoint",
             "journal-chokepoint",
             "metric-docs-sync", "mv-cache-chokepoint",
-            "spill-chokepoint",
+            "spill-chokepoint", "ici-exchange-chokepoint",
             "alert-rule-metric-exists"} <= names
 
 
@@ -122,6 +122,29 @@ def test_spill_chokepoint_allowlist_honesty():
     # allowlist is vacuous and the rule must say so
     fs = _findings("spill-chokepoint", {
         "presto_tpu/exec/spill.py": "x = 1\n"})
+    assert fs and "vacuous" in fs[0].message
+
+
+def test_ici_exchange_chokepoint_fires():
+    # the ICI-vs-HTTP exchange decision (the stamped descriptor) may
+    # only be spelled in server/mesh_tier.py — a second decision site
+    # would let exchange bytes bypass the tier's fallback accounting
+    bad = "presto_tpu/server/evil.py"
+    fs = _findings("ici-exchange-chokepoint", {
+        bad: 'props["x_ici_exchange"] = "{}"\n'}, planted=bad)
+    assert fs and fs[0].rule == "ici-exchange-chokepoint"
+    # mesh_tier.py itself is the allowlisted chokepoint
+    assert not _findings("ici-exchange-chokepoint", {
+        "presto_tpu/server/mesh_tier.py":
+            'props["x_ici_exchange"] = "{}"\n'},
+        planted="presto_tpu/server/mesh_tier.py")
+
+
+def test_ici_exchange_chokepoint_allowlist_honesty():
+    # mesh_tier.py present but no longer spelling the descriptor =>
+    # the allowlist is vacuous and the rule must say so
+    fs = _findings("ici-exchange-chokepoint", {
+        "presto_tpu/server/mesh_tier.py": "x = 1\n"})
     assert fs and "vacuous" in fs[0].message
 
 

@@ -49,14 +49,14 @@ FAST = TransportConfig(
     retry_budget_s=5.0, breaker_failure_threshold=3,
     breaker_cooldown_s=0.3)
 
-#: the frozen wide-event key set (event_version=2, which added the
-#: `mv` refresh annotation); a key change here must bump
-#: WIDE_EVENT_VERSION
+#: the frozen wide-event key set (event_version=3, which added the
+#: `cluster_mesh` co-location block; v2 added the `mv` refresh
+#: annotation); a key change here must bump WIDE_EVENT_VERSION
 WIDE_KEYS = {
     "event_version", "ts", "query_id", "query", "user_name", "state",
     "error", "wall_s", "result_rows", "admission", "hbo",
     "dynamic_filter_rows_pruned", "cache", "spool", "exchange", "mesh",
-    "mv", "membership", "trace_id", "stages"}
+    "cluster_mesh", "mv", "membership", "trace_id", "stages"}
 
 PRESTO_ROLES = {"worker", "coordinator", "exchange", "obs",
                 "discovery", "statement", "admission"}
