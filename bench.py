@@ -64,6 +64,13 @@ BENCH_SERVE_CLIENTS scales, default 200,600,1000, each scale
 submitting BENCH_SERVE_STATEMENTS statements — default = the client
 count — plus an aio-vs-threaded shell A/B sized by
 BENCH_SERVE_AB_CLIENTS / BENCH_SERVE_AB_REQUESTS).
+
+Data-plane lane: BENCH_DATA_PLANE=0 disables the `detail.data_plane`
+round (serde encode/decode GB/s on a lineitem-shaped page, spool +
+exchange drain GB/s over a multi-frame body, and q01/q06 at
+BENCH_DATA_PLANE_SF — default 10 — streamed through bounded scan runs
+and checked against a direct numpy oracle);
+BENCH_DATA_PLANE_TIMEOUT_S (default 1800) bounds the child.
 """
 
 import json
@@ -274,6 +281,8 @@ def main() -> None:
         return _mv_child()
     if os.environ.get("BENCH_MEMORY_ONE"):
         return _memory_child()
+    if os.environ.get("BENCH_DATA_PLANE_ONE"):
+        return _data_plane_child()
     if os.environ.get("BENCH_SERVE_ONE"):
         return _serve_child()
     if os.environ.get("BENCH_CLUSTER_MESH_ONE"):
@@ -664,6 +673,17 @@ def _main_orchestrator(sf, qids) -> None:
         detail["serve"] = _run_serve_child(
             float(os.environ.get("BENCH_SERVE_TIMEOUT_S", "300"))
             + 120.0)
+
+    # data-plane round (one JSON `data_plane` entry: serde GB/s,
+    # exchange-drain GB/s, q01/q06 at SF10 through streaming scan
+    # runs, oracle-exactness bit); BENCH_DATA_PLANE=0 disables
+    if os.environ.get("BENCH_DATA_PLANE", "1") != "0":
+        if wedged is not None:
+            detail["data_plane"] = {"error": f"infra: {wedged}"}
+        else:
+            detail["data_plane"] = _run_data_plane_child(
+                float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S",
+                                     "1800")) + 120.0)
 
     # cluster-mesh tier round (one JSON `cluster_mesh` entry: q03/q18
     # through the HTTP cluster with mesh-lowered fused execution —
@@ -1722,6 +1742,217 @@ def _run_memory_child(timeout_s: float):
                          f"{tail[:120]}"[:200]}
     return json.loads(line).get("detail", {}).get(
         "memory", {"error": "child produced no memory entry"})
+
+
+def _data_plane_page_blocks(n: int):
+    """A lineitem-shaped wire page: 2 LONG keys, an INT line number,
+    4 float64-as-LONG measures, 3 INT dates, 2 dictionary strings —
+    the mixed-type shape the exchange actually ships."""
+    import numpy as np
+
+    from presto_tpu.protocol.serde import WireBlock
+
+    rng = np.random.default_rng(11)
+    blocks = [
+        WireBlock("LONG_ARRAY",
+                  rng.integers(0, 6_000_000, n, dtype=np.int64)),
+        WireBlock("LONG_ARRAY",
+                  rng.integers(0, 200_000, n, dtype=np.int64)),
+        WireBlock("INT_ARRAY", rng.integers(1, 8, n, dtype=np.int32)),
+    ]
+    for _ in range(4):
+        blocks.append(WireBlock(
+            "LONG_ARRAY", rng.random(n).view(np.int64)))
+    for _ in range(3):
+        blocks.append(WireBlock(
+            "INT_ARRAY",
+            rng.integers(8000, 10600, n, dtype=np.int32)))
+    d = WireBlock("VARIABLE_WIDTH",
+                  np.array([b"A", b"N", b"R"], dtype=object))
+    for _ in range(2):
+        blocks.append(WireBlock(
+            "DICTIONARY", rng.integers(0, 3, n, dtype=np.int32),
+            dictionary=d))
+    return blocks
+
+
+def _data_plane_child() -> None:
+    """Data-plane round: (1) serde encode/decode GB/s on a
+    lineitem-shaped page (the zero-copy PageBuffer path), (2) spool +
+    exchange drain GB/s — frames appended to a FrameFile, read back as
+    memoryview ranges, every frame decoded, (3) q01/q06 at
+    BENCH_DATA_PLANE_SF streamed through bounded scan runs
+    (streaming_scan_rows) and checked against a direct numpy oracle
+    (sqlite is infeasible at SF10)."""
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    import math
+
+    import numpy as np
+
+    from presto_tpu.protocol.serde import (
+        decode_serialized_page, encode_serialized_page,
+    )
+
+    out = {}
+
+    # ---- serde microbench -------------------------------------------
+    n = int(os.environ.get("BENCH_DATA_PLANE_ROWS", "131072"))
+    reps = int(os.environ.get("BENCH_DATA_PLANE_REPS", "10"))
+    blocks = _data_plane_page_blocks(n)
+    frame = encode_serialized_page(blocks)
+    size = len(frame)
+    encode_serialized_page(blocks)                 # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        encode_serialized_page(blocks)
+    enc_s = (time.perf_counter() - t0) / reps
+    decode_serialized_page(frame)                  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        decode_serialized_page(frame)
+    dec_s = (time.perf_counter() - t0) / reps
+    out["serde"] = {"rows": n, "frame_bytes": size,
+                    "encode_gbps": round(size / enc_s / 1e9, 3),
+                    "decode_gbps": round(size / dec_s / 1e9, 3)}
+
+    # ---- spool + exchange drain -------------------------------------
+    from presto_tpu.spool.files import FrameFile
+
+    nframes = int(os.environ.get("BENCH_DATA_PLANE_FRAMES", "24"))
+    ff = FrameFile(prefix="bench_data_plane_")
+    try:
+        for _ in range(nframes):
+            ff.append(frame)
+        total = size * nframes
+        t0 = time.perf_counter()
+        token, drained, pages = 0, 0, 0
+        while True:
+            frames, token = ff.read_range(token, 8 << 20)
+            if not frames:
+                break
+            for fr in frames:
+                decode_serialized_page(fr)
+                drained += len(fr)
+                pages += 1
+        drain_s = time.perf_counter() - t0
+        assert drained == total and pages == nframes
+        out["drain"] = {"frames": nframes, "bytes": total,
+                        "drain_gbps": round(total / drain_s / 1e9, 3)}
+    finally:
+        ff.close()
+
+    # ---- q01/q06 at scale, streamed, oracle-exact -------------------
+    from presto_tpu.config import Session
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.exec.lifespan import execute_batched
+
+    sf = float(os.environ.get("BENCH_DATA_PLANE_SF", "10"))
+    run_rows = int(os.environ.get("BENCH_DATA_PLANE_RUN_ROWS",
+                                  "2000000"))
+    batches = int(os.environ.get("BENCH_DATA_PLANE_BATCHES", "8"))
+    t0 = time.perf_counter()
+    conn = TpchConnector(sf)
+    t = conn.table("lineitem")
+    gen_s = time.perf_counter() - t0
+    nrows = int(t.num_rows)
+    qty = t.arrays["l_quantity"][:nrows]
+    eprice = t.arrays["l_extendedprice"][:nrows]
+    disc = t.arrays["l_discount"][:nrows]
+    sdate = t.arrays["l_shipdate"][:nrows]
+    rf = t.arrays["l_returnflag"][:nrows]
+    ls = t.arrays["l_linestatus"][:nrows]
+
+    def close(g, w):
+        return math.isclose(g, w, rel_tol=1e-6, abs_tol=1e-9)
+
+    from presto_tpu.expr.compile import days_from_civil
+    cutoff = days_from_civil(1998, 9, 2)
+
+    # q01 oracle: grouped sums over the dictionary codes (StringDict is
+    # sorted, so code order == ORDER BY 1, 2)
+    keep = sdate <= cutoff
+    key = rf[keep].astype(np.int64) * 64 + ls[keep]
+    uniq, inv = np.unique(key, return_inverse=True)
+    o_cnt = np.bincount(inv)
+    o_qty = np.bincount(inv, weights=qty[keep])
+    o_ep = np.bincount(inv, weights=eprice[keep])
+    o_avg = np.bincount(inv, weights=disc[keep]) / o_cnt
+    q01_want = [
+        (t.dicts["l_returnflag"][int(k) // 64],
+         t.dicts["l_linestatus"][int(k) % 64],
+         o_qty[i], o_ep[i], o_avg[i], int(o_cnt[i]))
+        for i, k in enumerate(uniq)]
+
+    q06_keep = (disc >= 0.05) & (disc <= 0.07) & (qty < 24)
+    q06_want = float((eprice[q06_keep] * disc[q06_keep]).sum())
+
+    engine = LocalEngine(conn)
+    session = Session({"streaming_scan_rows": str(run_rows)})
+    lanes = {
+        "q01": ("select l_returnflag, l_linestatus, sum(l_quantity), "
+                "sum(l_extendedprice), avg(l_discount), count(*) "
+                "from lineitem "
+                "where l_shipdate <= date '1998-09-02' "
+                "group by l_returnflag, l_linestatus order by 1, 2"),
+        "q06": ("select sum(l_extendedprice * l_discount) from lineitem "
+                "where l_discount between 0.05 and 0.07 "
+                "and l_quantity < 24"),
+    }
+    out["queries"] = {"sf": sf, "lineitem_rows": nrows,
+                      "gen_s": round(gen_s, 1), "batches": batches,
+                      "streaming_scan_rows": run_rows, "exact": True}
+    for name, sql in lanes.items():
+        plan = engine.executor._resolve_subqueries(engine.plan_sql(sql))
+        stats = {}
+        t0 = time.perf_counter()
+        page = execute_batched(conn, plan, batches, session=session,
+                               stats=stats)
+        wall = time.perf_counter() - t0
+        got = page.to_pylist()
+        if name == "q01":
+            exact = len(got) == len(q01_want) and all(
+                g[0] == w[0] and g[1] == w[1]
+                and all(close(a, b) for a, b in zip(g[2:], w[2:]))
+                for g, w in zip(got, q01_want))
+        else:
+            exact = close(got[0][0], q06_want)
+        out["queries"]["exact"] = out["queries"]["exact"] and exact
+        out["queries"][name] = {
+            "wall_s": round(wall, 2), "exact": exact,
+            "rows_per_sec": round(nrows / wall, 1), **stats}
+
+    geo = math.sqrt(out["serde"]["encode_gbps"]
+                    * out["serde"]["decode_gbps"])
+    print(json.dumps({"metric": "data_plane_serde_gbps",
+                      "value": round(geo, 3), "unit": "gb/s",
+                      "detail": {"data_plane": out}}))
+
+
+def _run_data_plane_child(timeout_s: float):
+    """Run the data-plane round in a subprocess; returns the
+    `data_plane` detail dict (or an {"error": ...} entry)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(BENCH_DATA_PLANE_ONE="1", BENCH_QUERIES=""),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s"}
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if line is None:
+        tail = (r.stderr.splitlines() or [""])[-1]
+        return {"error": f"no output (rc={r.returncode}) "
+                         f"{tail[:120]}"[:200]}
+    return json.loads(line).get("detail", {}).get(
+        "data_plane", {"error": "child produced no data_plane entry"})
 
 
 def _hbo_probe(conn, sql):

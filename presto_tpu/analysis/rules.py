@@ -1163,3 +1163,49 @@ class IciExchangeChokepointRule(Rule):
 
 
 register(IciExchangeChokepointRule())
+
+# =====================================================================
+# 20. no-page-copy-in-data-plane — page bytes cross protocol/ and
+#     spool/ as views; copies live only at serde.py's sanctioned sites
+# =====================================================================
+
+#: flattening an array lane into an owned bytes object — the idiom the
+#: PageBuffer scatter-gather writer exists to remove
+_TOBYTES = re.compile(r"\.tobytes\(")
+#: materializing a decoded lane that frombuffer already aliased
+_FROMBUFFER_COPY = re.compile(r"frombuffer\([^)]*\)\s*\.copy\(")
+
+_SERDE = "presto_tpu/protocol/serde.py"
+
+
+class NoPageCopyInDataPlaneRule(Rule):
+    name = "no-page-copy-in-data-plane"
+    description = (
+        "the columnar data plane (protocol/, spool/) moves page bytes "
+        "as buffer views: encode scatter-gathers lanes into one "
+        "pre-sized frame, decode returns read-only frombuffer aliases, "
+        "spool reads slice one contiguous read — a stray .tobytes() "
+        "or frombuffer(...).copy() reintroduces a per-lane copy that "
+        "the zero-copy contract (and its GB/s bench lane) exists to "
+        "keep out; sanctioned copies live in protocol/serde.py only, "
+        "counted by page_copy_fallback_total")
+
+    def run(self, pkg: Package) -> Iterable[Finding]:
+        out = regex_findings(
+            self, pkg, (_TOBYTES, _FROMBUFFER_COPY),
+            "page-lane copy in the data plane — emit through the "
+            "PageBuffer writer / return a frombuffer view (sanctioned "
+            "copy sites live in protocol/serde.py and count "
+            "page_copy_fallback_total)",
+            allowed=(_SERDE,),
+            prefixes=("presto_tpu/protocol/", "presto_tpu/spool/"))
+        # honesty: serde.py must still contain a policed idiom (the
+        # small-piece coalesce in _PageWriter.put_array); if the last
+        # sanctioned copy disappears, the allowlist is vacuous
+        out.extend(honesty_finding(
+            self, pkg, _SERDE, (_TOBYTES,),
+            "the sanctioned data-plane copy sites"))
+        return out
+
+
+register(NoPageCopyInDataPlaneRule())

@@ -45,6 +45,11 @@ PROPERTIES = [
     Property("lifespan_batches",
              "Row-range lifespans to stream the driving scan in "
              "(0 = single shot)", int, 0),
+    Property("streaming_scan_rows",
+             "Bound the rows a driving leaf scan materializes at once: "
+             "each lifespan streams through the partial plan in scan "
+             "runs of at most this many rows (0 = whole-split "
+             "materialization; the SF10 scale-ladder knob)", int, 0),
     Property("group_count_hint",
              "Default aggregation output-capacity hint when the planner "
              "has no estimate", int, 65536),

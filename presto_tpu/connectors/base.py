@@ -27,6 +27,25 @@ class SplitSource:
         return [{"@type": cid, "part": i, "numParts": n_splits}
                 for i in range(n_splits)]
 
+    # ---------------------------------------------------- streaming scans
+    def scan_runs(self, table: str, max_rows: int, part: int = 0,
+                  num_parts: int = 1):
+        """Yield one split's rows as a sequence of bounded host tables
+        (streaming leaf scans — the scale-ladder contract): each run
+        holds at most `max_rows` rows, so a consumer never needs the
+        whole split resident at once. The default yields row-window
+        VIEWS of the split table (numpy slices sharing the parent's
+        buffers and StringDicts); connectors with natural unit
+        boundaries (parquet row groups) override this to bound physical
+        IO per run too."""
+        t = self.table(table, part=part, num_parts=num_parts)
+        n = int(t.num_rows)
+        if max_rows <= 0 or n <= max_rows:
+            yield t
+            return
+        for lo in range(0, n, max_rows):
+            yield t.row_slice(lo, min(lo + max_rows, n))
+
     # ------------------------------------------------------- data versions
     # Per-table monotonic versions for the fragment result cache
     # (cache/): every write/INSERT/CTAS/drop bumps the version, which

@@ -208,6 +208,10 @@ class ParquetTable(LazyFileTable):
         super().__init__(name, n, _LazyArrays(self._load_column), types,
                          self._dicts, self._nulls)
 
+    def unit_rows(self, unit: Tuple[int, int]) -> int:
+        fi, g = unit
+        return self._files[fi].metadata.row_group(g).num_rows
+
     # -- lazy column load (projection pushdown) -------------------------
     def _load_column(self, col: str):
         import pyarrow as pa
@@ -506,6 +510,42 @@ class FileCatalogConnector(SplitSource):
                  if full.null_mask(c) is not None}
         return HostTable(name, hi - lo, arrays, full.types, full.dicts,
                          nulls or None)
+
+    def scan_runs(self, table: str, max_rows: int, part: int = 0,
+                  num_parts: int = 1):
+        """Streaming scans with bounded PHYSICAL IO: chunk the split's
+        units (row groups / stripes) greedily so each run decodes only
+        its own column chunks and holds ~max_rows rows (a single unit
+        larger than max_rows still ships whole — the unit is the IO
+        granularity). Splits that fell back to row slicing (fewer units
+        than parts) stream by row windows instead."""
+        if self._load(table) is None and self.fallback is not None:
+            yield from self.fallback.scan_runs(
+                table, max_rows, part=part, num_parts=num_parts)
+            return
+        t = self.table(table, part=part, num_parts=num_parts)
+        units = getattr(t, "units", None)
+        if units is not None and not units:   # empty split: one empty run
+            yield t
+            return
+        if max_rows <= 0 or units is None:
+            if max_rows > 0 and t.num_rows > max_rows:
+                for lo in range(0, int(t.num_rows), max_rows):
+                    yield t.row_slice(lo, min(lo + max_rows,
+                                              int(t.num_rows)))
+            else:
+                yield t
+            return
+        chunk, rows = [], 0
+        for u in units:
+            r = t.unit_rows(u)
+            if chunk and rows + r > max_rows:
+                yield self._slice(t, table, chunk)
+                chunk, rows = [], 0
+            chunk.append(u)
+            rows += r
+        if chunk:
+            yield self._slice(t, table, chunk)
 
     def invalidate(self, table: Optional[str] = None):
         """Drop cached handles after files changed on disk — the

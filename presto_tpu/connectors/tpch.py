@@ -195,6 +195,21 @@ class HostTable:
         m = self.nulls.get(c)
         return m[:self.num_rows] if m is not None else None
 
+    def row_slice(self, lo: int, hi: int) -> "HostTable":
+        """A [lo, hi) row window as a VIEW table: numpy slices share the
+        parent's buffers and StringDicts — no copy, and no entry in the
+        parent's device-page cache (run tables are throwaway by design;
+        streaming scans upload each run once). Column access goes
+        through `arrays[c]` so lazy tables (parquet) load on demand."""
+        arrays = {c: self.arrays[c][lo:hi] for c in self.column_names()}
+        nulls = None
+        if self.nulls is not None:
+            nulls = {c: m[lo:hi] for c, m in
+                     ((c, self.null_mask(c)) for c in self.column_names())
+                     if m is not None}
+        return HostTable(self.name, hi - lo, arrays, self.types,
+                         self.dicts, nulls)
+
     def page(self, columns: Optional[Sequence[str]] = None,
              capacity: Optional[int] = None) -> Page:
         cols = list(columns) if columns is not None else self.column_names()

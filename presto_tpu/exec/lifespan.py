@@ -329,6 +329,11 @@ class BatchedRunner:
         # spill_path set -> partials revoke to DISK files
         # (FileSingleStreamSpiller role); empty -> host RAM offload
         self.spill_dir = self.ex.session["spill_path"] or None
+        # streaming scans (the scale ladder): bound the rows one leaf
+        # scan materializes, so a lifespan's working set is the run size,
+        # not the split size. Mesh executors keep whole-split splits
+        # (their sub-split sharding already bounds per-device rows).
+        self.stream_rows = int(self.ex.session["streaming_scan_rows"] or 0)
 
     def _host_pages(self, p: Page) -> List[Page]:
         """A mesh executor returns a stacked sharded page — split it into
@@ -400,8 +405,7 @@ class BatchedRunner:
                     if pruned:
                         skipped += 1
                         continue
-            ex.set_splits({driving: [(b, num_batches)]})
-            for p in self._host_pages(ex.execute(self.partial_plan)):
+            for p in self._partial_pages(b):
                 if self.spill:
                     if spiller is not None:
                         p = spiller.spill(p)
@@ -422,6 +426,31 @@ class BatchedRunner:
             stats.update(spilled_bytes=spiller.total_spilled_bytes,
                          spill_files=len(spiller.handles))
         return _concat_pages(partials, spiller)
+
+    def _partial_pages(self, b: int):
+        """Execute the partial plan over lifespan `b`, yielding its
+        output pages. With streaming_scan_rows set (single-device
+        executors only), the driving split flows through in bounded
+        scan runs — connector.scan_runs — so the lifespan never holds
+        its whole split resident; otherwise one whole-split shot."""
+        ex = self.ex
+        if (self.stream_rows > 0 and getattr(ex, "ndev", 1) == 1
+                and hasattr(ex, "set_split_tables")
+                and hasattr(self.connector, "scan_runs")):
+            try:
+                for run in self.connector.scan_runs(
+                        self.driving, self.stream_rows, part=b,
+                        num_parts=self.num_batches):
+                    ex.set_split_tables({self.driving: run})
+                    for p in self._host_pages(
+                            ex.execute(self.partial_plan)):
+                        yield p
+            finally:
+                ex.set_split_tables({})
+            return
+        ex.set_splits({self.driving: [(b, self.num_batches)]})
+        for p in self._host_pages(ex.execute(self.partial_plan)):
+            yield p
 
     def _finish_above(self, page: Page) -> Page:
         # Interpret the small chain above the aggregation.

@@ -26,12 +26,21 @@ class SplitExecutor(Executor):
     def __init__(self, connector, session=None):
         super().__init__(connector, session=session)
         self.splits: Dict[str, List[Tuple[int, int]]] = {}
+        # table -> pre-materialized host table (one streaming scan run);
+        # consulted BEFORE split (part, numParts) resolution so lifespan
+        # streaming can feed bounded page runs through an unchanged plan.
+        self.split_tables: Dict[str, object] = {}
         # node_id -> concatenated engine Page pulled over the HTTP
         # exchange before execution (data/column.concat_pages_host).
         self.remote_pages: Dict[str, "Page"] = {}
 
     def set_splits(self, by_table: Dict[str, List[Tuple[int, int]]]):
         self.splits = by_table
+
+    def set_split_tables(self, by_table: Dict[str, object]):
+        """Bind host tables (streaming scan runs) directly to leaf
+        scans; pass {} to fall back to split-range resolution."""
+        self.split_tables = by_table
 
     def set_remote_pages(self, by_node: Dict[str, Page]):
         self.remote_pages = by_node
@@ -46,6 +55,9 @@ class SplitExecutor(Executor):
         return (lambda pages: pages[idx]), page.capacity
 
     def _scan_rows(self, node) -> int:
+        t = self.split_tables.get(node.table)
+        if t is not None:
+            return max(1, int(t.num_rows))
         parts = self.splits.get(node.table)
         if parts is None:
             return self.connector.table(node.table).num_rows
@@ -58,6 +70,9 @@ class SplitExecutor(Executor):
             return self.remote_pages[s.node_id]
         if not hasattr(s, "table"):       # island PageInputSpec
             return super()._fetch(s)
+        t = self.split_tables.get(s.table)
+        if t is not None:
+            return t.page(columns=list(s.columns), capacity=s.capacity)
         parts = self.splits.get(s.table)
         if parts is None:
             return super()._fetch(s)

@@ -78,6 +78,12 @@ def load() -> Optional[ctypes.CDLL]:
                 lib.pt_lz4_decompress.argtypes = [
                     ctypes.c_void_p, ctypes.c_size_t,
                     ctypes.c_void_p, ctypes.c_size_t]
+            if hasattr(lib, "pt_lz4_compress_crc"):
+                lib.pt_lz4_compress_crc.restype = ctypes.c_size_t
+                lib.pt_lz4_compress_crc.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_uint32)]
             _lib = lib
         except OSError:
             _lib = None
@@ -122,6 +128,27 @@ def lz4_compress(data: bytes) -> Optional[bytes]:
     if got == 0:
         return None
     return out[:got].tobytes()
+
+
+def lz4_compress_crc(data) -> "Optional[tuple]":
+    """Fused LZ4 block compress + CRC32 of the compressed output in one
+    native call (the frame checksum covers the payload as transmitted).
+    Returns (compressed_bytes, crc) or None when the library (or a
+    stale build without the symbol) is absent."""
+    lib = load()
+    if lib is None or not hasattr(lib, "pt_lz4_compress_crc"):
+        return None
+    n = len(data)
+    src = np.frombuffer(data, dtype=np.uint8)
+    cap = n + n // 255 + 64
+    out = np.empty(cap, dtype=np.uint8)
+    crc = ctypes.c_uint32(0)
+    got = lib.pt_lz4_compress_crc(
+        src.ctypes.data if n else None, n, out.ctypes.data, cap,
+        ctypes.byref(crc))
+    if got == 0:
+        return None
+    return out[:got].tobytes(), int(crc.value)
 
 
 def lz4_decompress(data: bytes, uncompressed: int) -> Optional[bytes]:

@@ -42,18 +42,25 @@ void pt_unpack_nulls(const uint8_t* bits, size_t n, uint8_t* out) {
     }
 }
 
-// zlib-compatible CRC32 (reflected, poly 0xEDB88320), slice-by-8-free
-// table variant — matches java.util.zip.CRC32 / Python zlib.crc32.
-// Table built by a static initializer: dlopen runs it single-threaded
-// before any pt_crc32 call, so there is no lazy-init data race.
+// zlib-compatible CRC32 (reflected, poly 0xEDB88320), slice-by-8 table
+// variant — matches java.util.zip.CRC32 / Python zlib.crc32. Table
+// built by a static initializer: dlopen runs it single-threaded before
+// any pt_crc32 call, so there is no lazy-init data race.
 struct CrcTable {
-    uint32_t t[256];
+    uint32_t t[8][256];
     CrcTable() {
         for (uint32_t i = 0; i < 256; i++) {
             uint32_t c = i;
             for (int k = 0; k < 8; k++)
                 c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
-            t[i] = c;
+            t[0][i] = c;
+        }
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = t[0][i];
+            for (int s = 1; s < 8; s++) {
+                c = t[0][c & 0xFFu] ^ (c >> 8);
+                t[s][i] = c;
+            }
         }
     }
 };
@@ -61,8 +68,21 @@ static const CrcTable crc_table;
 
 uint32_t pt_crc32(const uint8_t* data, size_t n, uint32_t crc) {
     crc = ~crc;
+    const uint32_t (*t)[256] = crc_table.t;
+    while (n >= 8) {
+        uint32_t lo, hi;
+        std::memcpy(&lo, data, 4);
+        std::memcpy(&hi, data + 4, 4);
+        lo ^= crc;
+        crc = t[7][lo & 0xFFu] ^ t[6][(lo >> 8) & 0xFFu]
+            ^ t[5][(lo >> 16) & 0xFFu] ^ t[4][lo >> 24]
+            ^ t[3][hi & 0xFFu] ^ t[2][(hi >> 8) & 0xFFu]
+            ^ t[1][(hi >> 16) & 0xFFu] ^ t[0][hi >> 24];
+        data += 8;
+        n -= 8;
+    }
     for (size_t i = 0; i < n; i++)
-        crc = crc_table.t[(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
+        crc = t[0][(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
     return ~crc;
 }
 
@@ -164,6 +184,19 @@ size_t pt_lz4_compress(const uint8_t* src, size_t n,
     std::memcpy(op, anchor, lastlit);
     op += lastlit;
     return (size_t)(op - dst);
+}
+
+// Fused block-LZ4 + frame-CRC fast path: compress src -> dst and CRC32
+// the COMPRESSED output (the page checksum covers the payload as
+// transmitted) in one native call, so the Python encode path pays one
+// ctypes round trip instead of two. Returns the compressed size (0 if
+// dst_cap is too small); *crc_out receives the CRC of dst[0..size).
+size_t pt_lz4_compress_crc(const uint8_t* src, size_t n,
+                           uint8_t* dst, size_t dst_cap,
+                           uint32_t* crc_out) {
+    size_t got = pt_lz4_compress(src, n, dst, dst_cap);
+    if (crc_out) *crc_out = got ? pt_crc32(dst, got, 0u) : 0u;
+    return got;
 }
 
 // Decompress src -> dst (dst_cap = exact uncompressed size). Returns

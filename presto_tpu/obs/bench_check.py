@@ -42,7 +42,7 @@ from typing import Dict, List, Optional
 DEFAULT_TOLERANCE = 0.20
 
 #: units where a larger value is better
-_HIGHER_BETTER = ("rows/s", "rows/sec", "stmt/s", "q/s", "qps")
+_HIGHER_BETTER = ("rows/s", "rows/sec", "stmt/s", "q/s", "qps", "gb/s")
 #: units where a smaller value is better ("x" = slowdown multiple)
 _LOWER_BETTER = ("s", "sec", "seconds", "x", "ms")
 
@@ -65,7 +65,9 @@ def extract_lanes(doc: dict) -> Dict[str, dict]:
     Returns ``{lane_name: {"value": float, "unit": str}}``. The
     headline triple becomes one lane under its own metric name; every
     ``parsed.detail`` sub-dict with a numeric ``rows_per_sec`` becomes
-    a throughput lane named after its key.
+    a throughput lane named after its key, and every ``*_gbps`` figure
+    inside a sub-dict becomes a ``gb/s`` lane (the data-plane round's
+    serde/drain throughputs).
     """
     lanes: Dict[str, dict] = {}
     parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
@@ -89,6 +91,12 @@ def extract_lanes(doc: dict) -> Dict[str, dict]:
             if isinstance(rps, (int, float)) and rps > 0:
                 lanes[f"{key}_rows_per_sec"] = {"value": float(rps),
                                                 "unit": "rows/s"}
+            for k in sorted(sub):
+                v = sub[k]
+                if k.endswith("_gbps") and isinstance(v, (int, float)) \
+                        and v > 0:
+                    lanes[f"{key}_{k}"] = {"value": float(v),
+                                           "unit": "gb/s"}
     return lanes
 
 

@@ -21,16 +21,39 @@ BYTE_ARRAY, INT128_ARRAY, VARIABLE_WIDTH, RLE, DICTIONARY (each matching
 presto-common/.../block/<Name>BlockEncoding.java). Values live in numpy
 arrays; DICTIONARY of VARIABLE_WIDTH maps 1:1 onto this engine's
 code+StringDict string columns.
+
+Zero-copy contract (the PageBuffer data plane):
+
+  * encode builds the whole frame in ONE pre-sized allocation
+    (`PageBuffer`): `_PageWriter` coalesces small header pieces into
+    byte runs and scatters every numpy lane straight into the page
+    buffer — one copy per lane, no per-lane `tobytes()` + `extend()`
+    pair, with a payload-relative block-offsets table for writev-style
+    consumers.
+  * decode returns READ-ONLY `np.frombuffer` views over the received
+    frame: fixed-width lanes, int128 lanes, nested offsets and
+    dictionary ids alias the frame's memory, and each view's `.base`
+    pins the frame alive as long as any decoded block lives. The only
+    sanctioned copies — null-mask scatter, decompression, and
+    VARIABLE_WIDTH value slicing — are counted in
+    `page_copy_fallback_total{site}` and still come back read-only.
+  * `analysis/rules.py` (`no-page-copy-in-data-plane`) polices the
+    contract: `.tobytes()` / `frombuffer(...).copy()` under `protocol/`
+    and `spool/` only at the sanctioned sites in this file.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import struct
+import time
 import zlib
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+from presto_tpu.obs.metrics import counter as _counter, \
+    histogram as _histogram
 
 COMPRESSED = 1
 ENCRYPTED = 2
@@ -41,6 +64,22 @@ _CODEC_SHIFT = 4
 _CODEC_BITS = {"zlib": 1 << _CODEC_SHIFT, "gzip": 2 << _CODEC_SHIFT,
                "lz4": 3 << _CODEC_SHIFT}
 _CODEC_BY_ID = {1: "zlib", 2: "gzip", 3: "lz4"}
+
+_HEADER = struct.Struct("<ibiiq")
+
+_ZERO_COPY_BYTES = _counter(
+    "presto_tpu_page_zero_copy_bytes_total",
+    "Page bytes that crossed the data plane without an intermediate "
+    "copy (scatter-gathered encode lanes, aliased decode payloads, "
+    "spool range reads served as views)")
+_COPY_FALLBACK = _counter(
+    "presto_tpu_page_copy_fallback_total",
+    "Sanctioned data-plane copies by site (null_scatter, decompress, "
+    "varwidth)", labelnames=("site",))
+_ENCODE_SECONDS = _histogram(
+    "presto_tpu_serde_encode_seconds", "Wall time per encode_serialized_page call")
+_DECODE_SECONDS = _histogram(
+    "presto_tpu_serde_decode_seconds", "Wall time per decode_serialized_page call")
 
 
 @dataclasses.dataclass
@@ -70,24 +109,116 @@ class WireBlock:
         return len(self.values)
 
 
+class PageBuffer:
+    """One page, one allocation: the full encoded frame (21-byte header
+    + payload) in a single pre-sized buffer plus a payload-relative
+    offsets table locating each block. This is the unit of zero-copy
+    ownership: exchange, spool and the fragment cache can emit
+    `memoryview(page_buffer.buffer)` (or the per-block slices the
+    offsets table yields) without reassembling bytes; `to_bytes()` is
+    the one sanctioned copy out, for callers that hash or key frames."""
+
+    __slots__ = ("buffer", "block_offsets", "position_count")
+
+    def __init__(self, buffer: bytearray, block_offsets: Tuple[int, ...],
+                 position_count: int):
+        self.buffer = buffer
+        self.block_offsets = block_offsets
+        self.position_count = position_count
+
+    def __len__(self) -> int:
+        return len(self.buffer)
+
+    def view(self) -> memoryview:
+        return memoryview(self.buffer)
+
+    def to_bytes(self) -> bytes:
+        return bytes(self.buffer)
+
+
+class _PageWriter:
+    """Scatter-gather payload builder. Small struct-packed pieces
+    coalesce into pending byte runs; numpy lanes are recorded by
+    REFERENCE and written straight into the single page buffer at
+    emission time (`write_into`) — the writev analogue of the reference
+    native worker's serializer. Exactly one copy per lane."""
+
+    #: lanes under this many bytes ride the coalesced byte run — a
+    #: part-table entry costs more than the copy it saves (this
+    #: `tobytes()` is a sanctioned site of no-page-copy-in-data-plane)
+    _SMALL = 64
+
+    __slots__ = ("_parts", "_pending", "_size", "array_bytes")
+
+    def __init__(self):
+        self._parts: List[Tuple[int, object]] = []
+        self._pending = bytearray()
+        self._size = 0
+        self.array_bytes = 0       # bytes scatter-gathered, not copied
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def put(self, piece: bytes):
+        self._pending += piece
+        self._size += len(piece)
+
+    def put_bytes(self, piece: bytes):
+        """A pre-built byte string; large ones are emitted by reference."""
+        if len(piece) < self._SMALL:
+            self.put(piece)
+            return
+        self._flush()
+        self._parts.append((self._size, piece))
+        self._size += len(piece)
+
+    def put_array(self, a: np.ndarray):
+        a = np.ascontiguousarray(a)
+        if a.nbytes < self._SMALL:
+            self.put(a.tobytes())
+            return
+        self._flush()
+        self._parts.append((self._size, a))
+        self._size += a.nbytes
+        self.array_bytes += a.nbytes
+
+    def _flush(self):
+        if self._pending:
+            self._parts.append(
+                (self._size - len(self._pending), bytes(self._pending)))
+            self._pending = bytearray()
+
+    def write_into(self, mv: memoryview, base: int):
+        """Scatter every recorded part into `mv` at `base` + offset."""
+        self._flush()
+        for off, part in self._parts:
+            o = base + off
+            if isinstance(part, np.ndarray):
+                dst = np.frombuffer(mv, dtype=part.dtype,
+                                    count=part.size, offset=o)
+                dst.reshape(part.shape)[...] = part
+            else:
+                mv[o:o + len(part)] = part
+
+
 # ---------------------------------------------------------------------------
 # primitives
 # ---------------------------------------------------------------------------
 
-def _encode_nulls(out: bytearray, nulls: Optional[np.ndarray], n: int):
+def _encode_nulls(w: _PageWriter, nulls: Optional[np.ndarray], n: int):
     """EncoderUtil.encodeNullsAsBits: hasNulls byte then MSB-first bits.
     Uses the native (C++) packer when available (presto_tpu/native)."""
     if nulls is None or not nulls.any():
-        out.append(0)
+        w.put(b"\x00")
         return
-    out.append(1)
+    w.put(b"\x01")
     from presto_tpu import native
     packed = native.pack_nulls(np.asarray(nulls[:n]))
     if packed is not None:
-        out.extend(packed)
+        w.put_bytes(packed)
         return
-    bits = np.packbits(nulls[:n].astype(np.uint8))  # MSB-first, matches
-    out.extend(bits.tobytes())
+    w.put_array(np.packbits(nulls[:n].astype(np.uint8)))  # MSB-first
 
 
 def _decode_nulls(buf: memoryview, off: int, n: int
@@ -98,23 +229,29 @@ def _decode_nulls(buf: memoryview, off: int, n: int
         return None, off
     nbytes = (n + 7) // 8
     from presto_tpu import native
-    nulls = native.unpack_nulls(bytes(buf[off:off + nbytes]), n)
+    nulls = native.unpack_nulls(buf[off:off + nbytes], n)
     if nulls is None:
-        bits = np.frombuffer(buf[off:off + nbytes], dtype=np.uint8)
+        bits = np.frombuffer(buf, dtype=np.uint8, count=nbytes,
+                             offset=off)
         nulls = np.unpackbits(bits, count=n).astype(bool)
+    nulls.setflags(write=False)
     return nulls, off + nbytes
 
 
-def _fixed_width_encode(out: bytearray, b: WireBlock, dtype, width: int):
+def _view(buf: memoryview, off: int, dtype, count: int) -> np.ndarray:
+    """A read-only numpy view over `count` items of `buf` at `off`; the
+    view's .base pins the frame buffer alive (zero-copy decode)."""
+    return np.frombuffer(buf, dtype=dtype, count=count, offset=off)
+
+
+def _fixed_width_encode(w: _PageWriter, b: WireBlock, dtype, width: int):
     n = len(b.values)
-    out.extend(struct.pack("<i", n))
-    _encode_nulls(out, b.nulls, n)
+    w.put(struct.pack("<i", n))
+    _encode_nulls(w, b.nulls, n)
     vals = np.ascontiguousarray(b.values, dtype=dtype)
     if b.nulls is not None and b.nulls.any():
-        # Java writes only non-null slots
-        out.extend(vals[~b.nulls].tobytes())
-    else:
-        out.extend(vals.tobytes())
+        vals = vals[~b.nulls]          # Java writes only non-null slots
+    w.put_array(vals)
 
 
 def _fixed_width_decode(buf: memoryview, off: int, dtype, width: int
@@ -123,14 +260,18 @@ def _fixed_width_decode(buf: memoryview, off: int, dtype, width: int
     off += 4
     nulls, off = _decode_nulls(buf, off, n)
     if nulls is None:
-        vals = np.frombuffer(buf[off:off + n * width], dtype=dtype).copy()
+        vals = _view(buf, off, dtype, n)
         off += n * width
     else:
+        # null scatter — the wire carries only non-null slots, so the
+        # full lane must be rebuilt (sanctioned copy)
         k = int((~nulls).sum())
-        packed = np.frombuffer(buf[off:off + k * width], dtype=dtype)
+        packed = _view(buf, off, dtype, k)
         off += k * width
         vals = np.zeros(n, dtype=dtype)
         vals[~nulls] = packed
+        vals.setflags(write=False)
+        _COPY_FALLBACK.inc(site="null_scatter")
     return WireBlock("", vals, nulls), off
 
 
@@ -142,77 +283,72 @@ _FIXED = {"LONG_ARRAY": (np.int64, 8), "INT_ARRAY": (np.int32, 4),
           "SHORT_ARRAY": (np.int16, 2), "BYTE_ARRAY": (np.uint8, 1)}
 
 
-def _encode_block(out: bytearray, b: WireBlock):
+def _encode_block(w: _PageWriter, b: WireBlock):
     name = b.encoding.encode()
-    out.extend(struct.pack("<i", len(name)))
-    out.extend(name)
+    w.put(struct.pack("<i", len(name)))
+    w.put(name)
     if b.encoding in _FIXED:
         dtype, width = _FIXED[b.encoding]
-        _fixed_width_encode(out, b, dtype, width)
+        _fixed_width_encode(w, b, dtype, width)
     elif b.encoding == "INT128_ARRAY":
         # two int64 lanes per position (values shape [n, 2]: low, high)
         n = len(b.values)
-        out.extend(struct.pack("<i", n))
-        _encode_nulls(out, b.nulls, n)
+        w.put(struct.pack("<i", n))
+        _encode_nulls(w, b.nulls, n)
         vals = np.ascontiguousarray(b.values, dtype=np.int64)
         if b.nulls is not None and b.nulls.any():
             vals = vals[~b.nulls]
-        out.extend(vals.tobytes())
+        w.put_array(vals)
     elif b.encoding == "VARIABLE_WIDTH":
         n = len(b.values)
-        out.extend(struct.pack("<i", n))
+        w.put(struct.pack("<i", n))
         lens = np.array([0 if v is None else len(v) for v in b.values],
                         dtype=np.int64)
-        offsets = np.cumsum(lens).astype(np.int32)
-        out.extend(offsets.tobytes())
-        _encode_nulls(out, b.nulls, n)
+        w.put_array(np.cumsum(lens).astype(np.int32))
+        _encode_nulls(w, b.nulls, n)
         payload = b"".join(v for v in b.values if v is not None)
-        out.extend(struct.pack("<i", len(payload)))
-        out.extend(payload)
+        w.put(struct.pack("<i", len(payload)))
+        w.put_bytes(payload)
     elif b.encoding == "ARRAY":
         # reference ArrayBlockEncoding.java: elements block, then
         # positionCount, offsets[n+1] rebased to 0, null bits
         n = b.position_count
-        _encode_block(out, b.children[0])
-        out.extend(struct.pack("<i", n))
-        out.extend(np.ascontiguousarray(b.offsets,
-                                        dtype=np.int32).tobytes())
-        _encode_nulls(out, b.nulls, n)
+        _encode_block(w, b.children[0])
+        w.put(struct.pack("<i", n))
+        w.put_array(np.ascontiguousarray(b.offsets, dtype=np.int32))
+        _encode_nulls(w, b.nulls, n)
     elif b.encoding == "MAP":
         # reference MapBlockEncoding.java: key block, value block,
         # hashtable length (-1 = absent; readers rebuild lazily),
         # positionCount, offsets[n+1], null bits
         n = b.position_count
-        _encode_block(out, b.children[0])
-        _encode_block(out, b.children[1])
-        out.extend(struct.pack("<i", -1))
-        out.extend(struct.pack("<i", n))
-        out.extend(np.ascontiguousarray(b.offsets,
-                                        dtype=np.int32).tobytes())
-        _encode_nulls(out, b.nulls, n)
+        _encode_block(w, b.children[0])
+        _encode_block(w, b.children[1])
+        w.put(struct.pack("<i", -1))
+        w.put(struct.pack("<i", n))
+        w.put_array(np.ascontiguousarray(b.offsets, dtype=np.int32))
+        _encode_nulls(w, b.nulls, n)
     elif b.encoding == "ROW":
         # reference RowBlockEncoding.java: numFields, field blocks,
         # positionCount, fieldBlockOffsets[n+1], null bits
         n = b.position_count
-        out.extend(struct.pack("<i", len(b.children)))
+        w.put(struct.pack("<i", len(b.children)))
         for child in b.children:
-            _encode_block(out, child)
-        out.extend(struct.pack("<i", n))
-        out.extend(np.ascontiguousarray(b.offsets,
-                                        dtype=np.int32).tobytes())
-        _encode_nulls(out, b.nulls, n)
+            _encode_block(w, child)
+        w.put(struct.pack("<i", n))
+        w.put_array(np.ascontiguousarray(b.offsets, dtype=np.int32))
+        _encode_nulls(w, b.nulls, n)
     elif b.encoding == "RLE":
-        out.extend(struct.pack("<i", b.count))
-        _encode_block(out, b.rle_value)
+        w.put(struct.pack("<i", b.count))
+        _encode_block(w, b.rle_value)
     elif b.encoding == "DICTIONARY":
         n = len(b.values)
-        out.extend(struct.pack("<i", n))
-        _encode_block(out, b.dictionary)
-        out.extend(np.ascontiguousarray(b.values,
-                                        dtype=np.int32).tobytes())
+        w.put(struct.pack("<i", n))
+        _encode_block(w, b.dictionary)
+        w.put_array(np.ascontiguousarray(b.values, dtype=np.int32))
         # dictionary instance id (most/least significant bits, sequence);
         # receivers only use it for caching — send a fixed id
-        out.extend(struct.pack("<qqq", 0, 0, 0))
+        w.put(struct.pack("<qqq", 0, 0, 0))
     else:
         raise ValueError(f"unsupported encoding {b.encoding}")
 
@@ -231,23 +367,31 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
         (n,) = struct.unpack_from("<i", buf, off)
         off += 4
         nulls, off = _decode_nulls(buf, off, n)
-        k = n if nulls is None else int((~nulls).sum())
-        packed = np.frombuffer(buf[off:off + k * 16],
-                               dtype=np.int64).reshape(k, 2)
+        if nulls is None:
+            vals = _view(buf, off, np.int64, 2 * n).reshape(n, 2)
+            off += n * 16
+            return WireBlock(name, vals, None), off
+        k = int((~nulls).sum())
+        packed = _view(buf, off, np.int64, 2 * k).reshape(k, 2)
         off += k * 16
         vals = np.zeros((n, 2), dtype=np.int64)
-        vals[(~nulls) if nulls is not None else slice(None)] = packed
+        vals[~nulls] = packed
+        vals.setflags(write=False)
+        _COPY_FALLBACK.inc(site="null_scatter")
         return WireBlock(name, vals, nulls), off
     if name == "VARIABLE_WIDTH":
         (n,) = struct.unpack_from("<i", buf, off)
         off += 4
-        offsets = np.frombuffer(buf[off:off + 4 * n], dtype=np.int32)
+        offsets = _view(buf, off, np.int32, n)
         off += 4 * n
         nulls, off = _decode_nulls(buf, off, n)
         (total,) = struct.unpack_from("<i", buf, off)
         off += 4
+        # per-value bytes objects: downstream string decode needs real
+        # bytes (`.decode()`), so this lane is a sanctioned copy
         payload = bytes(buf[off:off + total])
         off += total
+        _COPY_FALLBACK.inc(site="varwidth")
         vals = np.empty(n, dtype=object)
         prev = 0
         for i in range(n):
@@ -257,13 +401,13 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
             else:
                 vals[i] = payload[prev:end]
             prev = end
+        vals.setflags(write=False)
         return WireBlock(name, vals, nulls), off
     if name == "ARRAY":
         elements, off = _decode_block(buf, off)
         (n,) = struct.unpack_from("<i", buf, off)
         off += 4
-        offsets = np.frombuffer(buf[off:off + 4 * (n + 1)],
-                                dtype=np.int32).copy()
+        offsets = _view(buf, off, np.int32, n + 1)
         off += 4 * (n + 1)
         nulls, off = _decode_nulls(buf, off, n)
         return WireBlock("ARRAY", nulls=nulls, children=[elements],
@@ -277,8 +421,7 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
             off += 4 * ht_len
         (n,) = struct.unpack_from("<i", buf, off)
         off += 4
-        offsets = np.frombuffer(buf[off:off + 4 * (n + 1)],
-                                dtype=np.int32).copy()
+        offsets = _view(buf, off, np.int32, n + 1)
         off += 4 * (n + 1)
         nulls, off = _decode_nulls(buf, off, n)
         return WireBlock("MAP", nulls=nulls, children=[keys, vals],
@@ -292,8 +435,7 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
             fields.append(f)
         (n,) = struct.unpack_from("<i", buf, off)
         off += 4
-        offsets = np.frombuffer(buf[off:off + 4 * (n + 1)],
-                                dtype=np.int32).copy()
+        offsets = _view(buf, off, np.int32, n + 1)
         off += 4 * (n + 1)
         nulls, off = _decode_nulls(buf, off, n)
         return WireBlock("ROW", nulls=nulls, children=fields,
@@ -307,7 +449,7 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
         (n,) = struct.unpack_from("<i", buf, off)
         off += 4
         dictionary, off = _decode_block(buf, off)
-        ids = np.frombuffer(buf[off:off + 4 * n], dtype=np.int32).copy()
+        ids = _view(buf, off, np.int32, n)
         off += 4 * n
         off += 24  # instance id
         return WireBlock("DICTIONARY", ids, None, dictionary=dictionary), off
@@ -318,63 +460,109 @@ def _decode_block(buf: memoryview, off: int) -> Tuple[WireBlock, int]:
 # page level
 # ---------------------------------------------------------------------------
 
-def _checksum(payload: bytes, markers: int, position_count: int,
-              uncompressed: int) -> int:
-    from presto_tpu import native
+def _checksum_tail(crc: int, markers: int, position_count: int,
+                   uncompressed: int) -> int:
+    """Chain the header fields onto a payload CRC (Java updateCrc order:
+    markers byte, positionCount, uncompressedSize, little-endian)."""
     tail = bytes([markers & 0xFF]) + struct.pack("<i", position_count) \
         + struct.pack("<i", uncompressed)
+    return zlib.crc32(tail, crc)
+
+
+def _checksum(payload, markers: int, position_count: int,
+              uncompressed: int) -> int:
+    # the native slice-by-8 CRC outruns zlib's on this image; both
+    # compute the same reflected-poly value java.util.zip.CRC32 does
+    from presto_tpu import native
     crc = native.crc32(payload)
-    if crc is not None:
-        return native.crc32(tail, crc)
-    # Java updateCrc: 4 low-order bytes, little-endian order
-    return zlib.crc32(tail, zlib.crc32(payload))
+    if crc is None:
+        crc = zlib.crc32(payload)
+    return _checksum_tail(crc, markers, position_count, uncompressed)
+
+
+def encode_page_buffer(blocks: List[WireBlock],
+                       checksummed: bool = True,
+                       compression: Optional[str] = None) -> PageBuffer:
+    """Encode a page into ONE pre-sized allocation (see `PageBuffer`)."""
+    if not blocks:
+        raise ValueError("page needs at least one block")
+    t0 = time.perf_counter()
+    position_count = blocks[0].position_count
+    w = _PageWriter()
+    w.put(struct.pack("<i", len(blocks)))
+    block_offsets = []
+    for b in blocks:
+        block_offsets.append(w.size)
+        _encode_block(w, b)
+    uncompressed = w.size
+    markers = CHECKSUMMED if checksummed else 0
+    buf = None
+    comp_crc = None
+    if compression in ("zlib", "gzip", "lz4") and uncompressed > 256:
+        raw = bytearray(uncompressed)
+        w.write_into(memoryview(raw), 0)
+        comp = None
+        if compression == "lz4" and checksummed:
+            # native fused path: compress + CRC the transmitted payload
+            # in one call (frame CRC fast path, native/page_codec.cc)
+            from presto_tpu import native
+            pair = native.lz4_compress_crc(raw)
+            if pair is not None:
+                comp, comp_crc = pair
+        if comp is None:
+            comp = _compress(raw, compression)
+        if comp is not None and len(comp) < uncompressed:
+            buf = bytearray(21 + len(comp))
+            buf[21:] = comp
+            # codec id in the marker byte's spare bits (above
+            # COMPRESSED/ENCRYPTED/CHECKSUMMED) so the consumer decodes
+            # deterministically instead of sniffing magic bytes — an
+            # LZ4 block can begin with zlib's 0x78
+            markers |= COMPRESSED | _CODEC_BITS[compression]
+        else:
+            buf = bytearray(21 + uncompressed)
+            buf[21:] = raw             # keep raw when incompressible
+            comp_crc = None
+    elif compression not in (None, "none", "zlib", "gzip", "lz4"):
+        raise ValueError(f"unsupported exchange compression "
+                         f"{compression!r}")
+    if buf is None:
+        buf = bytearray(21 + uncompressed)
+        w.write_into(memoryview(buf), 21)
+    # checksum covers the payload AS TRANSMITTED
+    # (PagesSerdeUtil.computeSerializedPageChecksum)
+    checksum = 0
+    if checksummed:
+        if comp_crc is not None:
+            checksum = _checksum_tail(comp_crc, markers, position_count,
+                                      uncompressed)
+        else:
+            checksum = _checksum(memoryview(buf)[21:], markers,
+                                 position_count, uncompressed)
+    _HEADER.pack_into(buf, 0, position_count, markers, uncompressed,
+                      len(buf) - 21, checksum)
+    _ZERO_COPY_BYTES.inc(w.array_bytes)
+    _ENCODE_SECONDS.observe(time.perf_counter() - t0)
+    return PageBuffer(buf, tuple(block_offsets), position_count)
 
 
 def encode_serialized_page(blocks: List[WireBlock],
                            checksummed: bool = True,
                            compression: Optional[str] = None) -> bytes:
-    if not blocks:
-        raise ValueError("page needs at least one block")
-    position_count = blocks[0].position_count
-    payload = bytearray()
-    payload.extend(struct.pack("<i", len(blocks)))
-    for b in blocks:
-        _encode_block(payload, b)
-    payload = bytes(payload)
-    markers = CHECKSUMMED if checksummed else 0
-    uncompressed = len(payload)
-    if compression in ("zlib", "gzip", "lz4") and uncompressed > 256:
-        comp = _compress(payload, compression)
-        if comp is not None and len(comp) < uncompressed:
-            payload = comp             # keep raw when incompressible
-            markers |= COMPRESSED
-            # codec id in the marker byte's spare bits (above
-            # COMPRESSED/ENCRYPTED/CHECKSUMMED) so the consumer decodes
-            # deterministically instead of sniffing magic bytes — an
-            # LZ4 block can begin with zlib's 0x78
-            markers |= _CODEC_BITS[compression]
-    elif compression not in (None, "none", "zlib", "gzip", "lz4"):
-        raise ValueError(f"unsupported exchange compression "
-                         f"{compression!r}")
-    # checksum covers the payload AS TRANSMITTED
-    # (PagesSerdeUtil.computeSerializedPageChecksum)
-    checksum = _checksum(payload, markers, position_count,
-                         uncompressed) if checksummed else 0
-    header = struct.pack("<ibiiq", position_count, markers, uncompressed,
-                         len(payload), checksum)
-    return header + payload
+    return encode_page_buffer(blocks, checksummed,
+                              compression).to_bytes()
 
 
-def _compress(payload: bytes, codec: str):
+def _compress(payload, codec: str):
     """Compress per the session codec (CompressionCodec.java:16 — the
     reference offers GZIP/LZ4/ZSTD next to NONE). LZ4 block format runs
     in the native C++ layer (native/page_codec.cc); zstd has no library
     in this image and is rejected at the session-property level."""
     if codec == "zlib":
-        return zlib.compress(payload, 6)
+        return zlib.compress(bytes(payload), 6)
     if codec == "gzip":
         co = zlib.compressobj(6, zlib.DEFLATED, 31)   # gzip wrapper
-        return co.compress(payload) + co.flush()
+        return co.compress(bytes(payload)) + co.flush()
     # lz4 block
     from presto_tpu import native
     out = native.lz4_compress(payload)
@@ -384,7 +572,7 @@ def _compress(payload: bytes, codec: str):
     return out
 
 
-def _decompress(payload: bytes, uncompressed: int,
+def _decompress(payload, uncompressed: int,
                 codec: Optional[str] = None) -> bytes:
     """Deterministic decode when the frame's marker bits name the codec;
     magic-byte sniffing (zlib/gzip by magic, LZ4 block fallback) only
@@ -418,13 +606,19 @@ def _decompress(payload: bytes, uncompressed: int,
     return out
 
 
-def decode_serialized_page(data: bytes, offset: int = 0
+def decode_serialized_page(data, offset: int = 0
                            ) -> Tuple[List[WireBlock], int, int]:
-    """Returns (blocks, position_count, next_offset)."""
+    """Returns (blocks, position_count, next_offset). Decoded lanes are
+    READ-ONLY views aliasing `data` (zero-copy; writing raises) — the
+    views' .base keeps the frame buffer alive with the page."""
+    t0 = time.perf_counter()
     position_count, markers, uncompressed, size, checksum = \
-        struct.unpack_from("<ibiiq", data, offset)
+        _HEADER.unpack_from(data, offset)
     off = offset + 21
-    payload = bytes(data[off:off + size])
+    mv = memoryview(data)
+    if not mv.readonly:
+        mv = mv.toreadonly()
+    payload = mv[off:off + size]
     if markers & ENCRYPTED:
         raise NotImplementedError("encrypted pages")
     if markers & CHECKSUMMED:
@@ -433,18 +627,21 @@ def decode_serialized_page(data: bytes, offset: int = 0
             raise ValueError(f"page checksum mismatch: {want} != {checksum}")
     if markers & COMPRESSED:
         codec = _CODEC_BY_ID.get((markers >> _CODEC_SHIFT) & 0x3)
-        payload = _decompress(payload, uncompressed, codec)
+        payload = memoryview(_decompress(payload, uncompressed, codec))
+        _COPY_FALLBACK.inc(site="decompress")
         if len(payload) != uncompressed:
             raise ValueError(
                 f"decompressed size {len(payload)} != declared "
                 f"{uncompressed}")
-    buf = memoryview(payload)
-    (nblocks,) = struct.unpack_from("<i", buf, 0)
+    else:
+        _ZERO_COPY_BYTES.inc(size)
+    (nblocks,) = struct.unpack_from("<i", payload, 0)
     p = 4
     blocks = []
     for _ in range(nblocks):
-        b, p = _decode_block(buf, p)
+        b, p = _decode_block(payload, p)
         blocks.append(b)
+    _DECODE_SECONDS.observe(time.perf_counter() - t0)
     return blocks, position_count, off + size
 
 
@@ -480,10 +677,10 @@ def _flat_to_wire(t, vals: np.ndarray, nulls: np.ndarray,
         return WireBlock("LONG_ARRAY", vals.astype(np.int64),
                          nulls if nulls.any() else None)
     if t.dtype == np.float64:
-        return WireBlock("LONG_ARRAY", vals.view(np.int64).copy(),
+        return WireBlock("LONG_ARRAY", vals.view(np.int64),
                          nulls if nulls.any() else None)
     if t.dtype == np.float32:
-        return WireBlock("INT_ARRAY", vals.view(np.int32).copy(),
+        return WireBlock("INT_ARRAY", vals.view(np.int32),
                          nulls if nulls.any() else None)
     raise NotImplementedError(f"wire type {t}")
 

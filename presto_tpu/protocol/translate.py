@@ -169,7 +169,8 @@ def encode_constant(value, t: Type) -> S.Constant:
     """Typed python value -> ConstantExpression with a wire-format
     valueBlock (inverse of decode_constant; used by tests and the
     coordinator-side fragment builder)."""
-    from presto_tpu.protocol.serde import WireBlock, _encode_block
+    from presto_tpu.protocol.serde import WireBlock, _PageWriter, \
+        _encode_block
 
     sig = t.name if not isinstance(t, DecimalType) else \
         f"decimal({t.precision},{t.scale})"
@@ -196,8 +197,10 @@ def encode_constant(value, t: Type) -> S.Constant:
         blk = WireBlock("BYTE_ARRAY", np.array([value], np.int8), None)
     else:
         blk = WireBlock("LONG_ARRAY", np.array([value], np.int64), None)
-    out = bytearray()
-    _encode_block(out, blk)
+    w = _PageWriter()
+    _encode_block(w, blk)
+    out = bytearray(w.size)
+    w.write_into(memoryview(out), 0)
     return S.Constant(base64.b64encode(bytes(out)).decode(), sig)
 
 

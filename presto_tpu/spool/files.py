@@ -23,6 +23,11 @@ import threading
 import zlib
 from typing import List, Optional, Tuple
 
+# the data-plane zero-copy counter is registered once, by the serde
+# module that owns the PageBuffer contract; spool range reads count
+# into the same series
+from presto_tpu.protocol.serde import _ZERO_COPY_BYTES
+
 
 def _disk_faults():
     """The installed testing.faults disk injector (None when the
@@ -114,25 +119,37 @@ class FrameFile:
             return len(self._index)
 
     def read_range(self, token: int, max_bytes: int
-                   ) -> Tuple[List[bytes], int]:
+                   ) -> Tuple[List[memoryview], int]:
         """Frames starting at `token`, size-capped like ClientBuffer.get
         (always at least one frame when available). Returns
-        (frames, next_token)."""
-        out: List[bytes] = []
-        size = 0
+        (frames, next_token). Committed frames are adjacent in the
+        append-only file, so the whole range is ONE contiguous read and
+        the frames come back as memoryview slices over that single
+        buffer — no per-frame bytes reassembly (the spool side of the
+        zero-copy data plane; the sendfile path in server/http.py never
+        touches this)."""
         t = max(token, 0)
         with self._lock:
             if self._closed:
                 return [], t
+            spans: List[Tuple[int, int]] = []
+            size = 0
             while t < len(self._index):
                 off, ln = self._index[t]
-                if out and size + ln > max_bytes:
+                if spans and size + ln > max_bytes:
                     break
-                self._f.seek(off)
-                out.append(self._f.read(ln))
+                spans.append((off, ln))
                 size += ln
                 t += 1
-        return out, t
+            if not spans:
+                return [], t
+            base = spans[0][0]
+            self._f.seek(base)
+            blob = self._f.read(size)
+        mv = memoryview(blob)
+        _ZERO_COPY_BYTES.inc(len(blob))
+        return [mv[off - base:off - base + ln]
+                for off, ln in spans], t
 
     # ------------------------------------------------------------- close
     def close(self, unlink: bool = True):

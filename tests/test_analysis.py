@@ -55,7 +55,8 @@ def test_rule_catalog_complete():
             "journal-chokepoint",
             "metric-docs-sync", "mv-cache-chokepoint",
             "spill-chokepoint", "ici-exchange-chokepoint",
-            "alert-rule-metric-exists"} <= names
+            "alert-rule-metric-exists",
+            "no-page-copy-in-data-plane"} <= names
 
 
 # ===================================================================
@@ -145,6 +146,38 @@ def test_ici_exchange_chokepoint_allowlist_honesty():
     # the allowlist is vacuous and the rule must say so
     fs = _findings("ici-exchange-chokepoint", {
         "presto_tpu/server/mesh_tier.py": "x = 1\n"})
+    assert fs and "vacuous" in fs[0].message
+
+
+def test_no_page_copy_in_data_plane_fires():
+    # a stray per-lane copy in the data plane (encode flattening a lane
+    # to owned bytes, or decode materializing a frombuffer alias)
+    # reintroduces exactly the copies the PageBuffer plane removed
+    bad = "presto_tpu/protocol/evil.py"
+    fs = _findings("no-page-copy-in-data-plane", {
+        bad: "payload = arr.tobytes()\n"}, planted=bad)
+    assert fs and fs[0].rule == "no-page-copy-in-data-plane"
+    bad2 = "presto_tpu/spool/evil.py"
+    fs = _findings("no-page-copy-in-data-plane", {
+        bad2: "vals = np.frombuffer(buf, np.int64).copy()\n"},
+        planted=bad2)
+    assert fs and "copy" in fs[0].message
+    # serde.py itself holds the sanctioned copy sites
+    assert not _findings("no-page-copy-in-data-plane", {
+        "presto_tpu/protocol/serde.py": "x = arr.tobytes()\n"},
+        planted="presto_tpu/protocol/serde.py")
+    # outside the data-plane prefixes the idiom is fine (engine code
+    # materializes arrays all the time)
+    assert not _findings("no-page-copy-in-data-plane", {
+        "presto_tpu/exec/evil.py": "x = arr.tobytes()\n"},
+        planted="presto_tpu/exec/evil.py")
+
+
+def test_no_page_copy_in_data_plane_allowlist_honesty():
+    # serde.py present but no longer containing a sanctioned copy site
+    # => the allowlist is vacuous and the rule must say so
+    fs = _findings("no-page-copy-in-data-plane", {
+        "presto_tpu/protocol/serde.py": "x = 1\n"})
     assert fs and "vacuous" in fs[0].message
 
 

@@ -20,13 +20,13 @@ def test_materialized_buffer_replays_after_ack(tmp_path):
             b.add(f"frame-{i}".encode())
         b.no_more_pages = True
         frames, nxt, complete = b.get(0, 1 << 20)
-        assert [f.decode() for f in frames] == [f"frame-{i}"
+        assert [bytes(f).decode() for f in frames] == [f"frame-{i}"
                                                 for i in range(5)]
         assert complete and nxt == 5
         b.acknowledge(5)
         # a replacement consumer re-pulls the FULL stream from 0
         frames2, _nxt, complete2 = b.get(0, 1 << 20)
-        assert [f.decode() for f in frames2] == [f"frame-{i}"
+        assert [bytes(f).decode() for f in frames2] == [f"frame-{i}"
                                                  for i in range(5)]
         assert complete2
     finally:

@@ -1,0 +1,399 @@
+"""PageBuffer data-plane contract tests.
+
+Three properties, each checked across a (block type x codec x null
+pattern) grid:
+
+1. **Wire identity** — `encode_page_buffer` (single-allocation
+   scatter-gather) produces byte-for-byte the frame an independent,
+   straight-line append-style reference encoder produces. The reference
+   encoder here is written from the SerializedPage layout spec
+   (PagesSerdeUtil header + per-encoding block bodies), NOT from
+   serde.py's code, so a layout regression in either shows up.
+2. **Round trip** — decode(encode(blocks)) reproduces values, nulls
+   and structure for every combination.
+3. **Zero-copy decode** — fixed-width lanes come back as READ-ONLY
+   numpy views aliasing the received frame (writing raises; the view
+   shares memory with the frame; `.base` pins the buffer alive).
+
+Plus: native-vs-numpy fallback agreement, and a slow-marked SF10
+streaming smoke (q06 shape against a direct numpy oracle).
+"""
+
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from presto_tpu.protocol.serde import (
+    WireBlock, decode_serialized_page, encode_page_buffer,
+    encode_serialized_page,
+)
+
+# ---------------------------------------------------------------------------
+# independent reference encoder (layout spec, bytearray appends)
+# ---------------------------------------------------------------------------
+
+_REF_FIXED = {"LONG_ARRAY": np.int64, "INT_ARRAY": np.int32,
+              "SHORT_ARRAY": np.int16, "BYTE_ARRAY": np.uint8}
+
+
+def _ref_nulls(out: bytearray, nulls, n: int):
+    if nulls is None or not nulls.any():
+        out += b"\x00"
+        return
+    out += b"\x01"
+    out += np.packbits(nulls[:n].astype(np.uint8)).tobytes()
+
+
+def _ref_block(out: bytearray, b: WireBlock):
+    name = b.encoding.encode()
+    out += struct.pack("<i", len(name))
+    out += name
+    if b.encoding in _REF_FIXED:
+        dtype = _REF_FIXED[b.encoding]
+        n = len(b.values)
+        out += struct.pack("<i", n)
+        _ref_nulls(out, b.nulls, n)
+        vals = np.ascontiguousarray(b.values, dtype=dtype)
+        if b.nulls is not None and b.nulls.any():
+            vals = vals[~b.nulls]
+        out += vals.tobytes()
+    elif b.encoding == "INT128_ARRAY":
+        n = len(b.values)
+        out += struct.pack("<i", n)
+        _ref_nulls(out, b.nulls, n)
+        vals = np.ascontiguousarray(b.values, dtype=np.int64)
+        if b.nulls is not None and b.nulls.any():
+            vals = vals[~b.nulls]
+        out += vals.tobytes()
+    elif b.encoding == "VARIABLE_WIDTH":
+        n = len(b.values)
+        out += struct.pack("<i", n)
+        lens = [0 if v is None else len(v) for v in b.values]
+        acc = 0
+        for ln in lens:
+            acc += ln
+            out += struct.pack("<i", acc)
+        _ref_nulls(out, b.nulls, n)
+        payload = b"".join(v for v in b.values if v is not None)
+        out += struct.pack("<i", len(payload))
+        out += payload
+    elif b.encoding == "ARRAY":
+        n = len(b.offsets) - 1
+        _ref_block(out, b.children[0])
+        out += struct.pack("<i", n)
+        out += np.ascontiguousarray(b.offsets, dtype=np.int32).tobytes()
+        _ref_nulls(out, b.nulls, n)
+    elif b.encoding == "RLE":
+        out += struct.pack("<i", b.count)
+        _ref_block(out, b.rle_value)
+    elif b.encoding == "DICTIONARY":
+        n = len(b.values)
+        out += struct.pack("<i", n)
+        _ref_block(out, b.dictionary)
+        out += np.ascontiguousarray(b.values, dtype=np.int32).tobytes()
+        out += struct.pack("<qqq", 0, 0, 0)
+    else:
+        raise AssertionError(b.encoding)
+
+
+def _ref_compress(body: bytes, codec: str):
+    if codec == "zlib":
+        return zlib.compress(body, 6)
+    if codec == "gzip":
+        co = zlib.compressobj(6, zlib.DEFLATED, 31)
+        return co.compress(body) + co.flush()
+    from presto_tpu import native
+    return native.lz4_compress(body)
+
+
+def ref_encode(blocks, checksummed=True, compression=None) -> bytes:
+    position_count = blocks[0].position_count
+    payload = bytearray()
+    payload += struct.pack("<i", len(blocks))
+    for b in blocks:
+        _ref_block(payload, b)
+    uncompressed = len(payload)
+    markers = 4 if checksummed else 0
+    body = bytes(payload)
+    if compression in ("zlib", "gzip", "lz4") and uncompressed > 256:
+        comp = _ref_compress(body, compression)
+        if comp is not None and len(comp) < uncompressed:
+            body = comp
+            markers |= 1 | ({"zlib": 1, "gzip": 2, "lz4": 3}[compression]
+                            << 4)
+    checksum = 0
+    if checksummed:
+        crc = zlib.crc32(body)
+        tail = (bytes([markers]) + struct.pack("<i", position_count)
+                + struct.pack("<i", uncompressed))
+        checksum = zlib.crc32(tail, crc)
+    return (struct.pack("<ibiiq", position_count, markers, uncompressed,
+                        len(body), checksum) + body)
+
+
+# ---------------------------------------------------------------------------
+# the grid
+# ---------------------------------------------------------------------------
+
+N = 300   # big enough that codecs engage (compression floor is 256 B)
+
+
+def _null_pattern(kind: str, n: int):
+    if kind == "none":
+        return None
+    if kind == "some":
+        m = np.zeros(n, dtype=bool)
+        m[::7] = True
+        return m
+    return np.ones(n, dtype=bool)        # "all"
+
+
+def _block(kind: str, nulls) -> WireBlock:
+    rng = np.random.default_rng(hash(kind) % (2 ** 31))
+    if kind in _REF_FIXED:
+        info = np.iinfo(_REF_FIXED[kind])
+        vals = rng.integers(info.min, info.max, N,
+                            dtype=_REF_FIXED[kind], endpoint=False)
+        return WireBlock(kind, vals, nulls)
+    if kind == "INT128_ARRAY":
+        vals = rng.integers(-2 ** 62, 2 ** 62, (N, 2), dtype=np.int64)
+        return WireBlock(kind, vals, nulls)
+    if kind == "VARIABLE_WIDTH":
+        vals = np.empty(N, dtype=object)
+        for i in range(N):
+            if nulls is not None and nulls[i]:
+                vals[i] = None
+            else:
+                vals[i] = bytes(rng.integers(97, 123, i % 11,
+                                             dtype=np.uint8))
+        return WireBlock(kind, vals, nulls)
+    if kind == "DICTIONARY":
+        d = WireBlock("VARIABLE_WIDTH",
+                      np.array([b"lo", b"mid", b"high"], dtype=object))
+        ids = rng.integers(0, 3, N, dtype=np.int32)
+        return WireBlock(kind, ids, dictionary=d)
+    if kind == "RLE":
+        one = WireBlock("LONG_ARRAY", np.array([42], dtype=np.int64))
+        return WireBlock(kind, rle_value=one, count=N)
+    if kind == "ARRAY":
+        per = 2
+        elems = WireBlock("LONG_ARRAY",
+                          rng.integers(0, 1000, N * per, dtype=np.int64))
+        offs = (np.arange(N + 1, dtype=np.int32) * per)
+        return WireBlock(kind, nulls=nulls, children=[elems],
+                         offsets=offs)
+    raise AssertionError(kind)
+
+
+def _lz4_available() -> bool:
+    from presto_tpu import native
+    return native.lz4_compress(b"x" * 300) is not None
+
+
+BLOCK_KINDS = ["LONG_ARRAY", "INT_ARRAY", "SHORT_ARRAY", "BYTE_ARRAY",
+               "INT128_ARRAY", "VARIABLE_WIDTH", "DICTIONARY", "RLE",
+               "ARRAY"]
+CODECS = [None, "zlib", "gzip", "lz4"]
+NULLS = ["none", "some", "all"]
+
+
+@pytest.mark.parametrize("nullkind", NULLS)
+@pytest.mark.parametrize("codec", CODECS)
+@pytest.mark.parametrize("kind", BLOCK_KINDS)
+def test_wire_identity_and_round_trip(kind, codec, nullkind):
+    if codec == "lz4" and not _lz4_available():
+        pytest.skip("no native lz4")
+    if kind in ("RLE", "DICTIONARY") and nullkind != "none":
+        pytest.skip("wrapper blocks carry no top-level null mask")
+    nulls = _null_pattern(nullkind, N)
+    b = _block(kind, nulls)
+
+    got = encode_serialized_page([b], compression=codec)
+    want = ref_encode([b], compression=codec)
+    assert got == want, f"wire mismatch: {kind}/{codec}/{nullkind}"
+
+    blocks, n, _ = decode_serialized_page(got)
+    assert n == N
+    d = blocks[0]
+    assert d.encoding == kind
+    if kind in _REF_FIXED or kind == "INT128_ARRAY":
+        keep = slice(None) if nulls is None else ~nulls
+        np.testing.assert_array_equal(np.asarray(d.values)[keep],
+                                      np.asarray(b.values)[keep])
+    elif kind == "VARIABLE_WIDTH":
+        assert list(d.values) == list(b.values)
+    elif kind == "DICTIONARY":
+        np.testing.assert_array_equal(d.values, b.values)
+        assert list(d.dictionary.values) == list(b.dictionary.values)
+    elif kind == "RLE":
+        assert d.count == N
+        assert int(d.rle_value.values[0]) == 42
+    elif kind == "ARRAY":
+        np.testing.assert_array_equal(d.offsets, b.offsets)
+        np.testing.assert_array_equal(d.children[0].values,
+                                      b.children[0].values)
+    if nulls is None:
+        assert d.nulls is None or not d.nulls.any()
+    elif kind not in ("RLE", "DICTIONARY"):
+        np.testing.assert_array_equal(d.nulls, nulls)
+
+
+def test_uncheck_summed_frames_match_reference():
+    b = _block("LONG_ARRAY", None)
+    assert (encode_serialized_page([b], checksummed=False)
+            == ref_encode([b], checksummed=False))
+
+
+def test_multi_block_page_wire_identity():
+    blocks = [_block("LONG_ARRAY", None),
+              _block("VARIABLE_WIDTH", _null_pattern("some", N)),
+              _block("DICTIONARY", None),
+              _block("INT_ARRAY", _null_pattern("some", N))]
+    assert encode_serialized_page(blocks) == ref_encode(blocks)
+
+
+# ---------------------------------------------------------------------------
+# PageBuffer surface
+# ---------------------------------------------------------------------------
+
+def test_page_buffer_block_offsets_address_each_block():
+    from presto_tpu.protocol.serde import _decode_block
+    blocks = [_block("LONG_ARRAY", None), _block("INT_ARRAY", None),
+              _block("VARIABLE_WIDTH", None)]
+    pb = encode_page_buffer(blocks)
+    assert len(pb.block_offsets) == len(blocks)
+    assert pb.position_count == N
+    payload = memoryview(bytes(pb.buffer))[21:]
+    for off, b in zip(pb.block_offsets, blocks):
+        d, _ = _decode_block(payload, off)
+        assert d.encoding == b.encoding
+    # the offsets table walks the payload in order, starting after the
+    # numBlocks i32
+    assert pb.block_offsets[0] == 4
+    assert list(pb.block_offsets) == sorted(pb.block_offsets)
+
+
+def test_page_buffer_view_is_not_a_copy():
+    pb = encode_page_buffer([_block("LONG_ARRAY", None)])
+    v = pb.view()
+    assert v.obj is pb.buffer
+    assert bytes(v) == pb.to_bytes()
+    assert len(pb) == len(pb.buffer)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy decode contract
+# ---------------------------------------------------------------------------
+
+def test_decode_returns_read_only_views_over_the_frame():
+    vals = np.arange(N, dtype=np.int64)
+    data = encode_serialized_page([WireBlock("LONG_ARRAY", vals)])
+    blocks, _, _ = decode_serialized_page(data)
+    got = blocks[0].values
+    assert got.flags.writeable is False
+    with pytest.raises((ValueError, RuntimeError)):
+        got[0] = 99
+    # the lane is a VIEW over the received frame, not a copy
+    frame = np.frombuffer(data, dtype=np.uint8)
+    assert np.shares_memory(got, frame)
+
+
+def test_decode_view_base_pins_frame_lifetime():
+    import gc
+    data = encode_serialized_page(
+        [WireBlock("LONG_ARRAY", np.arange(N, dtype=np.int64))])
+    blocks, _, _ = decode_serialized_page(data)
+    got = blocks[0].values
+    del data, blocks
+    gc.collect()
+    # the view's .base chain keeps the frame buffer alive
+    np.testing.assert_array_equal(got, np.arange(N, dtype=np.int64))
+
+
+def test_null_scatter_lane_is_read_only_too():
+    nulls = _null_pattern("some", N)
+    data = encode_serialized_page(
+        [WireBlock("LONG_ARRAY", np.arange(N, dtype=np.int64), nulls)])
+    blocks, _, _ = decode_serialized_page(data)
+    assert blocks[0].values.flags.writeable is False
+    assert blocks[0].nulls.flags.writeable is False
+
+
+def test_dictionary_ids_and_offsets_are_views():
+    data = encode_serialized_page([_block("DICTIONARY", None)])
+    blocks, _, _ = decode_serialized_page(data)
+    frame = np.frombuffer(data, dtype=np.uint8)
+    assert np.shares_memory(blocks[0].values, frame)
+    data2 = encode_serialized_page([_block("ARRAY", None)])
+    blocks2, _, _ = decode_serialized_page(data2)
+    frame2 = np.frombuffer(data2, dtype=np.uint8)
+    assert np.shares_memory(blocks2[0].offsets, frame2)
+    assert np.shares_memory(blocks2[0].children[0].values, frame2)
+
+
+def test_compressed_decode_still_round_trips_read_only():
+    b = _block("LONG_ARRAY", None)
+    data = encode_serialized_page([b], compression="zlib")
+    blocks, _, _ = decode_serialized_page(data)
+    assert blocks[0].values.flags.writeable is False
+    np.testing.assert_array_equal(blocks[0].values, b.values)
+
+
+# ---------------------------------------------------------------------------
+# native-vs-numpy fallback agreement
+# ---------------------------------------------------------------------------
+
+def test_numpy_fallback_produces_identical_frames(monkeypatch):
+    from presto_tpu import native
+    blocks = [_block("LONG_ARRAY", _null_pattern("some", N)),
+              _block("VARIABLE_WIDTH", None)]
+    with_native = [encode_serialized_page(blocks, compression=c)
+                   for c in (None, "zlib", "gzip")]
+    monkeypatch.setattr(native, "pack_nulls", lambda *a, **k: None)
+    monkeypatch.setattr(native, "unpack_nulls", lambda *a, **k: None)
+    monkeypatch.setattr(native, "crc32", lambda *a, **k: None)
+    monkeypatch.setattr(native, "lz4_compress_crc",
+                        lambda *a, **k: None)
+    without = [encode_serialized_page(blocks, compression=c)
+               for c in (None, "zlib", "gzip")]
+    assert with_native == without
+    dec_a, _, _ = decode_serialized_page(with_native[0])
+    keep = ~blocks[0].nulls            # null slots decode as zeros
+    np.testing.assert_array_equal(np.asarray(dec_a[0].values)[keep],
+                                  np.asarray(blocks[0].values)[keep])
+
+
+# ---------------------------------------------------------------------------
+# SF10 scale-ladder smoke (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sf10_q06_streams_exactly():
+    """q06 at SF10 through lifespan batching + bounded streaming scan
+    runs, checked against a direct numpy oracle over the generator's
+    own arrays (sqlite is infeasible at this scale)."""
+    from presto_tpu.config import Session
+    from presto_tpu.connectors import TpchConnector
+    from presto_tpu.exec import LocalEngine
+    from presto_tpu.exec.lifespan import execute_batched
+
+    conn = TpchConnector(10.0)
+    engine = LocalEngine(conn)
+    sql = ("select sum(l_extendedprice * l_discount) from lineitem "
+           "where l_discount between 0.05 and 0.07 "
+           "and l_quantity < 24")
+    plan = engine.executor._resolve_subqueries(engine.plan_sql(sql))
+    page = execute_batched(
+        conn, plan, 16,
+        session=Session({"streaming_scan_rows": 2_000_000}))
+    got = page.to_pylist()[0][0]
+
+    t = conn.table("lineitem")
+    disc = t.arrays["l_discount"][:t.num_rows]
+    qty = t.arrays["l_quantity"][:t.num_rows]
+    ep = t.arrays["l_extendedprice"][:t.num_rows]
+    keep = (disc >= 0.05 - 1e-9) & (disc <= 0.07 + 1e-9) & (qty < 24)
+    want = float((ep[keep] * disc[keep]).sum())
+    assert got == pytest.approx(want, rel=1e-9)
